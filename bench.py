@@ -1,0 +1,68 @@
+"""Headline benchmark: GBM histogram training throughput on TPU.
+
+Mirrors BASELINE.json config #1 (GBM binomial, 50 trees, depth 6,
+airlines-like schema). Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+
+vs_baseline: the reference publishes no GBM numbers in-tree
+(BASELINE.md); the comparison constant below is an estimate of H2O-3 GBM
+single-node CPU throughput on this shape (dual-Xeon class, ~1M
+rows/sec·iteration across 50 iterations), derived from the reference's
+own DL throughput scaling notes (hex/deeplearning/README.md) and public
+H2O GBM benchmarks. Replace with a measured number when a JVM reference
+run is available.
+"""
+
+import json
+import time
+
+import numpy as np
+
+REFERENCE_ROWS_PER_SEC = 1.0e6  # estimated H2O-3 single-node CPU GBM
+
+N_ROWS = 1_000_000
+N_NUM = 20
+N_CAT = 8
+NTREES = 50
+DEPTH = 6
+
+
+def main():
+    import jax
+    import h2o3_tpu
+    from h2o3_tpu.models.gbm import GBMEstimator
+
+    h2o3_tpu.init()
+    r = np.random.RandomState(0)
+    cols = {f"n{i}": r.randn(N_ROWS).astype(np.float32) for i in range(N_NUM)}
+    for i in range(N_CAT):
+        cols[f"c{i}"] = r.randint(0, 30, N_ROWS).astype(np.float64)
+    logits = cols["n0"] * 1.5 + cols["n1"] - (cols["c0"] > 15) * 0.8
+    y = (r.rand(N_ROWS) < 1 / (1 + np.exp(-logits))).astype(int)
+    cols["dep_delayed"] = np.array(["N", "Y"], object)[y]
+    fr = h2o3_tpu.Frame.from_numpy(
+        cols, categorical=[f"c{i}" for i in range(N_CAT)] + ["dep_delayed"])
+
+    # warmup: compile the boost step on 2 trees
+    GBMEstimator(ntrees=2, max_depth=DEPTH, seed=1).train(fr, y="dep_delayed")
+
+    t0 = time.time()
+    model = GBMEstimator(ntrees=NTREES, max_depth=DEPTH, seed=1).train(
+        fr, y="dep_delayed")
+    dt = time.time() - t0
+
+    rows_per_sec = N_ROWS * NTREES / dt
+    print(json.dumps({
+        "metric": f"GBM-{NTREES}trees-d{DEPTH} training throughput "
+                  f"({N_ROWS / 1e6:.0f}M rows, {N_NUM + N_CAT} features)",
+        "value": round(rows_per_sec, 1),
+        "unit": "rows/sec/chip",
+        "vs_baseline": round(rows_per_sec / REFERENCE_ROWS_PER_SEC, 3),
+        "train_seconds": round(dt, 2),
+        "auc": round(model.training_metrics["AUC"], 4),
+        "backend": jax.default_backend(),
+    }))
+
+
+if __name__ == "__main__":
+    main()

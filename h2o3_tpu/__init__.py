@@ -1,0 +1,37 @@
+"""h2o3_tpu — a TPU-native, JAX/XLA-based reimplementation of the H2O-3
+distributed ML platform (reference: sashashura/h2o-3).
+
+The reference is a JVM cluster: Frame/Vec/Chunk columnar store + MRTask
+map/reduce (h2o-core/src/main/java/water/MRTask.java) + hex.* algorithms.
+Here the same capabilities are rebuilt TPU-first:
+
+- Frame        = dict of dtype-narrowed device arrays sharded over a
+                 ``jax.sharding.Mesh`` 'data' axis (replaces water.fvec).
+- map/reduce   = ``shard_map`` + ``psum`` over ICI (replaces the MRTask
+                 node tree + Fork/Join, water/MRTask.java:716-756).
+- algorithms   = jitted JAX programs (histogram GBM/DRF on the MXU, GLM via
+                 einsum Gram + Cholesky, DeepLearning as an MLP, ...).
+- REST surface = the /3 and /99 JSON API kept compatible in spirit with
+                 water.api.RequestServer so h2o-py-style clients can drive it.
+
+Public API mirrors the h2o-py module surface (h2o-py/h2o/h2o.py):
+``init``, ``import_file``, ``H2OFrame``-like ``Frame``, estimator classes.
+"""
+
+from h2o3_tpu.version import __version__
+from h2o3_tpu.core.cloud import init, cluster_info, shutdown
+from h2o3_tpu.frame.frame import Frame
+from h2o3_tpu.io.parser import import_file, parse_raw, upload_numpy
+from h2o3_tpu.core.kv import DKV
+
+__all__ = [
+    "__version__",
+    "init",
+    "cluster_info",
+    "shutdown",
+    "Frame",
+    "import_file",
+    "parse_raw",
+    "upload_numpy",
+    "DKV",
+]

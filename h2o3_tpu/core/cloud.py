@@ -1,0 +1,89 @@
+"""Cloud bootstrap — process/mesh startup, the ``h2o.init()`` analogue.
+
+Reference call stack (SURVEY §3.1): h2o.init (h2o-py/h2o/h2o.py:138) →
+water.H2O.main (water/H2O.java:2328) → NetworkInit → Paxos heartbeat
+consensus (water/Paxos.java:40) → CLOUD committed. TPU-native: membership
+is either a single process over local devices or ``jax.distributed``
+across hosts (its coordinator barrier replaces the heartbeat quorum); the
+"cloud" object is a ``jax.sharding.Mesh``. Cloud shape locks at first use
+just like Paxos._cloudLocked (water/Paxos.java:32) because the mesh is
+baked into compiled programs.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from typing import Optional
+
+import jax
+
+from h2o3_tpu.core import config as _config
+from h2o3_tpu.core.kv import DKV
+from h2o3_tpu.parallel import mesh as mesh_mod
+from h2o3_tpu.utils.log import get_logger
+from h2o3_tpu.version import __version__
+
+log = get_logger("h2o3_tpu.cloud")
+
+_STARTED = False
+
+
+def init(backend: Optional[str] = None,
+         data_axis: int = 0,
+         model_axis: int = 1,
+         coordinator_address: Optional[str] = None,
+         num_processes: Optional[int] = None,
+         process_id: Optional[int] = None,
+         **kwargs) -> dict:
+    """Start (or attach to) the cloud. Analogue of h2o.init (h2o.py:49,138).
+
+    Single-host: builds the mesh over local devices. Multi-host: pass
+    ``coordinator_address``/``num_processes``/``process_id`` and every host
+    calls this with the same arguments — ``jax.distributed.initialize`` is
+    the clouding protocol (replaces multicast/flatfile discovery,
+    water/init/NetworkInit.java:62-174).
+    """
+    global _STARTED
+    cfg = _config.Config.from_env(backend=backend, data_axis=data_axis,
+                                  model_axis=model_axis, **kwargs)
+    _config.ARGS = cfg
+
+    if coordinator_address is not None and not _STARTED:
+        jax.distributed.initialize(coordinator_address=coordinator_address,
+                                   num_processes=num_processes,
+                                   process_id=process_id)
+
+    devices = jax.devices(cfg.backend) if cfg.backend else jax.devices()
+    m = mesh_mod.make_mesh(devices, cfg.data_axis, cfg.model_axis)
+    mesh_mod.set_global_mesh(m)
+    _STARTED = True
+    info = cluster_info()
+    log.info("cloud up: %s", info)
+    return info
+
+
+def cluster_info() -> dict:
+    """GET /3/Cloud shape (water/api/CloudHandler.java)."""
+    m = mesh_mod.get_mesh()
+    devs = list(m.devices.flat)
+    return {
+        "version": __version__,
+        "cloud_name": _config.ARGS.name,
+        "cloud_size": len(devs),
+        "cloud_healthy": True,
+        "mesh_shape": dict(m.shape),
+        "process_count": jax.process_count(),
+        "process_index": jax.process_index(),
+        "devices": [str(d) for d in devs],
+        "platform": devs[0].platform if devs else "none",
+        "build_age_sec": 0,
+        "cloud_uptime_ms": int(time.time() * 1000),
+    }
+
+
+def shutdown() -> None:
+    """Drop all state (reference: POST /3/Shutdown)."""
+    global _STARTED
+    DKV.clear()
+    _STARTED = False

@@ -1,0 +1,50 @@
+"""Runtime configuration (analogue of water.H2O.OptArgs, reference
+h2o-core/src/main/java/water/H2O.java:209,296-355).
+
+The reference parses a flat CLI flag struct plus ``sys.ai.h2o.*`` system
+properties (H2O.java:1321-1334). Here: a flat dataclass overridable from
+``init()`` kwargs and ``H2O3TPU_*`` environment variables.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Optional
+
+
+@dataclasses.dataclass
+class Config:
+    name: str = "h2o3-tpu"           # cloud name (-name)
+    port: int = 54321                 # REST port (-port)
+    log_level: str = "INFO"           # -log_level
+    nthreads: int = 0                 # 0 = all (host-side thread pools)
+    # mesh shape: data axis size; 0 = all visible devices
+    data_axis: int = 0
+    # optional second axis for model-parallel Gram/GLM (SURVEY §2.4 item 6)
+    model_axis: int = 1
+    backend: Optional[str] = None     # None = jax default; 'cpu' forces host
+    # chunked-compute block size (rows per scan step in map/reduce kernels);
+    # analogue of the reference's chunk target (water/fvec/Vec.java chunk
+    # sizing), chosen for MXU tiling: multiple of 8*128.
+    block_rows: int = 32768
+    # default number of histogram bins (reference nbins, hex/tree/DHistogram.java)
+    nbins: int = 64
+    ice_root: str = "/tmp/h2o3_tpu"   # spill/checkpoint dir (-ice_root)
+
+    @staticmethod
+    def from_env(**overrides) -> "Config":
+        cfg = Config()
+        for f in dataclasses.fields(Config):
+            env = os.environ.get("H2O3TPU_" + f.name.upper())
+            if env is not None:
+                t = f.type if isinstance(f.type, type) else type(getattr(cfg, f.name) or "")
+                setattr(cfg, f.name, int(env) if t is int else env)
+        for k, v in overrides.items():
+            if v is not None and hasattr(cfg, k):
+                setattr(cfg, k, v)
+        return cfg
+
+
+# process-wide config singleton (reference: static H2O.ARGS)
+ARGS = Config()

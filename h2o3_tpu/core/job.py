@@ -1,0 +1,125 @@
+"""Job system — async job tracking with progress and cancellation.
+
+Reference: water/Job.java:24 (start/update/progress, lines 206-225) and the
+REST polling loop (client polls GET /3/Jobs/{id}). Jobs here run either
+inline (fast path: device compute is async anyway, the Python 'job' merely
+brackets it) or on a worker thread for long trainings so the REST server
+stays responsive — the analogue of launching the ModelBuilder Driver on the
+F/J pool (hex/ModelBuilder.java:234).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+import traceback
+from typing import Any, Callable, Optional
+
+from h2o3_tpu.core.kv import DKV, make_key
+from h2o3_tpu.utils.log import get_logger
+
+log = get_logger("h2o3_tpu.job")
+
+CREATED, RUNNING, DONE, FAILED, CANCELLED = (
+    "CREATED", "RUNNING", "DONE", "FAILED", "CANCELLED")
+
+
+class JobCancelledException(Exception):
+    pass
+
+
+class Job:
+    """One unit of trackable async work (reference water/Job.java:24)."""
+
+    def __init__(self, description: str, work: float = 1.0, dest: Optional[str] = None):
+        self.key = make_key("job")
+        self.description = description
+        self.dest = dest                      # key of the result object
+        self.status = CREATED
+        self.exception: Optional[str] = None
+        self._work = max(work, 1e-9)
+        self._worked = 0.0
+        self._msg = ""
+        self.start_time = 0.0
+        self.end_time = 0.0
+        self._cancel_requested = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self.result: Any = None
+        DKV.put(self.key, self)
+
+    # -- lifecycle (Job.start / Job.update, water/Job.java:206-225) ------
+    def start(self, fn: Callable[["Job"], Any], background: bool = False) -> "Job":
+        self.status = RUNNING
+        self.start_time = time.time()
+
+        def _run():
+            try:
+                self.result = fn(self)
+                if self.dest and self.result is not None:
+                    DKV.put(self.dest, self.result)
+                self.status = DONE
+            except JobCancelledException:
+                self.status = CANCELLED
+            except Exception as e:  # noqa: BLE001 - job boundary
+                self.status = FAILED
+                self.exception = "".join(
+                    traceback.format_exception(type(e), e, e.__traceback__))
+                log.error("job %s failed: %s", self.key, e)
+                if not background:
+                    raise
+            finally:
+                self.end_time = time.time()
+
+        if background:
+            self._thread = threading.Thread(target=_run, daemon=True, name=self.key)
+            self._thread.start()
+        else:
+            _run()
+        return self
+
+    def update(self, units: float, msg: str = "") -> None:
+        self._worked = min(self._work, self._worked + units)
+        if msg:
+            self._msg = msg
+        if self._cancel_requested.is_set():
+            raise JobCancelledException(self.key)
+
+    @property
+    def progress(self) -> float:
+        if self.status == DONE:
+            return 1.0
+        return self._worked / self._work
+
+    @property
+    def progress_msg(self) -> str:
+        return self._msg
+
+    def cancel(self) -> None:
+        self._cancel_requested.set()
+
+    def join(self, timeout: Optional[float] = None) -> "Job":
+        if self._thread is not None:
+            self._thread.join(timeout)
+        return self
+
+    @property
+    def run_time(self) -> float:
+        end = self.end_time or time.time()
+        return end - self.start_time if self.start_time else 0.0
+
+    def to_dict(self) -> dict:
+        """JSON shape for GET /3/Jobs/{key} (water/api/JobsHandler.java)."""
+        return {
+            "key": self.key,
+            "description": self.description,
+            "status": self.status,
+            "progress": self.progress,
+            "progress_msg": self._msg,
+            "dest": self.dest,
+            "exception": self.exception,
+            "run_time_ms": int(self.run_time * 1000),
+        }
+
+
+def list_jobs() -> list:
+    return [DKV.get(k).to_dict() for k in DKV.keys("job_")]

@@ -1,0 +1,67 @@
+"""DKV — the distributed key/value store, shrunk to what a TPU mesh needs.
+
+Reference: water/DKV.java, water/Key.java:44, water/Value.java:39. The
+reference implements a MESI-like cached K/V with home-node arbitration
+because every JVM owns a slice of the heap. On a TPU mesh the data plane
+(jax.Arrays) already lives sharded in HBM and is addressed by Python
+references; what remains of the DKV is a process-local metadata/object
+store on the controller holding Frames, Models, Jobs, Grids — exactly the
+objects the reference keeps globally addressable for its REST layer.
+
+Multi-host note: under ``jax.distributed`` every host runs the same
+program, so a plain dict per process is coherent by SPMD construction —
+the reference's invalidate/ack machinery (water/RPC.java:17-46) has no
+equivalent work to do.
+"""
+
+from __future__ import annotations
+
+import threading
+import itertools
+from typing import Any, Dict, Iterator, Optional
+
+_counter = itertools.count()
+
+
+def make_key(prefix: str) -> str:
+    """Unique key (reference Key.make, water/Key.java:44)."""
+    return f"{prefix}_{next(_counter):04d}"
+
+
+class _DKV:
+    def __init__(self) -> None:
+        self._store: Dict[str, Any] = {}
+        self._lock = threading.RLock()
+
+    def put(self, key: str, value: Any) -> str:
+        with self._lock:
+            self._store[key] = value
+        return key
+
+    def get(self, key: str) -> Optional[Any]:
+        with self._lock:
+            return self._store.get(key)
+
+    def remove(self, key: str) -> None:
+        with self._lock:
+            self._store.pop(key, None)
+
+    def keys(self, prefix: str = "") -> Iterator[str]:
+        with self._lock:
+            return iter([k for k in self._store if k.startswith(prefix)])
+
+    def clear(self) -> None:
+        """Test helper — analogue of water/runner/CleanAllKeysTask.java."""
+        with self._lock:
+            self._store.clear()
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._store)
+
+    def __contains__(self, key: str) -> bool:
+        with self._lock:
+            return key in self._store
+
+
+DKV = _DKV()

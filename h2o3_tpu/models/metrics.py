@@ -1,0 +1,175 @@
+"""Model metrics — the hex.ModelMetrics* family.
+
+Reference: one ModelMetrics class per problem type filled by incremental
+MetricBuilders inside scoring MRTasks (h2o-core/src/main/java/hex/
+ModelMetrics*.java); exact AUC from a 400-bin score histogram
+(hex/AUC2.java:24, NBINS=400). Here the same shape: one device pass
+builds weighted histograms/sums (psum over the mesh), host finishes the
+scalar math.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from h2o3_tpu.ops.segments import segment_sum
+from h2o3_tpu.parallel.mesh import get_mesh
+
+AUC_NBINS = 400  # hex/AUC2.java:24
+
+
+def _auc_histograms(p, y, w, mesh):
+    """Weighted positive/negative count per probability bin (AUC2 scheme)."""
+    bins = jnp.clip((p * AUC_NBINS).astype(jnp.int32), 0, AUC_NBINS - 1)
+    vals = jnp.stack([w * y, w * (1.0 - y)], axis=1)
+    hist = segment_sum(bins, vals, n_nodes=AUC_NBINS, mesh=mesh)
+    return np.asarray(hist[:, 0]), np.asarray(hist[:, 1])
+
+
+def _auc_from_hist(pos: np.ndarray, neg: np.ndarray) -> Dict[str, float]:
+    """AUC + AUCPR + max-F1 threshold from the bin histograms
+    (hex/AUC2.java compute path)."""
+    # sweep thresholds from high to low: cumulative TP/FP
+    tp = np.cumsum(pos[::-1])[::-1]
+    fp = np.cumsum(neg[::-1])[::-1]
+    P, N = pos.sum(), neg.sum()
+    if P == 0 or N == 0:
+        return {"auc": 0.5, "pr_auc": 0.0, "max_f1": 0.0,
+                "max_f1_threshold": 0.5, "gini": 0.0}
+    tpr = np.concatenate([tp / P, [0.0]])
+    fpr = np.concatenate([fp / N, [0.0]])
+    auc = float(np.trapezoid(tpr[::-1], fpr[::-1]))
+    prec = tp / np.maximum(tp + fp, 1e-12)
+    rec = tp / P
+    order = np.argsort(rec)
+    pr_auc = float(np.trapezoid(np.concatenate([[prec[order][0]], prec[order]]),
+                                np.concatenate([[0.0], rec[order]])))
+    f1 = 2 * prec * rec / np.maximum(prec + rec, 1e-12)
+    k = int(np.argmax(f1))
+    return {"auc": auc, "pr_auc": pr_auc, "max_f1": float(f1[k]),
+            "max_f1_threshold": float(k / AUC_NBINS), "gini": 2 * auc - 1}
+
+
+class ModelMetrics:
+    """Base: shared scalar fields (hex/ModelMetrics.java)."""
+
+    def __init__(self, kind: str, nobs: int, mse: float, **extra):
+        self.kind = kind
+        self.nobs = nobs
+        self.mse = mse
+        self.rmse = float(np.sqrt(mse))
+        self.extra = extra
+
+    def to_dict(self) -> dict:
+        d = {"model_category": self.kind, "nobs": self.nobs,
+             "MSE": self.mse, "RMSE": self.rmse}
+        d.update(self.extra)
+        return d
+
+    def __getitem__(self, k):
+        return self.to_dict()[k]
+
+    def __repr__(self):
+        items = ", ".join(f"{k}={v:.6g}" if isinstance(v, float) else f"{k}={v}"
+                          for k, v in self.to_dict().items() if not isinstance(v, (list, dict)))
+        return f"<ModelMetrics {items}>"
+
+
+def binomial_metrics(p, y, w=None, mesh=None) -> ModelMetrics:
+    """hex/ModelMetricsBinomial.java: AUC/logloss/Brier from one pass.
+
+    p: P(class 1) [N]; y: 0/1 labels; w: weights (0 on padding rows).
+    """
+    mesh = mesh or get_mesh()
+    p = jnp.asarray(p, jnp.float32)
+    y = jnp.asarray(y, jnp.float32)
+    w = jnp.ones_like(p) if w is None else jnp.asarray(w, jnp.float32)
+    pc = jnp.clip(p, 1e-7, 1 - 1e-7)
+    sums = segment_sum(
+        jnp.zeros_like(y, jnp.int32),
+        jnp.stack([w,
+                   w * (p - y) ** 2,
+                   -w * (y * jnp.log(pc) + (1 - y) * jnp.log(1 - pc)),
+                   w * y], axis=1),
+        n_nodes=1, mesh=mesh)
+    tot, sse, ll, pos = (float(x) for x in np.asarray(sums[0]))
+    pos_h, neg_h = _auc_histograms(pc, y, w, mesh)
+    roc = _auc_from_hist(pos_h, neg_h)
+    t = roc["max_f1_threshold"]
+    # confusion at max-F1 threshold (reference default criterion)
+    idx = int(t * AUC_NBINS)
+    tp = pos_h[idx:].sum(); fp = neg_h[idx:].sum()
+    fn = pos_h[:idx].sum(); tn = neg_h[:idx].sum()
+    err0 = fp / max(fp + tn, 1e-12)
+    err1 = fn / max(fn + tp, 1e-12)
+    return ModelMetrics(
+        "Binomial", int(tot), sse / max(tot, 1e-12),
+        logloss=ll / max(tot, 1e-12),
+        AUC=roc["auc"], pr_auc=roc["pr_auc"], Gini=roc["gini"],
+        max_f1=roc["max_f1"], max_f1_threshold=t,
+        mean_per_class_error=float((err0 + err1) / 2),
+        confusion_matrix=[[float(tn), float(fp)], [float(fn), float(tp)]],
+        positive_fraction=pos / max(tot, 1e-12))
+
+
+def multinomial_metrics(probs, y, w=None, mesh=None,
+                        domain: Optional[List[str]] = None) -> ModelMetrics:
+    """hex/ModelMetricsMultinomial.java: logloss, per-class error, CM."""
+    mesh = mesh or get_mesh()
+    K = probs.shape[1]
+    y = jnp.asarray(y, jnp.int32)
+    w = jnp.ones(probs.shape[0], jnp.float32) if w is None else jnp.asarray(w, jnp.float32)
+    py = jnp.clip(jnp.take_along_axis(probs, y[:, None], axis=1)[:, 0],
+                  1e-7, 1.0)
+    pred = jnp.argmax(probs, axis=1).astype(jnp.int32)
+    onehot_err = (pred != y).astype(jnp.float32)
+    sse = jnp.sum((probs - (jnp.arange(K)[None, :] == y[:, None])) ** 2, axis=1)
+    sums = segment_sum(
+        jnp.zeros_like(y), jnp.stack([w, -w * jnp.log(py), w * onehot_err,
+                                      w * sse], axis=1),
+        n_nodes=1, mesh=mesh)
+    tot, ll, err, sse_t = (float(x) for x in np.asarray(sums[0]))
+    # confusion matrix via segment over true*K+pred
+    cm = segment_sum((y * K + pred).astype(jnp.int32), w[:, None],
+                     n_nodes=K * K, mesh=mesh)
+    cm = np.asarray(cm).reshape(K, K)
+    row = cm.sum(axis=1)
+    per_class_err = np.where(row > 0, 1.0 - np.diag(cm) / np.maximum(row, 1e-12), 0.0)
+    return ModelMetrics(
+        "Multinomial", int(tot), sse_t / max(tot, 1e-12),
+        logloss=ll / max(tot, 1e-12),
+        mean_per_class_error=float(per_class_err[row > 0].mean()) if (row > 0).any() else 0.0,
+        error_rate=err / max(tot, 1e-12),
+        confusion_matrix=cm.tolist(),
+        domain=domain)
+
+
+def regression_metrics(pred, y, w=None, mesh=None,
+                       deviance_fn=None) -> ModelMetrics:
+    """hex/ModelMetricsRegression.java: MSE/MAE/RMSLE/deviance/R2."""
+    mesh = mesh or get_mesh()
+    pred = jnp.asarray(pred, jnp.float32)
+    y = jnp.asarray(y, jnp.float32)
+    w = jnp.ones_like(y) if w is None else jnp.asarray(w, jnp.float32)
+    ok_log = (y > -1) & (pred > -1)
+    rmsle_term = jnp.where(ok_log,
+                           (jnp.log1p(jnp.maximum(pred, -1 + 1e-12))
+                            - jnp.log1p(jnp.maximum(y, -1 + 1e-12))) ** 2, 0.0)
+    dev = deviance_fn(y, pred) if deviance_fn is not None else (y - pred) ** 2
+    sums = segment_sum(
+        jnp.zeros(y.shape[0], jnp.int32),
+        jnp.stack([w, w * (y - pred) ** 2, w * jnp.abs(y - pred),
+                   w * rmsle_term, w * y, w * y * y, w * dev], axis=1),
+        n_nodes=1, mesh=mesh)
+    tot, sse, sae, sle, sy, syy, sdev = (float(x) for x in np.asarray(sums[0]))
+    mse = sse / max(tot, 1e-12)
+    var_y = syy / max(tot, 1e-12) - (sy / max(tot, 1e-12)) ** 2
+    return ModelMetrics(
+        "Regression", int(tot), mse,
+        mae=sae / max(tot, 1e-12),
+        rmsle=float(np.sqrt(sle / max(tot, 1e-12))),
+        mean_residual_deviance=sdev / max(tot, 1e-12),
+        r2=1.0 - mse / max(var_y, 1e-12))

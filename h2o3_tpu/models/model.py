@@ -1,0 +1,212 @@
+"""Model / ModelBuilder abstractions — the hex.Model / hex.ModelBuilder layer.
+
+Reference: hex/Model.java (parameters/output/scoring, adaptTestForTrain at
+Model.java:1850, BigScore bulk scorer at Model.java:2085) and
+hex/ModelBuilder.java:25 (trainModel at :374 launches a Driver Job;
+cross-validation orchestration at :603). Here the same lifecycle:
+
+    builder = GBMEstimator(**params)
+    model   = builder.train(frame, y="col", x=[...])   # Job-wrapped
+    preds   = model.predict(frame)                      # Frame of predictions
+    mm      = model.model_performance(frame)            # ModelMetrics
+
+Categorical response/feature adaptation follows adaptTestForTrain: test
+categorical codes are remapped into training domains (unseen level → NA).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from h2o3_tpu.core.job import Job
+from h2o3_tpu.core.kv import DKV, make_key
+from h2o3_tpu.frame.frame import Frame
+from h2o3_tpu.utils.log import get_logger
+
+log = get_logger("h2o3_tpu.model")
+
+
+class ModelCategory:
+    BINOMIAL = "Binomial"
+    MULTINOMIAL = "Multinomial"
+    REGRESSION = "Regression"
+    CLUSTERING = "Clustering"
+    DIMREDUCTION = "DimReduction"
+    ANOMALY = "AnomalyDetection"
+
+
+def infer_category(frame: Frame, y: Optional[str]) -> str:
+    """Response-type sniffing (reference ModelBuilder.init distribution
+    inference)."""
+    if y is None:
+        return ModelCategory.CLUSTERING
+    c = frame.col(y)
+    if c.is_categorical:
+        return (ModelCategory.BINOMIAL if c.cardinality == 2
+                else ModelCategory.MULTINOMIAL)
+    return ModelCategory.REGRESSION
+
+
+def adapt_domain(test_col, train_domain: List[str]) -> np.ndarray:
+    """Map test categorical codes into the training domain; unseen → -1
+    (NA). The adaptTestForTrain domain-mapping pass (hex/Model.java:1850).
+    """
+    if test_col.domain == train_domain:
+        codes = np.asarray(test_col.data)[: test_col.nrows].copy()
+        codes[np.asarray(test_col.na_mask)[: test_col.nrows]] = -1
+        return codes
+    lut = {lvl: i for i, lvl in enumerate(train_domain)}
+    mapping = np.array([lut.get(lvl, -1) for lvl in (test_col.domain or [])],
+                       dtype=np.int32)
+    codes = np.asarray(test_col.data)[: test_col.nrows]
+    out = mapping[codes] if len(mapping) else np.full(test_col.nrows, -1, np.int32)
+    out = out.copy()
+    out[np.asarray(test_col.na_mask)[: test_col.nrows]] = -1
+    return out
+
+
+class EarlyStopper:
+    """Metric-based early stopping (reference hex/ScoreKeeper.stopEarly +
+    the stopping_rounds/stopping_tolerance contract of SharedTree).
+
+    Lower-is-better metric; stops when the best of the last ``rounds``
+    scoring events fails to improve on the prior best by a relative
+    ``tol``.
+    """
+
+    def __init__(self, rounds: int, tol: float = 1e-3):
+        self.rounds = int(rounds)
+        self.tol = float(tol)
+        self.history: List[float] = []
+
+    @property
+    def enabled(self) -> bool:
+        return self.rounds > 0
+
+    def should_stop(self, value: float) -> bool:
+        self.history.append(float(value))
+        if not self.enabled or len(self.history) <= self.rounds:
+            return False
+        recent = min(self.history[-self.rounds:])
+        before = min(self.history[: -self.rounds])
+        denom = abs(before) if before else 1.0
+        return (before - recent) / denom < self.tol
+
+
+class Model:
+    """Trained-model base (hex/Model.java)."""
+
+    algo: str = "base"
+
+    def __init__(self, params: dict, output: dict, key: Optional[str] = None):
+        self.key = key or make_key(f"model_{self.algo}")
+        self.params = params
+        self.output = output           # domains, names, varimp, history...
+        self.training_metrics = None
+        self.validation_metrics = None
+        self.cross_validation_metrics = None
+        DKV.put(self.key, self)
+
+    # subclasses implement raw scoring on a Frame
+    def _score_raw(self, frame: Frame) -> Dict[str, np.ndarray]:
+        raise NotImplementedError
+
+    def predict(self, frame: Frame) -> Frame:
+        """Bulk scoring → prediction Frame (BigScore, hex/Model.java:2085)."""
+        cols = self._score_raw(frame)
+        out: Dict[str, np.ndarray] = {}
+        domains: Dict[str, List[str]] = {}
+        for name, arr in cols.items():
+            out[name] = arr
+            if name == "predict" and self.output.get("domain"):
+                domains[name] = self.output["domain"]
+        return Frame.from_numpy(out, domains=domains)
+
+    def model_performance(self, frame: Frame):
+        raise NotImplementedError
+
+    @property
+    def default_metrics(self):
+        return (self.cross_validation_metrics or self.validation_metrics
+                or self.training_metrics)
+
+    def to_dict(self) -> dict:
+        return {
+            "model_id": self.key,
+            "algo": self.algo,
+            "params": {k: v for k, v in self.params.items()
+                       if isinstance(v, (int, float, str, bool, list, type(None)))},
+            "output": {k: v for k, v in self.output.items()
+                       if isinstance(v, (int, float, str, bool, list, dict, type(None)))},
+            "training_metrics": self.training_metrics.to_dict() if self.training_metrics else None,
+            "validation_metrics": self.validation_metrics.to_dict() if self.validation_metrics else None,
+            "cross_validation_metrics": (self.cross_validation_metrics.to_dict()
+                                         if self.cross_validation_metrics else None),
+        }
+
+
+class ModelBuilder:
+    """Training lifecycle base (hex/ModelBuilder.java:25).
+
+    ``train`` = trainModel (ModelBuilder.java:374): wraps ``_fit`` in a Job
+    with progress; n-fold CV (computeCrossValidation, ModelBuilder.java:603)
+    is implemented generically in ml/cv.py and invoked when nfolds >= 2.
+    """
+
+    algo: str = "base"
+    supervised: bool = True
+
+    def __init__(self, **params):
+        self.params = params
+        self._job: Optional[Job] = None
+
+    # -- subclass contract --------------------------------------------
+    def _fit(self, frame: Frame, x: Sequence[str], y: Optional[str],
+             job: Job, validation_frame: Optional[Frame] = None) -> Model:
+        raise NotImplementedError
+
+    # -- public train --------------------------------------------------
+    def resolve_x(self, frame: Frame, x: Optional[Sequence[str]],
+                  y: Optional[str]) -> List[str]:
+        ignored = set(self.params.get("ignored_columns") or [])
+        drop = ignored | ({y} if y else set())
+        drop |= {self.params.get("weights_column"), self.params.get("fold_column")}
+        if x is None:
+            x = [n for n in frame.names if n not in drop]
+        else:
+            x = [n if isinstance(n, str) else frame.names[n] for n in x]
+            x = [n for n in x if n not in drop]
+        # strings can't enter math paths (reference drops them with a warning)
+        return [n for n in x if frame.col(n).type != "string"]
+
+    def train(self, training_frame: Frame, y: Optional[str] = None,
+              x: Optional[Sequence[str]] = None,
+              validation_frame: Optional[Frame] = None,
+              background: bool = False) -> Model:
+        x = self.resolve_x(training_frame, x, y)
+        nfolds = int(self.params.get("nfolds") or 0)
+        job = Job(f"{self.algo} train", work=1.0)
+        self._job = job
+
+        def _run(j: Job) -> Model:
+            t0 = time.time()
+            if nfolds >= 2:
+                from h2o3_tpu.ml.cv import train_with_cv
+                model = train_with_cv(self, training_frame, x, y, nfolds, j)
+            else:
+                model = self._fit(training_frame, x, y, j,
+                                  validation_frame=validation_frame)
+            model.output["run_time"] = time.time() - t0
+            log.info("%s trained in %.2fs -> %s", self.algo,
+                     time.time() - t0, model.key)
+            return model
+
+        job.start(_run, background=background)
+        if background:
+            return job  # poll via /3/Jobs
+        if job.status == "FAILED":
+            raise RuntimeError(job.exception)
+        return job.result

@@ -1,0 +1,201 @@
+"""Shared tree machinery — level-wise histogram tree growing on TPU.
+
+Reference: the SharedTree skeleton (hex/tree/SharedTree.java:29,481):
+per level, ScoreBuildHistogram2 routes rows to leaves and fills
+DHistograms, then DTree.findBestSplitPoint scans bins for best gain
+(hex/tree/DTree.java:619-697), leaves get Newton values (GammaPass).
+
+TPU-first redesign (SURVEY §7 hard part #1/#2):
+- trees are COMPLETE binary trees of static depth D: level d has 2^d
+  node slots (padded; empty nodes have zero histograms and never split).
+  Static shapes ⇒ one compiled program for the whole tree.
+- per level: matmul histogram (ops/histogram.py) → vectorized gain scan
+  over (feature, threshold, NA-direction) → argmax → elementwise
+  row-routing update of the node-id vector. No host roundtrips.
+- split criterion is the Newton gain on (g, h) — the XGBoost-style
+  generalization of the reference's {w,wY,wYY} SSE gain; with
+  g = residual, h = 1 it reduces exactly to the reference's variance
+  reduction.
+- NA handling: NAs live in the last bin; both NA-left and NA-right are
+  scored, best kept — mirroring DHistogram's NA bucket semantics.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from h2o3_tpu.ops.histogram import histogram
+from h2o3_tpu.ops.segments import segment_sum
+
+
+class Tree(NamedTuple):
+    """One complete tree; arrays padded to Lmax = 2^(D-1) internal slots."""
+    feat: jax.Array       # [D, Lmax] int32 split feature
+    thresh: jax.Array     # [D, Lmax] int32 split bin (go left if bin <= t)
+    na_left: jax.Array    # [D, Lmax] bool
+    is_split: jax.Array   # [D, Lmax] bool
+    leaf: jax.Array       # [2^D] float32 leaf values
+
+
+@dataclasses.dataclass(frozen=True)
+class TreeParams:
+    max_depth: int = 5
+    min_rows: float = 10.0
+    learn_rate: float = 0.1
+    reg_lambda: float = 1.0          # hessian regularization (reference min_rows+pred smoothing)
+    min_split_improvement: float = 1e-5
+    col_sample_rate: float = 1.0     # per-split column sampling is per-tree here
+    nbins_total: int = 65            # B incl. NA bin
+    block_rows: int = 16384
+
+
+def _best_splits(hist, nb, col_mask, params: TreeParams):
+    """Vectorized DTree.findBestSplitPoint over all nodes of a level.
+
+    hist: [L, F, B, 3] of {w, g, h}. Returns per-node best
+    (gain, feat, thresh, na_left).
+    """
+    lam = params.reg_lambda
+    B = hist.shape[2]
+    w, g, h = hist[..., 0], hist[..., 1], hist[..., 2]
+    # cumulative over value bins (0..B-2); NA bin is B-1
+    cw = jnp.cumsum(w[:, :, : B - 1], axis=2)
+    cg = jnp.cumsum(g[:, :, : B - 1], axis=2)
+    ch = jnp.cumsum(h[:, :, : B - 1], axis=2)
+    naw, nag, nah = w[:, :, B - 1], g[:, :, B - 1], h[:, :, B - 1]
+    tw = cw[:, :, -1] + naw
+    tg = cg[:, :, -1] + nag
+    th = ch[:, :, -1] + nah
+
+    def gain(gl, hl, gr, hr):
+        return (gl * gl / (hl + lam) + gr * gr / (hr + lam)
+                - tg[:, :, None] ** 2 / (th[:, :, None] + lam))
+
+    def masked_gain(wl, gl, hl):
+        wr = tw[:, :, None] - wl
+        gr = tg[:, :, None] - gl
+        hr = th[:, :, None] - hl
+        ok = (wl >= params.min_rows) & (wr >= params.min_rows)
+        return jnp.where(ok, gain(gl, hl, gr, hr), -jnp.inf)
+
+    g_nar = masked_gain(cw, cg, ch)                         # NA → right
+    g_nal = masked_gain(cw + naw[:, :, None], cg + nag[:, :, None],
+                        ch + nah[:, :, None])               # NA → left
+    # threshold validity: t <= nb[f]-2 (splitting at last real bin is void)
+    t_ids = jnp.arange(B - 1, dtype=jnp.int32)
+    valid_t = t_ids[None, :] <= (nb[:, None] - 2)           # [F, B-1]
+    mask = valid_t[None, :, :] & col_mask[None, :, None]
+    g_nar = jnp.where(mask, g_nar, -jnp.inf)
+    g_nal = jnp.where(mask, g_nal, -jnp.inf)
+
+    stacked = jnp.stack([g_nar, g_nal], axis=-1)            # [L, F, B-1, 2]
+    L = stacked.shape[0]
+    flat = stacked.reshape(L, -1)
+    best = jnp.argmax(flat, axis=1)
+    best_gain = jnp.take_along_axis(flat, best[:, None], axis=1)[:, 0]
+    na_left = (best % 2).astype(bool)
+    best_t = ((best // 2) % (B - 1)).astype(jnp.int32)
+    best_f = (best // (2 * (B - 1))).astype(jnp.int32)
+    return best_gain, best_f, best_t, na_left
+
+
+def grow_tree(bins, nb, w, g, h, col_mask, *, params: TreeParams, mesh):
+    """Grow one tree; returns (Tree, final_leaf_id_per_row).
+
+    bins [Npad, F] int32 row-sharded; w zero on padding rows; col_mask [F]
+    bool (per-tree column sampling, reference col_sample_rate_per_tree).
+    """
+    D = params.max_depth
+    B = params.nbins_total
+    F = bins.shape[1]
+    Lmax = 2 ** (D - 1) if D > 0 else 1
+    N = bins.shape[0]
+    nid = jnp.zeros((N,), jnp.int32)
+
+    feats = jnp.zeros((D, Lmax), jnp.int32)
+    threshs = jnp.full((D, Lmax), B, jnp.int32)
+    na_lefts = jnp.zeros((D, Lmax), bool)
+    is_splits = jnp.zeros((D, Lmax), bool)
+    gain_by_feat = jnp.zeros((F,), jnp.float32)  # relative varimp (hex/VarImp)
+
+    for d in range(D):
+        L = 2 ** d
+        hist = histogram(bins, nid, w, g, h, n_nodes=L, n_bins=B,
+                         mesh=mesh, block_rows=params.block_rows)
+        bg, bf, bt, bnal = _best_splits(hist, nb, col_mask, params)
+        split = bg > params.min_split_improvement
+        feats = feats.at[d, :L].set(jnp.where(split, bf, 0))
+        threshs = threshs.at[d, :L].set(jnp.where(split, bt, B))
+        na_lefts = na_lefts.at[d, :L].set(jnp.where(split, bnal, False))
+        is_splits = is_splits.at[d, :L].set(split)
+        gain_by_feat = gain_by_feat + jnp.sum(
+            jnp.where(split, jnp.maximum(bg, 0.0), 0.0)[:, None]
+            * (bf[:, None] == jnp.arange(F, dtype=jnp.int32)[None, :]),
+            axis=0)
+
+        # route rows (the reference's DecidedNode assignment pass)
+        f_r = feats[d][nid]
+        t_r = threshs[d][nid]
+        nal_r = na_lefts[d][nid]
+        isp_r = is_splits[d][nid]
+        b_r = jnp.take_along_axis(bins, f_r[:, None], axis=1)[:, 0]
+        isna = b_r == (B - 1)
+        goleft = jnp.where(isp_r,
+                           jnp.where(isna, nal_r, b_r <= t_r),
+                           True)
+        nid = 2 * nid + jnp.where(goleft, 0, 1)
+
+    # leaf Newton values from final assignment (GammaPass analogue)
+    nleaf = 2 ** D
+    stats = jnp.stack([w, w * g, w * h], axis=1)
+    leaf_stats = segment_sum(nid, stats, n_nodes=nleaf, mesh=mesh,
+                             block_rows=params.block_rows)
+    G, H = leaf_stats[:, 1], leaf_stats[:, 2]
+    leaf = jnp.where(leaf_stats[:, 0] > 0, -G / (H + params.reg_lambda), 0.0)
+    tree = Tree(feats, threshs, na_lefts, is_splits, leaf)
+    return tree, nid, gain_by_feat
+
+
+def predict_tree(tree: Tree, bins, B: int):
+    """Route binned rows through one tree → leaf values [N]."""
+    N = bins.shape[0]
+    D = tree.feat.shape[0]
+    nid = jnp.zeros((N,), jnp.int32)
+    for d in range(D):
+        f_r = tree.feat[d][nid]
+        t_r = tree.thresh[d][nid]
+        nal_r = tree.na_left[d][nid]
+        isp_r = tree.is_split[d][nid]
+        b_r = jnp.take_along_axis(bins, f_r[:, None], axis=1)[:, 0]
+        isna = b_r == (B - 1)
+        goleft = jnp.where(isp_r, jnp.where(isna, nal_r, b_r <= t_r), True)
+        nid = 2 * nid + jnp.where(goleft, 0, 1)
+    return tree.leaf[nid]
+
+
+def stack_trees(trees) -> Tree:
+    """Stack per-iteration Trees into [T, ...] arrays for scan-predict."""
+    return Tree(*(jnp.stack([getattr(t, f) for t in trees])
+                  for f in Tree._fields))
+
+
+@partial(jax.jit, static_argnames=("B",))
+def predict_forest(stacked: Tree, bins, B: int):
+    """Sum of all trees' outputs via lax.scan over the tree axis.
+
+    The compressed-forest scoring path (hex/tree/CompressedTree.java walk
+    inside BigScore, hex/Model.java:2085) as one jitted program.
+    """
+
+    def step(acc, tree):
+        return acc + predict_tree(tree, bins, B), None
+
+    init = jnp.zeros((bins.shape[0],), jnp.float32)
+    total, _ = jax.lax.scan(step, init, stacked)
+    return total

@@ -1,0 +1,66 @@
+"""Segment (per-node) stat sums via the same matmul trick as histogram.
+
+Reference: leaf-value passes like GammaPass (hex/tree/gbm/GBM.java:520)
+accumulate per-leaf numerator/denominator with an MRTask. Here: one
+one-hot matmul per row block, psum over the data axis.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import shard_map
+from jax.sharding import PartitionSpec as P
+
+from h2o3_tpu.parallel.mesh import DATA_AXIS, MODEL_AXIS
+
+
+def _local_segment_sum(nid, vals, n_nodes: int, block_rows: int):
+    N = nid.shape[0]
+    K = vals.shape[1]
+    C = min(block_rows, N)
+    nblk = (N + C - 1) // C
+    Npad = nblk * C
+    if Npad != N:
+        nid = jnp.pad(nid, (0, Npad - N))
+        vals = jnp.pad(vals, ((0, Npad - N), (0, 0)))
+    nid_b = nid.reshape(nblk, C)
+    vals_b = vals.reshape(nblk, C, K)
+
+    def step(acc, xs):
+        n, v = xs
+        oh = (n[:, None] == jnp.arange(n_nodes, dtype=jnp.int32)[None, :])
+        part = jax.lax.dot_general(
+            oh.astype(jnp.float32).T, v.astype(jnp.float32),
+            (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+        return acc + part, None
+
+    init = jnp.zeros((n_nodes, K), jnp.float32)
+    acc, _ = jax.lax.scan(step, init, (nid_b, vals_b))
+    return acc
+
+
+def segment_sum(nid, vals, *, n_nodes: int, mesh, block_rows: int = 16384):
+    """All-reduced per-node sums: vals [N, K] → [n_nodes, K].
+
+    Rows with all-zero vals (padding) contribute nothing; nid must be in
+    [0, n_nodes).
+    """
+    ndata = mesh.shape[DATA_AXIS]
+    N = nid.shape[0]
+    if N % ndata != 0:
+        pad = ndata - N % ndata
+        nid = jnp.pad(nid, (0, pad))
+        vals = jnp.pad(vals, ((0, pad), (0, 0)))
+
+    @functools.partial(
+        shard_map, mesh=mesh,
+        in_specs=(P(DATA_AXIS), P(DATA_AXIS)),
+        out_specs=P(), check_vma=False)
+    def _task(nid_l, vals_l):
+        s = _local_segment_sum(nid_l, vals_l, n_nodes, block_rows)
+        return jax.lax.psum(s, DATA_AXIS)
+
+    return _task(nid, vals)

@@ -1,0 +1,63 @@
+"""frame_map_reduce — the MRTask analogue, as one primitive.
+
+Reference: water/MRTask.java:69 — serialize task to all nodes, split node
+range as a binary tree (remote_compute, MRTask.java:716-756), split local
+chunks over Fork/Join, ``map(Chunk...)`` per chunk, ``reduce`` pairwise up
+both trees (MRTask.java:891). All of that machinery — RPC, ack/ackack,
+F/J priorities — exists to make one thing safe: a distributed map + an
+all-reduce.
+
+TPU-native: ``shard_map`` over the 'data' mesh axis runs ``map_fn`` on each
+row-shard; ``jax.lax.psum`` over the axis IS the reduce tree (XLA emits the
+ICI ring/tree). Elementwise (map-only) tasks skip the psum and keep outputs
+row-sharded. Local chunking (the F/J level) is either left to XLA fusion or
+done with ``lax.scan`` over row blocks inside the shard when the map needs
+bounded memory (see ops/histogram.py).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable
+
+import jax
+from jax import shard_map
+from jax.sharding import PartitionSpec as P
+
+from h2o3_tpu.parallel.mesh import DATA_AXIS, MODEL_AXIS, get_mesh
+
+
+def frame_reduce(map_fn: Callable[..., Any], *arrays, mesh=None) -> Any:
+    """All-reduce of ``map_fn`` applied per row-shard.
+
+    ``map_fn(*local_arrays) -> pytree of stats``; every leaf is summed over
+    the data axis. Equivalent of MRTask.doAll + reduce (water/MRTask.java).
+    """
+    mesh = mesh or get_mesh()
+
+    @functools.partial(
+        shard_map, mesh=mesh,
+        in_specs=tuple(P(DATA_AXIS) for _ in arrays),
+        out_specs=P(),
+        check_vma=False)
+    def _task(*local):
+        stats = map_fn(*local)
+        return jax.tree_util.tree_map(
+            lambda s: jax.lax.psum(s, DATA_AXIS), stats)
+
+    return _task(*arrays)
+
+
+def frame_map(map_fn: Callable[..., Any], *arrays, mesh=None) -> Any:
+    """Elementwise over rows; output stays row-sharded (map-only MRTask)."""
+    mesh = mesh or get_mesh()
+
+    @functools.partial(
+        shard_map, mesh=mesh,
+        in_specs=tuple(P(DATA_AXIS) for _ in arrays),
+        out_specs=P(DATA_AXIS),
+        check_vma=False)
+    def _task(*local):
+        return map_fn(*local)
+
+    return _task(*arrays)

@@ -1,0 +1,106 @@
+"""Device-mesh management — the TPU-native replacement for H2O "clouding".
+
+Reference: the cloud is N symmetric JVMs agreeing on membership via
+heartbeat gossip (water/Paxos.java:27, water/HeartBeatThread.java:16) and
+reducing over a binary node tree (water/MRTask.java:716-756). TPU-native:
+membership is ``jax.distributed`` (control plane), the node tree is a
+``jax.sharding.Mesh`` and every reduce is an XLA collective over ICI/DCN.
+
+Axes:
+- ``data``  — row-sharding axis; the analogue of H2O's chunk-to-node hash
+  distribution (water/fvec/Vec.java chunk homing). All MRTask-style work
+  shards rows over it and reduces with ``psum``.
+- ``model`` — reserved width-sharding axis (wide Gram matrices for GLM with
+  huge one-hot spaces; SURVEY §2.4 item 6). Size 1 on small meshes.
+
+Multi-slice pods map as mesh shape (dcn_slices, ici_chips_per_slice)
+flattened into ('data','model'); shardings are laid out so psum rides ICI
+first (innermost axis varies fastest across a slice).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+DATA_AXIS = "data"
+MODEL_AXIS = "model"
+
+_GLOBAL_MESH: Optional[Mesh] = None
+
+
+def make_mesh(devices: Optional[Sequence[jax.Device]] = None,
+              data_axis: int = 0, model_axis: int = 1) -> Mesh:
+    """Build the (data, model) mesh. data_axis=0 ⇒ use all devices."""
+    if devices is None:
+        devices = jax.devices()
+    devices = list(devices)
+    n = len(devices)
+    if data_axis <= 0:
+        data_axis = n // model_axis
+    assert data_axis * model_axis <= n, (
+        f"mesh {data_axis}x{model_axis} needs more than {n} devices")
+    dev = np.array(devices[: data_axis * model_axis]).reshape(
+        data_axis, model_axis)
+    return Mesh(dev, (DATA_AXIS, MODEL_AXIS))
+
+
+def set_global_mesh(mesh: Mesh) -> None:
+    global _GLOBAL_MESH
+    _GLOBAL_MESH = mesh
+
+
+def get_mesh() -> Mesh:
+    """The process mesh (analogue of the static H2O.CLOUD, water/H2O.java)."""
+    global _GLOBAL_MESH
+    if _GLOBAL_MESH is None:
+        _GLOBAL_MESH = make_mesh()
+    return _GLOBAL_MESH
+
+
+def data_size(mesh: Optional[Mesh] = None) -> int:
+    mesh = mesh or get_mesh()
+    return mesh.shape[DATA_AXIS]
+
+
+def row_sharding(mesh: Optional[Mesh] = None) -> NamedSharding:
+    """Rows sharded over 'data', everything else replicated."""
+    mesh = mesh or get_mesh()
+    return NamedSharding(mesh, P(DATA_AXIS))
+
+
+def replicated(mesh: Optional[Mesh] = None) -> NamedSharding:
+    mesh = mesh or get_mesh()
+    return NamedSharding(mesh, P())
+
+
+def padded_rows(n: int, mesh: Optional[Mesh] = None, block: int = 1) -> int:
+    """Rows padded so every data-shard holds an equal, block-aligned count.
+
+    The analogue of H2O chunk alignment (water/fvec/Vec.java ESPC layout):
+    padding rows carry weight 0 so reductions ignore them.
+    """
+    d = data_size(mesh) * max(block, 1)
+    return ((n + d - 1) // d) * d
+
+
+def shard_rows(x, mesh: Optional[Mesh] = None, block: int = 1,
+               fill: float = 0.0):
+    """Pad axis-0 to a shardable length and place with row_sharding."""
+    mesh = mesh or get_mesh()
+    n = x.shape[0]
+    npad = padded_rows(n, mesh, block)
+    if npad != n:
+        pad_widths = [(0, npad - n)] + [(0, 0)] * (x.ndim - 1)
+        x = np.pad(np.asarray(x), pad_widths, constant_values=fill)
+    return jax.device_put(x, row_sharding(mesh))
+
+
+def valid_mask(n: int, npad: int, mesh: Optional[Mesh] = None):
+    """float32 1/0 mask marking real rows among padded."""
+    m = np.zeros((npad,), dtype=np.float32)
+    m[:n] = 1.0
+    return jax.device_put(m, row_sharding(mesh))

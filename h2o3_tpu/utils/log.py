@@ -1,0 +1,40 @@
+"""Leveled logging (analogue of water.util.Log, reference
+h2o-core/src/main/java/water/util/Log.java:24).
+
+The reference keeps per-node rotating files via log4j; here a thin wrapper
+over the stdlib so every subsystem logs through one place and the REST
+``/3/Logs`` endpoint can replay the buffer.
+"""
+
+from __future__ import annotations
+
+import logging
+import collections
+
+_BUFFER: collections.deque = collections.deque(maxlen=10000)
+
+
+class _BufferHandler(logging.Handler):
+    def emit(self, record: logging.LogRecord) -> None:
+        _BUFFER.append(self.format(record))
+
+
+_logger = logging.getLogger("h2o3_tpu")
+if not _logger.handlers:
+    _h = logging.StreamHandler()
+    _h.setFormatter(logging.Formatter("%(asctime)s %(levelname).1s %(name)s: %(message)s"))
+    _logger.addHandler(_h)
+    _b = _BufferHandler()
+    _b.setFormatter(logging.Formatter("%(asctime)s %(levelname).1s %(message)s"))
+    _logger.addHandler(_b)
+    _logger.setLevel(logging.INFO)
+    _logger.propagate = False
+
+
+def get_logger(name: str = "h2o3_tpu") -> logging.Logger:
+    return logging.getLogger(name)
+
+
+def log_buffer() -> list:
+    """Recent log lines — backs GET /3/Logs (water/api/LogsHandler.java)."""
+    return list(_BUFFER)

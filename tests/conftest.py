@@ -1,0 +1,71 @@
+"""Test harness: force an 8-virtual-device CPU mesh.
+
+The analogue of the reference's multi-JVM-on-localhost test clouds
+(multiNodeUtils.sh + @CloudSize(n), water/runner/H2ORunner.java:27): tests
+exercise the same sharded/psum code paths the TPU pod runs, on 8 virtual
+CPU devices.
+"""
+
+import os
+
+os.environ.setdefault("XLA_FLAGS",
+                      "--xla_force_host_platform_device_count=8")
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import jax  # noqa: E402
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True, scope="session")
+def _cloud():
+    """Boot the cloud once per session (stall_till_cloudsize analogue)."""
+    import h2o3_tpu
+    cpu = jax.devices("cpu")
+    jax.config.update("jax_default_device", cpu[0])
+    h2o3_tpu.init(backend="cpu")
+    info = h2o3_tpu.cluster_info()
+    assert info["cloud_size"] == 8, info
+    yield
+    h2o3_tpu.shutdown()
+
+
+@pytest.fixture()
+def rng():
+    return np.random.RandomState(42)
+
+
+def make_classification(n=4000, f=8, seed=0, informative=4):
+    """Synthetic binary problem with known signal (TestFrameCatalog role)."""
+    r = np.random.RandomState(seed)
+    X = r.randn(n, f)
+    logits = X[:, :informative] @ r.uniform(0.5, 2.0, informative)
+    p = 1 / (1 + np.exp(-logits))
+    y = (r.rand(n) < p).astype(int)
+    return X, y
+
+
+def make_regression(n=4000, f=8, seed=0, noise=0.1):
+    r = np.random.RandomState(seed)
+    X = r.randn(n, f)
+    y = X[:, 0] * 2.0 + np.sin(X[:, 1] * 2) + 0.5 * X[:, 2] * X[:, 3]
+    y = y + noise * r.randn(n)
+    return X, y
+
+
+@pytest.fixture()
+def classif_frame():
+    import h2o3_tpu
+    X, y = make_classification()
+    cols = {f"x{i}": X[:, i] for i in range(X.shape[1])}
+    cols["y"] = np.array(["no", "yes"], dtype=object)[y]
+    return h2o3_tpu.Frame.from_numpy(cols, categorical=["y"])
+
+
+@pytest.fixture()
+def regress_frame():
+    import h2o3_tpu
+    X, y = make_regression()
+    cols = {f"x{i}": X[:, i] for i in range(X.shape[1])}
+    cols["y"] = y
+    return h2o3_tpu.Frame.from_numpy(cols)

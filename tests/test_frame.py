@@ -1,0 +1,65 @@
+"""Frame/Column/rollups tests — mirrors h2o-core fvec unit tests
+(h2o-core/src/test/java/water/fvec/FrameTest.java role)."""
+
+import numpy as np
+import pytest
+
+import h2o3_tpu
+from h2o3_tpu.frame.rollups import rollups
+
+
+def test_from_numpy_types():
+    fr = h2o3_tpu.Frame.from_numpy({
+        "ints": np.array([1, 2, 3, 4]),
+        "floats": np.array([1.5, 2.5, np.nan, 4.0]),
+        "cats": np.array(["a", "b", "a", "c"], dtype=object),
+    }, categorical=[])
+    assert fr.shape == (4, 3)
+    assert fr.col("ints").type == "numeric"
+    assert fr.col("floats").type == "numeric"
+    assert fr.col("cats").type == "categorical"
+    assert fr.col("cats").domain == ["a", "b", "c"]
+
+
+def test_na_handling():
+    fr = h2o3_tpu.Frame.from_numpy({"x": np.array([1.0, np.nan, 3.0])})
+    r = rollups(fr.col("x"))
+    assert r["na_count"] == 1
+    assert r["rows"] == 2
+    assert r["mean"] == pytest.approx(2.0)
+
+
+def test_rollups_match_numpy(rng):
+    v = rng.randn(1000) * 3 + 1
+    fr = h2o3_tpu.Frame.from_numpy({"x": v})
+    r = rollups(fr.col("x"))
+    assert r["mean"] == pytest.approx(v.mean(), rel=1e-4)
+    assert r["sigma"] == pytest.approx(v.std(ddof=1), rel=1e-3)
+    assert r["min"] == pytest.approx(v.min(), rel=1e-5)
+    assert r["max"] == pytest.approx(v.max(), rel=1e-5)
+
+
+def test_padding_is_masked():
+    # 5 rows over an 8-device mesh forces padding; stats must ignore it
+    fr = h2o3_tpu.Frame.from_numpy({"x": np.arange(5, dtype=float)})
+    assert fr.nrows == 5
+    assert fr.nrows_padded % 8 == 0
+    r = rollups(fr.col("x"))
+    assert r["rows"] == 5
+    assert r["mean"] == pytest.approx(2.0)
+
+
+def test_roundtrip_pandas():
+    import pandas as pd
+    df = pd.DataFrame({"a": [1.0, 2.0], "b": ["x", "y"]})
+    fr = h2o3_tpu.Frame.from_pandas(df)
+    back = fr.to_pandas()
+    assert list(back["a"]) == [1.0, 2.0]
+    assert list(back["b"]) == ["x", "y"]
+
+
+def test_subset_and_summary(classif_frame):
+    s = classif_frame.summary()
+    assert s["y"]["cardinality"] == 2
+    sub = classif_frame[["x0", "y"]]
+    assert sub.ncols == 2
