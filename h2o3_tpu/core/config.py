@@ -32,14 +32,20 @@ class Config:
     nbins: int = 64
     ice_root: str = "/tmp/h2o3_tpu"   # spill/checkpoint dir (-ice_root)
 
+    # fields that parse as int from the environment (annotations are
+    # strings under `from __future__ import annotations`, so resolve
+    # by hand)
+    _INT_FIELDS = frozenset({"port", "nthreads", "data_axis", "model_axis",
+                             "block_rows", "nbins"})
+
     @staticmethod
     def from_env(**overrides) -> "Config":
         cfg = Config()
         for f in dataclasses.fields(Config):
             env = os.environ.get("H2O3TPU_" + f.name.upper())
             if env is not None:
-                t = f.type if isinstance(f.type, type) else type(getattr(cfg, f.name) or "")
-                setattr(cfg, f.name, int(env) if t is int else env)
+                setattr(cfg, f.name,
+                        int(env) if f.name in Config._INT_FIELDS else env)
         for k, v in overrides.items():
             if v is not None and hasattr(cfg, k):
                 setattr(cfg, k, v)

@@ -71,8 +71,10 @@ class Frame:
                 arrays[name] = s.astype("int64").to_numpy().astype(np.float64)
             else:  # str / category / object → categorical via interning
                 vals = s.astype("object").to_numpy()
+                # keep missing as None so interning assigns code -1 (NA);
+                # genuine "" strings stay a real level
                 arrays[name] = np.array(
-                    ["" if v is None or (isinstance(v, float) and np.isnan(v))
+                    [None if v is None or (isinstance(v, float) and np.isnan(v))
                      else str(v) for v in vals], dtype=object)
                 categorical.append(name)
         return Frame.from_numpy(arrays, categorical=categorical, key=key)
@@ -146,11 +148,10 @@ class Frame:
             c = self.col(n)
             v = c.to_numpy()
             if c.is_categorical and c.domain:
-                dom = np.array(c.domain + [""], dtype=object)
+                dom = np.array(c.domain + [None], dtype=object)
                 codes = np.asarray(c.data)[: c.nrows].astype(np.int64)
                 codes[np.asarray(c.na_mask)[: c.nrows]] = len(c.domain)
                 v = dom[codes]
-                v = pd.Series(v).replace("", np.nan)
             data[n] = v
         return pd.DataFrame(data)
 

@@ -108,17 +108,28 @@ def train_with_cv(builder, frame: Frame, x: Sequence[str], y: str,
     # final model on all data (ModelBuilder.java "main model")
     final = builder.__class__(**sub_params)._fit(frame, list(x), y, job)
 
+    # CV metrics: NA-response rows excluded, user weights applied — same
+    # weighting contract as training metrics
     yc = frame.col(y)
-    if category == ModelCategory.BINOMIAL:
-        yv = adapt_domain(yc, yc.domain).astype(np.float32)
-        final.cross_validation_metrics = mm.binomial_metrics(holdout, yv)
-    elif category == ModelCategory.MULTINOMIAL:
+    wv = np.ones(n, np.float32)
+    if p.get("weights_column") and p["weights_column"] in frame:
+        wraw = frame.col(p["weights_column"]).to_numpy()
+        wv = np.nan_to_num(wraw).astype(np.float32)
+    if category in (ModelCategory.BINOMIAL, ModelCategory.MULTINOMIAL):
         yv = adapt_domain(yc, yc.domain)
-        final.cross_validation_metrics = mm.multinomial_metrics(holdout, yv,
-                                                                domain=yc.domain)
+        wv = wv * (yv >= 0)
+        yv = np.maximum(yv, 0)
+        if category == ModelCategory.BINOMIAL:
+            final.cross_validation_metrics = mm.binomial_metrics(
+                holdout, yv.astype(np.float32), wv)
+        else:
+            final.cross_validation_metrics = mm.multinomial_metrics(
+                holdout, yv, wv, domain=yc.domain)
     else:
-        yv = np.nan_to_num(yc.to_numpy()).astype(np.float32)
-        final.cross_validation_metrics = mm.regression_metrics(holdout, yv)
+        yraw = yc.to_numpy()
+        wv = wv * (~np.isnan(yraw)).astype(np.float32)
+        yv = np.nan_to_num(yraw).astype(np.float32)
+        final.cross_validation_metrics = mm.regression_metrics(holdout, yv, wv)
     final.output["cv_holdout_predictions"] = None
     final.output["nfolds"] = nfolds
     final._cv_holdout = holdout

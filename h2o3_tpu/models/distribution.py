@@ -135,14 +135,31 @@ _FACTORY = {
 }
 
 
+_CACHE: dict = {}
+
+
 def get_distribution(name: str, **kw) -> Distribution:
+    """Memoized per (name, shape-param): Distribution instances are static
+    jit arguments, so a fresh instance per call would recompile every
+    boosting program."""
     name = name.lower()
-    if name == "tweedie":
-        return tweedie(kw.get("tweedie_power", 1.5))
-    if name == "quantile":
-        return quantile(kw.get("quantile_alpha", 0.5))
-    if name == "huber":
-        return huber(kw.get("huber_alpha", 0.9))
     if name in ("auto", "multinomial"):
         raise ValueError(f"{name} resolved at the algorithm level")
-    return _FACTORY[name]()
+    if name == "tweedie":
+        key = (name, float(kw.get("tweedie_power", 1.5)))
+    elif name == "quantile":
+        key = (name, float(kw.get("quantile_alpha", 0.5)))
+    elif name == "huber":
+        key = (name, float(kw.get("huber_alpha", 0.9)))
+    else:
+        key = (name, 0.0)
+    if key not in _CACHE:
+        if name == "tweedie":
+            _CACHE[key] = tweedie(key[1])
+        elif name == "quantile":
+            _CACHE[key] = quantile(key[1])
+        elif name == "huber":
+            _CACHE[key] = huber(key[1])
+        else:
+            _CACHE[key] = _FACTORY[name]()
+    return _CACHE[key]

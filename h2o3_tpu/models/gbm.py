@@ -145,6 +145,10 @@ class GBMModel(Model):
         bm = rebin_for_scoring(self.bm, frame)
         marg = self._margins(bm)
         w = frame.valid_weights()
+        wc_name = self.params.get("weights_column")
+        if wc_name and wc_name in frame:
+            wc = frame.col(wc_name).numeric_view()
+            w = w * jnp.where(jnp.isnan(wc), 0.0, wc)
         cat = self.output["category"]
         if cat in (ModelCategory.BINOMIAL, ModelCategory.MULTINOMIAL):
             from h2o3_tpu.models.model import adapt_domain
@@ -270,12 +274,19 @@ class GBMEstimator(ModelBuilder):
             yv = np.asarray(rc.data)[: frame.nrows].astype(np.int32)
             yv = np.pad(yv, (0, bm.bins.shape[0] - frame.nrows))
             y_dev = jax.device_put(yv, row_sharding(mesh))
-            counts = np.bincount(yv[: frame.nrows], minlength=K).astype(np.float64)
-            pri = np.clip(counts / counts.sum(), 1e-10, 1.0)
+            # weighted class priors over rows that actually train (weights
+            # already zero NA-response and padding rows)
+            w_host = np.asarray(w)[: frame.nrows]
+            counts = np.bincount(yv[: frame.nrows], weights=w_host,
+                                 minlength=K).astype(np.float64)
+            pri = np.clip(counts / max(counts.sum(), 1e-12), 1e-10, 1.0)
             f0 = np.log(pri).astype(np.float32)
             margins = jnp.broadcast_to(jnp.asarray(f0)[None, :],
                                        (bm.bins.shape[0], K)).astype(jnp.float32)
             margins = jax.device_put(margins, row_sharding(mesh))
+            val_margins = (jnp.broadcast_to(jnp.asarray(f0)[None, :],
+                                            (vbm.bins.shape[0], K)).astype(jnp.float32)
+                           if vbm is not None else None)
             for t in range(ntrees):
                 key, sub = jax.random.split(key)
                 tr, margins, gains = _boost_step_multi(
@@ -284,11 +295,20 @@ class GBMEstimator(ModelBuilder):
                 trees.append(tr)
                 gains_total += np.asarray(gains)
                 job.update(1.0 / ntrees, f"tree {t + 1}/{ntrees}")
+                if vbm is not None:
+                    vadd = jnp.stack(
+                        [predict_tree(Tree(*(a[k] for a in tr)), vbm.bins,
+                                      bm.nbins_total) for k in range(K)], axis=1)
+                    val_margins = val_margins + vadd
                 if stopper.enabled and (t + 1) % score_interval == 0:
-                    py = jnp.take_along_axis(jax.nn.softmax(margins, axis=1),
-                                             y_dev[:, None], axis=1)[:, 0]
-                    dev = float(jnp.sum(-2.0 * w * jnp.log(jnp.clip(py, 1e-7, 1.0)))
-                                / jnp.maximum(jnp.sum(w), 1e-12))
+                    if vbm is not None:
+                        m_, w_, y_ = val_margins, val_w, val_y.astype(jnp.int32)
+                    else:
+                        m_, w_, y_ = margins, w, y_dev
+                    py = jnp.take_along_axis(jax.nn.softmax(m_, axis=1),
+                                             y_[:, None], axis=1)[:, 0]
+                    dev = float(jnp.sum(-2.0 * w_ * jnp.log(jnp.clip(py, 1e-7, 1.0)))
+                                / jnp.maximum(jnp.sum(w_), 1e-12))
                     scoring_history.append({"ntrees": t + 1, "deviance": dev})
                     if stopper.should_stop(dev):
                         break

@@ -1,0 +1,119 @@
+"""Optimization primitives: ADMM (L1 quadratic), L-BFGS, Cholesky solve.
+
+Reference: hex/optimization/ADMM.java (L1Solver for the IRLS proximal
+subproblem) and hex/optimization/L_BFGS.java (two-loop recursion +
+backtracking line search) — both driven from hex/glm/GLM.java:1451,2056.
+Here: the quadratic ADMM runs entirely on device around one Cholesky
+factorization; L-BFGS keeps its (small) history on host and calls a
+jitted value-and-gradient (the gradient evaluation is the distributed
+part — one Gram-style pass per iteration).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def soft_threshold(x, k):
+    return jnp.sign(x) * jnp.maximum(jnp.abs(x) - k, 0.0)
+
+
+def admm_l1_quadratic(A, q, l1: float, penalize_mask,
+                      rho: float = 1.0, iters: int = 200,
+                      tol: float = 1e-6):
+    """min_b ½ b'Ab - q'b + l1·|b∘mask|₁  via ADMM (ADMM.java:L1Solver).
+
+    A must be PSD; one Cholesky of (A + ρI), then ``iters`` cheap steps
+    inside lax.while_loop. penalize_mask: 1.0 for penalized coords, 0.0
+    for intercept.
+    """
+    P = A.shape[0]
+    L = jax.scipy.linalg.cho_factor(A + rho * jnp.eye(P, dtype=A.dtype))
+
+    def body(state):
+        b, z, u, it, _ = state
+        b_new = jax.scipy.linalg.cho_solve(L, q + rho * (z - u))
+        z_new = soft_threshold(b_new + u, l1 / rho * penalize_mask)
+        u_new = u + b_new - z_new
+        delta = jnp.max(jnp.abs(z_new - z))
+        return (b_new, z_new, u_new, it + 1, delta)
+
+    def cond(state):
+        _, _, _, it, delta = state
+        return (it < iters) & (delta > tol)
+
+    z0 = jnp.zeros((P,), A.dtype)
+    state = (z0, z0, z0, jnp.int32(0), jnp.float32(1.0))
+    b, z, u, _, _ = jax.lax.while_loop(cond, body, state)
+    return z  # the sparse iterate
+
+
+def cholesky_solve_regularized(XtWX, XtWz, l2: float, penalize_mask,
+                               ridge_boost: float = 1e-6):
+    """Solve (XtWX + l2·diag(mask)) b = XtWz, with a tiny ridge for rank
+    safety (the reference drops collinear columns, Gram.java:229; a
+    minimal ridge is the static-shape equivalent)."""
+    P = XtWX.shape[0]
+    reg = l2 * penalize_mask + ridge_boost
+    A = XtWX + jnp.diag(reg)
+    L = jax.scipy.linalg.cho_factor(A)
+    return jax.scipy.linalg.cho_solve(L, XtWz)
+
+
+def lbfgs(value_and_grad: Callable, x0: np.ndarray, max_iter: int = 100,
+          m: int = 10, gtol: float = 1e-5, ls_max: int = 20) -> Tuple[np.ndarray, float, int]:
+    """Host-orchestrated L-BFGS (L_BFGS.java) with Armijo backtracking.
+
+    ``value_and_grad(x) -> (f, g)`` runs jitted on device; history math is
+    tiny and stays on host.
+    """
+    x = np.asarray(x0, np.float64)
+    f, g = value_and_grad(x)
+    f, g = float(f), np.asarray(g, np.float64)
+    S, Y, rhos = [], [], []
+    n_iter = 0
+    for n_iter in range(1, max_iter + 1):
+        if np.max(np.abs(g)) < gtol:
+            break
+        # two-loop recursion
+        qd = g.copy()
+        alphas = []
+        for s, yv, r in zip(reversed(S), reversed(Y), reversed(rhos)):
+            a = r * s.dot(qd)
+            alphas.append(a)
+            qd -= a * yv
+        if Y:
+            gamma = S[-1].dot(Y[-1]) / max(Y[-1].dot(Y[-1]), 1e-12)
+            qd *= gamma
+        for s, yv, r, a in zip(S, Y, rhos, reversed(alphas)):
+            b = r * yv.dot(qd)
+            qd += (a - b) * s
+        d = -qd
+        gd = g.dot(d)
+        if gd > 0:  # not a descent direction; reset
+            d, gd = -g, -g.dot(g)
+            S, Y, rhos = [], [], []
+        # backtracking
+        step = 1.0
+        for _ in range(ls_max):
+            xn = x + step * d
+            fn, gn = value_and_grad(xn)
+            fn = float(fn)
+            if np.isfinite(fn) and fn <= f + 1e-4 * step * gd:
+                break
+            step *= 0.5
+        else:
+            break
+        gn = np.asarray(gn, np.float64)
+        s, yv = xn - x, gn - g
+        sy = s.dot(yv)
+        if sy > 1e-10:
+            S.append(s); Y.append(yv); rhos.append(1.0 / sy)
+            if len(S) > m:
+                S.pop(0); Y.pop(0); rhos.pop(0)
+        x, f, g = xn, fn, gn
+    return x, f, n_iter
