@@ -1,1 +1,198 @@
+"""AutoML — budgeted multi-algorithm search + stacked ensembles.
 
+Reference: ai/h2o/automl/AutoML.java:49 — planWork (AutoML.java:420)
+allocates a budget across modeling steps from ModelingStepsProviders
+(modeling/{GLM,GBM,DRF,DeepLearning,StackedEnsemble,...}StepsProvider),
+learn (AutoML.java:760) executes defaults then random grids under
+max_models / max_runtime_secs, every model cross-validated, results
+ranked in hex.leaderboard.Leaderboard, StackedEnsemble best-of-family +
+all-models trained last.
+
+Same plan here; every candidate trains with nfolds CV on the full mesh.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import List, Optional, Sequence
+
+from h2o3_tpu.frame.frame import Frame
+from h2o3_tpu.ml.ensemble import StackedEnsembleEstimator
+from h2o3_tpu.ml.grid import GridSearch
+from h2o3_tpu.ml.leaderboard import Leaderboard
+from h2o3_tpu.models import get_builder
+from h2o3_tpu.utils.log import get_logger
+
+log = get_logger("h2o3_tpu.automl")
+
+
+def _default_steps(seed: int) -> List[dict]:
+    """The modeling plan (modeling/*StepsProvider defaults, in the
+    reference's execution order: defaults first, then grids)."""
+    return [
+        {"algo": "glm", "name": "GLM_1",
+         "params": {"family": "auto", "lambda_search": True, "nlambdas": 10}},
+        {"algo": "gbm", "name": "GBM_1",
+         "params": {"ntrees": 50, "max_depth": 6, "learn_rate": 0.1,
+                    "sample_rate": 0.8, "col_sample_rate_per_tree": 0.8,
+                    "seed": seed}},
+        {"algo": "gbm", "name": "GBM_2",
+         "params": {"ntrees": 60, "max_depth": 7, "learn_rate": 0.08,
+                    "sample_rate": 0.9, "seed": seed + 1}},
+        {"algo": "gbm", "name": "GBM_3",
+         "params": {"ntrees": 40, "max_depth": 4, "learn_rate": 0.15,
+                    "seed": seed + 2}},
+        {"algo": "drf", "name": "DRF_1",
+         "params": {"ntrees": 50, "max_depth": 12, "seed": seed}},
+        {"algo": "deeplearning", "name": "DeepLearning_1",
+         "params": {"hidden": [64, 64], "epochs": 10, "seed": seed,
+                    "stopping_rounds": 3}},
+        {"grid": True, "algo": "gbm", "name": "GBM_grid_1",
+         "hyper": {"max_depth": [3, 5, 7, 9],
+                   "learn_rate": [0.05, 0.1, 0.2],
+                   "sample_rate": [0.7, 0.9, 1.0]},
+         "params": {"ntrees": 40, "seed": seed}},
+    ]
+
+
+class H2OAutoML:
+    """h2o-py H2OAutoML-compatible surface (h2o-py/h2o/automl/).
+
+    ``keep_cross_validation_predictions`` is effectively always True here
+    (holdouts are kept in-memory for stacking); ``balance_classes`` is not
+    implemented and warns if set; ``verbosity`` only affects logging.
+    """
+
+    def __init__(self, max_models: int = 0, max_runtime_secs: float = 3600.0,
+                 seed: int = -1, nfolds: int = 5,
+                 project_name: Optional[str] = None,
+                 sort_metric: Optional[str] = None,
+                 include_algos: Optional[Sequence[str]] = None,
+                 exclude_algos: Optional[Sequence[str]] = None,
+                 stopping_rounds: int = 3, stopping_tolerance: float = 1e-3,
+                 keep_cross_validation_predictions: bool = True,
+                 verbosity: str = "warn", balance_classes: bool = False,
+                 max_runtime_secs_per_model: float = 0.0):
+        self.max_models = int(max_models)
+        self.max_runtime_secs = float(max_runtime_secs)
+        self.seed = int(seed) if int(seed) >= 0 else 5723
+        self.nfolds = int(nfolds)
+        self.project_name = project_name or f"automl_{int(time.time())}"
+        self.sort_metric = sort_metric
+        self.include = ({a.lower() for a in include_algos}
+                        if include_algos else None)
+        self.exclude = {a.lower() for a in (exclude_algos or ())}
+        self.leaderboard_obj = Leaderboard(self.project_name, sort_metric)
+        self.stopping_rounds = int(stopping_rounds)
+        self.stopping_tolerance = float(stopping_tolerance)
+        self.max_runtime_secs_per_model = float(max_runtime_secs_per_model)
+        if balance_classes:
+            log.warning("balance_classes is not implemented; ignoring")
+
+    # -- helpers -------------------------------------------------------
+    def _allowed(self, algo: str) -> bool:
+        a = algo.lower()
+        if self.include is not None and a not in self.include:
+            return False
+        return a not in self.exclude
+
+    @property
+    def leader(self):
+        return self.leaderboard_obj.leader
+
+    @property
+    def leaderboard(self):
+        return self.leaderboard_obj
+
+    def predict(self, frame: Frame) -> Frame:
+        return self.leader.predict(frame)
+
+    # -- train ---------------------------------------------------------
+    def train(self, y: str, training_frame: Frame,
+              x: Optional[Sequence[str]] = None,
+              validation_frame: Optional[Frame] = None,
+              leaderboard_frame: Optional[Frame] = None):
+        t0 = time.time()
+        deadline = (t0 + self.max_runtime_secs
+                    if self.max_runtime_secs else None)
+        steps = _default_steps(self.seed)
+        budget_models = self.max_models or 10 ** 9
+        trained: List = []
+
+        def out_of_budget():
+            if len(trained) >= budget_models:
+                return True
+            return deadline is not None and time.time() > deadline
+
+        for step in steps:
+            algo = step["algo"]
+            if not self._allowed(algo) or out_of_budget():
+                continue
+            try:
+                if step.get("grid"):
+                    remaining = budget_models - len(trained)
+                    budget_s = (max(0.0, deadline - time.time())
+                                if deadline else 0)
+                    gs = GridSearch(
+                        get_builder(algo),
+                        step["hyper"],
+                        search_criteria={"strategy": "RandomDiscrete",
+                                         "max_models": min(remaining, 5),
+                                         "max_runtime_secs": budget_s,
+                                         "seed": self.seed},
+                        **{**step["params"], "nfolds": self.nfolds})
+                    grid = gs.train(training_frame, y=y, x=x)
+                    for m in grid.models:
+                        m.output["automl_step"] = step["name"]
+                    trained.extend(grid.models)
+                    self.leaderboard_obj.add(*grid.models)
+                else:
+                    params = {**step["params"], "nfolds": self.nfolds}
+                    # wire AutoML early stopping into builders that take it
+                    cls = get_builder(algo)
+                    if "stopping_rounds" in cls.DEFAULTS:
+                        params.setdefault("stopping_rounds",
+                                          self.stopping_rounds)
+                        params.setdefault("stopping_tolerance",
+                                          self.stopping_tolerance)
+                    m = cls(**params).train(training_frame, y=y, x=x)
+                    m.output["automl_step"] = step["name"]
+                    trained.append(m)
+                    self.leaderboard_obj.add(m)
+                log.info("automl: %s done (%d models, %.0fs elapsed)",
+                         step["name"], len(trained), time.time() - t0)
+            except Exception as e:
+                log.warning("automl step %s failed: %s", step["name"], e)
+
+        # stacked ensembles last (StackedEnsembleStepsProvider):
+        # best-of-family + all-models
+        with_cv = [m for m in trained
+                   if getattr(m, "_cv_holdout", None) is not None]
+        best_of_family = {}
+        if self._allowed("stackedensemble") and len(with_cv) >= 2:
+            for m in self.leaderboard_obj.sorted_models():
+                if m in with_cv and m.algo not in best_of_family:
+                    best_of_family[m.algo] = m
+            if len(best_of_family) >= 2:
+                try:
+                    se = StackedEnsembleEstimator(
+                        base_models=list(best_of_family.values())).train(
+                        training_frame, y=y, x=x)
+                    se.output["automl_step"] = "StackedEnsemble_BestOfFamily"
+                    self.leaderboard_obj.add(se)
+                except Exception as e:
+                    log.warning("automl best-of-family ensemble failed: %s", e)
+            if len(with_cv) > max(2, len(best_of_family)):
+                try:
+                    se2 = StackedEnsembleEstimator(
+                        base_models=with_cv[:10]).train(
+                        training_frame, y=y, x=x)
+                    se2.output["automl_step"] = "StackedEnsemble_AllModels"
+                    self.leaderboard_obj.add(se2)
+                except Exception as e:
+                    log.warning("automl all-models ensemble failed: %s", e)
+
+        log.info("automl done: %d models in %.0fs; leader=%s",
+                 len(self.leaderboard_obj.models), time.time() - t0,
+                 self.leader.key if self.leader else None)
+        return self.leader

@@ -43,13 +43,22 @@ class BinnedMatrix:
         return len(self.names)
 
 
-def _numeric_edges(x: np.ndarray, nbins: int) -> np.ndarray:
-    """Quantile bin edges over valid values (the QuantilesGlobal histogram
-    type of the reference, hex/tree/SharedTree; default hist behavior of
-    its XGBoost extension)."""
+def _numeric_edges(x: np.ndarray, nbins: int,
+                   method: str = "quantiles") -> np.ndarray:
+    """Bin edges over valid values. method='quantiles' is the
+    QuantilesGlobal histogram type (hex/tree/SharedTree; default hist
+    behavior of the reference's XGBoost extension); 'uniform' is the
+    equal-width UniformAdaptive type (hex/tree/DHistogram.java min/maxEx
+    range binning) — required by IsolationForest, whose random thresholds
+    must be uniform over the VALUE range, not the rank space."""
     v = x[np.isfinite(x)]
     if v.size == 0:
         return np.zeros((0,), dtype=np.float32)
+    if method == "uniform":
+        lo, hi = float(v.min()), float(v.max())
+        if hi <= lo:
+            return np.zeros((0,), dtype=np.float32)
+        return np.linspace(lo, hi, nbins + 1)[1:-1].astype(np.float32)
     if v.size > 200_000:  # sketch on a sample, like the reference's ExactQuantilesToUse cap
         rng = np.random.RandomState(0xC0FFEE)
         v = v[rng.randint(0, v.size, 200_000)]
@@ -62,7 +71,8 @@ def bin_frame(frame: Frame, features: Sequence[str], nbins: int = 64,
               nbins_cats: int = 64,
               edges_override: Optional[List[np.ndarray]] = None,
               nbins_total_override: Optional[int] = None,
-              train_domains: Optional[List[Optional[List[str]]]] = None) -> BinnedMatrix:
+              train_domains: Optional[List[Optional[List[str]]]] = None,
+              histogram_type: str = "quantiles") -> BinnedMatrix:
     """Bin ``features`` of ``frame`` into a device int matrix.
 
     ``edges_override``/``train_domains`` re-bin a scoring frame with
@@ -90,7 +100,7 @@ def bin_frame(frame: Frame, features: Sequence[str], nbins: int = 64,
             if edges_override is not None:
                 e = edges_override[i]
             else:
-                e = _numeric_edges(c.to_numpy(), nbins)
+                e = _numeric_edges(c.to_numpy(), nbins, histogram_type)
             nb[i] = len(e) + 1
             edge_list.append(e)
 

@@ -91,6 +91,11 @@ def column_from_numpy(name: str, values: np.ndarray, nrows_padded: int,
             codes, uniques = pd.factorize(values, sort=True)
             domain = [str(u) for u in uniques]
             values = codes.astype(np.int32)
+        else:
+            # explicit domain: map labels to codes, unseen/None → NA
+            lut = {lvl: i for i, lvl in enumerate(domain)}
+            values = np.asarray([lut.get(v, -1) if v is not None else -1
+                                 for v in values], np.int32)
         na = values < 0
         data = np.where(na, 0, values).astype(np.int32)
         ctype = T_CAT

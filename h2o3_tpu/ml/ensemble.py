@@ -1,0 +1,146 @@
+"""StackedEnsemble — level-one frame from base-model CV predictions +
+metalearner.
+
+Reference: hex/ensemble/StackedEnsemble.java:29 — the level-one training
+frame is assembled from each base model's cross-validation HOLDOUT
+predictions (StackedEnsemble.java:205), so the metalearner never sees a
+base model's in-bag fit; default metalearner is GLM
+(hex/ensemble/Metalearners.java), any algo allowed.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from h2o3_tpu.frame.frame import Frame
+from h2o3_tpu.models import get_builder
+from h2o3_tpu.models.model import Model, ModelBuilder, ModelCategory
+
+
+def _level_one_columns(model, frame: Optional[Frame]) -> Dict[str, np.ndarray]:
+    """Base-model prediction columns: CV holdout (train time) or fresh
+    predictions on ``frame`` (scoring time)."""
+    cat = model.output["category"]
+    mid = model.key
+    if frame is None:
+        h = model._cv_holdout
+        if cat == ModelCategory.MULTINOMIAL:
+            return {f"{mid}_p{k}": h[:, k] for k in range(h.shape[1])}
+        return {mid: h}
+    preds = model._score_raw(frame)
+    if cat == ModelCategory.BINOMIAL:
+        return {mid: np.asarray(preds["p1"])}
+    if cat == ModelCategory.MULTINOMIAL:
+        K = model.output["nclasses"]
+        return {f"{mid}_p{k}": np.asarray(preds[f"p{k}"]) for k in range(K)}
+    return {mid: np.asarray(preds["predict"])}
+
+
+def _with_response(arrs: Dict[str, np.ndarray], yc, y: str, n: int) -> Frame:
+    """Attach the response column preserving NAs (NA rows must NOT become
+    class-0 labels — the metalearner excludes them like any builder)."""
+    arrs = dict(arrs)
+    if yc.is_categorical:
+        codes = np.asarray(yc.data)[:n].copy()
+        na = np.asarray(yc.na_mask)[:n]
+        dom = yc.domain
+        labels = np.asarray(dom, dtype=object)[np.maximum(codes, 0)]
+        labels[na] = None
+        arrs[y] = labels
+        return Frame.from_numpy(arrs, categorical=[y], domains={y: dom})
+    arrs[y] = yc.to_numpy()
+    return Frame.from_numpy(arrs)
+
+
+class StackedEnsembleModel(Model):
+    algo = "stackedensemble"
+
+    def __init__(self, params, output, base_models: List,
+                 metalearner: Model):
+        super().__init__(params, output)
+        self.base_models = base_models
+        self.metalearner = metalearner
+
+    def _level_one(self, frame: Frame) -> Frame:
+        cols: Dict[str, np.ndarray] = {}
+        for m in self.base_models:
+            cols.update(_level_one_columns(m, frame))
+        return Frame.from_numpy(cols)
+
+    def _score_raw(self, frame: Frame) -> Dict[str, np.ndarray]:
+        return self.metalearner._score_raw(self._level_one(frame))
+
+    def model_performance(self, frame: Frame):
+        l1f = self._level_one(frame)
+        y = self.output["response"]
+        arrs = {n: l1f.col(n).to_numpy() for n in l1f.names}
+        l1y = _with_response(arrs, frame.col(y), y, frame.nrows)
+        return self.metalearner.model_performance(l1y)
+
+
+class StackedEnsembleEstimator(ModelBuilder):
+    """h2o-py H2OStackedEnsembleEstimator-compatible surface."""
+
+    algo = "stackedensemble"
+
+    DEFAULTS = dict(
+        base_models=(), metalearner_algorithm="AUTO",
+        metalearner_params=None, metalearner_nfolds=0, seed=-1,
+        ignored_columns=None,
+    )
+
+    def __init__(self, **params):
+        merged = dict(self.DEFAULTS)
+        unknown = set(params) - set(merged)
+        if unknown:
+            raise ValueError(f"unknown StackedEnsemble params: {sorted(unknown)}")
+        merged.update(params)
+        super().__init__(**merged)
+
+    def _fit(self, frame: Frame, x: Sequence[str], y: Optional[str],
+             job, validation_frame: Optional[Frame] = None) -> Model:
+        p = self.params
+        from h2o3_tpu.core.kv import DKV
+        base = [m if isinstance(m, Model) else DKV.get(m)
+                for m in p["base_models"]]
+        if len(base) < 2:
+            raise ValueError("StackedEnsemble needs >= 2 base models")
+        for m in base:
+            if getattr(m, "_cv_holdout", None) is None:
+                raise ValueError(
+                    f"base model {m.key} lacks CV holdout predictions; "
+                    "train base models with nfolds >= 2")
+        cat = base[0].output["category"]
+
+        # level-one training frame from CV holdouts (StackedEnsemble.java:205)
+        cols: Dict[str, np.ndarray] = {}
+        for m in base:
+            cols.update(_level_one_columns(m, None))
+        l1f = _with_response(cols, frame.col(y), y, frame.nrows)
+
+        meta_algo = str(p["metalearner_algorithm"]).lower()
+        meta_params = dict(p["metalearner_params"] or {})
+        if meta_algo == "auto":
+            meta_algo = "glm"
+            # AUTO default: non-negative GLM weights (Metalearners.java)
+            meta_params.setdefault("lambda_", 0.0)
+        if int(p["metalearner_nfolds"]):
+            meta_params["nfolds"] = int(p["metalearner_nfolds"])
+        builder = get_builder(meta_algo)(**meta_params)
+        job.update(0.5, "training metalearner")
+        meta = builder.train(l1f, y=y)
+
+        output = {"category": cat, "response": y,
+                  "names": [m.key for m in base],
+                  "nclasses": base[0].output.get("nclasses", 1),
+                  "domain": base[0].output.get("domain"),
+                  "metalearner": meta.key,
+                  "base_models": [m.key for m in base]}
+        model = StackedEnsembleModel(p, output, base, meta)
+        model.training_metrics = meta.training_metrics
+        model.cross_validation_metrics = meta.cross_validation_metrics
+        if validation_frame is not None:
+            model.validation_metrics = model.model_performance(validation_frame)
+        return model

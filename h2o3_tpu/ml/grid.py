@@ -1,0 +1,138 @@
+"""Grid search — cartesian + random-discrete hyperparameter walks.
+
+Reference: hex/grid/GridSearch.java:70 (startGridSearch at :662) with
+HyperSpaceWalker strategies (Cartesian, RandomDiscrete with max_models /
+max_runtime_secs / seed budgets) and the Grid key'd model collection.
+Model-parallel training over spare mesh slices is reference parallelism
+#5 (SURVEY §2.4); here candidates run sequentially on the one mesh —
+each candidate itself uses the full mesh.
+"""
+
+from __future__ import annotations
+
+import itertools
+import time
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from h2o3_tpu.core.job import Job
+from h2o3_tpu.core.kv import DKV, make_key
+from h2o3_tpu.utils.log import get_logger
+
+log = get_logger("h2o3_tpu.grid")
+
+# lower-is-better metrics (hex/ModelMetrics sort contract)
+_ASC = {"logloss", "rmse", "mse", "mae", "mean_per_class_error",
+        "mean_residual_deviance", "error_rate", "rmsle"}
+
+
+def sort_value(model, metric: str):
+    mmx = model.default_metrics
+    d = mmx.to_dict() if hasattr(mmx, "to_dict") else dict(mmx or {})
+    aliases = {"auc": "AUC", "gini": "Gini", "rmse": "RMSE", "mse": "MSE"}
+    key = aliases.get(metric.lower(), metric)
+    if key not in d and metric in d:
+        key = metric
+    return d.get(key)
+
+
+def default_sort_metric(model) -> str:
+    cat = model.output.get("category")
+    if cat == "Binomial":
+        return "auc"
+    if cat == "Multinomial":
+        return "mean_per_class_error"
+    return "mean_residual_deviance"
+
+
+class Grid:
+    """Trained-grid result (hex/grid/Grid.java)."""
+
+    def __init__(self, grid_id: str, models: List, failures: List[dict],
+                 sort_metric: str):
+        self.grid_id = grid_id
+        self.models = models
+        self.failures = failures
+        self.sort_metric = sort_metric
+        DKV.put(grid_id, self)
+
+    @property
+    def model_ids(self) -> List[str]:
+        return [m.key for m in self.models]
+
+    def sorted_models(self, metric: Optional[str] = None,
+                      decreasing: Optional[bool] = None) -> List:
+        metric = metric or self.sort_metric
+        vals = [(sort_value(m, metric), m) for m in self.models]
+        vals = [(v, m) for v, m in vals if v is not None]
+        if decreasing is None:
+            decreasing = metric.lower() not in _ASC
+        return [m for _, m in sorted(vals, key=lambda t: t[0],
+                                     reverse=decreasing)]
+
+    def summary_table(self, metric: Optional[str] = None) -> List[dict]:
+        metric = metric or self.sort_metric
+        return [{"model_id": m.key, metric: sort_value(m, metric)}
+                for m in self.sorted_models(metric)]
+
+
+class GridSearch:
+    """hex/grid/GridSearch.java driver.
+
+    strategy: 'Cartesian' walks the full cross product;
+    'RandomDiscrete' samples without replacement under max_models /
+    max_runtime_secs budgets (HyperSpaceWalker.RandomDiscreteValueWalker).
+    """
+
+    def __init__(self, builder_cls, hyper_params: Dict[str, Sequence],
+                 search_criteria: Optional[dict] = None, grid_id: str = None,
+                 **fixed_params):
+        self.builder_cls = builder_cls
+        self.hyper_params = {k: list(v) for k, v in hyper_params.items()}
+        self.criteria = dict(search_criteria or {"strategy": "Cartesian"})
+        self.fixed = fixed_params
+        self.grid_id = grid_id or make_key(f"grid_{builder_cls.algo}")
+
+    def _combos(self) -> List[dict]:
+        names = sorted(self.hyper_params)
+        all_combos = [dict(zip(names, vals)) for vals in
+                      itertools.product(*(self.hyper_params[n] for n in names))]
+        strat = str(self.criteria.get("strategy", "Cartesian")).lower()
+        if strat == "randomdiscrete":
+            seed = int(self.criteria.get("seed", -1))
+            rng = np.random.RandomState(seed if seed >= 0 else None)
+            rng.shuffle(all_combos)
+            mx = int(self.criteria.get("max_models", 0))
+            if mx > 0:
+                all_combos = all_combos[:mx]
+        return all_combos
+
+    def train(self, training_frame, y: Optional[str] = None,
+              x: Optional[Sequence[str]] = None,
+              validation_frame=None) -> Grid:
+        combos = self._combos()
+        budget_s = float(self.criteria.get("max_runtime_secs", 0) or 0)
+        t0 = time.time()
+        models, failures = [], []
+        job = Job(f"grid {self.builder_cls.algo}", work=float(len(combos)))
+        job.status = "RUNNING"
+        for i, combo in enumerate(combos):
+            if budget_s and time.time() - t0 > budget_s:
+                log.info("grid budget exhausted after %d models", len(models))
+                break
+            params = {**self.fixed, **combo}
+            try:
+                b = self.builder_cls(**params)
+                m = b.train(training_frame, y=y, x=x,
+                            validation_frame=validation_frame)
+                m.output["grid_params"] = combo
+                models.append(m)
+            except Exception as e:   # failed combos recorded, walk continues
+                log.warning("grid combo %s failed: %s", combo, e)
+                failures.append({"params": combo, "error": str(e)})
+            job.update(1.0, f"model {i + 1}/{len(combos)}")
+        job.status = "DONE"
+        sort_metric = (self.criteria.get("sort_metric")
+                       or (default_sort_metric(models[0]) if models else "mse"))
+        return Grid(self.grid_id, models, failures, sort_metric)

@@ -1,1 +1,51 @@
+"""Model layer — algorithm registry + estimator exports.
 
+The registry is the analogue of the reference's ServiceLoader algorithm
+registration (hex/api/RegisterAlgos.java:17-43): every ModelBuilder
+registers under its algo name so REST / grid search / AutoML can
+instantiate builders by name.
+"""
+
+from typing import Dict
+
+_REGISTRY: Dict[str, type] = {}
+
+
+def register(cls):
+    _REGISTRY[cls.algo] = cls
+    return cls
+
+
+def _auto_register():
+    """Populate the registry from the standard estimator modules."""
+    from h2o3_tpu.models.deeplearning import DeepLearningEstimator
+    from h2o3_tpu.models.drf import DRFEstimator
+    from h2o3_tpu.models.gbm import GBMEstimator
+    from h2o3_tpu.models.glm import GLMEstimator
+    from h2o3_tpu.models.glrm import GLRMEstimator
+    from h2o3_tpu.models.isofor import IsolationForestEstimator
+    from h2o3_tpu.models.isotonic import IsotonicRegressionEstimator
+    from h2o3_tpu.models.kmeans import KMeansEstimator
+    from h2o3_tpu.models.naivebayes import NaiveBayesEstimator
+    from h2o3_tpu.models.pca import PCAEstimator, SVDEstimator
+    for cls in (DeepLearningEstimator, DRFEstimator, GBMEstimator,
+                GLMEstimator, GLRMEstimator, IsolationForestEstimator,
+                IsotonicRegressionEstimator, KMeansEstimator,
+                NaiveBayesEstimator, PCAEstimator, SVDEstimator):
+        _REGISTRY[cls.algo] = cls
+
+
+def get_builder(algo: str):
+    """Builder class by algo name (ModelBuilder.make analogue)."""
+    if not _REGISTRY:
+        _auto_register()
+    key = algo.lower().replace("_", "")
+    if key not in _REGISTRY:
+        raise ValueError(f"unknown algo '{algo}'; have {sorted(_REGISTRY)}")
+    return _REGISTRY[key]
+
+
+def all_algos():
+    if not _REGISTRY:
+        _auto_register()
+    return sorted(_REGISTRY)

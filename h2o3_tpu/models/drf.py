@@ -1,0 +1,287 @@
+"""DRF — distributed random forest on the shared tree machinery.
+
+Reference: hex/tree/drf/DRF.java:30 on the SharedTree skeleton.
+Differences from GBM that this file reproduces:
+- each tree is an independent regression tree on the raw response
+  (indicator per class for classification), trained on a bagged row
+  sample (sample_rate, default 0.632) — no shrinkage, no margins;
+- per-NODE column subsampling of exactly `mtries` columns
+  (DRF.java mtries: -1 → sqrt(p) classification / p/3 regression);
+- prediction = average of per-tree leaf means (votes);
+- training metrics are OOB: every row is scored only by the trees whose
+  bag excluded it (DRF.java OOB scoring via Sample/Score).
+
+TPU redesign: one jitted `_bag_step` per tree — bag mask, grow_tree with
+(g=-y, h=1) so the Newton leaf value is the bag-weighted mean of y, and
+OOB accumulator updates — all on device; rows stay sharded on the mesh
+'data' axis throughout.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Dict, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from h2o3_tpu.frame.binning import BinnedMatrix, bin_frame, rebin_for_scoring
+from h2o3_tpu.frame.frame import Frame
+from h2o3_tpu.models import metrics as mm
+from h2o3_tpu.models.model import (Model, ModelBuilder, ModelCategory,
+                                   adapt_domain, infer_category)
+from h2o3_tpu.models.tree import (Tree, TreeParams, grow_tree, predict_forest,
+                                  stack_trees)
+from h2o3_tpu.parallel.mesh import get_mesh, row_sharding
+from h2o3_tpu.utils.log import get_logger
+
+log = get_logger("h2o3_tpu.drf")
+
+MAX_COMPLETE_DEPTH = 14  # complete-tree layout: histograms are 2^d·F·B·3
+
+
+@partial(jax.jit, static_argnames=("tp", "sample_rate", "mtries", "n_class"))
+def _bag_step(bins, nb, ys, w, oob_sum, oob_cnt, key, *, tp: TreeParams,
+              sample_rate: float, mtries: int, n_class: int):
+    """One forest iteration: bag rows, grow n_class mean-value trees,
+    update OOB accumulators. ys: [N, n_class] float targets."""
+    mesh = get_mesh()
+    kb, kc1, kc2, kt = jax.random.split(key, 4)
+    keep = jax.random.bernoulli(kb, sample_rate, shape=w.shape)
+    wbag = w * keep.astype(jnp.float32)
+    oob = (w > 0) & ~keep
+    F = bins.shape[1]
+    # per-tree column sampling (col_sample_rate_per_tree), one col forced
+    if tp.col_sample_rate < 1.0:
+        col_mask = (jax.random.bernoulli(kc1, tp.col_sample_rate, (F,))
+                    | (jnp.arange(F) == jax.random.randint(kc2, (), 0, F)))
+    else:
+        col_mask = jnp.ones((F,), bool)
+    trees = []
+    gains_tot = jnp.zeros((F,), jnp.float32)
+    for k in range(n_class):
+        kt, sub = jax.random.split(kt)
+        yk = ys[:, k]
+        # g=-y, h=1 ⇒ leaf value = Σ w·y / (Σ w + λ): the bagged leaf mean
+        tree, nid, gains = grow_tree(bins, nb, wbag, -yk, jnp.ones_like(yk),
+                                     col_mask, params=tp, mesh=mesh,
+                                     mtries=mtries, key=sub)
+        trees.append(tree)
+        gains_tot = gains_tot + gains
+        pred = tree.leaf[nid]          # routing nid is bag-independent
+        oob_sum = oob_sum.at[:, k].add(jnp.where(oob, pred, 0.0))
+    oob_cnt = oob_cnt + oob.astype(jnp.float32)
+    return stack_trees(trees), oob_sum, oob_cnt, gains_tot
+
+
+class DRFModel(Model):
+    algo = "drf"
+
+    def __init__(self, params, output, forest: Tree, bm: BinnedMatrix,
+                 ntrees: int):
+        super().__init__(params, output)
+        self.forest = forest           # [T*K, D, Lmax]
+        self.bm = bm
+        self.ntrees = ntrees
+
+    def _mean_votes(self, bm: BinnedMatrix):
+        """Per-class average tree output [N, K]."""
+        B = self.bm.nbins_total
+        K = max(1, self.output.get("nclasses", 1)
+                if self.output["category"] != ModelCategory.REGRESSION else 1)
+        if self.output["category"] == ModelCategory.BINOMIAL:
+            K = 1
+        T = self.forest.feat.shape[0] // K
+        outs = []
+        for k in range(K):
+            f = Tree(*(a.reshape((T, K) + a.shape[1:])[:, k]
+                       for a in self.forest))
+            outs.append(predict_forest(f, bm.bins, B) / T)
+        return jnp.stack(outs, axis=1)
+
+    def _probs(self, bm: BinnedMatrix):
+        cat = self.output["category"]
+        votes = self._mean_votes(bm)
+        if cat == ModelCategory.BINOMIAL:
+            p1 = jnp.clip(votes[:, 0], 0.0, 1.0)
+            return jnp.stack([1.0 - p1, p1], axis=1)
+        s = jnp.sum(votes, axis=1, keepdims=True)
+        return jnp.clip(votes, 0.0, 1.0) / jnp.maximum(s, 1e-12)
+
+    def _score_raw(self, frame: Frame) -> Dict[str, np.ndarray]:
+        bm = rebin_for_scoring(self.bm, frame)
+        n = frame.nrows
+        cat = self.output["category"]
+        if cat == ModelCategory.REGRESSION:
+            return {"predict": np.asarray(self._mean_votes(bm))[:n, 0]}
+        p = np.asarray(self._probs(bm))[:n]
+        if cat == ModelCategory.BINOMIAL:
+            t = self.output.get("default_threshold", 0.5)
+            return {"predict": (p[:, 1] >= t).astype(np.int32),
+                    "p0": p[:, 0], "p1": p[:, 1]}
+        out = {"predict": p.argmax(axis=1).astype(np.int32)}
+        for k in range(p.shape[1]):
+            out[f"p{k}"] = p[:, k]
+        return out
+
+    def model_performance(self, frame: Frame):
+        y = self.output["response"]
+        bm = rebin_for_scoring(self.bm, frame)
+        w = frame.valid_weights()
+        wc = self.params.get("weights_column")
+        if wc and wc in frame:
+            v = frame.col(wc).numeric_view()
+            w = w * jnp.where(jnp.isnan(v), 0.0, v)
+        cat = self.output["category"]
+        if cat == ModelCategory.REGRESSION:
+            yv = frame.col(y).numeric_view()
+            w = w * jnp.where(jnp.isnan(yv), 0.0, 1.0)
+            yv = jnp.where(jnp.isnan(yv), 0.0, yv)
+            return mm.regression_metrics(self._mean_votes(bm)[:, 0], yv, w)
+        yv = adapt_domain(frame.col(y), self.output["domain"])
+        yv = np.pad(yv, (0, bm.bins.shape[0] - frame.nrows), constant_values=-1)
+        w = w * jnp.asarray((yv >= 0).astype(np.float32))
+        yv = np.maximum(yv, 0)
+        p = self._probs(bm)
+        if cat == ModelCategory.BINOMIAL:
+            return mm.binomial_metrics(p[:, 1], jnp.asarray(yv.astype(np.float32)), w)
+        return mm.multinomial_metrics(p, jnp.asarray(yv), w,
+                                      domain=self.output["domain"])
+
+    @property
+    def varimp_table(self) -> List:
+        return self.output.get("varimp") or []
+
+
+class DRFEstimator(ModelBuilder):
+    """h2o-py H2ORandomForestEstimator-compatible surface."""
+
+    algo = "drf"
+
+    DEFAULTS = dict(
+        ntrees=50, max_depth=20, min_rows=1.0, nbins=20, nbins_cats=1024,
+        mtries=-1, sample_rate=0.632, col_sample_rate_per_tree=1.0,
+        min_split_improvement=1e-5, seed=-1, nfolds=0,
+        weights_column=None, fold_column=None, fold_assignment="auto",
+        ignored_columns=None, stopping_rounds=0, stopping_metric="auto",
+        stopping_tolerance=1e-3, binomial_double_trees=False,
+        distribution="auto", calibrate_model=False,
+    )
+
+    def __init__(self, **params):
+        merged = dict(self.DEFAULTS)
+        unknown = set(params) - set(merged)
+        if unknown:
+            raise ValueError(f"unknown DRF params: {sorted(unknown)}")
+        merged.update(params)
+        super().__init__(**merged)
+
+    def _fit(self, frame: Frame, x: Sequence[str], y: Optional[str],
+             job, validation_frame: Optional[Frame] = None) -> Model:
+        p = self.params
+        mesh = get_mesh()
+        category = infer_category(frame, y)
+        bm = bin_frame(frame, x, nbins=p["nbins"], nbins_cats=p["nbins_cats"])
+        w = frame.valid_weights()
+        if p.get("weights_column"):
+            wc = frame.col(p["weights_column"]).numeric_view()
+            w = w * jnp.where(jnp.isnan(wc), 0.0, wc)
+        rc = frame.col(y)
+        resp_na = np.asarray(rc.na_mask)[: frame.nrows]
+        if resp_na.any():
+            w = w * jnp.asarray((~resp_na).astype(np.float32))
+
+        depth = int(p["max_depth"])
+        if depth > MAX_COMPLETE_DEPTH:
+            log.warning("DRF max_depth=%d clamped to %d (complete-tree TPU "
+                        "layout)", depth, MAX_COMPLETE_DEPTH)
+            depth = MAX_COMPLETE_DEPTH
+        F = len(x)
+        mtries = int(p["mtries"])
+        if mtries == -1:
+            mtries = (max(1, int(np.sqrt(F)))
+                      if category != ModelCategory.REGRESSION
+                      else max(1, F // 3))
+        elif mtries <= 0:
+            mtries = F
+        tp = TreeParams(
+            max_depth=depth, min_rows=float(p["min_rows"]), learn_rate=1.0,
+            reg_lambda=0.0,
+            min_split_improvement=float(p["min_split_improvement"]),
+            col_sample_rate=float(p["col_sample_rate_per_tree"]),
+            nbins_total=bm.nbins_total)
+
+        # target matrix ys [Npad, K]: indicators for classification
+        N = bm.bins.shape[0]
+        if category == ModelCategory.REGRESSION:
+            K = 1
+            yv = np.nan_to_num(rc.to_numpy()).astype(np.float32)
+            ys = np.pad(yv, (0, N - frame.nrows))[:, None]
+            y_int = None
+        else:
+            codes = np.asarray(rc.data)[: frame.nrows].astype(np.int32)
+            codes[resp_na] = 0
+            codes = np.pad(codes, (0, N - frame.nrows))
+            K = 1 if category == ModelCategory.BINOMIAL else rc.cardinality
+            if K == 1:
+                ys = (codes == 1).astype(np.float32)[:, None]
+            else:
+                ys = (codes[:, None] == np.arange(K)[None, :]).astype(np.float32)
+            y_int = jax.device_put(codes, row_sharding(mesh))
+        ys = jax.device_put(ys, row_sharding(mesh))
+
+        seed = int(p["seed"]) if int(p["seed"]) >= 0 else 0xD2F
+        key = jax.random.PRNGKey(seed)
+        ntrees = int(p["ntrees"])
+        oob_sum = jnp.zeros((N, K), jnp.float32)
+        oob_cnt = jnp.zeros((N,), jnp.float32)
+        oob_sum = jax.device_put(oob_sum, row_sharding(mesh))
+        oob_cnt = jax.device_put(oob_cnt, row_sharding(mesh))
+        trees: List[Tree] = []
+        gains_total = np.zeros(F, np.float32)
+        for t in range(ntrees):
+            key, sub = jax.random.split(key)
+            tr, oob_sum, oob_cnt, gains = _bag_step(
+                bm.bins, bm.nbins, ys, w, oob_sum, oob_cnt, sub, tp=tp,
+                sample_rate=float(p["sample_rate"]), mtries=mtries, n_class=K)
+            trees.append(tr)
+            gains_total += np.asarray(gains)
+            job.update(1.0 / ntrees, f"tree {t + 1}/{ntrees}")
+
+        forest = Tree(*(jnp.concatenate([getattr(t, f) for t in trees])
+                        for f in Tree._fields))
+        output = {"category": category, "response": y, "names": list(x),
+                  "nclasses": rc.cardinality if rc.is_categorical else 1,
+                  "domain": rc.domain}
+        model = DRFModel(p, output, forest, bm, ntrees)
+
+        # OOB training metrics (rows never out-of-bag drop out via weight)
+        w_oob = w * (oob_cnt > 0).astype(jnp.float32)
+        mean_oob = oob_sum / jnp.maximum(oob_cnt[:, None], 1.0)
+        if category == ModelCategory.REGRESSION:
+            yv = jnp.asarray(np.pad(np.nan_to_num(rc.to_numpy()).astype(np.float32),
+                                    (0, N - frame.nrows)))
+            model.training_metrics = mm.regression_metrics(
+                mean_oob[:, 0], yv, w_oob)
+        elif category == ModelCategory.BINOMIAL:
+            p1 = jnp.clip(mean_oob[:, 0], 0.0, 1.0)
+            model.training_metrics = mm.binomial_metrics(
+                p1, (y_int == 1).astype(jnp.float32), w_oob)
+            model.output["default_threshold"] = \
+                model.training_metrics["max_f1_threshold"]
+        else:
+            s = jnp.sum(mean_oob, axis=1, keepdims=True)
+            probs = jnp.clip(mean_oob, 0.0, 1.0) / jnp.maximum(s, 1e-12)
+            model.training_metrics = mm.multinomial_metrics(
+                probs, y_int, w_oob, domain=rc.domain)
+
+        vi = gains_total
+        order = np.argsort(-vi)
+        tot = vi.sum() or 1.0
+        model.output["varimp"] = [
+            (x[i], float(vi[i]), float(vi[i] / max(vi.max(), 1e-12)),
+             float(vi[i] / tot)) for i in order]
+        if validation_frame is not None:
+            model.validation_metrics = model.model_performance(validation_frame)
+        return model

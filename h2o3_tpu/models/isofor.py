@@ -1,0 +1,183 @@
+"""Isolation Forest — anomaly detection via random isolation trees.
+
+Reference: hex/tree/isofor/IsolationForest.java:33 (random splits on a
+row subsample, anomaly score from average isolation depth; output frame
+has `predict` (normalized score) and `mean_length`).
+
+TPU redesign: a tree is the same complete-binary-tree layout as
+models/tree.py but splits are RANDOM (feature ~ U[F], threshold ~
+U[0, nbins(f)-1)) so no histograms are needed — one `lax`-free jitted
+pass per tree computes per-level node counts (segment_sum + psum over
+the mesh) to mark isolated nodes. Path length of a row = number of
+levels traversed while its node was still splitting, plus the standard
+c(n) correction at the final leaf (Liu et al.); anomaly score
+2^(-E[h]/c(sample_size)).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Dict, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from h2o3_tpu.frame.binning import BinnedMatrix, bin_frame, rebin_for_scoring
+from h2o3_tpu.frame.frame import Frame
+from h2o3_tpu.models.model import Model, ModelBuilder, ModelCategory
+from h2o3_tpu.models.tree import Tree, stack_trees
+from h2o3_tpu.ops.segments import segment_sum
+from h2o3_tpu.parallel.mesh import get_mesh
+
+
+def _avg_path_correction(n):
+    """c(n): expected remaining path length in an unresolved subsample."""
+    h = jnp.log(jnp.maximum(n - 1.0, 1.0)) + 0.5772156649
+    c = 2.0 * h - 2.0 * (n - 1.0) / jnp.maximum(n, 1.0)
+    return jnp.where(n > 2.0, c, jnp.where(n == 2.0, 1.0, 0.0))
+
+
+@partial(jax.jit, static_argnames=("depth", "B"))
+def _grow_random_tree(bins, nb, w, key, *, depth: int, B: int):
+    """One isolation tree: random (feature, threshold) per node; a node
+    stops being a 'split' once its bagged row count drops to <= 1."""
+    mesh = get_mesh()
+    F = bins.shape[1]
+    Lmax = 2 ** (depth - 1) if depth > 0 else 1
+    N = bins.shape[0]
+    nid = jnp.zeros((N,), jnp.int32)
+    feats = jnp.zeros((depth, Lmax), jnp.int32)
+    threshs = jnp.full((depth, Lmax), B, jnp.int32)
+    na_lefts = jnp.zeros((depth, Lmax), bool)
+    is_splits = jnp.zeros((depth, Lmax), bool)
+    for d in range(depth):
+        L = 2 ** d
+        key, kf, kt, kn = jax.random.split(key, 4)
+        f = jax.random.randint(kf, (L,), 0, F)
+        # threshold uniform over the feature's real bins [0, nb[f]-2]
+        u = jax.random.uniform(kt, (L,))
+        t = (u * jnp.maximum(nb[f] - 1, 1).astype(jnp.float32)).astype(jnp.int32)
+        nal = jax.random.bernoulli(kn, 0.5, (L,))
+        cnt = segment_sum(nid, w[:, None], n_nodes=L, mesh=mesh)[:, 0]
+        split = cnt > 1.0
+        feats = feats.at[d, :L].set(f)
+        threshs = threshs.at[d, :L].set(jnp.where(split, t, B))
+        na_lefts = na_lefts.at[d, :L].set(nal)
+        is_splits = is_splits.at[d, :L].set(split)
+        f_r = feats[d][nid]
+        t_r = threshs[d][nid]
+        nal_r = na_lefts[d][nid]
+        b_r = jnp.take_along_axis(bins, f_r[:, None], axis=1)[:, 0]
+        isna = b_r == (B - 1)
+        goleft = jnp.where(is_splits[d][nid],
+                           jnp.where(isna, nal_r, b_r <= t_r), True)
+        nid = 2 * nid + jnp.where(goleft, 0, 1)
+    leaf_cnt = segment_sum(nid, w[:, None], n_nodes=2 ** depth, mesh=mesh)[:, 0]
+    leaf = _avg_path_correction(leaf_cnt)
+    return Tree(feats, threshs, na_lefts, is_splits, leaf)
+
+
+def _tree_path_length(tree: Tree, bins, B: int):
+    """Per-row isolation path length through one tree."""
+    N = bins.shape[0]
+    D = tree.feat.shape[0]
+    nid = jnp.zeros((N,), jnp.int32)
+    plen = jnp.zeros((N,), jnp.float32)
+    for d in range(D):
+        isp_r = tree.is_split[d][nid]
+        plen = plen + isp_r.astype(jnp.float32)
+        f_r = tree.feat[d][nid]
+        t_r = tree.thresh[d][nid]
+        nal_r = tree.na_left[d][nid]
+        b_r = jnp.take_along_axis(bins, f_r[:, None], axis=1)[:, 0]
+        isna = b_r == (B - 1)
+        goleft = jnp.where(isp_r, jnp.where(isna, nal_r, b_r <= t_r), True)
+        nid = 2 * nid + jnp.where(goleft, 0, 1)
+    return plen + tree.leaf[nid]
+
+
+@partial(jax.jit, static_argnames=("B",))
+def _forest_mean_length(stacked: Tree, bins, B: int):
+    def step(acc, tree):
+        return acc + _tree_path_length(tree, bins, B), None
+    init = jnp.zeros((bins.shape[0],), jnp.float32)
+    tot, _ = jax.lax.scan(step, init, stacked)
+    return tot / stacked.feat.shape[0]
+
+
+class IsolationForestModel(Model):
+    algo = "isolationforest"
+
+    def __init__(self, params, output, forest: Tree, bm: BinnedMatrix,
+                 c_norm: float):
+        super().__init__(params, output)
+        self.forest = forest
+        self.bm = bm
+        self.c_norm = c_norm   # c(sample_size) — score normalizer
+
+    def _score_raw(self, frame: Frame) -> Dict[str, np.ndarray]:
+        bm = rebin_for_scoring(self.bm, frame)
+        ml = _forest_mean_length(self.forest, bm.bins, self.bm.nbins_total)
+        n = frame.nrows
+        ml = np.asarray(ml)[:n]
+        score = 2.0 ** (-ml / max(self.c_norm, 1e-12))
+        return {"predict": score, "mean_length": ml}
+
+    def model_performance(self, frame: Frame):
+        raw = self._score_raw(frame)
+        return {"mean_score": float(raw["predict"].mean()),
+                "mean_length": float(raw["mean_length"].mean())}
+
+
+class IsolationForestEstimator(ModelBuilder):
+    """h2o-py H2OIsolationForestEstimator-compatible surface."""
+
+    algo = "isolationforest"
+    supervised = False
+
+    DEFAULTS = dict(
+        ntrees=50, sample_size=256, sample_rate=-1.0, max_depth=8,
+        mtries=-1, nbins=64, nbins_cats=64, seed=-1,
+        ignored_columns=None, contamination=-1.0,
+    )
+
+    def __init__(self, **params):
+        merged = dict(self.DEFAULTS)
+        unknown = set(params) - set(merged)
+        if unknown:
+            raise ValueError(f"unknown IsolationForest params: {sorted(unknown)}")
+        merged.update(params)
+        super().__init__(**merged)
+
+    def _fit(self, frame: Frame, x: Sequence[str], y: Optional[str],
+             job, validation_frame: Optional[Frame] = None) -> Model:
+        p = self.params
+        bm = bin_frame(frame, x, nbins=p["nbins"], nbins_cats=p["nbins_cats"],
+                       histogram_type="uniform")
+        w = frame.valid_weights()
+        n = frame.nrows
+        rate = float(p["sample_rate"])
+        psi = int(p["sample_size"])
+        if rate > 0:
+            psi = max(2, int(rate * n))
+        bag_rate = min(1.0, psi / max(n, 1))
+        depth = int(p["max_depth"])
+        seed = int(p["seed"]) if int(p["seed"]) >= 0 else 0x150F
+        key = jax.random.PRNGKey(seed)
+        ntrees = int(p["ntrees"])
+        trees = []
+        for t in range(ntrees):
+            key, kb, kt = jax.random.split(key, 3)
+            keep = jax.random.bernoulli(kb, bag_rate, shape=w.shape)
+            trees.append(_grow_random_tree(bm.bins, bm.nbins,
+                                           w * keep.astype(jnp.float32), kt,
+                                           depth=depth, B=bm.nbins_total))
+            job.update(1.0 / ntrees, f"tree {t + 1}/{ntrees}")
+        forest = stack_trees(trees)
+        c_norm = float(_avg_path_correction(jnp.asarray(float(psi))))
+        output = {"category": ModelCategory.ANOMALY, "response": None,
+                  "names": list(x), "domain": None}
+        model = IsolationForestModel(p, output, forest, bm, c_norm)
+        model.training_metrics = model.model_performance(frame)
+        return model

@@ -1,0 +1,123 @@
+"""Isotonic regression — pool-adjacent-violators.
+
+Reference: hex/isotonic/ — distributed aggregation of (x, y, w) triples
+to unique-x buckets, then single-node PAV; scoring is piecewise-linear
+interpolation clamped to the training x-range
+(hex/isotonic/IsotonicRegressionModel.java).
+
+TPU split: the aggregation to unique thresholds is device work
+(sort/segment); PAV itself is inherently sequential and tiny (≤ number
+of unique x), so it runs on the host — same split as the reference
+(MRTask aggregate + driver-node PAV).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Sequence
+
+import numpy as np
+
+from h2o3_tpu.frame.frame import Frame
+from h2o3_tpu.models import metrics as mm
+from h2o3_tpu.models.model import Model, ModelBuilder, ModelCategory
+
+
+def _pav(x: np.ndarray, y: np.ndarray, w: np.ndarray):
+    """Weighted PAV on sorted-unique x. Returns isotonic fitted values."""
+    # stack-based O(n) pooling
+    means, weights, counts = [], [], []
+    for i in range(len(x)):
+        m, wt, c = y[i], w[i], 1
+        while means and means[-1] > m:
+            pm, pw, pc = means.pop(), weights.pop(), counts.pop()
+            m = (m * wt + pm * pw) / (wt + pw)
+            wt += pw
+            c += pc
+        means.append(m)
+        weights.append(wt)
+        counts.append(c)
+    out = np.empty_like(y)
+    j = 0
+    for m, c in zip(means, counts):
+        out[j:j + c] = m
+        j += c
+    return out
+
+
+class IsotonicRegressionModel(Model):
+    algo = "isotonicregression"
+
+    def __init__(self, params, output, thresholds_x, thresholds_y):
+        super().__init__(params, output)
+        self.tx = thresholds_x
+        self.ty = thresholds_y
+
+    def _score_raw(self, frame: Frame) -> Dict[str, np.ndarray]:
+        xname = self.output["names"][0]
+        x = np.asarray(frame.col(xname).numeric_view())[: frame.nrows]
+        xc = np.clip(x, self.tx[0], self.tx[-1])
+        pred = np.interp(xc, self.tx, self.ty)
+        pred[np.isnan(x)] = np.nan
+        if str(self.params.get("out_of_bounds", "clip")).lower() == "na":
+            pred[(x < self.tx[0]) | (x > self.tx[-1])] = np.nan
+        return {"predict": pred}
+
+    def model_performance(self, frame: Frame):
+        y = self.output["response"]
+        pred = self._score_raw(frame)["predict"]
+        yv = np.asarray(frame.col(y).numeric_view())[: frame.nrows]
+        ok = ~(np.isnan(pred) | np.isnan(yv))
+        import jax.numpy as jnp
+        return mm.regression_metrics(jnp.asarray(np.where(ok, pred, 0.0)),
+                                     jnp.asarray(np.where(ok, yv, 0.0)),
+                                     jnp.asarray(ok.astype(np.float32)))
+
+
+class IsotonicRegressionEstimator(ModelBuilder):
+    """h2o-py H2OIsotonicRegressionEstimator-compatible surface."""
+
+    algo = "isotonicregression"
+
+    DEFAULTS = dict(
+        out_of_bounds="clip", weights_column=None, ignored_columns=None,
+        nfolds=0, fold_column=None, fold_assignment="auto", seed=-1,
+    )
+
+    def __init__(self, **params):
+        merged = dict(self.DEFAULTS)
+        unknown = set(params) - set(merged)
+        if unknown:
+            raise ValueError(f"unknown Isotonic params: {sorted(unknown)}")
+        merged.update(params)
+        super().__init__(**merged)
+
+    def _fit(self, frame: Frame, x: Sequence[str], y: Optional[str],
+             job, validation_frame: Optional[Frame] = None) -> Model:
+        if len(x) != 1:
+            raise ValueError("IsotonicRegression takes exactly one feature")
+        p = self.params
+        n = frame.nrows
+        xv = np.asarray(frame.col(x[0]).numeric_view())[:n]
+        yv = np.asarray(frame.col(y).numeric_view())[:n]
+        w = np.asarray(frame.valid_weights())[:n]
+        if p.get("weights_column"):
+            w = w * np.nan_to_num(
+                np.asarray(frame.col(p["weights_column"]).numeric_view())[:n])
+        ok = ~(np.isnan(xv) | np.isnan(yv)) & (w > 0)
+        xv, yv, w = xv[ok], yv[ok], w[ok]
+        # aggregate duplicates to unique x (device-sized data is fine on
+        # host here; the reference also funnels to the driver node)
+        order = np.argsort(xv, kind="stable")
+        xs, ys, ws = xv[order], yv[order], w[order]
+        ux, inv = np.unique(xs, return_inverse=True)
+        wy = np.bincount(inv, weights=ws * ys)
+        ww = np.bincount(inv, weights=ws)
+        ymean = wy / np.maximum(ww, 1e-12)
+        fitted = _pav(ux, ymean, ww)
+        job.update(1.0, "pav done")
+        output = {"category": ModelCategory.REGRESSION, "response": y,
+                  "names": list(x), "domain": None,
+                  "thresholds_x": ux.tolist(), "thresholds_y": fitted.tolist()}
+        model = IsotonicRegressionModel(p, output, ux, fitted)
+        model.training_metrics = model.model_performance(frame)
+        return model

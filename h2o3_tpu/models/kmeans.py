@@ -1,0 +1,235 @@
+"""KMeans — Lloyd's iterations on the mesh, k-means++ / Furthest init.
+
+Reference: hex/kmeans/KMeans.java:26 — LloydsIterationTask (KMeans.java:731)
+is an MRTask computing per-row nearest center + accumulating per-cluster
+sums; init options Random / PlusPlus / Furthest (KMeans.java Initialization);
+categoricals one-hot expanded and numerics standardized via DataInfo.
+
+TPU redesign: the assignment step is ONE [N,P]x[P,K] matmul (MXU) — the
+distance trick d² = ‖x‖² − 2x·c + ‖c‖² — and the center update is a
+segment_sum + psum over the 'data' axis; one jitted `_lloyd_step` replaces
+the whole MRTask. Init rounds reuse the same distance matmul.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Dict, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from h2o3_tpu.frame.datainfo import DataInfo, build_datainfo, stats_of
+from h2o3_tpu.frame.frame import Frame
+from h2o3_tpu.models.metrics import ModelMetrics
+from h2o3_tpu.models.model import Model, ModelBuilder, ModelCategory
+from h2o3_tpu.ops.segments import segment_sum
+from h2o3_tpu.parallel.mesh import get_mesh
+
+
+def _dist2(X, centers):
+    """[N, K] squared distances via the matmul trick."""
+    xc = X @ centers.T
+    c2 = jnp.sum(centers * centers, axis=1)
+    x2 = jnp.sum(X * X, axis=1, keepdims=True)
+    return jnp.maximum(x2 - 2.0 * xc + c2[None, :], 0.0)
+
+
+@partial(jax.jit, static_argnames=("k",))
+def _lloyd_step(X, w, centers, *, k: int):
+    """One Lloyd's iteration: assign + recompute centers + withinss."""
+    mesh = get_mesh()
+    d2 = _dist2(X, centers)
+    assign = jnp.argmin(d2, axis=1).astype(jnp.int32)
+    mind2 = jnp.min(d2, axis=1)
+    vals = jnp.concatenate([X * w[:, None], w[:, None],
+                            (w * mind2)[:, None]], axis=1)
+    sums = segment_sum(assign, vals, n_nodes=k, mesh=mesh)
+    counts = sums[:, -2]
+    withinss = sums[:, -1]
+    new_centers = jnp.where(counts[:, None] > 0,
+                            sums[:, :-2] / jnp.maximum(counts[:, None], 1e-12),
+                            centers)   # empty cluster keeps its old center
+    return new_centers, assign, counts, withinss
+
+
+@partial(jax.jit, static_argnames=())
+def _min_dist2(X, centers):
+    return jnp.min(_dist2(X, centers), axis=1)
+
+
+def _init_centers(X, w, k: int, method: str, key) -> jnp.ndarray:
+    """Initial centers. PlusPlus = D² sampling; Furthest = max-distance
+    (both host-loop over k with one device reduce per pick, k is small)."""
+    n = X.shape[0]
+    wn = np.asarray(w)
+    valid = np.flatnonzero(wn > 0)
+    rng = np.random.RandomState(int(jax.random.randint(key, (), 0, 2**31 - 1)))
+    first = int(valid[rng.randint(len(valid))])
+    centers = [np.asarray(X[first])]
+    if method == "random":
+        picks = rng.choice(valid, size=k - 1, replace=False)
+        centers += [np.asarray(X[int(i)]) for i in picks]
+        return jnp.asarray(np.stack(centers), jnp.float32)
+    for _ in range(k - 1):
+        d2 = np.asarray(_min_dist2(X, jnp.asarray(np.stack(centers)))) * wn
+        if method == "furthest":
+            nxt = int(np.argmax(d2))
+        else:  # plusplus: sample ∝ d²
+            p = d2 / max(d2.sum(), 1e-12)
+            nxt = int(rng.choice(n, p=p))
+        centers.append(np.asarray(X[nxt]))
+    return jnp.asarray(np.stack(centers), jnp.float32)
+
+
+class KMeansModel(Model):
+    algo = "kmeans"
+
+    def __init__(self, params, output, centers_std, di_stats, features,
+                 standardize: bool):
+        super().__init__(params, output)
+        self.centers_std = centers_std     # in standardized space
+        self.di_stats = di_stats
+        self.features = features
+        self.standardize = standardize
+
+    def _design(self, frame: Frame) -> DataInfo:
+        return build_datainfo(frame, self.features,
+                              standardize=self.standardize,
+                              use_all_factor_levels=True,
+                              stats_override=self.di_stats)
+
+    def _score_raw(self, frame: Frame) -> Dict[str, np.ndarray]:
+        di = self._design(frame)
+        d2 = _dist2(di.X, self.centers_std)
+        assign = np.asarray(jnp.argmin(d2, axis=1))[: frame.nrows]
+        return {"predict": assign.astype(np.int32)}
+
+    def model_performance(self, frame: Frame):
+        di = self._design(frame)
+        w = frame.valid_weights()
+        wc = self.params.get("weights_column")
+        if wc and wc in frame:
+            v = frame.col(wc).numeric_view()
+            w = w * jnp.where(jnp.isnan(v), 0.0, v)
+        k = self.centers_std.shape[0]
+        _, assign, counts, withinss = _lloyd_step(di.X, w, self.centers_std,
+                                                  k=k)
+        return _clustering_metrics(di.X, w, counts, withinss, get_mesh())
+
+
+def _clustering_metrics(X, w, counts, withinss, mesh) -> ModelMetrics:
+    """ModelMetricsClustering: totss / tot_withinss / betweenss."""
+    gsum = segment_sum(jnp.zeros(X.shape[0], jnp.int32),
+                       jnp.concatenate([X * w[:, None], w[:, None]], axis=1),
+                       n_nodes=1, mesh=mesh)[0]
+    tot_w = float(gsum[-1])
+    gmean = gsum[:-1] / max(tot_w, 1e-12)
+    d2g = jnp.sum((X - gmean[None, :]) ** 2, axis=1)
+    totss = float(jnp.sum(w * d2g))
+    tot_within = float(jnp.sum(withinss))
+    return ModelMetrics(
+        "Clustering", int(tot_w), tot_within / max(tot_w, 1e-12),
+        totss=totss, tot_withinss=tot_within,
+        betweenss=totss - tot_within,
+        centroid_stats={"size": np.asarray(counts).tolist(),
+                        "within_cluster_sum_of_squares":
+                            np.asarray(withinss).tolist()})
+
+
+class KMeansEstimator(ModelBuilder):
+    """h2o-py H2OKMeansEstimator-compatible surface."""
+
+    algo = "kmeans"
+    supervised = False
+
+    DEFAULTS = dict(
+        k=1, max_iterations=10, init="Furthest", standardize=True,
+        seed=-1, estimate_k=False, max_runtime_secs=0,
+        ignored_columns=None, nfolds=0, fold_column=None, weights_column=None,
+        fold_assignment="auto",
+    )
+
+    def __init__(self, **params):
+        merged = dict(self.DEFAULTS)
+        unknown = set(params) - set(merged)
+        if unknown:
+            raise ValueError(f"unknown KMeans params: {sorted(unknown)}")
+        merged.update(params)
+        super().__init__(**merged)
+
+    def _run_lloyds(self, X, w, k, init, key, iters):
+        centers = _init_centers(X, w, k, init, key)
+        assign = counts = withinss = None
+        prev = np.inf
+        for _ in range(iters):
+            centers, assign, counts, withinss = _lloyd_step(X, w, centers, k=k)
+            tw = float(jnp.sum(withinss))
+            if prev - tw < 1e-7 * max(abs(prev), 1.0):
+                break
+            prev = tw
+        return centers, assign, counts, withinss
+
+    def _fit(self, frame: Frame, x: Sequence[str], y: Optional[str],
+             job, validation_frame: Optional[Frame] = None) -> Model:
+        p = self.params
+        mesh = get_mesh()
+        di = build_datainfo(frame, x, standardize=bool(p["standardize"]),
+                            use_all_factor_levels=True)
+        w = frame.valid_weights()
+        if p.get("weights_column"):
+            wc = frame.col(p["weights_column"]).numeric_view()
+            w = w * jnp.where(jnp.isnan(wc), 0.0, wc)
+        seed = int(p["seed"]) if int(p["seed"]) >= 0 else 0x63A7
+        key = jax.random.PRNGKey(seed)
+        init = str(p["init"]).lower()
+        iters = int(p["max_iterations"])
+        k = int(p["k"])
+
+        if p["estimate_k"]:
+            # greedy k sweep: stop when within-SS reduction falls under 20%
+            # (the reference's estimate_k heuristic, hex/kmeans/KMeans.java)
+            best = None
+            prev_tw = None
+            for kk in range(1, k + 1):
+                key, sub = jax.random.split(key)
+                cand = self._run_lloyds(di.X, w, kk, init, sub, iters)
+                tw = float(jnp.sum(cand[3]))
+                if prev_tw is not None and tw > 0.8 * prev_tw:
+                    break
+                best, prev_tw, k_used = cand, tw, kk
+            centers, assign, counts, withinss = best
+            k = k_used
+        else:
+            centers, assign, counts, withinss = self._run_lloyds(
+                di.X, w, k, init, key, iters)
+            job.update(1.0, "lloyds done")
+
+        # de-standardized centers for reporting (numeric block only)
+        cstd = np.asarray(centers)
+        c_out = cstd.copy()
+        ptr = 0
+        num_j = 0
+        for i, is_c in enumerate(di.is_cat):
+            if is_c:
+                ptr += len(di.domains[i] or [])   # all-levels one-hot block
+            else:
+                if bool(p["standardize"]):
+                    c_out[:, ptr] = (cstd[:, ptr] * di.num_sigmas[num_j]
+                                     + di.num_means[num_j])
+                num_j += 1
+                ptr += 1
+
+        output = {"category": ModelCategory.CLUSTERING, "response": None,
+                  "names": list(x), "domain": None, "k": k,
+                  "centers": c_out.tolist(),
+                  "centers_std": cstd.tolist(),
+                  "coef_names": di.coef_names}
+        model = KMeansModel(p, output, centers, stats_of(di), list(x),
+                            bool(p["standardize"]))
+        model.training_metrics = _clustering_metrics(di.X, w, counts,
+                                                     withinss, mesh)
+        if validation_frame is not None:
+            model.validation_metrics = model.model_performance(validation_frame)
+        return model

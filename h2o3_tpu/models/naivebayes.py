@@ -1,0 +1,181 @@
+"""Naive Bayes — count/moment-based conditional probabilities.
+
+Reference: hex/naivebayes/NaiveBayes.java:26 — one MRTask accumulates
+per-class counts for categoricals and per-class mean/variance for
+numerics; laplace smoothing; Gaussian likelihood for numerics; min_sdev /
+min_prob floors.
+
+TPU redesign: all sufficient statistics come from ONE segment_sum over
+the class id (psum across the mesh): for numerics {w, w·x, w·x²} per
+(class, feature); for categoricals the (class × level) contingency table
+via one-hot matmul. Scoring is a dense [N,K] log-likelihood matmul.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from h2o3_tpu.frame.frame import Frame
+from h2o3_tpu.models import metrics as mm
+from h2o3_tpu.models.model import (Model, ModelBuilder, ModelCategory,
+                                   adapt_domain, infer_category)
+from h2o3_tpu.ops.segments import segment_sum
+from h2o3_tpu.parallel.mesh import get_mesh
+
+
+class NaiveBayesModel(Model):
+    algo = "naivebayes"
+
+    def __init__(self, params, output, stats):
+        super().__init__(params, output)
+        self.stats = stats   # dict: priors, num (mu/sd per class), cat tables
+
+    def _loglik(self, frame: Frame):
+        s = self.stats
+        K = len(s["priors"])
+        n = frame.nrows
+        ll = np.log(np.maximum(s["priors"], 1e-12))[None, :].repeat(n, 0)
+        eps = float(self.params.get("eps_sdev") or 0.0)
+        min_sd = max(float(self.params.get("min_sdev") or 1e-3), 1e-6)
+        for j, name in enumerate(s["num_names"]):
+            x = np.asarray(frame.col(name).numeric_view())[:n]
+            mu = s["num_mu"][j]            # [K]
+            sd = np.maximum(s["num_sd"][j], min_sd) + eps
+            t = (x[:, None] - mu[None, :]) / sd[None, :]
+            contrib = -0.5 * t * t - np.log(sd)[None, :]
+            ll += np.where(np.isnan(x)[:, None], 0.0, contrib)
+        min_p = max(float(self.params.get("min_prob") or 1e-3), 1e-10)
+        for j, name in enumerate(s["cat_names"]):
+            codes = adapt_domain(frame.col(name), s["cat_domains"][j])
+            tab = s["cat_tables"][j]       # [K, card] conditional probs
+            probs = np.maximum(tab, min_p)
+            safe = np.maximum(codes, 0)
+            contrib = np.log(probs[:, safe]).T     # [n, K]
+            ll += np.where((codes < 0)[:, None], 0.0, contrib)
+        return ll
+
+    def _score_raw(self, frame: Frame) -> Dict[str, np.ndarray]:
+        ll = self._loglik(frame)
+        p = np.exp(ll - ll.max(axis=1, keepdims=True))
+        p = p / p.sum(axis=1, keepdims=True)
+        out = {"predict": p.argmax(axis=1).astype(np.int32)}
+        for k in range(p.shape[1]):
+            out[f"p{k}"] = p[:, k]
+        return out
+
+    def model_performance(self, frame: Frame):
+        y = self.output["response"]
+        ll = self._loglik(frame)
+        p = np.exp(ll - ll.max(axis=1, keepdims=True))
+        p = p / p.sum(axis=1, keepdims=True)
+        yv = adapt_domain(frame.col(y), self.output["domain"])
+        ok = yv >= 0
+        w = np.asarray(frame.valid_weights())[: frame.nrows] * ok
+        yv = np.maximum(yv, 0)
+        if p.shape[1] == 2:
+            return mm.binomial_metrics(jnp.asarray(p[:, 1]),
+                                       jnp.asarray(yv.astype(np.float32)),
+                                       jnp.asarray(w.astype(np.float32)))
+        return mm.multinomial_metrics(jnp.asarray(p), jnp.asarray(yv),
+                                      jnp.asarray(w.astype(np.float32)),
+                                      domain=self.output["domain"])
+
+
+class NaiveBayesEstimator(ModelBuilder):
+    """h2o-py H2ONaiveBayesEstimator-compatible surface."""
+
+    algo = "naivebayes"
+
+    DEFAULTS = dict(
+        laplace=0.0, min_sdev=1e-3, eps_sdev=0.0, min_prob=1e-3,
+        eps_prob=0.0, seed=-1, nfolds=0, fold_column=None,
+        fold_assignment="auto", ignored_columns=None, weights_column=None,
+        compute_metrics=True,
+    )
+
+    def __init__(self, **params):
+        merged = dict(self.DEFAULTS)
+        unknown = set(params) - set(merged)
+        if unknown:
+            raise ValueError(f"unknown NaiveBayes params: {sorted(unknown)}")
+        merged.update(params)
+        super().__init__(**merged)
+
+    def _fit(self, frame: Frame, x: Sequence[str], y: Optional[str],
+             job, validation_frame: Optional[Frame] = None) -> Model:
+        p = self.params
+        mesh = get_mesh()
+        category = infer_category(frame, y)
+        if category == ModelCategory.REGRESSION:
+            raise ValueError("NaiveBayes requires a categorical response")
+        rc = frame.col(y)
+        K = rc.cardinality
+        n = frame.nrows
+        N = frame.nrows_padded
+        codes = np.asarray(rc.data)[:n].astype(np.int32)
+        na = np.asarray(rc.na_mask)[:n]
+        codes[na] = 0
+        cls = jnp.asarray(np.pad(codes, (0, N - n)))
+        w = frame.valid_weights()
+        w = w * jnp.asarray(np.pad((~na).astype(np.float32), (0, N - n)))
+        lap = float(p["laplace"])
+
+        num_names = [c for c in x if not frame.col(c).is_categorical]
+        cat_names = [c for c in x if frame.col(c).is_categorical]
+        # numeric moments per class in one pass
+        num_mu, num_sd = [], []
+        if num_names:
+            cols = []
+            for name in num_names:
+                v = frame.col(name).numeric_view()
+                valid = ~jnp.isnan(v)
+                v0 = jnp.where(valid, v, 0.0)
+                cols += [w * valid, w * v0, w * v0 * v0]
+            vals = jnp.stack(cols, axis=1)
+            sums = np.asarray(segment_sum(cls, vals, n_nodes=K, mesh=mesh))
+            for j in range(len(num_names)):
+                cw, cx, cxx = sums[:, 3 * j], sums[:, 3 * j + 1], sums[:, 3 * j + 2]
+                mu = cx / np.maximum(cw, 1e-12)
+                var = cxx / np.maximum(cw, 1e-12) - mu * mu
+                num_mu.append(mu)
+                num_sd.append(np.sqrt(np.maximum(var, 1e-12)))
+        # categorical contingency tables: segment over class*card+code
+        cat_tables, cat_domains = [], []
+        for name in cat_names:
+            c = frame.col(name)
+            card = max(c.cardinality, 1)
+            cc = c.data.astype(jnp.int32)
+            wna = w * (~c.na_mask).astype(jnp.float32)
+            idx = cls * card + jnp.clip(cc, 0, card - 1)
+            tab = np.asarray(segment_sum(idx.astype(jnp.int32), wna[:, None],
+                                         n_nodes=K * card, mesh=mesh))
+            tab = tab.reshape(K, card)
+            tab = (tab + lap) / np.maximum(
+                tab.sum(axis=1, keepdims=True) + lap * card, 1e-12)
+            cat_tables.append(tab)
+            cat_domains.append(c.domain)
+
+        prior_w = np.asarray(segment_sum(cls, w[:, None], n_nodes=K,
+                                         mesh=mesh))[:, 0]
+        priors = prior_w / max(prior_w.sum(), 1e-12)
+        job.update(1.0, "stats done")
+
+        stats = {"priors": priors, "num_names": num_names,
+                 "num_mu": num_mu, "num_sd": num_sd,
+                 "cat_names": cat_names, "cat_tables": cat_tables,
+                 "cat_domains": cat_domains}
+        output = {"category": category, "response": y, "names": list(x),
+                  "nclasses": K, "domain": rc.domain,
+                  "priors": priors.tolist()}
+        model = NaiveBayesModel(p, output, stats)
+        model.training_metrics = model.model_performance(frame)
+        if category == ModelCategory.BINOMIAL:
+            model.output["default_threshold"] = \
+                model.training_metrics["max_f1_threshold"]
+        if validation_frame is not None:
+            model.validation_metrics = model.model_performance(validation_frame)
+        return model

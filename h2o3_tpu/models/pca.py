@@ -1,0 +1,218 @@
+"""PCA + SVD — dimensionality reduction via the distributed Gram path.
+
+Reference: hex/pca/PCA.java:41 (pca_method GramSVD default: MRTask Gram
+then local SVD; Power / Randomized / GLRM alternatives) and
+hex/svd/SVD.java (distributed power iteration / randomized subspace).
+
+TPU redesign: the Gram X'X is one einsum + psum over the row-sharded
+design matrix (ops/gram.py); the [P,P] eigendecomposition runs on a
+single chip (P is feature-space width — modest in H2O's tabular regime).
+Randomized SVD (Halko et al.) keeps everything as tall-matmuls on the
+MXU: Y = X Ω → QR → B = Qᵀ X → small SVD, one pass over the data axis.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Dict, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from h2o3_tpu.frame.datainfo import build_datainfo, stats_of
+from h2o3_tpu.frame.frame import Frame
+from h2o3_tpu.models.metrics import ModelMetrics
+from h2o3_tpu.models.model import Model, ModelBuilder, ModelCategory
+from h2o3_tpu.ops.gram import gram
+from h2o3_tpu.parallel.mesh import get_mesh
+
+
+def _gram_eig(X, w, mesh):
+    """X'WX eigen-decomposition (GramSVD): returns (eigvals desc, eigvecs)."""
+    z = jnp.zeros(X.shape[0], jnp.float32)
+    xtx, _, wsum = gram(X, w, z, mesh=mesh)
+    cov = xtx / jnp.maximum(wsum - 1.0, 1.0)
+    evals, evecs = jnp.linalg.eigh(cov)        # ascending
+    return evals[::-1], evecs[:, ::-1], wsum
+
+
+@partial(jax.jit, static_argnames=("k", "iters"))
+def _randomized_range(X, k: int, iters: int, key):
+    """Randomized subspace: Q [N, k] orthonormal range of X (Halko)."""
+    P = X.shape[1]
+    omega = jax.random.normal(key, (P, k), jnp.float32)
+    Y = X @ omega
+    Q, _ = jnp.linalg.qr(Y)
+    for _ in range(iters):
+        Z = X.T @ Q          # [P, k] — psum over data axis by XLA
+        Q, _ = jnp.linalg.qr(X @ Z)
+    return Q
+
+
+class PCAModel(Model):
+    algo = "pca"
+
+    def __init__(self, params, output, eigvecs, di_stats, features,
+                 transform: str, use_all_levels: bool):
+        super().__init__(params, output)
+        self.eigvecs = eigvecs          # [P, k]
+        self.di_stats = di_stats
+        self.features = features
+        self.transform = transform
+        self.use_all_levels = use_all_levels
+
+    def _design(self, frame: Frame):
+        return build_datainfo(frame, self.features,
+                              standardize=(self.transform == "standardize"),
+                              use_all_factor_levels=self.use_all_levels,
+                              stats_override=self.di_stats)
+
+    def _score_raw(self, frame: Frame) -> Dict[str, np.ndarray]:
+        di = self._design(frame)
+        scores = np.asarray(di.X @ self.eigvecs)[: frame.nrows]
+        return {f"PC{i + 1}": scores[:, i] for i in range(scores.shape[1])}
+
+    def model_performance(self, frame: Frame):
+        return self.training_metrics
+
+
+class PCAEstimator(ModelBuilder):
+    """h2o-py H2OPrincipalComponentAnalysisEstimator-compatible surface."""
+
+    algo = "pca"
+    supervised = False
+
+    DEFAULTS = dict(
+        k=1, transform="standardize", pca_method="GramSVD",
+        max_iterations=20, seed=-1, use_all_factor_levels=False,
+        compute_metrics=True, impute_missing=True, ignored_columns=None,
+    )
+
+    def __init__(self, **params):
+        merged = dict(self.DEFAULTS)
+        unknown = set(params) - set(merged)
+        if unknown:
+            raise ValueError(f"unknown PCA params: {sorted(unknown)}")
+        merged.update(params)
+        super().__init__(**merged)
+
+    def _fit(self, frame: Frame, x: Sequence[str], y: Optional[str],
+             job, validation_frame: Optional[Frame] = None) -> Model:
+        p = self.params
+        mesh = get_mesh()
+        transform = str(p["transform"]).lower()
+        di = build_datainfo(frame, x, standardize=(transform == "standardize"),
+                            use_all_factor_levels=bool(p["use_all_factor_levels"]))
+        w = frame.valid_weights()
+        k = min(int(p["k"]), di.P)
+        method = str(p["pca_method"]).lower()
+
+        if method in ("gramsvd", "power", "glrm"):
+            evals, evecs, wsum = _gram_eig(di.X, w, mesh)
+            evals = np.maximum(np.asarray(evals), 0.0)
+            V = np.asarray(evecs)[:, :k]
+            sdev = np.sqrt(evals)
+        else:  # randomized
+            seed = int(p["seed"]) if int(p["seed"]) >= 0 else 0x9CA
+            Q = _randomized_range(di.X * w[:, None], k + 4,
+                                  int(p["max_iterations"]),
+                                  jax.random.PRNGKey(seed))
+            B = Q.T @ di.X                         # [k+4, P]
+            _, s, Vt = jnp.linalg.svd(B, full_matrices=False)
+            V = np.asarray(Vt.T)[:, :k]
+            n_eff = float(jnp.sum(w))
+            sdev = np.asarray(s) / np.sqrt(max(n_eff - 1.0, 1.0))
+            evals = sdev ** 2
+        job.update(1.0, "decomposition done")
+
+        tot = float(evals.sum()) or 1.0
+        prop = evals[:k] / tot
+        output = {"category": ModelCategory.DIMREDUCTION, "response": None,
+                  "names": list(x), "domain": None,
+                  "std_deviation": sdev[:k].tolist(),
+                  "eigenvectors": V.tolist(),
+                  "coef_names": di.coef_names,
+                  "pct_variance": prop.tolist(),
+                  "cum_pct_variance": np.cumsum(prop).tolist()}
+        model = PCAModel(p, output, jnp.asarray(V), stats_of(di), list(x),
+                         transform, bool(p["use_all_factor_levels"]))
+        model.training_metrics = ModelMetrics(
+            "PCA", frame.nrows, 0.0,
+            pct_variance_explained=float(np.cumsum(prop)[-1]))
+        return model
+
+
+class SVDModel(Model):
+    algo = "svd"
+
+    def __init__(self, params, output, V, di_stats, features, transform,
+                 use_all_levels: bool):
+        super().__init__(params, output)
+        self.V = V
+        self.di_stats = di_stats
+        self.features = features
+        self.transform = transform
+        self.use_all_levels = use_all_levels
+
+    def _design(self, frame: Frame):
+        return build_datainfo(frame, self.features,
+                              standardize=(self.transform == "standardize"),
+                              use_all_factor_levels=self.use_all_levels,
+                              stats_override=self.di_stats)
+
+    def _score_raw(self, frame: Frame) -> Dict[str, np.ndarray]:
+        di = self._design(frame)
+        sv = np.asarray(self.output["d"], np.float32)
+        proj = np.asarray(di.X @ self.V)[: frame.nrows]
+        u = proj / np.maximum(sv[None, :], 1e-12)
+        return {f"u{i + 1}": u[:, i] for i in range(u.shape[1])}
+
+    def model_performance(self, frame: Frame):
+        return self.training_metrics
+
+
+class SVDEstimator(ModelBuilder):
+    """h2o-py H2OSingularValueDecompositionEstimator-compatible surface."""
+
+    algo = "svd"
+    supervised = False
+
+    DEFAULTS = dict(
+        nv=1, transform="none", svd_method="GramSVD", max_iterations=20,
+        seed=-1, use_all_factor_levels=True, ignored_columns=None,
+    )
+
+    def __init__(self, **params):
+        merged = dict(self.DEFAULTS)
+        unknown = set(params) - set(merged)
+        if unknown:
+            raise ValueError(f"unknown SVD params: {sorted(unknown)}")
+        merged.update(params)
+        super().__init__(**merged)
+
+    def _fit(self, frame: Frame, x: Sequence[str], y: Optional[str],
+             job, validation_frame: Optional[Frame] = None) -> Model:
+        p = self.params
+        mesh = get_mesh()
+        transform = str(p["transform"]).lower()
+        di = build_datainfo(frame, x, standardize=(transform == "standardize"),
+                            use_all_factor_levels=bool(p["use_all_factor_levels"]))
+        w = frame.valid_weights()
+        k = min(int(p["nv"]), di.P)
+        # X'X eigen → right singular vectors; σ = sqrt(λ) (unscaled Gram)
+        z = jnp.zeros(di.X.shape[0], jnp.float32)
+        xtx, _, _ = gram(di.X, w, z, mesh=mesh)
+        evals, evecs = jnp.linalg.eigh(xtx)
+        evals = np.maximum(np.asarray(evals)[::-1], 0.0)
+        V = np.asarray(evecs)[:, ::-1][:, :k]
+        d = np.sqrt(evals[:k])
+        job.update(1.0, "svd done")
+        output = {"category": ModelCategory.DIMREDUCTION, "response": None,
+                  "names": list(x), "domain": None,
+                  "d": d.tolist(), "v": V.tolist(),
+                  "coef_names": di.coef_names}
+        model = SVDModel(p, output, jnp.asarray(V), stats_of(di), list(x),
+                         transform, bool(p["use_all_factor_levels"]))
+        model.training_metrics = ModelMetrics("SVD", frame.nrows, 0.0)
+        return model

@@ -58,7 +58,8 @@ class TreeParams:
 def _best_splits(hist, nb, col_mask, params: TreeParams):
     """Vectorized DTree.findBestSplitPoint over all nodes of a level.
 
-    hist: [L, F, B, 3] of {w, g, h}. Returns per-node best
+    hist: [L, F, B, 3] of {w, g, h}; col_mask [F] (per-tree sampling) or
+    [L, F] (per-node mtries, DRF). Returns per-node best
     (gain, feat, thresh, na_left).
     """
     lam = params.reg_lambda
@@ -90,7 +91,8 @@ def _best_splits(hist, nb, col_mask, params: TreeParams):
     # threshold validity: t <= nb[f]-2 (splitting at last real bin is void)
     t_ids = jnp.arange(B - 1, dtype=jnp.int32)
     valid_t = t_ids[None, :] <= (nb[:, None] - 2)           # [F, B-1]
-    mask = valid_t[None, :, :] & col_mask[None, :, None]
+    cm = col_mask if col_mask.ndim == 2 else col_mask[None, :]   # [L|1, F]
+    mask = valid_t[None, :, :] & cm[:, :, None]
     g_nar = jnp.where(mask, g_nar, -jnp.inf)
     g_nal = jnp.where(mask, g_nal, -jnp.inf)
 
@@ -105,11 +107,23 @@ def _best_splits(hist, nb, col_mask, params: TreeParams):
     return best_gain, best_f, best_t, na_left
 
 
-def grow_tree(bins, nb, w, g, h, col_mask, *, params: TreeParams, mesh):
+def _mtries_mask(key, L: int, F: int, mtries: int):
+    """Exactly-mtries-per-node column mask [L, F] — the reference DRF
+    per-split column subsample (hex/tree/DTree.java UndecidedNode scoreCols,
+    mtries semantics of hex/tree/drf/DRF.java:30)."""
+    u = jax.random.uniform(key, (L, F))
+    rank = jnp.argsort(jnp.argsort(u, axis=1), axis=1)
+    return rank < mtries
+
+
+def grow_tree(bins, nb, w, g, h, col_mask, *, params: TreeParams, mesh,
+              mtries: int = 0, key=None):
     """Grow one tree; returns (Tree, final_leaf_id_per_row).
 
     bins [Npad, F] int32 row-sharded; w zero on padding rows; col_mask [F]
     bool (per-tree column sampling, reference col_sample_rate_per_tree).
+    mtries > 0 additionally samples exactly-mtries columns per NODE per
+    level (DRF semantics) using `key`.
     """
     D = params.max_depth
     B = params.nbins_total
@@ -128,7 +142,11 @@ def grow_tree(bins, nb, w, g, h, col_mask, *, params: TreeParams, mesh):
         L = 2 ** d
         hist = histogram(bins, nid, w, g, h, n_nodes=L, n_bins=B,
                          mesh=mesh, block_rows=params.block_rows)
-        bg, bf, bt, bnal = _best_splits(hist, nb, col_mask, params)
+        cm = col_mask
+        if mtries > 0 and mtries < F:
+            key, sub = jax.random.split(key)
+            cm = _mtries_mask(sub, L, F, mtries) & col_mask[None, :]
+        bg, bf, bt, bnal = _best_splits(hist, nb, cm, params)
         split = bg > params.min_split_improvement
         feats = feats.at[d, :L].set(jnp.where(split, bf, 0))
         threshs = threshs.at[d, :L].set(jnp.where(split, bt, B))

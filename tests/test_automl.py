@@ -1,0 +1,39 @@
+"""AutoML tests — pyunit_automl* role (h2o-py/tests/testdir_algos/automl/)."""
+
+import numpy as np
+
+import h2o3_tpu
+from h2o3_tpu.automl import H2OAutoML
+
+
+def test_automl_runs_and_ranks(classif_frame):
+    aml = H2OAutoML(max_models=4, nfolds=3, seed=1,
+                    include_algos=["glm", "gbm", "drf", "stackedensemble"],
+                    max_runtime_secs=600)
+    leader = aml.train(y="y", training_frame=classif_frame)
+    assert leader is not None
+    tab = aml.leaderboard.as_table()
+    assert len(tab) >= 3
+    aucs = [r["auc"] for r in tab]
+    assert aucs == sorted(aucs, reverse=True)
+    assert aucs[0] > 0.8
+    # leader predicts
+    p = aml.predict(classif_frame).to_pandas()
+    assert {"predict", "p0", "p1"} <= set(p.columns)
+
+
+def test_automl_exclude_algos(classif_frame):
+    aml = H2OAutoML(max_models=2, nfolds=2, seed=2,
+                    include_algos=["gbm"], max_runtime_secs=300)
+    aml.train(y="y", training_frame=classif_frame)
+    algos = {m.algo for m in aml.leaderboard.models}
+    assert algos == {"gbm"}
+
+
+def test_automl_ensemble_present(classif_frame):
+    aml = H2OAutoML(max_models=3, nfolds=3, seed=3,
+                    include_algos=["glm", "gbm", "stackedensemble"],
+                    max_runtime_secs=600)
+    aml.train(y="y", training_frame=classif_frame)
+    steps = {m.output.get("automl_step") for m in aml.leaderboard.models}
+    assert "StackedEnsemble_BestOfFamily" in steps, steps

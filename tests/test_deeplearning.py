@@ -1,0 +1,67 @@
+"""DeepLearning tests — pyunit_deeplearning* role
+(h2o-py/tests/testdir_algos/deeplearning/)."""
+
+import numpy as np
+import pytest
+
+import h2o3_tpu
+from h2o3_tpu.models.deeplearning import DeepLearningEstimator
+
+
+def test_dl_binomial_learns(classif_frame):
+    m = DeepLearningEstimator(hidden=[32, 32], epochs=30, seed=42,
+                              stopping_rounds=0)
+    model = m.train(classif_frame, y="y")
+    tm = model.training_metrics
+    assert tm["AUC"] > 0.80, tm.to_dict()
+    preds = model.predict(classif_frame).to_pandas()
+    assert ((preds["p0"] + preds["p1"]).round(4) == 1.0).all()
+
+
+def test_dl_regression(regress_frame):
+    m = DeepLearningEstimator(hidden=[64, 64], epochs=40, seed=3,
+                              stopping_rounds=0)
+    model = m.train(regress_frame, y="y")
+    y = regress_frame.col("y").to_numpy()
+    assert model.training_metrics["MSE"] < 0.35 * float(np.var(y))
+
+
+def test_dl_multinomial():
+    r = np.random.RandomState(7)
+    n = 3000
+    X = r.randn(n, 6)
+    y = (X[:, 0] > 0).astype(int) + (X[:, 1] > 0.5).astype(int)
+    f = h2o3_tpu.Frame.from_numpy(
+        {**{f"x{i}": X[:, i] for i in range(6)},
+         "y": np.array(["a", "b", "c"], dtype=object)[y]},
+        categorical=["y"])
+    model = DeepLearningEstimator(hidden=[32], epochs=25, seed=5,
+                                  stopping_rounds=0).train(f, y="y")
+    assert model.training_metrics["error_rate"] < 0.2
+
+
+def test_dl_tanh_and_momentum(classif_frame):
+    m = DeepLearningEstimator(hidden=[16], epochs=15, activation="Tanh",
+                              adaptive_rate=False, rate=0.05,
+                              momentum_start=0.5, momentum_stable=0.9,
+                              seed=1, stopping_rounds=0)
+    model = m.train(classif_frame, y="y")
+    assert model.training_metrics["AUC"] > 0.75
+
+
+def test_dl_autoencoder():
+    r = np.random.RandomState(2)
+    X = r.randn(1500, 6)
+    X[:, 3] = X[:, 0] + 0.1 * r.randn(1500)     # learnable structure
+    f = h2o3_tpu.Frame.from_numpy({f"x{i}": X[:, i] for i in range(6)})
+    m = DeepLearningEstimator(hidden=[3], epochs=40, autoencoder=True,
+                              seed=4, stopping_rounds=0)
+    model = m.train(f)
+    rec = model.anomaly(f).to_pandas()["reconstruction_error"]
+    assert rec.mean() < 1.0          # better than predicting zeros (var=1)
+    # anomalous rows reconstruct worse
+    Xo = X.copy()
+    Xo[:50] += 8.0
+    fo = h2o3_tpu.Frame.from_numpy({f"x{i}": Xo[:, i] for i in range(6)})
+    rec2 = model.anomaly(fo).to_pandas()["reconstruction_error"]
+    assert rec2[:50].mean() > 3 * rec[50:].mean()

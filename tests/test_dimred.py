@@ -1,0 +1,80 @@
+"""PCA / SVD / GLRM tests — pyunit_pca* / pyunit_svd* / pyunit_glrm* role."""
+
+import numpy as np
+
+import h2o3_tpu
+from h2o3_tpu.models.glrm import GLRMEstimator
+from h2o3_tpu.models.pca import PCAEstimator, SVDEstimator
+
+
+def _lowrank(n=1200, p=6, k=2, seed=0, noise=0.05):
+    r = np.random.RandomState(seed)
+    A = r.randn(n, k)
+    Y = r.randn(k, p)
+    return A @ Y + noise * r.randn(n, p)
+
+
+def test_pca_variance_explained():
+    X = _lowrank()
+    f = h2o3_tpu.Frame.from_numpy({f"x{i}": X[:, i] for i in range(6)})
+    m = PCAEstimator(k=2, transform="demean").train(f)
+    cum = m.output["cum_pct_variance"]
+    assert cum[1] > 0.95, cum
+    scores = m.predict(f).to_pandas()
+    assert list(scores.columns) == ["PC1", "PC2"]
+    # principal scores are uncorrelated
+    cc = np.corrcoef(scores["PC1"], scores["PC2"])[0, 1]
+    assert abs(cc) < 0.05
+
+
+def test_pca_vs_numpy():
+    X = _lowrank(seed=3)
+    f = h2o3_tpu.Frame.from_numpy({f"x{i}": X[:, i] for i in range(6)})
+    m = PCAEstimator(k=3, transform="demean").train(f)
+    Xc = X - X.mean(axis=0)
+    ref = np.linalg.svd(Xc, full_matrices=False)[1] ** 2 / (len(X) - 1)
+    got = np.asarray(m.output["std_deviation"]) ** 2
+    np.testing.assert_allclose(got, ref[:3], rtol=0.05)
+
+
+def test_pca_randomized_close_to_exact():
+    X = _lowrank(seed=5)
+    f = h2o3_tpu.Frame.from_numpy({f"x{i}": X[:, i] for i in range(6)})
+    exact = PCAEstimator(k=2, transform="demean").train(f)
+    rand = PCAEstimator(k=2, transform="demean", pca_method="Randomized",
+                        seed=1).train(f)
+    np.testing.assert_allclose(rand.output["std_deviation"],
+                               exact.output["std_deviation"], rtol=0.05)
+
+
+def test_svd_orthogonal_v():
+    X = _lowrank(seed=7)
+    f = h2o3_tpu.Frame.from_numpy({f"x{i}": X[:, i] for i in range(6)})
+    m = SVDEstimator(nv=3).train(f)
+    V = np.asarray(m.output["v"])
+    np.testing.assert_allclose(V.T @ V, np.eye(3), atol=1e-4)
+    d = np.asarray(m.output["d"])
+    assert (np.diff(d) <= 1e-6).all()   # descending
+
+
+def test_glrm_reconstructs_lowrank():
+    X = _lowrank(n=800, p=5, k=2, seed=9, noise=0.02)
+    f = h2o3_tpu.Frame.from_numpy({f"x{i}": X[:, i] for i in range(5)})
+    m = GLRMEstimator(k=2, max_iterations=30, seed=2).train(f)
+    rec = m.reconstruct(f).to_pandas().to_numpy()
+    rel = np.linalg.norm(rec - X) / np.linalg.norm(X)
+    assert rel < 0.05, rel
+
+
+def test_glrm_handles_missing_cells():
+    X = _lowrank(n=600, p=5, k=2, seed=11, noise=0.02)
+    Xna = X.copy()
+    r = np.random.RandomState(0)
+    holes = r.rand(*X.shape) < 0.15
+    Xna[holes] = np.nan
+    f = h2o3_tpu.Frame.from_numpy({f"x{i}": Xna[:, i] for i in range(5)})
+    m = GLRMEstimator(k=2, max_iterations=40, seed=3).train(f)
+    rec = m.reconstruct(f).to_pandas().to_numpy()
+    # held-out (missing) cells reconstructed from low-rank structure
+    err = np.abs(rec[holes] - X[holes]).mean()
+    assert err < 0.25, err

@@ -1,0 +1,76 @@
+"""DRF + IsolationForest tests — pyunit_drf* / pyunit_isofor* role
+(h2o-py/tests/testdir_algos/{rf,isoforest}/)."""
+
+import numpy as np
+import pytest
+
+import h2o3_tpu
+from h2o3_tpu.models.drf import DRFEstimator
+from h2o3_tpu.models.isofor import IsolationForestEstimator
+from tests.conftest import make_classification
+
+
+def test_drf_binomial_learns(classif_frame):
+    m = DRFEstimator(ntrees=30, max_depth=8, seed=42)
+    model = m.train(classif_frame, y="y")
+    tm = model.training_metrics          # OOB metrics
+    assert tm["AUC"] > 0.75, tm.to_dict()
+    val = model.model_performance(classif_frame)
+    assert val["AUC"] > tm["AUC"] - 0.05   # in-bag score >= OOB
+
+
+def test_drf_predictions(classif_frame):
+    m = DRFEstimator(ntrees=10, max_depth=6, seed=1)
+    model = m.train(classif_frame, y="y")
+    preds = model.predict(classif_frame)
+    assert preds.names == ["predict", "p0", "p1"]
+    p = preds.to_pandas()
+    assert ((p["p0"] + p["p1"]).round(4) == 1.0).all()
+    assert p["p1"].between(0, 1).all()
+
+
+def test_drf_regression(regress_frame):
+    m = DRFEstimator(ntrees=30, max_depth=10, seed=3)
+    model = m.train(regress_frame, y="y")
+    tm = model.training_metrics
+    y = regress_frame.col("y").to_numpy()
+    assert tm["MSE"] < 0.6 * float(np.var(y))
+
+
+def test_drf_multinomial():
+    r = np.random.RandomState(11)
+    n = 3000
+    X = r.randn(n, 5)
+    y = (X[:, 0] > 0.5).astype(int) + (X[:, 1] > 0).astype(int)
+    f = h2o3_tpu.Frame.from_numpy(
+        {**{f"x{i}": X[:, i] for i in range(5)},
+         "y": np.array(["a", "b", "c"], dtype=object)[y]},
+        categorical=["y"])
+    model = DRFEstimator(ntrees=20, max_depth=8, seed=5).train(f, y="y")
+    assert model.training_metrics["error_rate"] < 0.25
+    preds = model.predict(f).to_pandas()
+    assert set(preds["predict"].unique()) <= {"a", "b", "c"}
+
+
+def test_drf_varimp(classif_frame):
+    model = DRFEstimator(ntrees=15, max_depth=6, seed=2).train(
+        classif_frame, y="y")
+    vi = model.varimp_table
+    assert len(vi) == 8
+    top = {name for name, *_ in vi[:4]}
+    # informative features are x0..x3
+    assert len(top & {"x0", "x1", "x2", "x3"}) >= 3, vi
+
+
+def test_isolation_forest_separates_outliers():
+    r = np.random.RandomState(0)
+    inliers = r.randn(2000, 4)
+    outliers = r.randn(40, 4) * 0.5 + 6.0
+    X = np.vstack([inliers, outliers])
+    f = h2o3_tpu.Frame.from_numpy({f"x{i}": X[:, i] for i in range(4)})
+    m = IsolationForestEstimator(ntrees=40, seed=7).train(f)
+    s = m.predict(f).to_pandas()
+    assert {"predict", "mean_length"} <= set(s.columns)
+    inl = s["predict"][:2000].mean()
+    out = s["predict"][2000:].mean()
+    assert out > inl + 0.1, (inl, out)

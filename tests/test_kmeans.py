@@ -1,0 +1,61 @@
+"""KMeans tests — pyunit_kmeans* role (h2o-py/tests/testdir_algos/kmeans/)."""
+
+import numpy as np
+
+import h2o3_tpu
+from h2o3_tpu.models.kmeans import KMeansEstimator
+
+
+def _blobs(n_per=500, k=3, f=4, seed=0, spread=0.3):
+    r = np.random.RandomState(seed)
+    centers = r.randn(k, f) * 4
+    X = np.vstack([centers[i] + spread * r.randn(n_per, f) for i in range(k)])
+    y = np.repeat(np.arange(k), n_per)
+    return X, y, centers
+
+
+def test_kmeans_recovers_blobs():
+    X, y, _ = _blobs()
+    f = h2o3_tpu.Frame.from_numpy({f"x{i}": X[:, i] for i in range(4)})
+    m = KMeansEstimator(k=3, seed=42, max_iterations=20).train(f)
+    tm = m.training_metrics
+    assert tm["betweenss"] / tm["totss"] > 0.9, tm.to_dict()
+    pred = m.predict(f).to_pandas()["predict"].to_numpy()
+    # cluster labels must be a permutation-consistent refinement of truth
+    for cls in range(3):
+        vals, cnt = np.unique(pred[y == cls], return_counts=True)
+        assert cnt.max() / cnt.sum() > 0.95
+
+
+def test_kmeans_inits_agree():
+    X, y, _ = _blobs(seed=3)
+    f = h2o3_tpu.Frame.from_numpy({f"x{i}": X[:, i] for i in range(4)})
+    results = {}
+    for init in ("Furthest", "PlusPlus", "Random"):
+        m = KMeansEstimator(k=3, init=init, seed=7, max_iterations=25).train(f)
+        results[init] = m.training_metrics["tot_withinss"]
+    vals = list(results.values())
+    assert max(vals) < 2.0 * min(vals) + 1e-9, results
+
+
+def test_kmeans_categorical_onehot():
+    r = np.random.RandomState(5)
+    n = 900
+    g = r.randint(0, 3, n)
+    f = h2o3_tpu.Frame.from_numpy(
+        {"num": r.randn(n) + g * 5,
+         "cat": np.array(["a", "b", "c"], dtype=object)[g]},
+        categorical=["cat"])
+    m = KMeansEstimator(k=3, seed=1, max_iterations=15).train(f)
+    assert m.output["k"] == 3
+    assert len(m.output["centers"]) == 3
+    # coef space: 1 numeric + 3 one-hot levels
+    assert len(m.output["coef_names"]) == 4
+
+
+def test_kmeans_estimate_k():
+    X, y, _ = _blobs(k=3, seed=9)
+    f = h2o3_tpu.Frame.from_numpy({f"x{i}": X[:, i] for i in range(4)})
+    m = KMeansEstimator(k=8, estimate_k=True, seed=11,
+                        max_iterations=20).train(f)
+    assert 2 <= m.output["k"] <= 4, m.output["k"]

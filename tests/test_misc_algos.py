@@ -1,0 +1,96 @@
+"""NaiveBayes / Isotonic / Quantile tests — pyunit_nb* / pyunit_isotonic* /
+pyunit_quantile* role."""
+
+import numpy as np
+
+import h2o3_tpu
+from h2o3_tpu.frame.quantiles import column_quantiles, frame_quantiles
+from h2o3_tpu.models.isotonic import IsotonicRegressionEstimator
+from h2o3_tpu.models.naivebayes import NaiveBayesEstimator
+
+
+def test_naive_bayes_gaussian(classif_frame):
+    m = NaiveBayesEstimator().train(classif_frame, y="y")
+    assert m.training_metrics["AUC"] > 0.75, m.training_metrics.to_dict()
+    p = m.predict(classif_frame).to_pandas()
+    assert ((p["p0"] + p["p1"]).round(4) == 1.0).all()
+
+
+def test_naive_bayes_categorical_features():
+    r = np.random.RandomState(3)
+    n = 4000
+    g = r.randint(0, 4, n)
+    noise = r.randint(0, 4, n)
+    y = (g >= 2) ^ (r.rand(n) < 0.1)
+    f = h2o3_tpu.Frame.from_numpy(
+        {"g": np.array(list("abcd"), dtype=object)[g],
+         "noise": np.array(list("wxyz"), dtype=object)[noise],
+         "y": np.array(["n", "p"], dtype=object)[y.astype(int)]},
+        categorical=["g", "noise", "y"])
+    m = NaiveBayesEstimator(laplace=1.0).train(f, y="y")
+    assert m.training_metrics["AUC"] > 0.85
+
+
+def test_isotonic_monotone_fit():
+    r = np.random.RandomState(0)
+    n = 3000
+    x = r.uniform(0, 10, n)
+    y = np.log1p(x) + 0.2 * r.randn(n)
+    f = h2o3_tpu.Frame.from_numpy({"x": x, "y": y})
+    m = IsotonicRegressionEstimator().train(f, x=["x"], y="y")
+    pred = m.predict(f).to_pandas()["predict"].to_numpy()
+    order = np.argsort(x)
+    assert (np.diff(pred[order]) >= -1e-9).all()     # monotone
+    assert m.training_metrics["MSE"] < 0.06
+
+
+def test_quantiles_match_numpy():
+    r = np.random.RandomState(1)
+    v = r.lognormal(0, 1, 50_000)
+    f = h2o3_tpu.Frame.from_numpy({"v": v})
+    probs = [0.1, 0.5, 0.9, 0.99]
+    got = column_quantiles(f.col("v"), probs)
+    ref = np.quantile(v, probs)
+    np.testing.assert_allclose(got, ref, rtol=1e-3)
+
+
+def test_quantiles_with_nas():
+    r = np.random.RandomState(2)
+    v = r.randn(10_000)
+    v[::7] = np.nan
+    f = h2o3_tpu.Frame.from_numpy({"v": v})
+    got = column_quantiles(f.col("v"), [0.5])
+    ref = np.nanquantile(v, 0.5)
+    assert abs(got[0] - ref) < 2e-3
+
+def test_frame_quantiles_table():
+    r = np.random.RandomState(4)
+    f = h2o3_tpu.Frame.from_numpy({"a": r.randn(5000), "b": r.rand(5000),
+                                   "c": np.array(["x", "y"], dtype=object)[
+                                       r.randint(0, 2, 5000)]},
+                                  categorical=["c"])
+    t = frame_quantiles(f, probs=[0.25, 0.5, 0.75])
+    assert set(t) == {"probs", "a", "b"}
+    assert abs(t["b"][1] - 0.5) < 0.02
+
+
+def test_quantile_combine_methods():
+    v = np.array([1.0, 2.0, 3.0, 4.0, 5.0, 6.0, 7.0, 8.0, 9.0, 11.0])
+    f = h2o3_tpu.Frame.from_numpy({"v": v})
+    c = f.col("v")
+    # rank for p=0.5 on 10 values is 4.5 → low=5, high=6
+    assert abs(column_quantiles(c, [0.5], combine_method="low")[0] - 5.0) < 1e-3
+    assert abs(column_quantiles(c, [0.5], combine_method="high")[0] - 6.0) < 1e-3
+    assert abs(column_quantiles(c, [0.5], combine_method="average")[0] - 5.5) < 1e-3
+    assert abs(column_quantiles(c, [0.5])[0] - 5.5) < 1e-3
+
+
+def test_isotonic_out_of_bounds_na():
+    r = np.random.RandomState(1)
+    x = r.uniform(0, 10, 500)
+    y = x + 0.1 * r.randn(500)
+    f = h2o3_tpu.Frame.from_numpy({"x": x, "y": y})
+    m = IsotonicRegressionEstimator(out_of_bounds="na").train(f, x=["x"], y="y")
+    f2 = h2o3_tpu.Frame.from_numpy({"x": np.array([-5.0, 5.0, 50.0])})
+    p = m.predict(f2).to_pandas()["predict"].to_numpy()
+    assert np.isnan(p[0]) and np.isnan(p[2]) and not np.isnan(p[1])

@@ -1,0 +1,86 @@
+"""Grid search / Leaderboard / StackedEnsemble tests — pyunit_grid* /
+pyunit_stackedensemble* role."""
+
+import numpy as np
+import pytest
+
+import h2o3_tpu
+from h2o3_tpu.ml.ensemble import StackedEnsembleEstimator
+from h2o3_tpu.ml.grid import GridSearch
+from h2o3_tpu.ml.leaderboard import Leaderboard
+from h2o3_tpu.models.drf import DRFEstimator
+from h2o3_tpu.models.gbm import GBMEstimator
+from h2o3_tpu.models.glm import GLMEstimator
+
+
+def test_grid_cartesian(classif_frame):
+    gs = GridSearch(GBMEstimator,
+                    {"max_depth": [2, 4], "learn_rate": [0.1, 0.3]},
+                    ntrees=8, seed=1)
+    grid = gs.train(classif_frame, y="y")
+    assert len(grid.models) == 4
+    ms = grid.sorted_models("auc")
+    aucs = [m.default_metrics["AUC"] for m in ms]
+    assert aucs == sorted(aucs, reverse=True)
+    assert all("grid_params" in m.output for m in ms)
+
+
+def test_grid_random_discrete_budget(classif_frame):
+    gs = GridSearch(GBMEstimator,
+                    {"max_depth": [2, 3, 4, 5], "learn_rate": [0.05, 0.1, 0.2]},
+                    search_criteria={"strategy": "RandomDiscrete",
+                                     "max_models": 3, "seed": 42},
+                    ntrees=5, seed=1)
+    grid = gs.train(classif_frame, y="y")
+    assert len(grid.models) == 3
+
+
+def test_grid_failure_recorded(classif_frame):
+    gs = GridSearch(GBMEstimator, {"max_depth": [3, -5]}, ntrees=5)
+    grid = gs.train(classif_frame, y="y")
+    assert len(grid.models) >= 1
+    assert len(grid.failures) >= 1 or len(grid.models) == 2
+
+
+def test_leaderboard_ranks(classif_frame):
+    m1 = GBMEstimator(ntrees=15, max_depth=4, seed=1).train(classif_frame, y="y")
+    m2 = GLMEstimator(family="binomial").train(classif_frame, y="y")
+    lb = Leaderboard("t")
+    lb.add(m1, m2)
+    tab = lb.as_table()
+    assert len(tab) == 2
+    assert tab[0]["auc"] >= tab[1]["auc"]
+    assert lb.leader.key == tab[0]["model_id"]
+
+
+def test_stacked_ensemble_beats_or_matches_base(classif_frame):
+    m1 = GBMEstimator(ntrees=15, max_depth=3, seed=1, nfolds=3).train(
+        classif_frame, y="y")
+    m2 = GLMEstimator(family="binomial", nfolds=3).train(classif_frame, y="y")
+    se = StackedEnsembleEstimator(base_models=[m1, m2]).train(
+        classif_frame, y="y")
+    perf = se.model_performance(classif_frame)
+    base_best = max(m1.cross_validation_metrics["AUC"],
+                    m2.cross_validation_metrics["AUC"])
+    assert perf["AUC"] > base_best - 0.03, (perf["AUC"], base_best)
+    preds = se.predict(classif_frame).to_pandas()
+    assert {"predict", "p0", "p1"} <= set(preds.columns)
+
+
+def test_stacked_ensemble_requires_cv(classif_frame):
+    m1 = GBMEstimator(ntrees=5, seed=1).train(classif_frame, y="y")
+    m2 = GLMEstimator(family="binomial").train(classif_frame, y="y")
+    with pytest.raises((RuntimeError, ValueError), match="holdout"):
+        StackedEnsembleEstimator(base_models=[m1, m2]).train(
+            classif_frame, y="y")
+
+
+def test_stacked_ensemble_regression(regress_frame):
+    m1 = GBMEstimator(ntrees=15, max_depth=4, seed=1, nfolds=3).train(
+        regress_frame, y="y")
+    m2 = GLMEstimator(family="gaussian", nfolds=3).train(regress_frame, y="y")
+    se = StackedEnsembleEstimator(base_models=[m1, m2]).train(
+        regress_frame, y="y")
+    perf = se.model_performance(regress_frame)
+    assert perf["MSE"] <= 1.1 * min(m1.cross_validation_metrics["MSE"],
+                                    m2.cross_validation_metrics["MSE"])
