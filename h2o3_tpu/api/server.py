@@ -1,0 +1,502 @@
+"""REST API server — the water.api surface.
+
+Reference: water/api/RequestServer.java:56 (route tree, dispatch at
+:371-388), versioned Schema wire contract (water/api/Schema.java),
+handlers per endpoint (CloudHandler, ParseHandler, ModelBuilderHandler,
+JobsHandler, FramesHandler, RapidsHandler, ...). The reference serves
+/3/* (stable) and /99/* (experimental: Rapids, AutoML); clients poll
+GET /3/Jobs/{key} for async work.
+
+This server keeps the same URI shapes and JSON field names that h2o-py
+relies on (h2o-py/h2o/backend/connection.py), implemented on Python's
+threading HTTP server — the web tier is control-plane only; all data
+stays in device HBM, responses carry keys + small previews.
+"""
+
+from __future__ import annotations
+
+import json
+import re
+import threading
+import urllib.parse
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from h2o3_tpu.core import cloud as cloud_mod
+from h2o3_tpu.core.job import Job, list_jobs
+from h2o3_tpu.core.kv import DKV
+from h2o3_tpu.frame.frame import Frame
+from h2o3_tpu.models import all_algos, get_builder
+from h2o3_tpu.models.model import Model
+from h2o3_tpu.utils.log import get_logger
+
+log = get_logger("h2o3_tpu.api")
+
+ROUTES: List[Tuple[str, re.Pattern, Callable]] = []
+
+
+def route(method: str, pattern: str):
+    rx = re.compile("^" + pattern + "$")
+
+    def deco(fn):
+        ROUTES.append((method, rx, fn))
+        return fn
+    return deco
+
+
+def _coerce(v: str) -> Any:
+    """Form-value → python (the Schema fillFromParms coercion)."""
+    if not isinstance(v, str):
+        return v
+    s = v.strip()
+    if s.lower() in ("true", "false"):
+        return s.lower() == "true"
+    if s.lower() in ("null", "none", ""):
+        return None
+    if s.startswith("[") or s.startswith("{"):
+        try:
+            return json.loads(s.replace("'", '"'))
+        except json.JSONDecodeError:
+            pass
+    try:
+        f = float(s)
+        return int(f) if f == int(f) and "." not in s and "e" not in s.lower() else f
+    except ValueError:
+        return s
+
+
+def _frame_json(fr: Frame, rows: int = 10) -> dict:
+    """Frame preview schema (water/api/schemas3/FrameV3)."""
+    cols = []
+    for n in fr.names:
+        c = fr.col(n)
+        preview = c.to_numpy()[:rows]
+        if c.is_categorical and c.domain:
+            dom = np.array(c.domain + [None], dtype=object)
+            codes = np.asarray(c.data)[: min(rows, fr.nrows)].astype(np.int64)
+            na = np.asarray(c.na_mask)[: min(rows, fr.nrows)]
+            preview = dom[np.where(na, len(c.domain), codes)]
+        cols.append({
+            "label": n, "type": c.type,
+            "domain": c.domain,
+            "data": [None if (isinstance(x, float) and np.isnan(x)) else
+                     (x.item() if isinstance(x, np.generic) else x)
+                     for x in preview],
+        })
+    return {"frame_id": {"name": fr.key}, "rows": fr.nrows,
+            "num_columns": fr.ncols, "column_names": fr.names,
+            "columns": cols}
+
+
+# ------------------------------------------------------------- handlers
+
+
+@route("GET", "/3/Cloud")
+def _cloud(params, body):
+    info = cloud_mod.cluster_info()
+    return {"version": info["version"], "cloud_name": info["cloud_name"],
+            "cloud_size": info["cloud_size"],
+            "cloud_healthy": info["cloud_healthy"],
+            "consensus": True, "locked": True,
+            "nodes": [{"h2o": d, "healthy": True}
+                      for d in info["devices"]]}
+
+
+@route("GET", "/3/Ping")
+def _ping(params, body):
+    return {"status": "running"}
+
+
+@route("GET", "/3/About")
+def _about(params, body):
+    info = cloud_mod.cluster_info()
+    return {"entries": [{"name": "Build version", "value": info["version"]},
+                        {"name": "Backend", "value": info["platform"]}]}
+
+
+@route("POST", "/3/ImportFiles")
+def _import_files(params, body):
+    path = params.get("path")
+    return {"files": [path], "destination_frames": [path], "fails": [],
+            "dels": []}
+
+
+@route("POST", "/3/ParseSetup")
+def _parse_setup(params, body):
+    from h2o3_tpu.io.parser import parse_setup
+    src = params.get("source_frames")
+    if isinstance(src, list):
+        src = src[0]
+    src = str(src).strip('[]"')
+    setup = parse_setup(src)
+    return {"source_frames": [{"name": src}],
+            "destination_frame": src.split("/")[-1] + ".hex",
+            "column_names": setup["columns"],
+            "column_types": [setup["types"][c] for c in setup["columns"]],
+            "separator": ord(setup["separator"]),
+            "check_header": 1 if setup["header"] else 0,
+            "number_columns": len(setup["columns"])}
+
+
+@route("POST", "/3/Parse")
+def _parse(params, body):
+    from h2o3_tpu.io.parser import import_file
+    src = params.get("source_frames")
+    if isinstance(src, list):
+        src = src[0]
+    src = str(src).strip('[]"')
+    dest = params.get("destination_frame") or None
+    job = Job(f"parse {src}", dest=dest)
+
+    def _run(j):
+        fr = import_file(src, destination_frame=dest)
+        j.update(1.0, "parsed")
+        return fr
+
+    job.start(_run, background=True)
+    return {"job": job.to_dict()}
+
+
+@route("GET", "/3/Frames")
+def _frames(params, body):
+    out = []
+    for k in DKV.keys():
+        v = DKV.get(k)
+        if isinstance(v, Frame):
+            out.append({"frame_id": {"name": k}, "rows": v.nrows,
+                        "num_columns": v.ncols})
+    return {"frames": out}
+
+
+@route("GET", r"/3/Frames/(?P<fid>[^/]+)/summary")
+def _frame_summary(params, body, fid=None):
+    fr = DKV.get(fid)
+    if not isinstance(fr, Frame):
+        raise KeyError(f"frame {fid} not found")
+    summ = fr.summary()
+    j = _frame_json(fr)
+    for c in j["columns"]:
+        s = summ.get(c["label"], {})
+        c.update({k: (None if v is None or (isinstance(v, float) and np.isnan(v)) else v)
+                  for k, v in s.items() if k in
+                  ("min", "max", "mean", "sigma", "na_count", "zeros",
+                   "cardinality", "type")})
+    return {"frames": [j]}
+
+
+@route("GET", r"/3/Frames/(?P<fid>[^/]+)")
+def _frame_one(params, body, fid=None):
+    fr = DKV.get(fid)
+    if not isinstance(fr, Frame):
+        raise KeyError(f"frame {fid} not found")
+    rows = int(params.get("row_count") or 10)
+    return {"frames": [_frame_json(fr, rows=rows)]}
+
+
+@route("DELETE", r"/3/Frames/(?P<fid>[^/]+)")
+def _frame_del(params, body, fid=None):
+    DKV.remove(fid)
+    return {}
+
+
+@route("DELETE", r"/3/DKV/(?P<key>[^/]+)")
+def _dkv_del(params, body, key=None):
+    DKV.remove(key)
+    return {}
+
+
+@route("GET", "/3/ModelBuilders")
+def _builders(params, body):
+    out = {}
+    for algo in all_algos():
+        cls = get_builder(algo)
+        out[algo] = {"algo": algo, "algo_full_name": cls.__name__,
+                     "parameters": [
+                         {"name": k, "default_value": d,
+                          "type": type(d).__name__}
+                         for k, d in cls.DEFAULTS.items()]}
+    return {"model_builders": out}
+
+
+@route("POST", r"/3/ModelBuilders/(?P<algo>[^/]+)")
+def _train(params, body, algo=None):
+    cls = get_builder(algo)
+    p = {k: _coerce(v) for k, v in params.items()}
+    frame_key = p.pop("training_frame", None)
+    y = p.pop("response_column", None)
+    valid_key = p.pop("validation_frame", None)
+    model_id = p.pop("model_id", None)
+    ignored = p.pop("ignored_columns", None)
+    fr = DKV.get(str(frame_key))
+    if not isinstance(fr, Frame):
+        raise KeyError(f"training_frame {frame_key} not found")
+    vf = DKV.get(str(valid_key)) if valid_key else None
+    known = set(cls.DEFAULTS)
+    builder_params = {k: v for k, v in p.items() if k in known}
+    if ignored is not None:
+        builder_params["ignored_columns"] = ignored
+    builder = cls(**builder_params)
+    job = Job(f"{algo} train", dest=model_id)
+
+    # run the full ModelBuilder.train lifecycle on a worker thread
+    def _run2(j):
+        nfolds = int(builder.params.get("nfolds") or 0)
+        x = builder.resolve_x(fr, None, y)
+        if nfolds >= 2:
+            from h2o3_tpu.ml.cv import train_with_cv
+            model = train_with_cv(builder, fr, x, y, nfolds, j)
+        else:
+            model = builder._fit(fr, x, y, j, validation_frame=vf)
+        if model_id:
+            DKV.put(model_id, model)
+            model.key = model_id
+        return model
+
+    job.start(_run2, background=True)
+    return {"job": job.to_dict()}
+
+
+@route("GET", r"/3/Jobs/(?P<key>[^/]+)")
+def _job(params, body, key=None):
+    j = DKV.get(key)
+    if not isinstance(j, Job):
+        raise KeyError(f"job {key} not found")
+    d = j.to_dict()
+    # h2o-py expects job.status in {CREATED,RUNNING,DONE,FAILED,CANCELLED}
+    if j.status == "DONE" and j.result is not None and \
+            isinstance(j.result, Model):
+        d["dest"] = {"name": j.result.key, "type": "Key<Model>"}
+    return {"jobs": [d]}
+
+
+@route("POST", r"/3/Jobs/(?P<key>[^/]+)/cancel")
+def _job_cancel(params, body, key=None):
+    j = DKV.get(key)
+    if isinstance(j, Job):
+        j.cancel()
+    return {}
+
+
+@route("GET", "/3/Jobs")
+def _jobs(params, body):
+    return {"jobs": list_jobs()}
+
+
+@route("GET", "/3/Models")
+def _models(params, body):
+    out = []
+    for k in DKV.keys():
+        v = DKV.get(k)
+        if isinstance(v, Model):
+            out.append(v.to_dict())
+    return {"models": out}
+
+
+@route("GET", r"/3/Models/(?P<mid>[^/]+)")
+def _model_one(params, body, mid=None):
+    m = DKV.get(mid)
+    if not isinstance(m, Model):
+        raise KeyError(f"model {mid} not found")
+    return {"models": [m.to_dict()]}
+
+
+@route("DELETE", r"/3/Models/(?P<mid>[^/]+)")
+def _model_del(params, body, mid=None):
+    DKV.remove(mid)
+    return {}
+
+
+@route("POST", r"/3/Predictions/models/(?P<mid>[^/]+)/frames/(?P<fid>[^/]+)")
+def _predict(params, body, mid=None, fid=None):
+    m = DKV.get(mid)
+    fr = DKV.get(fid)
+    if not isinstance(m, Model):
+        raise KeyError(f"model {mid} not found")
+    if not isinstance(fr, Frame):
+        raise KeyError(f"frame {fid} not found")
+    dest = params.get("predictions_frame") or f"predictions_{mid}_{fid}"
+    preds = m.predict(fr)
+    DKV.remove(preds.key)
+    preds.key = str(dest)
+    DKV.put(preds.key, preds)
+    return {"predictions_frame": {"name": preds.key},
+            "model_metrics": [{}]}
+
+
+@route("POST", "/99/Rapids")
+def _rapids_ep(params, body):
+    from h2o3_tpu.rapids import rapids
+    expr = params.get("ast") or ""
+    try:
+        val = rapids(expr)
+    except Exception as e:
+        return {"error": str(e)}
+    if isinstance(val, Frame):
+        return {"key": {"name": val.key},
+                "frame": _frame_json(val, rows=5)}
+    if isinstance(val, (int, float)):
+        return {"scalar": float(val)}
+    return {"string": str(val)}
+
+
+@route("POST", "/99/AutoMLBuilder")
+def _automl(params, body):
+    from h2o3_tpu.automl import H2OAutoML
+    p = {k: _coerce(v) for k, v in params.items()}
+    spec = p.get("build_control") or {}
+    if isinstance(spec, str):
+        spec = json.loads(spec)
+    frame_key = p.get("training_frame")
+    y = p.get("response_column")
+    fr = DKV.get(str(frame_key))
+    aml = H2OAutoML(
+        max_models=int(p.get("max_models") or 0),
+        max_runtime_secs=float(p.get("max_runtime_secs") or 3600),
+        seed=int(p.get("seed") or -1),
+        nfolds=int(p.get("nfolds") or 5),
+        project_name=p.get("project_name"))
+    job = Job("automl", dest=aml.project_name)
+
+    def _run(j):
+        aml.train(y=y, training_frame=fr)
+        j.update(1.0, "done")
+        DKV.put(f"leaderboard_{aml.project_name}_result", aml)
+        return aml
+
+    job.start(_run, background=True)
+    return {"job": job.to_dict(), "project_name": aml.project_name}
+
+
+@route("GET", r"/99/Leaderboards/(?P<project>[^/]+)")
+def _leaderboard(params, body, project=None):
+    aml = DKV.get(f"leaderboard_{project}_result")
+    if aml is None:
+        raise KeyError(f"automl project {project} not found")
+    return {"project_name": project,
+            "models": [m.key for m in aml.leaderboard.sorted_models()],
+            "leaderboard_table": aml.leaderboard.as_table()}
+
+
+@route("GET", "/3/Timeline")
+def _timeline(params, body):
+    return {"events": []}
+
+
+@route("GET", "/3/Logs/download")
+def _logs(params, body):
+    return {"log": ""}
+
+
+@route("POST", "/3/Shutdown")
+def _shutdown(params, body):
+    threading.Thread(target=lambda: _SERVER and _SERVER.shutdown(),
+                     daemon=True).start()
+    return {}
+
+
+# ------------------------------------------------------------- plumbing
+
+
+class _Handler(BaseHTTPRequestHandler):
+    protocol_version = "HTTP/1.1"
+
+    def log_message(self, fmt, *args):   # route to our logger
+        log.debug("http: " + fmt, *args)
+
+    def _dispatch(self, method: str):
+        parsed = urllib.parse.urlparse(self.path)
+        path = parsed.path
+        params: Dict[str, str] = {
+            k: v[0] for k, v in urllib.parse.parse_qs(parsed.query).items()}
+        length = int(self.headers.get("Content-Length") or 0)
+        raw = self.rfile.read(length) if length else b""
+        body = raw.decode("utf-8", "replace")
+        ctype = self.headers.get("Content-Type", "")
+        if "json" in ctype and body:
+            try:
+                params.update(json.loads(body))
+            except json.JSONDecodeError:
+                pass
+        elif body:
+            params.update({k: v[0]
+                           for k, v in urllib.parse.parse_qs(body).items()})
+        for m, rx, fn in ROUTES:
+            if m != method:
+                continue
+            match = rx.match(path)
+            if match:
+                try:
+                    out = fn(params, body, **match.groupdict())
+                    code = 200
+                except KeyError as e:
+                    out = {"__meta": {"schema_type": "H2OError"},
+                           "error_url": path, "msg": str(e),
+                           "exception_msg": str(e)}
+                    code = 404
+                except Exception as e:   # noqa: BLE001 - request boundary
+                    log.exception("handler error on %s %s", method, path)
+                    out = {"__meta": {"schema_type": "H2OError"},
+                           "error_url": path, "msg": str(e),
+                           "exception_msg": str(e)}
+                    code = 500
+                payload = json.dumps(out, default=_json_default).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(payload)))
+                self.end_headers()
+                self.wfile.write(payload)
+                return
+        self.send_response(404)
+        payload = json.dumps({"msg": f"no route {method} {path}"}).encode()
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(payload)))
+        self.end_headers()
+        self.wfile.write(payload)
+
+    def do_GET(self):
+        self._dispatch("GET")
+
+    def do_POST(self):
+        self._dispatch("POST")
+
+    def do_DELETE(self):
+        self._dispatch("DELETE")
+
+
+def _json_default(o):
+    if isinstance(o, np.generic):
+        return o.item()
+    if isinstance(o, np.ndarray):
+        return o.tolist()
+    if isinstance(o, float) and np.isnan(o):
+        return None
+    return str(o)
+
+
+_SERVER: Optional[ThreadingHTTPServer] = None
+_THREAD: Optional[threading.Thread] = None
+
+
+def start_server(port: int = 54321, background: bool = True) -> int:
+    """Start the REST server (water.api.RequestServer.start).
+
+    Returns the bound port (0 picks an ephemeral port)."""
+    global _SERVER, _THREAD
+    _SERVER = ThreadingHTTPServer(("127.0.0.1", port), _Handler)
+    actual = _SERVER.server_address[1]
+    log.info("REST server on http://127.0.0.1:%d (/3, /99)", actual)
+    if background:
+        _THREAD = threading.Thread(target=_SERVER.serve_forever, daemon=True)
+        _THREAD.start()
+    else:
+        _SERVER.serve_forever()
+    return actual
+
+
+def stop_server():
+    global _SERVER
+    if _SERVER is not None:
+        _SERVER.shutdown()
+        _SERVER = None

@@ -1,0 +1,150 @@
+"""Rapids expression engine tests — the pyunit munging suite role
+(h2o-py/tests/testdir_munging/)."""
+
+import numpy as np
+import pytest
+
+import h2o3_tpu
+from h2o3_tpu.rapids import Session, parse, rapids
+
+
+@pytest.fixture()
+def sess():
+    return Session()
+
+
+@pytest.fixture()
+def data(sess):
+    r = np.random.RandomState(0)
+    f = h2o3_tpu.Frame.from_numpy(
+        {"a": np.arange(10, dtype=np.float64),
+         "b": r.randn(10),
+         "g": np.array(list("xyxyxyxyxy"), dtype=object)},
+        categorical=["g"], key="data")
+    sess.assign("data", f)
+    return f
+
+
+def test_parser():
+    ast = parse('(tmp= x (+ (cols_py data [0]) 5))')
+    assert ast[0] == ("id", "tmp=")
+    assert ast[1] == ("id", "x")
+
+
+def test_arithmetic(sess, data):
+    out = rapids('(+ (cols_py data ["a"]) 5)', sess)
+    v = out.col("a").to_numpy()
+    np.testing.assert_allclose(v, np.arange(10) + 5)
+
+
+def test_comparison_and_rows(sess, data):
+    out = rapids('(rows data (> (cols_py data ["a"]) 6))', sess)
+    assert out.nrows == 3
+    np.testing.assert_allclose(out.col("a").to_numpy(), [7, 8, 9])
+    # categorical survives the slice
+    assert out.col("g").is_categorical
+
+
+def test_reducers(sess, data):
+    assert rapids('(sum (cols_py data ["a"]))', sess) == 45.0
+    assert rapids('(mean (cols_py data ["a"]))', sess) == 4.5
+    assert abs(rapids('(sd (cols_py data ["a"]))', sess)
+               - np.std(np.arange(10), ddof=1)) < 1e-9
+
+
+def test_assign_and_lookup(sess, data):
+    rapids('(tmp= doubled (* (cols_py data ["a"]) 2))', sess)
+    out = rapids('(sum doubled)', sess)
+    assert out == 90.0
+
+
+def test_ifelse(sess, data):
+    out = rapids('(ifelse (> (cols_py data ["a"]) 4) 1 0)', sess)
+    np.testing.assert_allclose(out.col("C1").to_numpy(),
+                               (np.arange(10) > 4).astype(float))
+
+
+def test_cbind_rbind(sess, data):
+    out = rapids('(cbind (cols_py data ["a"]) (cols_py data ["b"]))', sess)
+    assert out.names == ["a", "b"]
+    out2 = rapids('(rbind data data)', sess)
+    assert out2.nrows == 20
+    assert out2.col("g").domain == ["x", "y"]
+
+
+def test_groupby_device_aggs(sess, data):
+    out = rapids('(GB data ["g"] "mean" "a" "all" "sum" "b" "all" '
+                 '"count" "a" "all")', sess)
+    df = out.to_pandas().sort_values("g").reset_index(drop=True)
+    a = np.arange(10)
+    assert list(df["g"]) == ["x", "y"]
+    np.testing.assert_allclose(df["mean_a"], [a[::2].mean(), a[1::2].mean()])
+    np.testing.assert_allclose(df["nrow"], [5, 5])
+
+
+def test_groupby_minmax(sess, data):
+    out = rapids('(GB data ["g"] "max" "a" "all" "min" "a" "all")', sess)
+    df = out.to_pandas().sort_values("g")
+    np.testing.assert_allclose(df["max_a"], [8, 9])
+    np.testing.assert_allclose(df["min_a"], [0, 1])
+
+
+def test_sort(sess, data):
+    out = rapids('(sort data ["b"] [1])', sess)
+    v = out.col("b").to_numpy()
+    assert (np.diff(v) >= 0).all()
+
+
+def test_merge(sess):
+    l = h2o3_tpu.Frame.from_numpy(
+        {"k": np.array(["a", "b", "c"], dtype=object),
+         "v1": np.array([1.0, 2.0, 3.0])}, categorical=["k"])
+    r = h2o3_tpu.Frame.from_numpy(
+        {"k": np.array(["b", "c", "d"], dtype=object),
+         "v2": np.array([20.0, 30.0, 40.0])}, categorical=["k"])
+    sess.assign("L", l)
+    sess.assign("R", r)
+    out = rapids('(merge L R 0 0)', sess)
+    df = out.to_pandas().sort_values("k")
+    assert list(df["k"]) == ["b", "c"]
+    np.testing.assert_allclose(df["v2"], [20.0, 30.0])
+
+
+def test_string_ops(sess):
+    f = h2o3_tpu.Frame.from_numpy(
+        {"s": np.array(["Hello", "World", None], dtype=object)},
+        categorical=["s"])
+    sess.assign("S", f)
+    out = rapids('(tolower S)', sess)
+    vals = out.to_pandas()["s"].tolist()
+    assert vals[:2] == ["hello", "world"]
+    n = rapids('(nchar S)', sess)
+    v = n.col("s").to_numpy()
+    assert v[0] == 5.0 and np.isnan(v[2])
+
+
+def test_as_factor_numeric_roundtrip(sess, data):
+    out = rapids('(as.factor (cols_py data ["a"]))', sess)
+    assert out.col("a").is_categorical
+    back = rapids('(as.numeric (as.factor (cols_py data ["a"])))', sess)
+    np.testing.assert_allclose(back.col("a").to_numpy(), np.arange(10))
+
+
+def test_na_handling(sess):
+    v = np.array([1.0, np.nan, 3.0])
+    f = h2o3_tpu.Frame.from_numpy({"x": v})
+    sess.assign("N", f)
+    assert np.isnan(rapids('(sum N)', sess))
+    assert rapids('(sum N 1)', sess) == 4.0       # na_rm
+    isna = rapids('(is.na N)', sess).col("x").to_numpy()
+    np.testing.assert_allclose(isna, [0, 1, 0])
+    imp = rapids('(h2o.impute N [0] "mean")', sess)
+    np.testing.assert_allclose(imp.col("x").to_numpy(), [1.0, 2.0, 3.0])
+
+
+def test_unique_table(sess, data):
+    t = rapids('(table (cols_py data ["g"]))', sess)
+    df = t.to_pandas()
+    assert df["Count"].sum() == 10
+    u = rapids('(unique (cols_py data ["g"]))', sess)
+    assert u.nrows == 2

@@ -1,0 +1,159 @@
+"""REST API tests — the testdir_apis role: drive the server over real
+HTTP the way h2o-py's connection layer does."""
+
+import json
+import time
+import urllib.parse
+import urllib.request
+
+import numpy as np
+import pytest
+
+import h2o3_tpu
+from h2o3_tpu.api.server import start_server, stop_server
+
+
+@pytest.fixture(scope="module")
+def port():
+    p = start_server(port=0, background=True)
+    yield p
+    stop_server()
+
+
+def _req(port, method, path, **params):
+    url = f"http://127.0.0.1:{port}{path}"
+    data = None
+    if method in ("POST",):
+        data = urllib.parse.urlencode(
+            {k: (json.dumps(v) if isinstance(v, (list, dict)) else v)
+             for k, v in params.items()}).encode()
+    elif params:
+        url += "?" + urllib.parse.urlencode(params)
+    req = urllib.request.Request(url, data=data, method=method)
+    if data:
+        req.add_header("Content-Type", "application/x-www-form-urlencoded")
+    try:
+        with urllib.request.urlopen(req) as resp:
+            return resp.status, json.loads(resp.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+def _wait_job(port, key, timeout=300):
+    t0 = time.time()
+    while time.time() - t0 < timeout:
+        st, j = _req(port, "GET", f"/3/Jobs/{key}")
+        assert st == 200, j
+        status = j["jobs"][0]["status"]
+        if status in ("DONE", "FAILED", "CANCELLED"):
+            return j["jobs"][0]
+        time.sleep(0.3)
+    raise TimeoutError(key)
+
+
+def test_cloud_up(port):
+    st, j = _req(port, "GET", "/3/Cloud")
+    assert st == 200
+    assert j["cloud_size"] == 8
+    assert j["cloud_healthy"]
+
+
+def test_frames_roundtrip(port):
+    r = np.random.RandomState(0)
+    fr = h2o3_tpu.Frame.from_numpy(
+        {"x": r.randn(50), "g": np.array(["a", "b"], dtype=object)[
+            r.randint(0, 2, 50)]},
+        categorical=["g"], key="rest_test_frame")
+    st, j = _req(port, "GET", "/3/Frames")
+    assert st == 200
+    names = [f["frame_id"]["name"] for f in j["frames"]]
+    assert "rest_test_frame" in names
+    st, j = _req(port, "GET", "/3/Frames/rest_test_frame")
+    assert st == 200
+    f0 = j["frames"][0]
+    assert f0["rows"] == 50 and f0["num_columns"] == 2
+    st, j = _req(port, "GET", "/3/Frames/rest_test_frame/summary")
+    assert st == 200
+    assert any("mean" in c for c in j["frames"][0]["columns"])
+
+
+def test_frame_not_found(port):
+    st, j = _req(port, "GET", "/3/Frames/nope")
+    assert st == 404
+
+
+def test_train_and_predict_over_rest(port):
+    r = np.random.RandomState(1)
+    n = 2000
+    X = r.randn(n, 4)
+    y = (X[:, 0] + 0.5 * X[:, 1] > 0).astype(int)
+    h2o3_tpu.Frame.from_numpy(
+        {**{f"x{i}": X[:, i] for i in range(4)},
+         "y": np.array(["n", "p"], dtype=object)[y]},
+        categorical=["y"], key="rest_train")
+    st, j = _req(port, "POST", "/3/ModelBuilders/gbm",
+                 training_frame="rest_train", response_column="y",
+                 ntrees=5, max_depth=3, seed=1, model_id="rest_gbm_model")
+    assert st == 200, j
+    job = _wait_job(port, j["job"]["key"])
+    assert job["status"] == "DONE", job
+    st, j = _req(port, "GET", "/3/Models/rest_gbm_model")
+    assert st == 200
+    md = j["models"][0]
+    assert md["algo"] == "gbm"
+    assert md["training_metrics"]["AUC"] > 0.7
+    st, j = _req(port, "POST",
+                 "/3/Predictions/models/rest_gbm_model/frames/rest_train")
+    assert st == 200
+    pred_key = j["predictions_frame"]["name"]
+    st, j = _req(port, "GET", f"/3/Frames/{pred_key}")
+    assert st == 200
+    assert "predict" in j["frames"][0]["column_names"]
+
+
+def test_model_builders_listing(port):
+    st, j = _req(port, "GET", "/3/ModelBuilders")
+    assert st == 200
+    assert "gbm" in j["model_builders"]
+    names = {p["name"] for p in j["model_builders"]["gbm"]["parameters"]}
+    assert "ntrees" in names and "learn_rate" in names
+
+
+def test_rapids_over_rest(port):
+    h2o3_tpu.Frame.from_numpy({"v": np.arange(20, dtype=np.float64)},
+                              key="rapids_rest")
+    st, j = _req(port, "POST", "/99/Rapids",
+                 ast='(sum (cols_py rapids_rest ["v"]))')
+    assert st == 200
+    assert j["scalar"] == 190.0
+    st, j = _req(port, "POST", "/99/Rapids",
+                 ast='(tmp= rr2 (* (cols_py rapids_rest ["v"]) 2))')
+    assert st == 200
+    assert j["frame"]["rows"] == 20
+
+
+def test_parse_endpoint(port, tmp_path):
+    csv = tmp_path / "mini.csv"
+    csv.write_text("a,b\n1,x\n2,y\n3,x\n")
+    st, j = _req(port, "POST", "/3/ParseSetup",
+                 source_frames=json.dumps([str(csv)]))
+    assert st == 200
+    assert j["column_names"] == ["a", "b"]
+    st, j = _req(port, "POST", "/3/Parse",
+                 source_frames=json.dumps([str(csv)]),
+                 destination_frame="mini_hex")
+    assert st == 200
+    _wait_job(port, j["job"]["key"])
+    st, j = _req(port, "GET", "/3/Frames/mini_hex")
+    assert st == 200
+    assert j["frames"][0]["rows"] == 3
+
+
+def test_jobs_listing_and_delete(port):
+    st, j = _req(port, "GET", "/3/Jobs")
+    assert st == 200
+    assert isinstance(j["jobs"], list)
+    st, _ = _req(port, "DELETE", "/3/Frames/rapids_rest")
+    assert st == 200
+    st, j = _req(port, "GET", "/3/Frames/rapids_rest")
+    assert st == 404
