@@ -238,23 +238,9 @@ def _train(params, body, algo=None):
     if ignored is not None:
         builder_params["ignored_columns"] = ignored
     builder = cls(**builder_params)
-    job = Job(f"{algo} train", dest=model_id)
-
-    # run the full ModelBuilder.train lifecycle on a worker thread
-    def _run2(j):
-        nfolds = int(builder.params.get("nfolds") or 0)
-        x = builder.resolve_x(fr, None, y)
-        if nfolds >= 2:
-            from h2o3_tpu.ml.cv import train_with_cv
-            model = train_with_cv(builder, fr, x, y, nfolds, j)
-        else:
-            model = builder._fit(fr, x, y, j, validation_frame=vf)
-        if model_id:
-            DKV.put(model_id, model)
-            model.key = model_id
-        return model
-
-    job.start(_run2, background=True)
+    # the one ModelBuilder.train lifecycle (CV dispatch, run_time, logs)
+    job = builder.train(fr, y=y, validation_frame=vf, background=True,
+                        dest_key=model_id)
     return {"job": job.to_dict()}
 
 
@@ -345,18 +331,33 @@ def _rapids_ep(params, body):
 def _automl(params, body):
     from h2o3_tpu.automl import H2OAutoML
     p = {k: _coerce(v) for k, v in params.items()}
-    spec = p.get("build_control") or {}
-    if isinstance(spec, str):
-        spec = json.loads(spec)
-    frame_key = p.get("training_frame")
-    y = p.get("response_column")
+    # h2o-py ships nested specs (h2o-py/h2o/automl/_estimator.py):
+    # build_control{project_name,nfolds,stopping_criteria{...}},
+    # input_spec{training_frame,response_column}, build_models{*_algos}
+    ctl = p.get("build_control") or {}
+    if isinstance(ctl, str):
+        ctl = json.loads(ctl)
+    crit = ctl.get("stopping_criteria") or {}
+    inp = p.get("input_spec") or {}
+    if isinstance(inp, str):
+        inp = json.loads(inp)
+    bm = p.get("build_models") or {}
+    if isinstance(bm, str):
+        bm = json.loads(bm)
+    frame_key = inp.get("training_frame") or p.get("training_frame")
+    y = inp.get("response_column") or p.get("response_column")
+    if isinstance(y, dict):
+        y = y.get("column_name")
     fr = DKV.get(str(frame_key))
     aml = H2OAutoML(
-        max_models=int(p.get("max_models") or 0),
-        max_runtime_secs=float(p.get("max_runtime_secs") or 3600),
-        seed=int(p.get("seed") or -1),
-        nfolds=int(p.get("nfolds") or 5),
-        project_name=p.get("project_name"))
+        max_models=int(crit.get("max_models") or p.get("max_models") or 0),
+        max_runtime_secs=float(crit.get("max_runtime_secs")
+                               or p.get("max_runtime_secs") or 3600),
+        seed=int(crit.get("seed") or p.get("seed") or -1),
+        nfolds=int(ctl.get("nfolds") or p.get("nfolds") or 5),
+        include_algos=bm.get("include_algos"),
+        exclude_algos=bm.get("exclude_algos"),
+        project_name=ctl.get("project_name") or p.get("project_name"))
     job = Job("automl", dest=aml.project_name)
 
     def _run(j):

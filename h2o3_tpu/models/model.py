@@ -185,7 +185,8 @@ class ModelBuilder:
     def train(self, training_frame: Frame, y: Optional[str] = None,
               x: Optional[Sequence[str]] = None,
               validation_frame: Optional[Frame] = None,
-              background: bool = False) -> Model:
+              background: bool = False,
+              dest_key: Optional[str] = None) -> Model:
         x = self.resolve_x(training_frame, x, y)
         nfolds = int(self.params.get("nfolds") or 0)
         job = Job(f"{self.algo} train", work=1.0)
@@ -200,6 +201,10 @@ class ModelBuilder:
                 model = self._fit(training_frame, x, y, j,
                                   validation_frame=validation_frame)
             model.output["run_time"] = time.time() - t0
+            if dest_key:   # REST model_id: rename into the requested key
+                DKV.remove(model.key)
+                model.key = dest_key
+                DKV.put(dest_key, model)
             log.info("%s trained in %.2fs -> %s", self.algo,
                      time.time() - t0, model.key)
             return model

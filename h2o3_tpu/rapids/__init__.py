@@ -80,7 +80,17 @@ class _Parser:
         try:
             return ("num", float(tok))
         except ValueError:
-            return ("id", tok)
+            pass
+        # 'lo:cnt[:step]' range inside number lists (AstNumList range
+        # syntax: cnt elements starting at lo, stride step; cnt may be
+        # 'nan' = through the end — h2o-py serializes Python slices this
+        # way, h2o-py/h2o/expr.py _arg_to_expr)
+        m = _re.fullmatch(
+            r"(-?\d+(?:\.\d+)?):(nan|-?\d+(?:\.\d+)?)(?::(-?\d+))?", tok)
+        if m:
+            return ("range", float(m.group(1)), float(m.group(2)),
+                    int(m.group(3) or 1))
+        return ("id", tok)
 
 
 def parse(expr: str):
@@ -195,9 +205,38 @@ def prim(*names):
     return deco
 
 
-def _binop(op):
+def _cmp_str(fr: Frame, s: str, negate: bool) -> Frame:
+    """Categorical/string column vs string literal — the wire form of
+    ``fr['g'] == 'x'``; matches against the domain, NA rows → NA."""
+    out = {}
+    for n in fr.names:
+        c = fr.col(n)
+        if c.is_categorical:
+            try:
+                code = (c.domain or []).index(s)
+            except ValueError:
+                code = -2
+            codes = _cat_codes(fr, n).astype(np.float64)
+            eq = (codes == code).astype(np.float64)
+            eq[codes < 0] = np.nan
+        elif c.type == "string":
+            eq = np.array([np.nan if v is None else float(v == s)
+                           for v in c.to_numpy()])
+        else:
+            eq = np.zeros(fr.nrows)   # numeric vs string: never equal
+        out[n] = (1.0 - eq) if negate else eq
+    return _rebuild(fr, out, keep_domains=False)
+
+
+def _binop(op, name: str = ""):
     def fn(env, l, r):
         l, r = env.ev(l), env.ev(r)
+        if name in ("==", "!=") and (isinstance(l, str) or isinstance(r, str)):
+            fr = l if isinstance(l, Frame) else r
+            s = r if isinstance(r, str) else l
+            if isinstance(fr, Frame) and isinstance(s, str):
+                return _cmp_str(fr, s, negate=(name == "!="))
+            return float((l == r) if name == "==" else (l != r))
         if not isinstance(l, Frame) and not isinstance(r, Frame):
             return float(op(l, r))
         pairs = _broadcast2(l, r)
@@ -224,7 +263,7 @@ for _name, _op in [("+", np.add), ("-", np.subtract), ("*", np.multiply),
                    ("&", lambda a, b: ((a != 0) & (b != 0)).astype(float)),
                    ("|", lambda a, b: ((a != 0) | (b != 0)).astype(float)),
                    ("intDiv", np.floor_divide), ("%/%", np.floor_divide)]:
-    PRIMS[_name] = _binop(_op)
+    PRIMS[_name] = _binop(_op, _name)
 
 
 def _unop(op):
@@ -330,18 +369,54 @@ for _name, _op in [("cumsum", np.cumsum), ("cumprod", np.cumprod),
 # ---- structural (ast/prims/mungers) ---------------------------------
 
 
+def _num_list_indices(sel, n: Optional[int] = None) -> Optional[List[int]]:
+    """Flatten a numeric selector (num / range / list of those) to ints;
+    None when the selector isn't purely numeric. ``n`` resolves
+    open-ended ('lo:nan') ranges."""
+    if isinstance(sel, tuple) and sel[0] == "num":
+        return [int(sel[1])]
+    if isinstance(sel, tuple) and sel[0] == "range":
+        lo = int(sel[1])
+        step = int(sel[3]) if len(sel) > 3 else 1
+        if math.isnan(sel[2]):
+            if n is None:
+                raise ValueError("open range needs a bound")
+            return list(range(lo, n, step))
+        return list(range(lo, lo + int(sel[2]) * step, step))
+    if isinstance(sel, tuple) and sel[0] == "list":
+        out: List[int] = []
+        for it in sel[1]:
+            sub = _num_list_indices(it, n)
+            if sub is None:
+                return None
+            out.extend(sub)
+        return out
+    if isinstance(sel, (int, float)):
+        return [int(sel)]
+    return None
+
+
+def _is_empty_list(sel) -> bool:
+    return isinstance(sel, tuple) and sel[0] == "list" and not sel[1]
+
+
 def _resolve_cols(frame: Frame, sel) -> List[str]:
+    nums = _num_list_indices(sel, frame.ncols)
+    if nums is not None:
+        # all-negative numeric selector = COMPLEMENT: h2o-py's pop/del
+        # send -(i+1) meaning "every column except i"
+        # (h2o-py/h2o/frame.py pop/drop wire format)
+        if nums and all(v < 0 for v in nums):
+            drop = {-(v) - 1 for v in nums}
+            return [n for i, n in enumerate(frame.names) if i not in drop]
+        return [frame.names[v] for v in nums]
     if isinstance(sel, tuple) and sel[0] == "list":
         out = []
         for it in sel[1]:
             out.extend(_resolve_cols(frame, it))
         return out
-    if isinstance(sel, tuple) and sel[0] == "num":
-        return [frame.names[int(sel[1])]]
     if isinstance(sel, tuple) and sel[0] in ("str", "id"):
         return [sel[1]]
-    if isinstance(sel, (int, float)):
-        return [frame.names[int(sel)]]
     if isinstance(sel, str):
         return [sel]
     raise ValueError(f"bad column selector {sel!r}")
@@ -353,19 +428,25 @@ def _cols(env, fr, sel):
     return f[_resolve_cols(f, sel)]
 
 
+def _row_indices(f: Frame, sel, env) -> np.ndarray:
+    nums = _num_list_indices(sel, f.nrows)
+    if nums is not None:
+        idx = np.asarray(nums, np.int64)
+        if len(idx) and (idx < 0).all():
+            # negative row list = complement (AstNumList semantics)
+            drop = set((-idx - 1).tolist())
+            return np.asarray([i for i in range(f.nrows) if i not in drop],
+                              np.int64)
+        return idx
+    mask_fr = _as_frame(env.ev(sel))
+    m = _col_np(mask_fr, mask_fr.names[0])
+    return np.flatnonzero(np.nan_to_num(m) != 0)
+
+
 @prim("rows")
 def _rows(env, fr, sel):
     f = _as_frame(env.ev(fr))
-    if isinstance(sel, tuple) and sel[0] == "list":
-        idx = np.asarray([int(i[1]) for i in sel[1]], np.int64)
-        idx = np.where(idx < 0, f.nrows + idx, idx)
-    elif isinstance(sel, tuple) and sel[0] == "num":
-        idx = np.asarray([int(sel[1])])
-    else:
-        mask_fr = _as_frame(env.ev(sel))
-        m = _col_np(mask_fr, mask_fr.names[0])
-        idx = np.flatnonzero(np.nan_to_num(m) != 0)
-    return _take_rows(f, idx)
+    return _take_rows(f, _row_indices(f, sel, env))
 
 
 @prim("append", "cbind")
@@ -451,12 +532,84 @@ def _colnames(env, fr, idxs, names):
     return Frame.from_numpy(out, categorical=cats, domains=doms)
 
 
-@prim("tmp=", ":=", "assign")
+@prim("tmp=", "assign")
 def _assign(env, name, expr, *rest):
     nm = name[1] if isinstance(name, tuple) else str(name)
     val = env.ev(expr)
     env.session.assign(nm, val)
     return val
+
+
+@prim(":=")
+def _rect_assign(env, dst, src, col_sel, row_sel):
+    """Rectangle assign (water/rapids/ast/prims/assign/AstRectangleAssign
+    role): h2o-py `fr[rows, col] = value` ships
+    ``(:= <frame> <value> <col> <rows>)`` with '[]' = all rows/cols
+    (h2o-py/h2o/frame.py:2242, expr.py _arg_to_expr None → '[]')."""
+    f = _as_frame(env.ev(dst))
+    cols = (f.names if _is_empty_list(col_sel)
+            else _resolve_cols(f, col_sel))
+    rows = (np.arange(f.nrows)
+            if _is_empty_list(row_sel) or row_sel is None
+            else _row_indices(f, row_sel, env))
+    val = env.ev(src)
+
+    arrays, cats, doms = {}, [], {}
+    for i, n in enumerate(f.names):
+        c = f.col(n)
+        if c.is_categorical:
+            arr = _cat_codes(f, n).astype(np.float64)
+            arr[arr < 0] = np.nan
+            dom = list(c.domain or [])
+        elif c.type == "string":
+            arr = c.to_numpy().copy()
+            dom = None
+        else:
+            arr = _col_np(f, n).copy()
+            dom = None
+        if n in cols:
+            if isinstance(val, Frame):
+                j = cols.index(n) if val.ncols > 1 else 0
+                vc = val.col(val.names[j])
+                v = (_cat_codes(val, val.names[j]).astype(np.float64)
+                     if vc.is_categorical else vc.to_numpy())
+                if vc.is_categorical and dom is not None:
+                    # remap source codes into the destination domain
+                    lut = {lvl: k for k, lvl in enumerate(dom)}
+                    src_dom = vc.domain or []
+                    for lvl in src_dom:
+                        if lvl not in lut:
+                            lut[lvl] = len(dom)
+                            dom.append(lvl)
+                    mp = np.array([lut[lvl] for lvl in src_dom], np.float64)
+                    ok = ~np.isnan(v)
+                    v = v.copy()
+                    v[ok] = mp[v[ok].astype(np.int64)]
+                v = v[: f.nrows] if len(v) >= f.nrows else v
+                arr[rows] = v[rows] if len(v) == f.nrows else v[: len(rows)]
+            elif isinstance(val, str):
+                if dom is not None:
+                    if val not in dom:
+                        dom.append(val)
+                    arr[rows] = float(dom.index(val))
+                elif c.type == "string":
+                    arr[rows] = val
+                else:
+                    raise ValueError(
+                        f"cannot assign string into numeric column '{n}'")
+            else:
+                arr[rows] = float(val)
+        if dom is not None:
+            na = np.isnan(arr)
+            arr = np.where(na, -1, arr).astype(np.int32)
+            arrays[n] = arr
+            cats.append(n)
+            doms[n] = dom
+        else:
+            arrays[n] = arr
+    out = Frame.from_numpy(arrays, categorical=cats, domains=doms)
+    # preserve column order
+    return out[f.names]
 
 
 @prim("rm")

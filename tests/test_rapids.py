@@ -148,3 +148,53 @@ def test_unique_table(sess, data):
     assert df["Count"].sum() == 10
     u = rapids('(unique (cols_py data ["g"]))', sess)
     assert u.nrows == 2
+
+
+def test_slice_ranges(sess, data):
+    # h2o-py serializes fr[0:5, :] as (rows data [0:5]) — start:count
+    out = rapids('(rows data [0:5])', sess)
+    assert out.nrows == 5
+    assert out.col("a").to_numpy().tolist() == [0, 1, 2, 3, 4]
+    # open-ended [2:nan] = rows 2..end
+    out = rapids('(rows data [2:nan])', sess)
+    assert out.nrows == 8
+    # strided [0:5:2] = 5 elements step 2 -> 0,2,4,6,8
+    out = rapids('(rows data [0:5:2])', sess)
+    assert out.col("a").to_numpy().tolist() == [0, 2, 4, 6, 8]
+    # column slice
+    out = rapids('(cols_py data [0:2])', sess)
+    assert out.names == ["a", "b"]
+
+
+def test_negative_cols_means_drop(sess, data):
+    # h2o-py pop/del sends -(i+1): drop column i, keep the rest
+    out = rapids('(cols data -1)', sess)
+    assert out.names == ["b", "g"]
+    out = rapids('(cols data [-2])', sess)
+    assert out.names == ["a", "g"]
+
+
+def test_categorical_eq_string(sess, data):
+    out = rapids('(== (cols_py data ["g"]) "x")', sess)
+    v = out.col(out.names[0]).to_numpy()
+    assert v.tolist() == [1, 0, 1, 0, 1, 0, 1, 0, 1, 0]
+    out = rapids('(!= (cols_py data ["g"]) "x")', sess)
+    assert out.col(out.names[0]).to_numpy().tolist() == \
+        [0, 1, 0, 1, 0, 1, 0, 1, 0, 1]
+
+
+def test_rectangle_assign(sess, data):
+    # fr[rows, col] = scalar → (:= fr value col rows)
+    out = rapids('(:= data 99 [0] [0:3])', sess)
+    a = out.col("a").to_numpy()
+    assert a[:4].tolist() == [99, 99, 99, 3]
+    assert out.ncols == 3 and out.nrows == 10
+    # whole-column assign, [] = all rows
+    out = rapids('(:= data 7 [1] [])', sess)
+    assert np.allclose(out.col("b").to_numpy(), 7.0)
+    # string into categorical extends/uses domain
+    out = rapids('(:= data "z" [2] [0:2])', sess)
+    g = out.col("g")
+    assert g.domain is not None and "z" in g.domain
+    codes = np.asarray(g.data)[:2]
+    assert all(g.domain[c] == "z" for c in codes)
