@@ -18,20 +18,27 @@ def register(cls):
 
 def _auto_register():
     """Populate the registry from the standard estimator modules."""
+    from h2o3_tpu.models.coxph import CoxPHEstimator
     from h2o3_tpu.models.deeplearning import DeepLearningEstimator
     from h2o3_tpu.models.drf import DRFEstimator
+    from h2o3_tpu.models.gam import GAMEstimator
     from h2o3_tpu.models.gbm import GBMEstimator
     from h2o3_tpu.models.glm import GLMEstimator
     from h2o3_tpu.models.glrm import GLRMEstimator
     from h2o3_tpu.models.isofor import IsolationForestEstimator
     from h2o3_tpu.models.isotonic import IsotonicRegressionEstimator
     from h2o3_tpu.models.kmeans import KMeansEstimator
+    from h2o3_tpu.models.model_selection import (ANOVAGLMEstimator,
+                                                 ModelSelectionEstimator)
     from h2o3_tpu.models.naivebayes import NaiveBayesEstimator
     from h2o3_tpu.models.pca import PCAEstimator, SVDEstimator
-    for cls in (DeepLearningEstimator, DRFEstimator, GBMEstimator,
+    from h2o3_tpu.models.rulefit import RuleFitEstimator
+    for cls in (ANOVAGLMEstimator, CoxPHEstimator, DeepLearningEstimator,
+                DRFEstimator, GAMEstimator, GBMEstimator,
                 GLMEstimator, GLRMEstimator, IsolationForestEstimator,
                 IsotonicRegressionEstimator, KMeansEstimator,
-                NaiveBayesEstimator, PCAEstimator, SVDEstimator):
+                ModelSelectionEstimator, NaiveBayesEstimator, PCAEstimator,
+                RuleFitEstimator, SVDEstimator):
         _REGISTRY[cls.algo] = cls
 
 

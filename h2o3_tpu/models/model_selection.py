@@ -1,0 +1,295 @@
+"""ANOVAGLM + ModelSelection — GLM wrapper algorithms.
+
+Reference: hex/anovaglm/ANOVAGLM.java:1 (~1.1K LoC) — trains the GLM on
+predictor subsets formed by dropping each term, derives type-III-style
+significance from deviance differences (likelihood-ratio chi-square);
+hex/modelselection/ (~1.9K LoC) — best-subset GLM search with modes
+maxr / allsubsets / forward / backward, reporting the best model per
+predictor-count.
+
+TPU note: each candidate fit is one GLM (einsum Gram + solve per IRLS
+step, models/glm.py), so a whole subset sweep is a sequence of small
+jitted programs against the SAME row-sharded design columns; nothing new
+moves host→device between candidates.
+"""
+
+from __future__ import annotations
+
+from itertools import combinations
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from h2o3_tpu.frame.frame import Frame
+from h2o3_tpu.models.glm import GLMEstimator
+from h2o3_tpu.models.model import Model, ModelBuilder, ModelCategory, infer_category
+from h2o3_tpu.utils.log import get_logger
+
+log = get_logger("h2o3_tpu.model_selection")
+
+
+def _chi2_sf(x: float, df: int) -> float:
+    """Survival function of chi-square (regularized upper gamma)."""
+    from scipy.stats import chi2
+    return float(chi2.sf(max(x, 0.0), max(df, 1)))
+
+
+def _fit_glm(frame, x, y, family, **kw) -> Model:
+    return GLMEstimator(family=family, **kw).train(frame, y=y, x=list(x))
+
+
+def _resid_deviance(m: Model, frame: Frame) -> float:
+    mm = m.training_metrics
+    d = mm.to_dict()
+    if "mean_residual_deviance" in d:
+        return d["mean_residual_deviance"] * d["nobs"]
+    return d["logloss"] * d["nobs"] * 2.0
+
+
+class ANOVAGLMModel(Model):
+    algo = "anovaglm"
+
+    def __init__(self, params, output, full_model: Model):
+        super().__init__(params, output)
+        self.full_model = full_model
+
+    def _score_raw(self, frame):
+        return self.full_model._score_raw(frame)
+
+    def model_performance(self, frame):
+        return self.full_model.model_performance(frame)
+
+    @property
+    def anova_table(self) -> List[dict]:
+        return self.output["anova_table"]
+
+
+class ANOVAGLMEstimator(ModelBuilder):
+    """h2o-py H2OANOVAGLMEstimator surface
+    (h2o-py/h2o/estimators/anovaglm.py). Likelihood-ratio ANOVA: each
+    term's significance from the deviance gain of adding it last."""
+
+    algo = "anovaglm"
+
+    DEFAULTS = dict(
+        family="auto", link=None, lambda_=0.0, alpha=0.0,
+        standardize=True, max_iterations=50, tweedie_power=1.5,
+        highest_interaction_term=2, seed=-1, nfolds=0,
+        weights_column=None, fold_column=None, ignored_columns=None,
+        fold_assignment="auto",
+    )
+
+    def __init__(self, **params):
+        merged = dict(self.DEFAULTS)
+        if "Lambda" in params:
+            params["lambda_"] = params.pop("Lambda")
+        unknown = set(params) - set(merged)
+        if unknown:
+            raise ValueError(f"unknown ANOVAGLM params: {sorted(unknown)}")
+        merged.update(params)
+        super().__init__(**merged)
+
+    def _fit(self, frame: Frame, x: Sequence[str], y: Optional[str],
+             job, validation_frame: Optional[Frame] = None) -> Model:
+        p = self.params
+        category = infer_category(frame, y)
+        family = p["family"]
+        if family == "auto":
+            family = {"Binomial": "binomial",
+                      "Regression": "gaussian"}.get(category)
+            if family is None:
+                raise ValueError(f"ANOVAGLM: unsupported category {category}")
+        glm_kw = dict(lambda_=p["lambda_"], alpha=p["alpha"],
+                      standardize=p["standardize"],
+                      max_iterations=p["max_iterations"],
+                      weights_column=p.get("weights_column"))
+
+        # interaction terms up to highest_interaction_term via products
+        terms: List[tuple] = [(n,) for n in x]
+        if int(p["highest_interaction_term"]) >= 2:
+            numeric = [n for n in x if not frame.col(n).is_categorical]
+            terms += list(combinations(numeric, 2))
+
+        work = frame
+        term_cols: Dict[tuple, List[str]] = {}
+        for t in terms:
+            if len(t) == 1:
+                term_cols[t] = [t[0]]
+            else:
+                nm = ":".join(t)
+                if nm not in work:
+                    import h2o3_tpu.frame.column as colmod
+                    v = (work.col(t[0]).to_numpy()
+                         * work.col(t[1]).to_numpy())
+                    from h2o3_tpu.parallel import mesh as mesh_mod
+                    c = colmod.column_from_numpy(
+                        nm, v, work.nrows_padded, mesh_mod.row_sharding())
+                    work.add_column(c)
+                term_cols[t] = [nm]
+
+        all_cols = [c for cols in term_cols.values() for c in cols]
+        full = _fit_glm(work, all_cols, y, family, **glm_kw)
+        dev_full = _resid_deviance(full, work)
+        n_done = 0
+        table: List[dict] = []
+        for t in terms:
+            reduced_cols = [c for c in all_cols if c not in term_cols[t]]
+            red = _fit_glm(work, reduced_cols, y, family, **glm_kw)
+            dev_red = _resid_deviance(red, work)
+            # df of the term = number of expanded coefficients it adds
+            df = (frame.col(t[0]).cardinality - 1
+                  if len(t) == 1 and frame.col(t[0]).is_categorical
+                  else 1)
+            lr = max(dev_red - dev_full, 0.0)
+            table.append({"term": ":".join(t), "df": df,
+                          "deviance": lr, "p_value": _chi2_sf(lr, df)})
+            n_done += 1
+            job.update(1.0 / (len(terms) + 1), f"term {n_done}/{len(terms)}")
+
+        output = {"category": category, "response": y, "names": list(x),
+                  "domain": frame.col(y).domain, "anova_table": table,
+                  "full_deviance": dev_full}
+        model = ANOVAGLMModel(p, output, full)
+        model.training_metrics = full.training_metrics
+        return model
+
+
+class ModelSelectionModel(Model):
+    algo = "modelselection"
+
+    def __init__(self, params, output, best_models: Dict[int, Model]):
+        super().__init__(params, output)
+        self.best_models = best_models
+
+    def _score_raw(self, frame):
+        k = max(self.best_models)
+        return self.best_models[k]._score_raw(frame)
+
+    def model_performance(self, frame):
+        k = max(self.best_models)
+        return self.best_models[k].model_performance(frame)
+
+    def result(self) -> List[dict]:
+        return self.output["best_per_size"]
+
+    def coef(self, size: int) -> Dict[str, float]:
+        return self.best_models[size].coefficients
+
+
+class ModelSelectionEstimator(ModelBuilder):
+    """h2o-py H2OModelSelectionEstimator surface
+    (h2o-py/h2o/estimators/model_selection.py): best-subset GLM per
+    predictor count, modes maxr/allsubsets/forward/backward."""
+
+    algo = "modelselection"
+
+    DEFAULTS = dict(
+        mode="maxr", max_predictor_number=0, min_predictor_number=1,
+        family="auto", link=None, lambda_=0.0, alpha=0.0,
+        standardize=True, max_iterations=50, seed=-1, nfolds=0,
+        weights_column=None, fold_column=None, ignored_columns=None,
+        fold_assignment="auto", p_values_threshold=0.0,
+    )
+
+    def __init__(self, **params):
+        merged = dict(self.DEFAULTS)
+        if "Lambda" in params:
+            params["lambda_"] = params.pop("Lambda")
+        unknown = set(params) - set(merged)
+        if unknown:
+            raise ValueError(
+                f"unknown ModelSelection params: {sorted(unknown)}")
+        merged.update(params)
+        super().__init__(**merged)
+
+    def _r2(self, m: Model) -> float:
+        d = m.training_metrics.to_dict()
+        return d.get("r2", -d.get("logloss", np.inf))
+
+    def _fit(self, frame: Frame, x: Sequence[str], y: Optional[str],
+             job, validation_frame: Optional[Frame] = None) -> Model:
+        p = self.params
+        category = infer_category(frame, y)
+        family = p["family"]
+        if family == "auto":
+            family = {"Binomial": "binomial",
+                      "Regression": "gaussian"}.get(category, "gaussian")
+        glm_kw = dict(lambda_=p["lambda_"], alpha=p["alpha"],
+                      standardize=p["standardize"],
+                      max_iterations=p["max_iterations"],
+                      weights_column=p.get("weights_column"))
+        mode = str(p["mode"]).lower()
+        kmax = int(p["max_predictor_number"]) or len(x)
+        kmax = min(kmax, len(x))
+        kmin = max(1, int(p["min_predictor_number"]))
+
+        best_models: Dict[int, Model] = {}
+        best_sets: Dict[int, List[str]] = {}
+
+        def fit(subset) -> Model:
+            return _fit_glm(frame, list(subset), y, family, **glm_kw)
+
+        if mode == "allsubsets":
+            if len(x) > 16:
+                raise ValueError("allsubsets limited to <=16 predictors")
+            for k in range(kmin, kmax + 1):
+                best, bs = None, None
+                for sub in combinations(x, k):
+                    m = fit(sub)
+                    if best is None or self._r2(m) > self._r2(best):
+                        best, bs = m, list(sub)
+                best_models[k], best_sets[k] = best, bs
+                job.update(1.0 / (kmax - kmin + 1), f"size {k}")
+        elif mode == "backward":
+            cur = list(x)
+            m = fit(cur)
+            if len(cur) <= kmax:
+                best_models[len(cur)], best_sets[len(cur)] = m, list(cur)
+            while len(cur) > kmin:
+                best, bs = None, None
+                for drop in cur:
+                    sub = [c for c in cur if c != drop]
+                    mm_ = fit(sub)
+                    if best is None or self._r2(mm_) > self._r2(best):
+                        best, bs = mm_, sub
+                cur = bs
+                if len(cur) <= kmax:
+                    best_models[len(cur)], best_sets[len(cur)] = best, cur
+                job.update(1.0 / len(x), f"size {len(cur)}")
+        else:   # forward and maxr (maxr = forward + replacement sweep)
+            cur: List[str] = []
+            while len(cur) < kmax:
+                best, bs = None, None
+                for add in [c for c in x if c not in cur]:
+                    sub = cur + [add]
+                    mm_ = fit(sub)
+                    if best is None or self._r2(mm_) > self._r2(best):
+                        best, bs = mm_, sub
+                cur = bs
+                if mode == "maxr" and len(cur) > 1:
+                    # replacement sweep: try swapping each member for each
+                    # non-member while it improves (hex/modelselection maxr)
+                    improved = True
+                    while improved:
+                        improved = False
+                        for i_, member in enumerate(list(cur)):
+                            for cand in [c for c in x if c not in cur]:
+                                sub = list(cur)
+                                sub[i_] = cand
+                                mm_ = fit(sub)
+                                if self._r2(mm_) > self._r2(best):
+                                    best, cur, improved = mm_, sub, True
+                if len(cur) >= kmin:
+                    best_models[len(cur)] = best
+                    best_sets[len(cur)] = list(cur)
+                job.update(1.0 / kmax, f"size {len(cur)}")
+
+        table = [{"size": k, "predictors": best_sets[k],
+                  "r2": self._r2(best_models[k])}
+                 for k in sorted(best_models)]
+        output = {"category": category, "response": y, "names": list(x),
+                  "domain": frame.col(y).domain, "best_per_size": table}
+        model = ModelSelectionModel(p, output, best_models)
+        kbest = max(best_models)
+        model.training_metrics = best_models[kbest].training_metrics
+        return model

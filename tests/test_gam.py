@@ -1,0 +1,84 @@
+"""GAM tests — smooth recovery + pyunit-style behavior checks
+(h2o-py/tests/testdir_algos/gam role)."""
+
+import numpy as np
+import pytest
+
+import h2o3_tpu
+from h2o3_tpu.frame.frame import Frame
+from h2o3_tpu.models.gam import GAMEstimator, bspline_basis, curvature_penalty
+
+
+def test_bspline_partition_of_unity():
+    x = np.linspace(0.0, 1.0, 200)
+    B = bspline_basis(x, np.linspace(0, 1, 8))
+    np.testing.assert_allclose(B.sum(axis=1), 1.0, atol=1e-9)
+    assert (B >= -1e-12).all()
+    # NaN rows get a zero basis
+    Bn = bspline_basis(np.array([np.nan, 0.5]), np.linspace(0, 1, 8))
+    assert Bn[0].sum() == 0.0 and Bn[1].sum() == pytest.approx(1.0)
+
+
+def test_curvature_penalty_annihilates_linear():
+    S = curvature_penalty(10)
+    lin = np.arange(10, dtype=float)
+    assert lin @ S @ lin == pytest.approx(0.0)
+    quad = lin ** 2
+    assert quad @ S @ quad > 0
+
+
+@pytest.fixture(scope="module")
+def wiggly():
+    r = np.random.RandomState(4)
+    n = 800
+    x = np.sort(r.uniform(-3, 3, n))
+    lin = r.randn(n)
+    f = np.sin(1.7 * x) + 0.5 * lin
+    y = f + r.randn(n) * 0.15
+    return Frame.from_numpy({"x": x, "lin": lin, "y": y}), x, lin, f
+
+
+def test_gam_gaussian_fits_nonlinearity(wiggly):
+    fr, x, lin, f = wiggly
+    m = GAMEstimator(gam_columns=["x"], num_knots=[12], scale=[0.01]).train(
+        fr, y="y", x=["lin", "x"])
+    pred = m.predict(fr).col("predict").to_numpy()
+    resid = pred - f
+    assert np.sqrt(np.mean(resid ** 2)) < 0.15   # captures sin shape
+    # a pure-linear GLM cannot get close
+    from h2o3_tpu.models.glm import GLMEstimator
+    g = GLMEstimator().train(fr, y="y", x=["lin", "x"])
+    glm_rmse = np.sqrt(np.mean((g.predict(fr).col("predict").to_numpy() - f) ** 2))
+    assert glm_rmse > 0.4
+
+
+def test_gam_binomial(wiggly):
+    fr, x, lin, f = wiggly
+    r = np.random.RandomState(5)
+    pr = 1.0 / (1.0 + np.exp(-2.0 * np.sin(1.5 * x)))
+    yb = (r.rand(len(x)) < pr).astype(object)
+    yb = np.where(yb == 1, "yes", "no").astype(object)
+    fr2 = Frame.from_numpy({"x": x, "lin": lin, "cls": yb},
+                           categorical=["cls"])
+    m = GAMEstimator(gam_columns=["x"], family="binomial",
+                     num_knots=[10]).train(fr2, y="cls", x=["lin", "x"])
+    assert m.training_metrics["AUC"] > 0.75
+
+
+def test_gam_scoring_new_frame(wiggly):
+    fr, x, lin, f = wiggly
+    m = GAMEstimator(gam_columns=["x"], num_knots=[12]).train(
+        fr, y="y", x=["lin", "x"])
+    # new frame, different row count (+ padding), values beyond knot range
+    xs = np.linspace(-4, 4, 101)
+    fr2 = Frame.from_numpy({"x": xs, "lin": np.zeros(101)})
+    pred = m.predict(fr2).col("predict").to_numpy()
+    assert pred.shape == (101,)
+    assert np.isfinite(pred).all()
+
+
+def test_gam_requires_gam_columns():
+    with pytest.raises(ValueError):
+        GAMEstimator()
+    with pytest.raises(ValueError):
+        GAMEstimator(gam_columns=["x"], bogus=1)

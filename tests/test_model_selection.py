@@ -1,0 +1,65 @@
+"""ANOVAGLM + ModelSelection tests (testdir_algos/anovaglm,
+modelselection pyunit roles)."""
+
+import numpy as np
+import pytest
+
+import h2o3_tpu
+from h2o3_tpu.frame.frame import Frame
+from h2o3_tpu.models.model_selection import (ANOVAGLMEstimator,
+                                             ModelSelectionEstimator)
+
+
+@pytest.fixture(scope="module")
+def lin_data():
+    r = np.random.RandomState(9)
+    n = 600
+    X = r.randn(n, 5)
+    # x0 strong, x1 moderate, x2 weak-through-interaction, x3/x4 noise
+    y = 2.0 * X[:, 0] + 0.8 * X[:, 1] + 1.5 * X[:, 0] * X[:, 2] \
+        + r.randn(n) * 0.5
+    fr = Frame.from_numpy({f"x{i}": X[:, i] for i in range(5)} | {"y": y})
+    return fr
+
+
+def test_anovaglm_table(lin_data):
+    m = ANOVAGLMEstimator(highest_interaction_term=2).train(
+        lin_data, y="y", x=["x0", "x1", "x2"])
+    tbl = {d["term"]: d for d in m.anova_table}
+    assert tbl["x0"]["p_value"] < 1e-6
+    assert tbl["x1"]["p_value"] < 1e-6
+    assert tbl["x0:x2"]["p_value"] < 1e-6
+    # pure-noise interaction should NOT be significant
+    assert tbl["x1:x2"]["p_value"] > 0.01
+    assert m.training_metrics["r2"] > 0.8
+
+
+@pytest.mark.parametrize("mode", ["forward", "backward", "maxr"])
+def test_model_selection_orders_predictors(lin_data, mode):
+    m = ModelSelectionEstimator(mode=mode, max_predictor_number=3).train(
+        lin_data, y="y", x=["x0", "x1", "x3", "x4"])
+    res = m.result()
+    sizes = [d["size"] for d in res]
+    assert sizes == sorted(sizes)
+    # size-1 best subset must be the strongest predictor x0
+    one = [d for d in res if d["size"] == 1]
+    if one:
+        assert one[0]["predictors"] == ["x0"]
+    # r2 must be monotone nondecreasing with size
+    r2s = [d["r2"] for d in res]
+    assert all(b >= a - 1e-6 for a, b in zip(r2s, r2s[1:]))
+    two = [d for d in res if d["size"] == 2]
+    if two:
+        assert set(two[0]["predictors"]) == {"x0", "x1"}
+
+
+def test_model_selection_allsubsets(lin_data):
+    m = ModelSelectionEstimator(mode="allsubsets",
+                                max_predictor_number=2).train(
+        lin_data, y="y", x=["x0", "x1", "x3"])
+    res = m.result()
+    assert [d["size"] for d in res] == [1, 2]
+    assert set(res[1]["predictors"]) == {"x0", "x1"}
+    # coef accessor
+    c = m.coef(2)
+    assert set(c) >= {"x0", "x1", "Intercept"}

@@ -1,0 +1,57 @@
+"""RuleFit tests (h2o-py/tests/testdir_algos/rulefit role)."""
+
+import numpy as np
+import pytest
+
+import h2o3_tpu
+from h2o3_tpu.frame.frame import Frame
+from h2o3_tpu.models.rulefit import RuleFitEstimator
+
+
+@pytest.fixture(scope="module")
+def rule_data():
+    """Response driven by an interaction rule: x0>0 AND x1<0 → +3."""
+    r = np.random.RandomState(11)
+    n = 1200
+    X = r.randn(n, 4)
+    y = 3.0 * ((X[:, 0] > 0) & (X[:, 1] < 0)) + 0.5 * X[:, 2] \
+        + r.randn(n) * 0.3
+    fr = Frame.from_numpy({f"x{i}": X[:, i] for i in range(4)} | {"y": y})
+    return fr, X, y
+
+
+def test_rulefit_regression_finds_rule(rule_data):
+    fr, X, y = rule_data
+    m = RuleFitEstimator(max_rule_length=3, min_rule_length=2,
+                         rule_generation_ntrees=20, seed=42).train(
+        fr, y="y", x=["x0", "x1", "x2", "x3"])
+    assert m.training_metrics["RMSE"] < 1.0   # vs sd(y) ~ 1.6
+    imp = m.rule_importance
+    assert len(imp) > 0
+    # top rule should involve x0 and x1 (the interaction)
+    top = " ".join(d["rule"] for d in imp[:3])
+    assert "x0" in top and "x1" in top
+    # predictions on a fresh frame
+    fr2 = Frame.from_numpy({f"x{i}": X[:100, i] for i in range(4)})
+    pred = m.predict(fr2).col("predict").to_numpy()
+    assert pred.shape == (100,)
+    assert np.isfinite(pred).all()
+
+
+def test_rulefit_binomial(rule_data):
+    fr, X, y = rule_data
+    cls = np.where(y > np.median(y), "hi", "lo").astype(object)
+    fr2 = Frame.from_numpy({f"x{i}": X[:, i] for i in range(4)}
+                           | {"cls": cls}, categorical=["cls"])
+    m = RuleFitEstimator(rule_generation_ntrees=15, seed=1).train(
+        fr2, y="cls", x=["x0", "x1", "x2", "x3"])
+    assert m.training_metrics["AUC"] > 0.8
+
+
+def test_rulefit_max_num_rules_and_linear_only(rule_data):
+    fr, X, y = rule_data
+    m = RuleFitEstimator(max_num_rules=5, rule_generation_ntrees=10,
+                         seed=2).train(fr, y="y")
+    assert len(m.rule_importance) <= 5
+    lin = RuleFitEstimator(model_type="linear", seed=2).train(fr, y="y")
+    assert all(d["rule"].startswith("linear(") for d in lin.rule_importance)
