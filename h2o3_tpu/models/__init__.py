@@ -21,6 +21,7 @@ def _auto_register():
     from h2o3_tpu.models.coxph import CoxPHEstimator
     from h2o3_tpu.models.deeplearning import DeepLearningEstimator
     from h2o3_tpu.models.drf import DRFEstimator
+    from h2o3_tpu.models.extisofor import ExtendedIsolationForestEstimator
     from h2o3_tpu.models.gam import GAMEstimator
     from h2o3_tpu.models.gbm import GBMEstimator
     from h2o3_tpu.models.glm import GLMEstimator
@@ -33,12 +34,14 @@ def _auto_register():
     from h2o3_tpu.models.naivebayes import NaiveBayesEstimator
     from h2o3_tpu.models.pca import PCAEstimator, SVDEstimator
     from h2o3_tpu.models.rulefit import RuleFitEstimator
+    from h2o3_tpu.models.uplift import UpliftDRFEstimator
     for cls in (ANOVAGLMEstimator, CoxPHEstimator, DeepLearningEstimator,
                 DRFEstimator, GAMEstimator, GBMEstimator,
                 GLMEstimator, GLRMEstimator, IsolationForestEstimator,
                 IsotonicRegressionEstimator, KMeansEstimator,
                 ModelSelectionEstimator, NaiveBayesEstimator, PCAEstimator,
-                RuleFitEstimator, SVDEstimator):
+                RuleFitEstimator, SVDEstimator,
+                ExtendedIsolationForestEstimator, UpliftDRFEstimator):
         _REGISTRY[cls.algo] = cls
 
 

@@ -1,0 +1,77 @@
+"""UpliftDRF + ExtendedIsolationForest tests (testdir_algos/uplift,
+isoforextended pyunit roles)."""
+
+import numpy as np
+import pytest
+
+import h2o3_tpu
+from h2o3_tpu.frame.frame import Frame
+from h2o3_tpu.models.extisofor import ExtendedIsolationForestEstimator
+from h2o3_tpu.models.uplift import UpliftDRFEstimator, auuc
+
+
+@pytest.fixture(scope="module")
+def uplift_data():
+    """x0>0 defines responders-to-treatment; x1 is a prognostic factor."""
+    r = np.random.RandomState(21)
+    n = 2000
+    X = r.randn(n, 3)
+    treat = r.randint(0, 2, n)
+    base = 0.2 + 0.2 * (X[:, 1] > 0)
+    lift = 0.35 * ((X[:, 0] > 0) & (treat == 1))
+    y = (r.rand(n) < base + lift).astype(int)
+    fr = Frame.from_numpy(
+        {"x0": X[:, 0], "x1": X[:, 1], "x2": X[:, 2],
+         "treatment": np.where(treat == 1, "treatment", "control").astype(object),
+         "conversion": np.where(y == 1, "yes", "no").astype(object)},
+        categorical=["treatment", "conversion"])
+    return fr, X, treat, y
+
+
+@pytest.mark.parametrize("metric", ["kl", "euclidean"])
+def test_uplift_drf_detects_heterogeneity(uplift_data, metric):
+    fr, X, treat, y = uplift_data
+    m = UpliftDRFEstimator(treatment_column="treatment", ntrees=20,
+                           max_depth=4, uplift_metric=metric,
+                           seed=7).train(fr, y="conversion")
+    raw = m._score_raw(fr)
+    up = raw["uplift_predict"]
+    # true uplift is 0.35 for x0>0, 0 otherwise
+    hi = up[X[:, 0] > 0.3].mean()
+    lo = up[X[:, 0] < -0.3].mean()
+    assert hi - lo > 0.15
+    assert (raw["p_y1_ct1"] >= 0).all() and (raw["p_y1_ct1"] <= 1).all()
+    d = m.training_metrics.to_dict()
+    assert d["auuc"] > 0
+
+
+def test_uplift_requires_treatment():
+    with pytest.raises(ValueError):
+        UpliftDRFEstimator()
+
+
+def test_auuc_ranks_informed_above_random():
+    r = np.random.RandomState(3)
+    n = 4000
+    tr = r.randint(0, 2, n).astype(float)
+    true_up = np.where(r.rand(n) < 0.5, 0.4, 0.0)
+    y = (r.rand(n) < 0.2 + true_up * tr).astype(float)
+    informed = auuc(true_up + r.randn(n) * 0.01, y, tr)
+    random = auuc(r.randn(n), y, tr)
+    assert informed["auuc"] > random["auuc"]
+
+
+def test_extended_isolation_forest_flags_outliers():
+    r = np.random.RandomState(5)
+    X = r.randn(500, 4)
+    X[:8] += 6.0   # planted anomalies
+    fr = Frame.from_numpy({f"x{i}": X[:, i] for i in range(4)})
+    m = ExtendedIsolationForestEstimator(ntrees=60, sample_size=128,
+                                         extension_level=1, seed=9).train(fr)
+    s = m._score_raw(fr)["anomaly_score"]
+    assert s[:8].mean() > s[8:].mean() + 0.1
+    # scoring a new frame works and extension_level is validated
+    s2 = m.predict(fr).col("anomaly_score").to_numpy()
+    np.testing.assert_allclose(s2, s)
+    with pytest.raises(ValueError):
+        ExtendedIsolationForestEstimator(extension_level=10).train(fr)
