@@ -1,1 +1,14 @@
+"""h2o3_tpu.genmodel — standalone offline scoring (the h2o-genmodel twin).
 
+Numpy-only: importable and usable without JAX or any device. See
+mojo.py (format), readers.py (per-algo scorers), easy.py (typed wrapper).
+"""
+
+from h2o3_tpu.genmodel.easy import EasyPredictModelWrapper  # noqa: F401
+from h2o3_tpu.genmodel.mojo import read_mojo, write_mojo     # noqa: F401
+from h2o3_tpu.genmodel.readers import MojoModel              # noqa: F401
+
+
+def load_mojo(path: str) -> MojoModel:
+    """Load a MOJO zip for offline scoring (MojoModel.load)."""
+    return MojoModel.load(path)

@@ -1,0 +1,114 @@
+"""MOJO export — serialize trained in-cluster models to the offline format.
+
+Reference: per-algo *MojoWriter classes (hex/tree/gbm/GbmMojoWriter etc.)
+invoked from Model.getMojo; here one dispatch over the live model object.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Tuple
+
+import numpy as np
+
+
+def _tree_artifacts(model) -> Tuple[dict, Dict[str, np.ndarray]]:
+    """Shared forest + binning serialization for SharedTree models."""
+    bm = model.bm
+    f = model.forest
+    arrays = {
+        "tree_feat": np.asarray(f.feat),
+        "tree_thresh": np.asarray(f.thresh),
+        "tree_na_left": np.asarray(f.na_left),
+        "tree_is_split": np.asarray(f.is_split),
+        "tree_leaf": np.asarray(f.leaf),
+        "edges": np.asarray(bm.edges),
+        "nbins": np.asarray(bm.nbins),
+        "is_cat": np.asarray(bm.is_cat),
+    }
+    meta = {
+        "nbins_total": int(bm.nbins_total),
+        "feature_domains": [list(d) if d is not None else None
+                            for d in bm.domains],
+    }
+    return meta, arrays
+
+
+def _base_meta(model) -> dict:
+    out = model.output
+    return {
+        "algo": model.algo,
+        "category": out.get("category"),
+        "names": list(out.get("names") or []),
+        "response": out.get("response"),
+        "domain": out.get("domain"),
+        "nclasses": out.get("nclasses", 1),
+        "default_threshold": out.get("default_threshold", 0.5),
+    }
+
+
+def mojo_artifacts(model) -> Tuple[dict, Dict[str, np.ndarray]]:
+    algo = model.algo
+    meta = _base_meta(model)
+    if algo in ("gbm", "drf", "isolationforest"):
+        tmeta, arrays = _tree_artifacts(model)
+        meta.update(tmeta)
+        if algo == "gbm":
+            meta["f0"] = (np.asarray(model.f0).tolist())
+            meta["distribution"] = model.dist_name
+            meta["tweedie_power"] = float(model.params.get("tweedie_power", 1.5))
+        elif algo == "isolationforest":
+            meta["c_norm"] = float(model.c_norm)
+        return meta, arrays
+    if algo == "glm":
+        meta["link"] = model.family.link
+        meta["family"] = model.family.name
+        meta["tweedie_power"] = float(getattr(model.family, "p", 1.5))
+        meta["standardize"] = bool(model.params.get("standardize", True))
+        meta["use_all_factor_levels"] = bool(
+            model.params.get("use_all_factor_levels", False))
+        meta["names"] = list(model.features)
+        meta["feature_domains"] = [list(d) if d is not None else None
+                                   for d in model.di_stats["domains"]]
+        arrays = {
+            "num_means": np.asarray(model.di_stats["num_means"]),
+            "num_sigmas": np.asarray(model.di_stats["num_sigmas"]),
+        }
+        if model.coef_multinomial is not None:
+            arrays["coef_multinomial"] = np.asarray(model.coef_multinomial)
+        else:
+            arrays["coef"] = np.asarray(model.coef)
+        return meta, arrays
+    if algo == "deeplearning":
+        meta["activation"] = model.act
+        meta["standardize"] = bool(model.standardize)
+        meta["use_all_factor_levels"] = bool(
+            model.params.get("use_all_factor_levels", False))
+        meta["autoencoder"] = bool(model.params.get("autoencoder", False))
+        meta["n_layers"] = len(model.net)
+        meta["names"] = list(model.features)
+        meta["feature_domains"] = [list(d) if d is not None else None
+                                   for d in model.di_stats["domains"]]
+        if model.resp_stats is not None:
+            meta["resp_stats"] = [float(model.resp_stats[0]),
+                                  float(model.resp_stats[1])]
+        arrays = {
+            "num_means": np.asarray(model.di_stats["num_means"]),
+            "num_sigmas": np.asarray(model.di_stats["num_sigmas"]),
+        }
+        for i, layer in enumerate(model.net):
+            arrays[f"W{i}"] = np.asarray(layer["W"])
+            arrays[f"b{i}"] = np.asarray(layer["b"])
+        return meta, arrays
+    if algo == "kmeans":
+        meta["standardize"] = bool(model.standardize)
+        meta["use_all_factor_levels"] = True
+        meta["names"] = list(model.features)
+        meta["feature_domains"] = [list(d) if d is not None else None
+                                   for d in model.di_stats["domains"]]
+        arrays = {
+            "centers": np.asarray(model.centers_std),
+            "num_means": np.asarray(model.di_stats["num_means"]),
+            "num_sigmas": np.asarray(model.di_stats["num_sigmas"]),
+        }
+        return meta, arrays
+    raise ValueError(f"MOJO export not supported for algo '{algo}'")

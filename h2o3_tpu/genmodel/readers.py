@@ -1,0 +1,223 @@
+"""Offline MOJO scoring runtime — numpy-only, no JAX / no device.
+
+Reference: hex/genmodel/MojoModel.java:12 + per-algo readers under
+hex/genmodel/algos/{gbm,drf,glm,deeplearning,kmeans,isofor}; the
+scoring contract is GenModel.score0 (hex/genmodel/GenModel.java:363):
+raw row in, prediction vector out, with the same categorical-domain and
+NA semantics as in-cluster scoring.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from h2o3_tpu.genmodel.mojo import (bin_raw, design_matrix, read_mojo,
+                                    walk_forest)
+
+
+class MojoModel:
+    """Loaded offline model (hex/genmodel/MojoModel.java role)."""
+
+    def __init__(self, meta: dict, arrays: Dict[str, np.ndarray]):
+        self.meta = meta
+        self.arrays = arrays
+
+    # -- introspection -------------------------------------------------
+    @property
+    def algo(self) -> str:
+        return self.meta["algo"]
+
+    @property
+    def category(self) -> str:
+        return self.meta["category"]
+
+    @property
+    def names(self) -> List[str]:
+        return self.meta["names"]
+
+    @property
+    def domain(self) -> Optional[List[str]]:
+        return self.meta.get("domain")
+
+    @property
+    def nclasses(self) -> int:
+        return int(self.meta.get("nclasses") or 1)
+
+    # -- scoring -------------------------------------------------------
+    def predict(self, data: Dict[str, np.ndarray]) -> Dict[str, np.ndarray]:
+        """Batch scoring on dict-of-raw-columns → dict of predictions,
+        matching the in-cluster ``model.predict`` column layout."""
+        raise NotImplementedError
+
+    def score0(self, row: dict) -> dict:
+        """Single-row score (GenModel.score0)."""
+        batch = {k: np.asarray([v]) for k, v in row.items()}
+        out = self.predict(batch)
+        return {k: v[0] for k, v in out.items()}
+
+    # -- loading -------------------------------------------------------
+    @staticmethod
+    def load(path: str) -> "MojoModel":
+        meta, arrays = read_mojo(path)
+        cls = _READERS.get(meta["algo"])
+        if cls is None:
+            raise ValueError(f"no MOJO reader for algo '{meta['algo']}'")
+        return cls(meta, arrays)
+
+
+def _softmax(z):
+    z = z - z.max(axis=1, keepdims=True)
+    e = np.exp(z)
+    return e / e.sum(axis=1, keepdims=True)
+
+
+def _class_output(p: np.ndarray, threshold: float, domain) -> dict:
+    if p.shape[1] == 2:
+        lab = (p[:, 1] >= threshold).astype(np.int32)
+        return {"predict": lab, "p0": p[:, 0], "p1": p[:, 1]}
+    out = {"predict": p.argmax(axis=1).astype(np.int32)}
+    for k in range(p.shape[1]):
+        out[f"p{k}"] = p[:, k]
+    return out
+
+
+def _link_inv(name: str, eta: np.ndarray, tweedie_power: float = 1.5):
+    if name in ("identity", "gaussian", "laplace", "quantile", "huber"):
+        return eta
+    if name in ("logit", "bernoulli", "binomial", "quasibinomial"):
+        return 1.0 / (1.0 + np.exp(-eta))
+    if name in ("log", "poisson", "gamma", "tweedie"):
+        return np.exp(eta)
+    if name == "inverse":
+        return 1.0 / np.where(np.abs(eta) < 1e-12, 1e-12, eta)
+    return eta
+
+
+class SharedTreeMojoModel(MojoModel):
+    """GBM/DRF/IsolationForest share the stored-forest walk
+    (hex/genmodel/algos/tree/SharedTreeMojoModel role)."""
+
+    def _tree_sums(self, data) -> np.ndarray:
+        B = int(self.meta["nbins_total"])
+        bins = bin_raw(self.meta, self.arrays, data)
+        return walk_forest(self.arrays, bins, B)   # [T_total, N]
+
+
+class GbmMojoModel(SharedTreeMojoModel):
+    def predict(self, data):
+        per_tree = self._tree_sums(data)
+        f0 = np.asarray(self.meta["f0"], dtype=np.float64)
+        cat = self.category
+        if cat == "Multinomial":
+            K = self.nclasses
+            T = per_tree.shape[0] // K
+            marg = f0[None, :] + per_tree.reshape(T, K, -1).sum(axis=0).T
+            return _class_output(_softmax(marg), 0.5, self.domain)
+        marg = float(f0) + per_tree.sum(axis=0)
+        if cat == "Binomial":
+            p1 = 1.0 / (1.0 + np.exp(-marg))
+            p = np.stack([1 - p1, p1], axis=1)
+            return _class_output(p, self.meta.get("default_threshold", 0.5),
+                                 self.domain)
+        mu = _link_inv(self.meta.get("distribution", "gaussian"), marg,
+                       self.meta.get("tweedie_power", 1.5))
+        return {"predict": mu}
+
+
+class DrfMojoModel(SharedTreeMojoModel):
+    def predict(self, data):
+        per_tree = self._tree_sums(data)
+        cat = self.category
+        if cat == "Regression":
+            return {"predict": per_tree.mean(axis=0)}
+        if cat == "Binomial":
+            p1 = np.clip(per_tree.mean(axis=0), 0.0, 1.0)
+            p = np.stack([1 - p1, p1], axis=1)
+            return _class_output(p, self.meta.get("default_threshold", 0.5),
+                                 self.domain)
+        K = self.nclasses
+        T = per_tree.shape[0] // K
+        votes = per_tree.reshape(T, K, -1).mean(axis=0).T   # [N, K]
+        votes = np.clip(votes, 0.0, 1.0)
+        p = votes / np.maximum(votes.sum(axis=1, keepdims=True), 1e-12)
+        return _class_output(p, 0.5, self.domain)
+
+
+class IsoForMojoModel(SharedTreeMojoModel):
+    def predict(self, data):
+        from h2o3_tpu.genmodel.mojo import bin_raw, walk_forest_pathlen
+        B = int(self.meta["nbins_total"])
+        bins = bin_raw(self.meta, self.arrays, data)
+        per_tree = walk_forest_pathlen(self.arrays, bins, B)
+        ml = per_tree.mean(axis=0)
+        c = max(float(self.meta["c_norm"]), 1e-12)
+        return {"predict": 2.0 ** (-ml / c), "mean_length": ml}
+
+
+class GlmMojoModel(MojoModel):
+    def predict(self, data):
+        X = design_matrix(self.meta, self.arrays, data)
+        X1 = np.concatenate([X, np.ones((X.shape[0], 1))], axis=1)
+        if "coef_multinomial" in self.arrays:
+            eta = X1 @ self.arrays["coef_multinomial"]
+            return _class_output(_softmax(eta), 0.5, self.domain)
+        eta = X1 @ self.arrays["coef"]
+        link = self.meta.get("link", "identity")
+        mu = _link_inv(link, eta, self.meta.get("tweedie_power", 1.5))
+        if self.category == "Binomial":
+            p = np.stack([1 - mu, mu], axis=1)
+            return _class_output(p, self.meta.get("default_threshold", 0.5),
+                                 self.domain)
+        return {"predict": mu}
+
+
+class DeepLearningMojoModel(MojoModel):
+    def _forward(self, X: np.ndarray) -> np.ndarray:
+        act = self.meta.get("activation", "rectifier")
+        n_layers = int(self.meta["n_layers"])
+        h = X
+        for i in range(n_layers):
+            z = h @ self.arrays[f"W{i}"] + self.arrays[f"b{i}"]
+            if i == n_layers - 1:
+                return z
+            if act == "maxout":
+                z = z.reshape(z.shape[0], -1, 2).max(axis=2)
+            elif act == "tanh":
+                z = np.tanh(z)
+            else:
+                z = np.maximum(z, 0.0)
+            h = z
+        return h
+
+    def predict(self, data):
+        X = design_matrix(self.meta, self.arrays, data)
+        out = self._forward(X)
+        cat = self.category
+        if self.meta.get("autoencoder"):
+            return {"reconstruction_error": np.mean((out - X) ** 2, axis=1)}
+        if cat in ("Binomial", "Multinomial"):
+            p = _softmax(out)
+            return _class_output(p, self.meta.get("default_threshold", 0.5),
+                                 self.domain)
+        mu, sd = self.meta["resp_stats"]
+        return {"predict": out[:, 0] * sd + mu}
+
+
+class KMeansMojoModel(MojoModel):
+    def predict(self, data):
+        X = design_matrix(self.meta, self.arrays, data)
+        C = self.arrays["centers"]
+        d2 = ((X[:, None, :] - C[None, :, :]) ** 2).sum(axis=2)
+        return {"predict": d2.argmin(axis=1).astype(np.int32)}
+
+
+_READERS = {
+    "gbm": GbmMojoModel,
+    "drf": DrfMojoModel,
+    "isolationforest": IsoForMojoModel,
+    "glm": GlmMojoModel,
+    "deeplearning": DeepLearningMojoModel,
+    "kmeans": KMeansMojoModel,
+}

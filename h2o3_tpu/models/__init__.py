@@ -24,6 +24,7 @@ def _auto_register():
     from h2o3_tpu.models.extisofor import ExtendedIsolationForestEstimator
     from h2o3_tpu.models.gam import GAMEstimator
     from h2o3_tpu.models.gbm import GBMEstimator
+    from h2o3_tpu.models.generic import GenericEstimator
     from h2o3_tpu.models.glm import GLMEstimator
     from h2o3_tpu.models.glrm import GLRMEstimator
     from h2o3_tpu.models.isofor import IsolationForestEstimator
@@ -36,7 +37,7 @@ def _auto_register():
     from h2o3_tpu.models.rulefit import RuleFitEstimator
     from h2o3_tpu.models.uplift import UpliftDRFEstimator
     for cls in (ANOVAGLMEstimator, CoxPHEstimator, DeepLearningEstimator,
-                DRFEstimator, GAMEstimator, GBMEstimator,
+                DRFEstimator, GAMEstimator, GBMEstimator, GenericEstimator,
                 GLMEstimator, GLRMEstimator, IsolationForestEstimator,
                 IsotonicRegressionEstimator, KMeansEstimator,
                 ModelSelectionEstimator, NaiveBayesEstimator, PCAEstimator,

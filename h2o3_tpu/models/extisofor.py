@@ -37,10 +37,7 @@ class ExtTree(NamedTuple):
     leaf: jax.Array       # [2^D] c(count) correction
 
 
-def _avg_path_correction(n):
-    h = jnp.log(jnp.maximum(n - 1.0, 1.0)) + 0.5772156649
-    c = 2.0 * h - 2.0 * (n - 1.0) / jnp.maximum(n, 1.0)
-    return jnp.where(n > 2.0, c, jnp.where(n == 2.0, 1.0, 0.0))
+from h2o3_tpu.models.isofor import _avg_path_correction  # noqa: E402 (shared c(n))
 
 
 @partial(jax.jit, static_argnames=("depth", "ext"))
@@ -58,11 +55,10 @@ def _grow_ext_tree(X, lo, hi, w, key, *, depth: int, ext: int):
     for d in range(depth):
         L = 2 ** d
         key, kn, km, kb = jax.random.split(key, 4)
+        from h2o3_tpu.models.tree import _mtries_mask
         Wn = jax.random.normal(kn, (L, F))
         # keep exactly ext+1 random components per node
-        u = jax.random.uniform(km, (L, F))
-        rank = jnp.argsort(jnp.argsort(u, axis=1), axis=1)
-        Wn = jnp.where(rank < k, Wn, 0.0)
+        Wn = jnp.where(_mtries_mask(km, L, F, k), Wn, 0.0)
         # offset b = w·p for a random point p in the value box
         pu = jax.random.uniform(kb, (L, F))
         pnt = lo[None, :] + pu * (hi - lo)[None, :]

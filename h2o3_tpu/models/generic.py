@@ -1,0 +1,131 @@
+"""Generic model — import an external MOJO as a first-class model.
+
+Reference: hex/generic/Generic.java — wraps a MOJO file in the Model API
+so it can predict, be measured, sit on leaderboards, and serve REST like
+any in-cluster model.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Sequence
+
+import numpy as np
+
+from h2o3_tpu.frame.frame import Frame
+from h2o3_tpu.models import register
+from h2o3_tpu.models.model import Model, ModelBuilder
+
+
+def _frame_raw_columns(frame: Frame, names) -> Dict[str, np.ndarray]:
+    """Frame → dict of raw host columns (levels decoded for categoricals)."""
+    out = {}
+    for n in names:
+        c = frame.col(n)
+        if c.is_categorical:
+            codes = np.asarray(c.data)[: c.nrows]
+            na = np.asarray(c.na_mask)[: c.nrows]
+            dom = np.asarray(c.domain or [], dtype=object)
+            vals = np.empty(c.nrows, dtype=object)
+            ok = ~na & (codes >= 0) & (codes < len(dom))
+            vals[ok] = dom[codes[ok]]
+            vals[~ok] = None
+            out[n] = vals
+        else:
+            out[n] = c.to_numpy()
+    return out
+
+
+class GenericModel(Model):
+    algo = "generic"
+
+    def __init__(self, params, output, mojo):
+        super().__init__(params, output)
+        self.mojo = mojo
+
+    def _score_raw(self, frame: Frame) -> Dict[str, np.ndarray]:
+        data = _frame_raw_columns(frame, self.mojo.names)
+        return self.mojo.predict(data)
+
+    def model_performance(self, frame: Frame):
+        from h2o3_tpu.models import metrics as mm
+        import jax.numpy as jnp
+        y = self.output.get("response")
+        if y is None or y not in frame:
+            raise ValueError("response column unavailable for metrics")
+        out = self._score_raw(frame)
+        cat = self.output["category"]
+        n = frame.nrows
+        w = np.asarray(frame.valid_weights())[:n]
+        if cat == "Binomial":
+            from h2o3_tpu.models.model import adapt_domain
+            yv = adapt_domain(frame.col(y), self.output["domain"])
+            w = w * (yv >= 0)
+            return mm.binomial_metrics(jnp.asarray(out["p1"]),
+                                       jnp.asarray(np.maximum(yv, 0).astype(np.float32)),
+                                       jnp.asarray(w.astype(np.float32)))
+        if cat == "Multinomial":
+            from h2o3_tpu.models.model import adapt_domain
+            yv = adapt_domain(frame.col(y), self.output["domain"])
+            w = w * (yv >= 0)
+            K = int(self.output.get("nclasses", 2))
+            p = np.stack([out[f"p{k}"] for k in range(K)], axis=1)
+            return mm.multinomial_metrics(jnp.asarray(p),
+                                          jnp.asarray(np.maximum(yv, 0)),
+                                          jnp.asarray(w.astype(np.float32)),
+                                          domain=self.output["domain"])
+        yv = frame.col(y).to_numpy()
+        ok = np.isfinite(yv)
+        return mm.regression_metrics(jnp.asarray(out["predict"][ok]),
+                                     jnp.asarray(yv[ok]),
+                                     jnp.asarray(w[ok].astype(np.float32)))
+
+
+@register
+class GenericEstimator(ModelBuilder):
+    """h2o-py H2OGenericEstimator surface: train() "imports" the MOJO."""
+
+    algo = "generic"
+    supervised = False
+
+    def __init__(self, **params):
+        if "path" not in params and "model_key" not in params:
+            raise ValueError("GenericEstimator requires path=<mojo zip>")
+        super().__init__(**params)
+
+    def _fit(self, frame: Optional[Frame], x: Sequence[str],
+             y: Optional[str], job, validation_frame=None) -> Model:
+        from h2o3_tpu.genmodel import load_mojo
+        mojo = load_mojo(self.params["path"])
+        output = {
+            "category": mojo.category,
+            "response": mojo.meta.get("response"),
+            "names": mojo.names,
+            "domain": mojo.domain,
+            "nclasses": mojo.nclasses,
+            "default_threshold": mojo.meta.get("default_threshold", 0.5),
+            "source_algo": mojo.algo,
+        }
+        model = GenericModel(self.params, output, mojo)
+        if frame is not None and output["response"] in (frame.names if frame else []):
+            model.training_metrics = model.model_performance(frame)
+        return model
+
+    def train(self, training_frame: Optional[Frame] = None, y=None, x=None,
+              validation_frame=None, background: bool = False,
+              dest_key: Optional[str] = None):
+        if training_frame is None:
+            job_frame = None
+            # bypass resolve_x (no frame to resolve against)
+            from h2o3_tpu.core.job import Job
+            job = Job("generic import", work=1.0)
+            self._job = job
+            job.start(lambda j: self._fit(None, [], None, j),
+                      background=background)
+            if background:
+                return job
+            if job.status == "FAILED":
+                raise RuntimeError(job.exception)
+            return job.result
+        return super().train(training_frame, y=y, x=x,
+                             validation_frame=validation_frame,
+                             background=background, dest_key=dest_key)

@@ -128,6 +128,14 @@ class Model:
     def model_performance(self, frame: Frame):
         raise NotImplementedError
 
+    def download_mojo(self, path: str) -> str:
+        """Export this model as a MOJO zip for offline scoring
+        (Model.getMojo + hex/genmodel readers; see h2o3_tpu/genmodel/)."""
+        from h2o3_tpu.genmodel.export import mojo_artifacts
+        from h2o3_tpu.genmodel.mojo import write_mojo
+        meta, arrays = mojo_artifacts(self)
+        return write_mojo(path, meta, arrays)
+
     @property
     def default_metrics(self):
         return (self.cross_validation_metrics or self.validation_metrics

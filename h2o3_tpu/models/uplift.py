@@ -109,9 +109,10 @@ def _best_uplift_splits(ht, hc, nb, col_mask, min_rows: float, metric: str):
     return best_gain, best_f, best_t, na_left
 
 
-@partial(jax.jit, static_argnames=("depth", "B", "mtries", "metric"))
+@partial(jax.jit, static_argnames=("depth", "B", "mtries", "metric",
+                                   "min_rows"))
 def _grow_uplift_tree(bins, nb, w, y, treat, key, *, depth: int, B: int,
-                      mtries: int, metric: str):
+                      mtries: int, metric: str, min_rows: float = 10.0):
     """One uplift tree fully on device; returns Tree (leaf=uplift) plus
     per-leaf treated/control response rates."""
     mesh = get_mesh()
@@ -133,7 +134,8 @@ def _grow_uplift_tree(bins, nb, w, y, treat, key, *, depth: int, B: int,
         key, sub = jax.random.split(key)
         cm = (_mtries_mask(sub, L, F, mtries) if 0 < mtries < F
               else jnp.ones((1, F), bool))
-        bg, bf, bt, bnal = _best_uplift_splits(ht, hc, nb, cm, 10.0, metric)
+        bg, bf, bt, bnal = _best_uplift_splits(ht, hc, nb, cm, min_rows,
+                                               metric)
         split = bg > 1e-9
         feats = feats.at[d, :L].set(jnp.where(split, bf, 0))
         threshs = threshs.at[d, :L].set(jnp.where(split, bt, B))
@@ -159,9 +161,10 @@ def _grow_uplift_tree(bins, nb, w, y, treat, key, *, depth: int, B: int,
 
 
 def auuc(uplift_pred: np.ndarray, y: np.ndarray, treat: np.ndarray,
-         nbins: int = 1000) -> Dict[str, float]:
+         nbins: int = 1000, auuc_type: str = "qini") -> Dict[str, float]:
     """AUUC / Qini from the cumulative uplift curve
-    (hex/AUUC.java semantics: rows sorted by predicted uplift desc)."""
+    (hex/AUUC.java semantics: rows sorted by predicted uplift desc;
+    curve types qini / lift / gain per hex/AUUC.AUUCType)."""
     order = np.argsort(-uplift_pred, kind="stable")
     y, tr = y[order], treat[order]
     n = len(y)
@@ -170,19 +173,26 @@ def auuc(uplift_pred: np.ndarray, y: np.ndarray, treat: np.ndarray,
     cn_t = np.cumsum(tr)
     cy_c = np.cumsum(y * (1 - tr))
     cn_c = np.cumsum(1 - tr)
-    qini = []
-    for k in idx - 1:
+
+    def curve_at(k: int, kind: str) -> float:
         nt, nc = cn_t[k], cn_c[k]
-        q = cy_t[k] - (cy_c[k] * nt / nc if nc > 0 else 0.0)
-        qini.append(q)
-    qini = np.asarray(qini)
-    auuc_v = float(qini.mean())
-    # random-targeting baseline endpoint
-    nt, nc = cn_t[-1], cn_c[-1]
-    q_final = cy_t[-1] - (cy_c[-1] * nt / nc if nc > 0 else 0.0)
-    qini_coef = float(auuc_v - q_final / 2.0)
-    return {"auuc": auuc_v, "qini": qini_coef,
-            "uplift_top_decile": float(qini[max(len(qini) // 10 - 1, 0)])}
+        rt = cy_t[k] / nt if nt > 0 else 0.0
+        rc = cy_c[k] / nc if nc > 0 else 0.0
+        if kind == "qini":
+            return cy_t[k] - (cy_c[k] * nt / nc if nc > 0 else 0.0)
+        if kind == "lift":
+            return rt - rc
+        return (rt - rc) * (nt + nc)   # gain
+
+    kind = auuc_type if auuc_type in ("qini", "lift", "gain") else "qini"
+    vals = np.asarray([curve_at(k, kind) for k in idx - 1])
+    qini = np.asarray([curve_at(k, "qini") for k in idx - 1])
+    auuc_v = float(vals.mean())
+    # random-targeting baseline endpoint (on the qini curve)
+    q_final = curve_at(n - 1, "qini")
+    qini_coef = float(qini.mean() - q_final / 2.0)
+    return {"auuc": auuc_v, "qini": qini_coef, "auuc_type": kind,
+            "uplift_top_decile": float(vals[max(len(vals) // 10 - 1, 0)])}
 
 
 class UpliftDRFModel(Model):
@@ -201,12 +211,13 @@ class UpliftDRFModel(Model):
         B = self.bm.nbins_total
         T = self.forest.feat.shape[0]
         n = frame.nrows
-        up = np.asarray(predict_forest(self.forest, bm.bins, B))[:n] / T
+        # tree leaves are p_t - p_c by construction, so uplift falls out
+        # of the two class-rate scans without a third forest walk
         pt = np.asarray(predict_forest(
             self.forest._replace(leaf=self.leaf_pt), bm.bins, B))[:n] / T
         pc = np.asarray(predict_forest(
             self.forest._replace(leaf=self.leaf_pc), bm.bins, B))[:n] / T
-        return {"uplift_predict": up, "p_y1_ct1": pt, "p_y1_ct0": pc}
+        return {"uplift_predict": pt - pc, "p_y1_ct1": pt, "p_y1_ct0": pc}
 
     def model_performance(self, frame: Frame):
         raw = self._score_raw(frame)
@@ -215,8 +226,12 @@ class UpliftDRFModel(Model):
         tr = adapt_domain(frame.col(self.params["treatment_column"]),
                           self.output["treatment_domain"])[: frame.nrows]
         ok = (y >= 0) & (tr >= 0)
+        nbins = int(self.params.get("auuc_nbins") or -1)
+        atype = str(self.params.get("auuc_type") or "auto").lower()
         a = auuc(raw["uplift_predict"][ok], y[ok].astype(float),
-                 tr[ok].astype(float))
+                 tr[ok].astype(float),
+                 nbins=nbins if nbins > 0 else 1000,
+                 auuc_type="qini" if atype == "auto" else atype)
         return mm.ModelMetrics("BinomialUplift", int(ok.sum()),
                                float(np.mean(raw["uplift_predict"] ** 2)),
                                **a)
@@ -260,14 +275,21 @@ class UpliftDRFEstimator(ModelBuilder):
             raise ValueError("UpliftDRF needs a 2-level categorical response")
         if not (tc.is_categorical and tc.cardinality == 2):
             raise ValueError("UpliftDRF needs a 2-level treatment column")
-        metric = str(p["uplift_metric"]).lower()
+        metric = str(p["uplift_metric"]).lower().replace("chisquared",
+                                                         "chi_squared")
         if metric == "auto":
             metric = "kl"
+        if metric not in ("kl", "euclidean", "chi_squared"):
+            raise ValueError(f"unknown uplift_metric '{p['uplift_metric']}'; "
+                             "use KL, Euclidean or ChiSquared")
         bm = bin_frame(frame, x, nbins=p["nbins"], nbins_cats=p["nbins_cats"])
         npad = bm.bins.shape[0]
         n = frame.nrows
 
         w = frame.valid_weights()
+        if p.get("weights_column") and p["weights_column"] in frame:
+            wc_ = frame.col(p["weights_column"]).numeric_view()
+            w = w * jnp.where(jnp.isnan(wc_), 0.0, wc_)
         yv = adapt_domain(rc, rc.domain)
         trv = adapt_domain(tc, tc.domain)
         ok = (yv >= 0) & (trv >= 0)
@@ -295,7 +317,7 @@ class UpliftDRFEstimator(ModelBuilder):
             tr_, pt_, pc_ = _grow_uplift_tree(
                 bm.bins, bm.nbins, w * keep.astype(jnp.float32), y_dev,
                 t_dev, kt, depth=depth, B=bm.nbins_total, mtries=mtries,
-                metric=metric)
+                metric=metric, min_rows=float(p["min_rows"]))
             trees.append(tr_)
             pts.append(pt_)
             pcs.append(pc_)

@@ -1,0 +1,139 @@
+"""MOJO export / offline-scoring conformance.
+
+The testdir_javapredict analogue (SURVEY §4): in-cluster predictions and
+MOJO (numpy-only offline runtime) predictions must agree to float
+precision on the same raw rows.
+"""
+
+import numpy as np
+import pytest
+
+import h2o3_tpu
+from h2o3_tpu.genmodel import EasyPredictModelWrapper, load_mojo
+from tests.conftest import make_classification, make_regression
+
+
+def _raw_cols(frame, names):
+    from h2o3_tpu.models.generic import _frame_raw_columns
+    return _frame_raw_columns(frame, names)
+
+
+def _roundtrip(model, frame, tmp_path, atol=1e-4):
+    path = str(tmp_path / f"{model.algo}.zip")
+    model.download_mojo(path)
+    mojo = load_mojo(path)
+    incluster = model._score_raw(frame)
+    offline = mojo.predict(_raw_cols(frame, mojo.names))
+    for k in incluster:
+        if k not in offline:
+            continue
+        a = np.asarray(incluster[k], dtype=np.float64)
+        b = np.asarray(offline[k], dtype=np.float64)
+        assert np.allclose(a, b, atol=atol), (
+            f"{model.algo}/{k}: max diff {np.abs(a - b).max()}")
+    return mojo
+
+
+def test_gbm_binomial_mojo(classif_frame, tmp_path):
+    from h2o3_tpu.models.gbm import GBMEstimator
+    m = GBMEstimator(ntrees=10, max_depth=4, seed=7).train(
+        classif_frame, y="y")
+    mojo = _roundtrip(m, classif_frame, tmp_path)
+    # EasyPredict single row
+    row = {f"x{i}": 0.1 * i for i in range(8)}
+    pred = EasyPredictModelWrapper(mojo).predict(row)
+    assert pred.label in ("no", "yes")
+    assert abs(sum(pred.class_probabilities) - 1.0) < 1e-6
+
+
+def test_gbm_regression_mojo(regress_frame, tmp_path):
+    from h2o3_tpu.models.gbm import GBMEstimator
+    m = GBMEstimator(ntrees=10, max_depth=4, seed=7).train(
+        regress_frame, y="y")
+    _roundtrip(m, regress_frame, tmp_path)
+
+
+def test_gbm_multinomial_mojo(tmp_path):
+    from h2o3_tpu.models.gbm import GBMEstimator
+    r = np.random.RandomState(3)
+    X = r.randn(600, 4)
+    y = (X[:, 0] + 0.7 * X[:, 1] > 0).astype(int) + (X[:, 2] > 1).astype(int)
+    fr = h2o3_tpu.Frame.from_numpy(
+        {**{f"x{i}": X[:, i] for i in range(4)},
+         "y": np.array(["a", "b", "c"], object)[y]}, categorical=["y"])
+    m = GBMEstimator(ntrees=6, max_depth=3, seed=7).train(fr, y="y")
+    _roundtrip(m, fr, tmp_path)
+
+
+def test_drf_mojo(classif_frame, tmp_path):
+    from h2o3_tpu.models.drf import DRFEstimator
+    m = DRFEstimator(ntrees=8, max_depth=4, seed=7).train(classif_frame, y="y")
+    _roundtrip(m, classif_frame, tmp_path)
+
+
+def test_glm_mojo_with_categoricals(tmp_path):
+    from h2o3_tpu.models.glm import GLMEstimator
+    r = np.random.RandomState(5)
+    n = 800
+    x0 = r.randn(n)
+    g = np.array(["u", "v", "w"], object)[r.randint(0, 3, n)]
+    logit = x0 + (g == "v") * 1.2 - (g == "w") * 0.7
+    y = (r.rand(n) < 1 / (1 + np.exp(-logit))).astype(int)
+    fr = h2o3_tpu.Frame.from_numpy(
+        {"x0": x0, "g": g, "y": np.array(["n", "y"], object)[y]},
+        categorical=["g", "y"])
+    m = GLMEstimator(family="binomial", lambda_=0.0).train(fr, y="y")
+    _roundtrip(m, fr, tmp_path)
+
+
+def test_kmeans_mojo(tmp_path):
+    from h2o3_tpu.models.kmeans import KMeansEstimator
+    r = np.random.RandomState(1)
+    X = np.concatenate([r.randn(200, 3) + 4, r.randn(200, 3) - 4])
+    fr = h2o3_tpu.Frame.from_numpy({f"x{i}": X[:, i] for i in range(3)})
+    m = KMeansEstimator(k=2, seed=3).train(fr)
+    _roundtrip(m, fr, tmp_path)
+
+
+def test_deeplearning_mojo(regress_frame, tmp_path):
+    from h2o3_tpu.models.deeplearning import DeepLearningEstimator
+    m = DeepLearningEstimator(hidden=[16], epochs=2, seed=5).train(
+        regress_frame, y="y")
+    _roundtrip(m, regress_frame, tmp_path, atol=1e-3)
+
+
+def test_isofor_mojo(tmp_path):
+    from h2o3_tpu.models.isofor import IsolationForestEstimator
+    r = np.random.RandomState(2)
+    X = r.randn(500, 4)
+    X[:8] += 6.0  # anomalies
+    fr = h2o3_tpu.Frame.from_numpy({f"x{i}": X[:, i] for i in range(4)})
+    m = IsolationForestEstimator(ntrees=10, seed=3).train(fr)
+    _roundtrip(m, fr, tmp_path)
+
+
+def test_generic_estimator_imports_mojo(classif_frame, tmp_path):
+    from h2o3_tpu.models.gbm import GBMEstimator
+    from h2o3_tpu.models.generic import GenericEstimator
+    m = GBMEstimator(ntrees=6, max_depth=3, seed=7).train(classif_frame, y="y")
+    path = str(tmp_path / "g.zip")
+    m.download_mojo(path)
+    gm = GenericEstimator(path=path).train(classif_frame, y="y")
+    # predictions agree with the source model
+    a = m.predict(classif_frame).col("p1").to_numpy()
+    b = gm.predict(classif_frame).col("p1").to_numpy()
+    assert np.allclose(a, b, atol=1e-5)
+    # and it produces metrics like a first-class model
+    assert gm.training_metrics is not None
+    assert gm.training_metrics["AUC"] > 0.7
+
+
+def test_generic_without_frame(tmp_path, classif_frame):
+    from h2o3_tpu.models.gbm import GBMEstimator
+    from h2o3_tpu.models.generic import GenericEstimator
+    m = GBMEstimator(ntrees=4, max_depth=3, seed=7).train(classif_frame, y="y")
+    path = str(tmp_path / "g2.zip")
+    m.download_mojo(path)
+    gm = GenericEstimator(path=path).train()
+    out = gm.predict(classif_frame)
+    assert "p1" in out.names
