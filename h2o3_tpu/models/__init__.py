@@ -18,6 +18,7 @@ def register(cls):
 
 def _auto_register():
     """Populate the registry from the standard estimator modules."""
+    from h2o3_tpu.models.aggregator import AggregatorEstimator
     from h2o3_tpu.models.coxph import CoxPHEstimator
     from h2o3_tpu.models.deeplearning import DeepLearningEstimator
     from h2o3_tpu.models.drf import DRFEstimator
@@ -27,6 +28,7 @@ def _auto_register():
     from h2o3_tpu.models.generic import GenericEstimator
     from h2o3_tpu.models.glm import GLMEstimator
     from h2o3_tpu.models.glrm import GLRMEstimator
+    from h2o3_tpu.models.infogram import InfogramEstimator
     from h2o3_tpu.models.isofor import IsolationForestEstimator
     from h2o3_tpu.models.isotonic import IsotonicRegressionEstimator
     from h2o3_tpu.models.kmeans import KMeansEstimator
@@ -34,15 +36,22 @@ def _auto_register():
                                                  ModelSelectionEstimator)
     from h2o3_tpu.models.naivebayes import NaiveBayesEstimator
     from h2o3_tpu.models.pca import PCAEstimator, SVDEstimator
+    from h2o3_tpu.models.psvm import PSVMEstimator
     from h2o3_tpu.models.rulefit import RuleFitEstimator
+    from h2o3_tpu.models.targetencoder import TargetEncoderEstimator
     from h2o3_tpu.models.uplift import UpliftDRFEstimator
-    for cls in (ANOVAGLMEstimator, CoxPHEstimator, DeepLearningEstimator,
+    from h2o3_tpu.models.word2vec import Word2VecEstimator
+    for cls in (AggregatorEstimator, ANOVAGLMEstimator, CoxPHEstimator,
+                DeepLearningEstimator,
                 DRFEstimator, GAMEstimator, GBMEstimator, GenericEstimator,
-                GLMEstimator, GLRMEstimator, IsolationForestEstimator,
+                GLMEstimator, GLRMEstimator, InfogramEstimator,
+                IsolationForestEstimator,
                 IsotonicRegressionEstimator, KMeansEstimator,
                 ModelSelectionEstimator, NaiveBayesEstimator, PCAEstimator,
-                RuleFitEstimator, SVDEstimator,
-                ExtendedIsolationForestEstimator, UpliftDRFEstimator):
+                PSVMEstimator, RuleFitEstimator, SVDEstimator,
+                TargetEncoderEstimator,
+                ExtendedIsolationForestEstimator, UpliftDRFEstimator,
+                Word2VecEstimator):
         _REGISTRY[cls.algo] = cls
 
 

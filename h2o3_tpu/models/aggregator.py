@@ -1,0 +1,174 @@
+"""Aggregator — exemplar-based dataset compression.
+
+Reference: hex/aggregator/Aggregator.java (~600 LoC): radius-based
+agglomeration — rows within ``radius`` of an exemplar are absorbed into
+it (counts accumulate), others become new exemplars; the radius is
+scaled until the exemplar count lands near ``target_num_exemplars``
+(within rel_tol_num_exemplars). Output is an aggregated frame of
+exemplar rows plus a ``counts`` column.
+
+TPU redesign: rows are standardized once into a device matrix; each
+candidate radius runs a batched sweep where distances of a whole batch
+against the current exemplar set are one matmul; only the
+new-exemplar selection inside a batch is a (short) host loop. The
+radius search is a geometric escalation like the reference's
+aggregate_radius_scale growth.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence
+
+import jax.numpy as jnp
+import numpy as np
+
+from h2o3_tpu.frame.datainfo import build_datainfo
+from h2o3_tpu.frame.frame import Frame
+from h2o3_tpu.models import register
+from h2o3_tpu.models.model import Model, ModelBuilder
+from h2o3_tpu.utils.log import get_logger
+
+log = get_logger("h2o3_tpu.aggregator")
+
+
+def _sweep(Xh: np.ndarray, radius: float, max_exemplars: int):
+    """One agglomeration pass at a fixed radius. Returns (exemplar row
+    indices, counts, assignment)."""
+    n = Xh.shape[0]
+    r2 = radius * radius
+    ex_idx: List[int] = [0]
+    assign = np.full(n, -1, dtype=np.int64)
+    assign[0] = 0
+    B = 4096
+    x2 = (Xh * Xh).sum(axis=1)
+    for s in range(0, n, B):
+        batch = Xh[s: s + B]
+        E = Xh[np.asarray(ex_idx)]
+        # ||x-e||² = x² + e² - 2 x·e — keeps the temp at [B, E]
+        d2 = (x2[s: s + B][:, None] + x2[np.asarray(ex_idx)][None, :]
+              - 2.0 * batch @ E.T)
+        best = d2.argmin(axis=1)
+        bestd = d2[np.arange(len(batch)), best]
+        within = bestd <= r2
+        assign[s: s + B][within] = best[within]
+        # rows beyond radius: greedily promote to exemplars
+        far = np.where(~within)[0]
+        for i in far:
+            gi = s + i
+            if assign[gi] >= 0:
+                continue
+            E_new = Xh[np.asarray(ex_idx[len(E):])] if len(ex_idx) > len(E) \
+                else None
+            if E_new is not None and len(E_new):
+                d2n = (x2[gi] + x2[np.asarray(ex_idx[len(E):])]
+                       - 2.0 * E_new @ Xh[gi])
+                j = d2n.argmin()
+                if d2n[j] <= r2:
+                    assign[gi] = len(E) + j
+                    continue
+            ex_idx.append(gi)
+            assign[gi] = len(ex_idx) - 1
+            if len(ex_idx) > max_exemplars:
+                return None, None, None   # radius too small
+    counts = np.bincount(assign, minlength=len(ex_idx))
+    return np.asarray(ex_idx), counts, assign
+
+
+class AggregatorModel(Model):
+    algo = "aggregator"
+
+    def __init__(self, params, output, exemplar_frame_key: str,
+                 exemplar_assignment: np.ndarray):
+        super().__init__(params, output)
+        self.exemplar_frame_key = exemplar_frame_key
+        self.exemplar_assignment = exemplar_assignment
+
+    @property
+    def aggregated_frame(self) -> Frame:
+        from h2o3_tpu.core.kv import DKV
+        return DKV.get(self.exemplar_frame_key)
+
+    def _score_raw(self, frame: Frame):
+        raise NotImplementedError("Aggregator produces aggregated_frame")
+
+    def model_performance(self, frame: Frame):
+        return None
+
+
+@register
+class AggregatorEstimator(ModelBuilder):
+    """h2o-py H2OAggregatorEstimator surface
+    (h2o-py/h2o/estimators/aggregator.py)."""
+
+    algo = "aggregator"
+    supervised = False
+
+    DEFAULTS = dict(
+        target_num_exemplars=5000, rel_tol_num_exemplars=0.5,
+        transform="normalize", categorical_encoding="auto",
+        ignored_columns=None, seed=-1,
+    )
+
+    def __init__(self, **params):
+        merged = dict(self.DEFAULTS)
+        unknown = set(params) - set(merged)
+        if unknown:
+            raise ValueError(f"unknown Aggregator params: {sorted(unknown)}")
+        merged.update(params)
+        super().__init__(**merged)
+
+    def _fit(self, frame: Frame, x: Sequence[str], y: Optional[str],
+             job, validation_frame: Optional[Frame] = None) -> Model:
+        p = self.params
+        standardize = str(p["transform"]).lower() in ("normalize",
+                                                      "standardize")
+        di = build_datainfo(frame, x, standardize=standardize,
+                            use_all_factor_levels=True)
+        n = frame.nrows
+        Xh = np.asarray(di.X)[:n].astype(np.float64)
+
+        target = int(p["target_num_exemplars"])
+        tol = float(p["rel_tol_num_exemplars"])
+        lo_ok = max(int(target * (1 - tol)), 1)
+        if n <= target:
+            ex_idx = np.arange(n)
+            counts = np.ones(n, dtype=np.int64)
+            assign = np.arange(n)
+        else:
+            # geometric radius escalation, then accept first radius whose
+            # exemplar count falls in [lo_ok, target]
+            radius = 0.05 * np.sqrt(di.P)
+            ex_idx = counts = assign = None
+            for _ in range(40):
+                res = _sweep(Xh, radius, max_exemplars=max(4 * target, 100))
+                if res[0] is not None and len(res[0]) <= target:
+                    ex_idx, counts, assign = res
+                    if len(ex_idx) >= lo_ok:
+                        break
+                    radius /= 1.5   # too few exemplars — shrink
+                else:
+                    radius *= 2.0   # too many — grow
+                job.update(0.02, f"radius {radius:.3g}")
+            if ex_idx is None:
+                res = _sweep(Xh, radius, max_exemplars=n + 1)
+                ex_idx, counts, assign = res
+
+        # aggregated output frame: original-space exemplar rows + counts
+        from h2o3_tpu.models.generic import _frame_raw_columns
+        raw = _frame_raw_columns(frame, x)
+        cols: Dict[str, np.ndarray] = {}
+        cats = []
+        for name in x:
+            v = raw[name][ex_idx]
+            cols[name] = v
+            if frame.col(name).is_categorical:
+                cats.append(name)
+        cols["counts"] = counts.astype(np.float64)
+        agg = Frame.from_numpy(cols, categorical=cats)
+
+        output = {"category": "Clustering", "response": None,
+                  "names": list(x), "domain": None,
+                  "num_exemplars": int(len(ex_idx)),
+                  "output_frame": agg.key}
+        model = AggregatorModel(p, output, agg.key, assign)
+        return model

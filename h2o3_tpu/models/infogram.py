@@ -1,0 +1,181 @@
+"""Infogram — admissible-ML feature screening.
+
+Reference: h2o-admissibleml (hex/Infogram/Infogram.java, 2735 LoC):
+for every predictor compute
+  - relevance ("total information"): normalized variable importance from
+    a model on all predictors;
+  - cmi ("net information"): normalized conditional mutual information
+    of the predictor with the response given the rest — estimated from
+    cross-validated model performance deltas.
+Core infogram: conditioning set = the other predictors; fair/safety
+infogram: conditioning set = the protected_columns, and predictors are
+screened for safety (low cmi w.r.t. protected info).
+Admissible features clear both thresholds; output is the
+relevance/cmi table the h2o-py client plots.
+
+TPU: every probe model is a shallow GBM on the mesh; the per-feature
+loop is job-parallel orchestration (reference runs these as parallel
+model builds too).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from h2o3_tpu.frame.frame import Frame
+from h2o3_tpu.models import register
+from h2o3_tpu.models.model import Model, ModelBuilder, infer_category
+from h2o3_tpu.utils.log import get_logger
+
+log = get_logger("h2o3_tpu.infogram")
+
+
+def _probe_logloss(frame, feats, y, ntrees, depth, seed) -> float:
+    """Deviance of a shallow GBM using ``feats`` (the CMI estimator's
+    model-performance probe)."""
+    from h2o3_tpu.models.gbm import GBMEstimator
+    m = GBMEstimator(ntrees=ntrees, max_depth=depth, seed=seed).train(
+        frame, y=y, x=list(feats))
+    tm = m.training_metrics.to_dict()
+    for k in ("logloss", "mean_per_class_error", "MSE"):
+        if tm.get(k) is not None:
+            return float(tm[k])
+    return float("nan")
+
+
+class InfogramModel(Model):
+    algo = "infogram"
+
+    def __init__(self, params, output):
+        super().__init__(params, output)
+
+    @property
+    def admissible_features(self) -> List[str]:
+        return self.output["admissible_features"]
+
+    def get_admissible_score_frame(self) -> Frame:
+        t = self.output["infogram_table"]
+        return Frame.from_numpy({
+            "column": np.asarray([r["column"] for r in t], dtype=object),
+            "admissible": np.asarray(
+                [1.0 if r["admissible"] else 0.0 for r in t]),
+            "admissible_index": np.asarray(
+                [r["admissible_index"] for r in t]),
+            "relevance_index": np.asarray([r["relevance"] for r in t]),
+            "safety_index": np.asarray([r["cmi"] for r in t]),
+        }, categorical=["column"])
+
+    def _score_raw(self, frame: Frame):
+        raise NotImplementedError("Infogram is a screening model")
+
+    def model_performance(self, frame: Frame):
+        return None
+
+
+@register
+class InfogramEstimator(ModelBuilder):
+    """h2o-py H2OInfogram surface (h2o-py/h2o/estimators/infogram.py)."""
+
+    algo = "infogram"
+
+    DEFAULTS = dict(
+        protected_columns=None, safety_index_threshold=0.1,
+        relevance_index_threshold=0.1, net_information_threshold=-1.0,
+        total_information_threshold=-1.0, ntop=50, seed=-1,
+        ntrees=10, max_depth=5, ignored_columns=None, nfolds=0,
+        fold_assignment="auto", weights_column=None, fold_column=None,
+    )
+
+    def __init__(self, **params):
+        merged = dict(self.DEFAULTS)
+        unknown = set(params) - set(merged)
+        if unknown:
+            raise ValueError(f"unknown Infogram params: {sorted(unknown)}")
+        merged.update(params)
+        if int(merged.get("nfolds") or 0) >= 2:
+            raise ValueError("Infogram is a screening model; generic CV is "
+                             "not applicable (nfolds must be 0)")
+        super().__init__(**merged)
+
+    def resolve_x(self, frame, x, y):
+        x = super().resolve_x(frame, x, y)
+        protected = set(self.params.get("protected_columns") or [])
+        return [n for n in x if n not in protected]
+
+    def _fit(self, frame: Frame, x: Sequence[str], y: Optional[str],
+             job, validation_frame: Optional[Frame] = None) -> Model:
+        p = self.params
+        protected = list(p.get("protected_columns") or [])
+        ntrees, depth = int(p["ntrees"]), int(p["max_depth"])
+        seed = int(p["seed"]) if int(p["seed"]) >= 0 else 0x1F06
+
+        # relevance: varimp of the full model over all predictors
+        from h2o3_tpu.models.gbm import GBMEstimator
+        full = GBMEstimator(ntrees=ntrees, max_depth=depth, seed=seed).train(
+            frame, y=y, x=list(x))
+        vi = {name: rel for name, _, rel, _ in
+              (full.output.get("varimp") or [])}
+        relevance = np.asarray([vi.get(f, 0.0) for f in x])
+        job.update(0.3, "relevance done")
+
+        # cap the probe budget at the ntop most relevant predictors
+        # (the reference's top-N screening bound); the rest score cmi=0
+        ntop = int(p["ntop"])
+        probe_set = set(np.asarray(list(x))[np.argsort(-relevance)[:ntop]])
+
+        # cmi probes
+        nf = len(x)
+        cmi_raw = np.zeros(nf)
+        if protected:
+            # fair infogram: gain of adding x_i to the protected set
+            base = _probe_logloss(frame, protected, y, ntrees, depth, seed)
+            for i, f in enumerate(x):
+                if f not in probe_set:
+                    continue
+                li = _probe_logloss(frame, protected + [f], y, ntrees,
+                                    depth, seed)
+                cmi_raw[i] = max(base - li, 0.0)
+                job.update(0.6 / nf, f"cmi {f}")
+        else:
+            # core infogram: drop-one loss increase given the rest
+            base = _probe_logloss(frame, x, y, ntrees, depth, seed)
+            for i, f in enumerate(x):
+                if f not in probe_set:
+                    continue
+                rest = [c for c in x if c != f]
+                if not rest:
+                    cmi_raw[i] = 1.0
+                    continue
+                li = _probe_logloss(frame, rest, y, ntrees, depth, seed)
+                cmi_raw[i] = max(li - base, 0.0)
+                job.update(0.6 / nf, f"cmi {f}")
+        cmi = cmi_raw / max(cmi_raw.max(), 1e-12)
+
+        rel_thr = float(p["relevance_index_threshold"])
+        if float(p["total_information_threshold"]) >= 0:
+            rel_thr = float(p["total_information_threshold"])
+        saf_thr = float(p["safety_index_threshold"])
+        if float(p["net_information_threshold"]) >= 0:
+            saf_thr = float(p["net_information_threshold"])
+
+        table = []
+        for i, f in enumerate(x):
+            adm = bool(relevance[i] >= rel_thr and cmi[i] >= saf_thr)
+            table.append({
+                "column": f, "relevance": float(relevance[i]),
+                "cmi": float(cmi[i]), "cmi_raw": float(cmi_raw[i]),
+                "admissible": adm,
+                "admissible_index": float(
+                    np.hypot(relevance[i], cmi[i]) / np.sqrt(2.0)),
+            })
+        table.sort(key=lambda r: -r["admissible_index"])
+        admissible = [r["column"] for r in table if r["admissible"]][:ntop]
+
+        output = {"category": infer_category(frame, y), "response": y,
+                  "names": list(x), "domain": frame.col(y).domain,
+                  "infogram_table": table,
+                  "admissible_features": admissible,
+                  "protected_columns": protected}
+        return InfogramModel(p, output)

@@ -1,0 +1,225 @@
+"""PSVM — kernel SVM via Incomplete Cholesky Factorization.
+
+Reference: hex/psvm/PSVM.java:24 (~2100 LoC) — the Chang et al. "PSVM:
+Parallelizing Support Vector Machines on Distributed Computers" recipe:
+approximate the Gaussian-kernel Gram matrix K ≈ V·Vᵀ with a rank-r
+incomplete Cholesky factorization (hex/psvm/psvm/IncompleteCholesky),
+then solve the regularized problem on the factorization; predictions and
+support-vector stats mirror ModelMetricsBinomial + svs_count/bsv_count
+outputs.
+
+TPU redesign: ICF runs as r pivot steps, each one fused row-kernel +
+rank-1 update over the row-sharded data (the per-step argmax/psum are
+the only collectives); the solve is an L2-SVM Newton iteration in the
+r-dimensional ICF feature space — smooth, so a handful of [r × r]
+cho_solves on the MXU replace the reference's interior-point method.
+Scoring maps a new row x into ICF space via k(x, pivots)·L⁻ᵀ.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Dict, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from h2o3_tpu.frame.datainfo import build_datainfo, stats_of
+from h2o3_tpu.frame.frame import Frame
+from h2o3_tpu.models import metrics as mm
+from h2o3_tpu.models import register
+from h2o3_tpu.models.model import (Model, ModelBuilder, ModelCategory,
+                                   adapt_domain)
+from h2o3_tpu.utils.log import get_logger
+
+log = get_logger("h2o3_tpu.psvm")
+
+
+def _rbf_rows(X, rows, gamma):
+    """K(X, rows) for Gaussian kernel, [N, m]."""
+    d2 = (jnp.sum(X * X, axis=1)[:, None]
+          + jnp.sum(rows * rows, axis=1)[None, :]
+          - 2.0 * X @ rows.T)
+    return jnp.exp(-gamma * jnp.maximum(d2, 0.0))
+
+
+def icf(X, w_valid, gamma: float, rank: int):
+    """Incomplete Cholesky of the RBF Gram matrix (IncompleteCholesky.java
+    role): returns V [N, r] with K ≈ V Vᵀ, pivot row indices, and L
+    (= V[pivots]) for out-of-sample mapping."""
+    N = X.shape[0]
+    diag = jnp.where(w_valid > 0, 1.0, 0.0)   # K(x,x) = 1 for RBF
+    V = jnp.zeros((N, rank), jnp.float32)
+    pivots = []
+    for j in range(rank):
+        piv = int(jnp.argmax(diag))
+        dmax = float(diag[piv])
+        if dmax <= 1e-8:
+            rank = j
+            break
+        pivots.append(piv)
+        kcol = _rbf_rows(X, X[piv][None, :], gamma)[:, 0]
+        vj = (kcol - V[:, :j] @ V[piv, :j]) / jnp.sqrt(dmax)
+        vj = jnp.where(w_valid > 0, vj, 0.0)
+        V = V.at[:, j].set(vj)
+        diag = jnp.maximum(diag - vj * vj, 0.0)
+    return V[:, :rank], np.asarray(pivots, np.int64), rank
+
+
+@partial(jax.jit, static_argnames=())
+def _newton_step(w_b, V1, y, cw):
+    """One Newton step on the smooth L2-SVM primal in ICF space:
+    min 0.5 wᵀw + Σ cwᵢ max(0, 1 - yᵢ fᵢ)²,  f = V1 @ [w; b]."""
+    f = V1 @ w_b
+    xi = 1.0 - y * f
+    act = (xi > 0).astype(jnp.float32) * cw
+    # gradient and (Gauss-Newton) Hessian
+    r = w_b.at[-1].set(0.0)                       # don't regularize bias
+    g = r - 2.0 * V1.T @ (act * y * xi)
+    H = (jnp.eye(w_b.shape[0]).at[-1, -1].set(1e-6)
+         + 2.0 * V1.T @ (act[:, None] * V1))
+    delta = jax.scipy.linalg.solve(H, g, assume_a="pos")
+    return w_b - delta, jnp.sum(act * xi * xi) + 0.5 * jnp.sum(r * r)
+
+
+class PSVMModel(Model):
+    algo = "psvm"
+
+    def __init__(self, params, output, w_b: np.ndarray, pivot_rows: np.ndarray,
+                 Linv_t: np.ndarray, gamma: float, di_stats: dict,
+                 features: List[str]):
+        super().__init__(params, output)
+        self.w_b = w_b                 # [r+1] weights + bias in ICF space
+        self.pivot_rows = pivot_rows   # [r, P] standardized pivot rows
+        self.Linv_t = Linv_t           # [r, r] L^{-T} for feature mapping
+        self.gamma = gamma
+        self.di_stats = di_stats
+        self.features = features
+
+    def _decision(self, frame: Frame) -> np.ndarray:
+        di = build_datainfo(frame, self.features, standardize=True,
+                            use_all_factor_levels=True,
+                            stats_override=self.di_stats)
+        k = _rbf_rows(di.X, jnp.asarray(self.pivot_rows), self.gamma)
+        phi = k @ jnp.asarray(self.Linv_t)
+        f = phi @ jnp.asarray(self.w_b[:-1]) + self.w_b[-1]
+        return np.asarray(f)
+
+    def _score_raw(self, frame: Frame) -> Dict[str, np.ndarray]:
+        f = self._decision(frame)[: frame.nrows]
+        pred = (f >= 0).astype(np.int32)
+        p1 = 1.0 / (1.0 + np.exp(-np.clip(f, -30, 30)))
+        return {"predict": pred, "decision_function": f,
+                "p0": 1.0 - p1, "p1": p1}
+
+    def model_performance(self, frame: Frame):
+        f = self._decision(frame)
+        y = adapt_domain(frame.col(self.output["response"]),
+                         self.output["domain"])
+        n = frame.nrows
+        npad = len(f)
+        y = np.pad(y, (0, npad - n), constant_values=-1)
+        w = np.asarray(frame.valid_weights()) * (y >= 0)
+        # squash the decision value through a sigmoid for AUC/logloss
+        p = 1.0 / (1.0 + np.exp(-np.clip(f, -30, 30)))
+        return mm.binomial_metrics(jnp.asarray(p.astype(np.float32)),
+                                   jnp.asarray(np.maximum(y, 0).astype(np.float32)),
+                                   jnp.asarray(w.astype(np.float32)))
+
+
+@register
+class PSVMEstimator(ModelBuilder):
+    """h2o-py H2OSupportVectorMachineEstimator surface
+    (h2o-py/h2o/estimators/psvm.py)."""
+
+    algo = "psvm"
+
+    DEFAULTS = dict(
+        hyper_param=1.0, kernel_type="gaussian", gamma=-1.0,
+        rank_ratio=-1.0, positive_weight=1.0, negative_weight=1.0,
+        sv_threshold=1e-4, max_iterations=200, ignored_columns=None,
+        seed=-1, nfolds=0, fold_assignment="auto", weights_column=None,
+        fold_column=None,
+    )
+
+    def __init__(self, **params):
+        merged = dict(self.DEFAULTS)
+        unknown = set(params) - set(merged)
+        if unknown:
+            raise ValueError(f"unknown PSVM params: {sorted(unknown)}")
+        merged.update(params)
+        super().__init__(**merged)
+        if str(self.params["kernel_type"]).lower() != "gaussian":
+            raise ValueError("only kernel_type='gaussian' is supported "
+                             "(reference PSVM.java supports gaussian only)")
+
+    def _fit(self, frame: Frame, x: Sequence[str], y: Optional[str],
+             job, validation_frame: Optional[Frame] = None) -> Model:
+        p = self.params
+        rc = frame.col(y)
+        if not (rc.is_categorical and rc.cardinality == 2):
+            raise ValueError("PSVM needs a binary categorical response")
+        di = build_datainfo(frame, x, standardize=True,
+                            use_all_factor_levels=True)
+        n = frame.nrows
+        npad = di.X.shape[0]
+        yv = adapt_domain(rc, rc.domain)
+        yv = np.pad(yv, (0, npad - n), constant_values=-1)
+        w_valid = np.asarray(frame.valid_weights()) * (yv >= 0)
+        if p.get("weights_column") and p["weights_column"] in frame:
+            wc = frame.col(p["weights_column"]).to_numpy()
+            wc = np.pad(np.where(np.isnan(wc), 0.0, wc), (0, npad - n))
+            w_valid = w_valid * wc
+        ypm = jnp.asarray(np.where(yv == 1, 1.0, -1.0).astype(np.float32))
+
+        gamma = float(p["gamma"])
+        if gamma <= 0:
+            gamma = 1.0 / max(di.P, 1)
+        rr = float(p["rank_ratio"])
+        rank = int(np.sqrt(n)) if rr <= 0 else max(int(n * rr), 1)
+        rank = min(rank, 256, n)
+
+        job.update(0.1, f"ICF rank {rank}")
+        V, pivots, rank = icf(di.X, jnp.asarray(w_valid.astype(np.float32)),
+                              gamma, rank)
+        V1 = jnp.concatenate([V, jnp.ones((npad, 1), jnp.float32)], axis=1)
+        V1 = V1 * jnp.asarray(w_valid > 0, jnp.float32)[:, None]
+
+        C = float(p["hyper_param"])
+        cw = jnp.asarray(np.where(yv == 1, C * float(p["positive_weight"]),
+                                  C * float(p["negative_weight"]))
+                         .astype(np.float32)) * jnp.asarray(
+            w_valid.astype(np.float32))
+        w_b = jnp.zeros((rank + 1,), jnp.float32)
+        last = np.inf
+        for it in range(int(p["max_iterations"])):
+            w_b, obj = _newton_step(w_b, V1, ypm, cw)
+            obj = float(obj)
+            job.update(0.8 / int(p["max_iterations"]), f"newton {it}")
+            if abs(last - obj) < 1e-7 * max(abs(obj), 1.0):
+                break
+            last = obj
+
+        # support vectors from the L2-SVM KKT: alpha_i = 2 cw_i ξ_i
+        f = np.asarray(V1 @ w_b)
+        xi = np.maximum(1.0 - np.where(yv == 1, 1.0, -1.0) * f, 0.0)
+        alpha = 2.0 * np.asarray(cw) * xi
+        sv = (alpha > float(p["sv_threshold"])) & (w_valid > 0)
+
+        # out-of-sample feature map: phi(x) = k(x, pivots) @ L^{-T}
+        L = np.asarray(V)[pivots][:, :rank]
+        Linv_t = np.linalg.solve(L.astype(np.float64),
+                                 np.eye(rank)).T.astype(np.float32)
+        pivot_rows = np.asarray(di.X)[pivots]
+
+        output = {"category": ModelCategory.BINOMIAL, "response": y,
+                  "names": list(x), "domain": rc.domain, "nclasses": 2,
+                  "svs_count": int(sv.sum()),
+                  "bsv_count": int(((alpha > 0) & (xi >= 1.0)).sum()),
+                  "rank": rank, "gamma": gamma,
+                  "default_threshold": 0.5}
+        model = PSVMModel(p, output, np.asarray(w_b), pivot_rows, Linv_t,
+                          gamma, stats_of(di), list(x))
+        model.training_metrics = model.model_performance(frame)
+        return model

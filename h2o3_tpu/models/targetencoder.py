@@ -1,0 +1,216 @@
+"""Target encoding — CV-safe categorical → numeric target statistics.
+
+Reference: h2o-extensions/target-encoder
+(ai/h2o/targetencoding/TargetEncoder.java, TargetEncoderModel.java):
+per-level {sum_y, count} "encoding maps" built at train time; transform
+replaces each encoded categorical with the (optionally blended) level
+mean of the response, with leakage control on training data:
+  - none:        plain level means
+  - loo:         leave-one-out (subtract own row from the level stats)
+  - kfold:       per-fold maps; a row's encoding excludes its own fold
+Blending (TargetEncoderHelper): lambda = 1/(1+exp(-(n-k)/f)) mixes the
+level mean with the global prior (inflection_point k, smoothing f).
+Optional uniform noise breaks exact memorization.
+
+TPU-native: the group stats are one segment_sum over (fold, level)
+segment ids on the mesh — the AstGroup/MRTask role — and the transform
+is a pure gather.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence
+
+import jax.numpy as jnp
+import numpy as np
+
+from h2o3_tpu.frame.frame import Frame
+from h2o3_tpu.models import register
+from h2o3_tpu.models.model import Model, ModelBuilder, adapt_domain
+from h2o3_tpu.ops.segments import segment_sum
+from h2o3_tpu.parallel.mesh import get_mesh
+
+
+def _level_stats(codes: np.ndarray, y: np.ndarray, w: np.ndarray,
+                 card: int, folds: Optional[np.ndarray] = None,
+                 nfolds: int = 1):
+    """Per-(fold, level) {sum_wy, sum_w} via one device segment_sum."""
+    mesh = get_mesh()
+    seg = codes.astype(np.int64)
+    if folds is not None:
+        seg = folds.astype(np.int64) * card + seg
+    n_seg = card * max(nfolds, 1)
+    stats = segment_sum(jnp.asarray(seg.astype(np.int32)),
+                        jnp.stack([jnp.asarray((w * y).astype(np.float32)),
+                                   jnp.asarray(w.astype(np.float32))], axis=1),
+                        n_nodes=int(n_seg), mesh=mesh)
+    s = np.asarray(stats, dtype=np.float64)
+    return s[:, 0].reshape(max(nfolds, 1), card), \
+        s[:, 1].reshape(max(nfolds, 1), card)
+
+
+def _blend(level_sum, level_cnt, prior, k: float, f: float, blending: bool):
+    mean = np.where(level_cnt > 0, level_sum / np.maximum(level_cnt, 1e-12),
+                    prior)
+    if not blending:
+        return mean
+    z = np.clip((level_cnt - k) / max(f, 1e-12), -50.0, 50.0)
+    lam = 1.0 / (1.0 + np.exp(-z))
+    return lam * mean + (1.0 - lam) * prior
+
+
+class TargetEncoderModel(Model):
+    algo = "targetencoder"
+
+    def __init__(self, params, output, enc_maps: Dict[str, dict]):
+        super().__init__(params, output)
+        # per column: {"sum": [nfolds, card], "cnt": [nfolds, card],
+        #              "domain": [...], "prior": float}
+        self.enc_maps = enc_maps
+
+    def transform(self, frame: Frame, as_training: bool = False,
+                  noise: Optional[float] = None,
+                  seed: Optional[int] = None) -> Frame:
+        """Append `<col>_te` columns (TargetEncoderModel.transform;
+        transformTraining → leakage handling active)."""
+        p = self.params
+        handling = str(p.get("data_leakage_handling") or "none").lower()
+        blending = bool(p.get("blending", False))
+        k = float(p.get("inflection_point", 10.0))
+        f = float(p.get("smoothing", 20.0))
+        noise = float(p.get("noise", 0.01) if noise is None else noise)
+        s = int(p.get("seed") or 0) if seed is None else int(seed)
+        rng = np.random.RandomState(s & 0xFFFFFFFF)
+
+        new_cols = []
+        n = frame.nrows
+        fold_col = p.get("fold_column")
+        folds = None
+        if as_training and handling == "kfold" and fold_col and fold_col in frame:
+            folds = frame.col(fold_col).to_numpy().astype(int)[:n]
+
+        for col, m in self.enc_maps.items():
+            if col not in frame:
+                continue
+            dom = m["domain"]
+            codes = adapt_domain(frame.col(col), dom)[:n]
+            prior = m["prior"]
+            tot_sum = m["sum"].sum(axis=0)
+            tot_cnt = m["cnt"].sum(axis=0)
+            if as_training and handling == "kfold" and folds is not None \
+                    and m["sum"].shape[0] > 1:
+                # encoding for fold j uses all folds but j
+                nf = m["sum"].shape[0]
+                te_f = np.stack([
+                    _blend(tot_sum - m["sum"][j], tot_cnt - m["cnt"][j],
+                           prior, k, f, blending) for j in range(nf)])
+                fj = np.clip(folds, 0, nf - 1)
+                enc = te_f[fj, np.clip(codes, 0, len(dom) - 1)]
+            elif as_training and handling == "loo":
+                yv = self._resp_numeric(frame)[:n]
+                c = np.clip(codes, 0, len(dom) - 1)
+                s = tot_sum[c] - np.where(np.isnan(yv), 0.0, yv)
+                cn = tot_cnt[c] - (~np.isnan(yv)).astype(float)
+                enc = _blend(s, cn, prior, k, f, blending)
+            else:
+                te = _blend(tot_sum, tot_cnt, prior, k, f, blending)
+                enc = te[np.clip(codes, 0, len(dom) - 1)]
+            enc = np.where(codes < 0, prior, enc)   # NA / unseen → prior
+            if as_training and noise > 0:
+                enc = enc + rng.uniform(-noise, noise, size=enc.shape)
+            new_cols.append((f"{col}_te", enc))
+
+        from h2o3_tpu.models.generic import _frame_raw_columns
+        cols = _frame_raw_columns(frame, frame.names)
+        cats = [nm for nm in frame.names if frame.col(nm).is_categorical]
+        for nm, arr in new_cols:
+            cols[nm] = arr
+        return Frame.from_numpy(cols, categorical=cats)
+
+    def _resp_numeric(self, frame: Frame) -> np.ndarray:
+        y = self.output["response"]
+        c = frame.col(y)
+        if c.is_categorical:
+            codes = adapt_domain(c, self.output["domain"])
+            return np.where(codes < 0, np.nan, codes.astype(float))
+        return c.to_numpy()
+
+    def predict(self, frame: Frame) -> Frame:
+        return self.transform(frame, as_training=False)
+
+    def model_performance(self, frame: Frame):
+        return None
+
+
+@register
+class TargetEncoderEstimator(ModelBuilder):
+    """h2o-py H2OTargetEncoderEstimator surface
+    (h2o-py/h2o/estimators/targetencoder.py)."""
+
+    algo = "targetencoder"
+
+    DEFAULTS = dict(
+        blending=False, inflection_point=10.0, smoothing=20.0,
+        data_leakage_handling="none", noise=0.01, seed=-1,
+        fold_column=None, ignored_columns=None, nfolds=0,
+        weights_column=None, fold_assignment="auto",
+    )
+
+    def __init__(self, **params):
+        merged = dict(self.DEFAULTS)
+        unknown = set(params) - set(merged)
+        if unknown:
+            raise ValueError(f"unknown TargetEncoder params: {sorted(unknown)}")
+        merged.update(params)
+        if int(merged.get("nfolds") or 0) >= 2:
+            raise ValueError("TargetEncoder leakage control is "
+                             "data_leakage_handling='kfold' + fold_column, "
+                             "not generic CV (nfolds must be 0)")
+        super().__init__(**merged)
+
+    def _fit(self, frame: Frame, x: Sequence[str], y: Optional[str],
+             job, validation_frame: Optional[Frame] = None) -> Model:
+        p = self.params
+        n = frame.nrows
+        rc = frame.col(y)
+        if rc.is_categorical:
+            yv = np.asarray(rc.data)[:n].astype(np.float64)
+            yna = np.asarray(rc.na_mask)[:n]
+            yv = np.where(yna, np.nan, yv)
+            if rc.cardinality > 2:
+                raise ValueError("TargetEncoder supports binomial or "
+                                 "numeric responses")
+        else:
+            yv = rc.to_numpy()
+        w = (~np.isnan(yv)).astype(np.float64)
+        yv = np.where(np.isnan(yv), 0.0, yv)
+
+        handling = str(p.get("data_leakage_handling") or "none").lower()
+        fold_col = p.get("fold_column")
+        folds = None
+        nfolds = 1
+        if handling == "kfold":
+            if not fold_col or fold_col not in frame:
+                raise ValueError("kfold leakage handling requires fold_column")
+            folds = frame.col(fold_col).to_numpy().astype(int)[:n]
+            nfolds = int(folds.max()) + 1
+
+        enc_cols = [c for c in x if frame.col(c).is_categorical]
+        prior = float((yv * w).sum() / max(w.sum(), 1e-12))
+        enc_maps = {}
+        for col in enc_cols:
+            c = frame.col(col)
+            dom = c.domain or []
+            codes = np.asarray(c.data)[:n].astype(np.int64)
+            cna = np.asarray(c.na_mask)[:n]
+            wcol = w * (~cna)
+            s, cnt = _level_stats(np.where(cna, 0, codes), yv, wcol,
+                                  max(len(dom), 1), folds, nfolds)
+            enc_maps[col] = {"sum": s, "cnt": cnt, "domain": list(dom),
+                             "prior": prior}
+            job.update(1.0 / max(len(enc_cols), 1), f"encoded {col}")
+
+        output = {"category": "TargetEncoder", "response": y,
+                  "names": enc_cols, "domain": rc.domain,
+                  "prior": prior}
+        return TargetEncoderModel(p, output, enc_maps)

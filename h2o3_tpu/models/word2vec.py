@@ -1,0 +1,286 @@
+"""Word2Vec — skip-gram with hierarchical softmax, TPU-batched.
+
+Reference: hex/word2vec/Word2Vec.java:15 + WordVectorTrainer.java
+(HOGWILD skip-gram over word chunks) + HBWTree.java (Huffman binary
+tree for the hierarchical softmax). Input contract is the reference's:
+one string column of pre-tokenized words, sentences delimited by NA
+rows; params vec_size / window_size / epochs / min_word_freq /
+init_learning_rate / sent_sample_rate; outputs word vectors, synonym
+search, and transform(frame, aggregate_method=NONE|AVERAGE).
+
+TPU redesign: vocabulary + Huffman coding happen once on host; training
+runs as jitted mini-batches — for a batch of (center, context) pairs the
+HS loss is a masked sum over the context word's tree path, and
+jax.grad's scatter-adds update the two embedding matrices. The
+reference's per-node HOGWILD race (WordVectorTrainer) becomes exact
+batched SGD; lr decays linearly like the reference's alpha schedule.
+"""
+
+from __future__ import annotations
+
+import heapq
+from functools import partial
+from typing import Dict, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from h2o3_tpu.frame.frame import Frame
+from h2o3_tpu.models import register
+from h2o3_tpu.models.model import Model, ModelBuilder
+from h2o3_tpu.utils.log import get_logger
+
+log = get_logger("h2o3_tpu.word2vec")
+
+
+def _build_huffman(counts: np.ndarray):
+    """Huffman tree over word counts → per-word (points, codes) paths
+    (HBWTree.java role). Returns [V, Lmax] int32 points (internal-node
+    ids), [V, Lmax] int8 codes, [V] path lengths."""
+    V = len(counts)
+    heap = [(int(c), i) for i, c in enumerate(counts)]
+    heapq.heapify(heap)
+    parent = np.zeros(2 * V - 1, dtype=np.int64)
+    binary = np.zeros(2 * V - 1, dtype=np.int8)
+    nxt = V
+    while len(heap) > 1:
+        c1, i1 = heapq.heappop(heap)
+        c2, i2 = heapq.heappop(heap)
+        parent[i1] = nxt
+        parent[i2] = nxt
+        binary[i2] = 1
+        heapq.heappush(heap, (c1 + c2, nxt))
+        nxt += 1
+    root = nxt - 1
+    paths, codes = [], []
+    for wi in range(V):
+        pt, cd = [], []
+        node = wi
+        while node != root:
+            pt.append(parent[node] - V)   # internal node id in [0, V-1)
+            cd.append(binary[node])
+            node = parent[node]
+        paths.append(pt[::-1])
+        codes.append(cd[::-1])
+    Lmax = max((len(p) for p in paths), default=1)
+    P = np.zeros((V, Lmax), dtype=np.int32)
+    C = np.zeros((V, Lmax), dtype=np.int8)
+    M = np.zeros((V, Lmax), dtype=bool)
+    for i, (pt, cd) in enumerate(zip(paths, codes)):
+        P[i, : len(pt)] = pt
+        C[i, : len(cd)] = cd
+        M[i, : len(pt)] = True
+    return P, C, M
+
+
+@partial(jax.jit, donate_argnums=(0, 1))
+def _sgd_step(W_in, W_out, centers, points, codes, mask, lr):
+    """One skip-gram HS mini-batch step (WordVectorTrainer fprop/bprop)."""
+
+    def loss_fn(win, wout):
+        v = win[centers]                        # [B, D]
+        u = wout[points]                        # [B, L, D]
+        dots = jnp.einsum("bd,bld->bl", v, u)
+        # code 0 → target 1 (go left), code 1 → target 0
+        sgn = 1.0 - 2.0 * codes
+        logp = jax.nn.log_sigmoid(sgn * dots)
+        return -jnp.sum(jnp.where(mask, logp, 0.0)) / centers.shape[0]
+
+    loss, grads = jax.value_and_grad(loss_fn, argnums=(0, 1))(W_in, W_out)
+    W_in = W_in - lr * grads[0]
+    W_out = W_out - lr * grads[1]
+    return W_in, W_out, loss
+
+
+class Word2VecModel(Model):
+    algo = "word2vec"
+
+    def __init__(self, params, output, vectors: np.ndarray,
+                 vocab: List[str]):
+        super().__init__(params, output)
+        self.vectors = vectors       # [V, D] float32
+        self.vocab = vocab
+        self._index = {w: i for i, w in enumerate(vocab)}
+
+    def find_synonyms(self, word: str, count: int = 20) -> Dict[str, float]:
+        """Cosine-similarity neighbors (Word2VecModel.findSynonyms)."""
+        if word not in self._index:
+            return {}
+        v = self.vectors[self._index[word]]
+        norms = np.linalg.norm(self.vectors, axis=1) * \
+            max(np.linalg.norm(v), 1e-12)
+        sims = self.vectors @ v / np.maximum(norms, 1e-12)
+        order = np.argsort(-sims)
+        out = {}
+        for i in order:
+            if self.vocab[i] == word:
+                continue
+            out[self.vocab[i]] = float(sims[i])
+            if len(out) >= count:
+                break
+        return out
+
+    def transform(self, frame: Frame, aggregate_method: str = "NONE") -> Frame:
+        """Embed a words column (Word2VecModel.transform): NONE → one
+        vector row per word; AVERAGE → mean vector per NA-delimited
+        sequence."""
+        from h2o3_tpu.models.generic import _frame_raw_columns
+        words = _frame_raw_columns(frame, [frame.names[0]])[frame.names[0]]
+        D = self.vectors.shape[1]
+        if aggregate_method.upper() == "NONE":
+            out = np.full((len(words), D), np.nan, dtype=np.float32)
+            for i, w in enumerate(words):
+                j = self._index.get(w if isinstance(w, str) else None)
+                if j is not None:
+                    out[i] = self.vectors[j]
+        else:  # AVERAGE
+            rows, acc, cnt = [], np.zeros(D, np.float32), 0
+            seen_tokens = False
+            for w in words:
+                if w is None or (isinstance(w, float) and np.isnan(w)):
+                    rows.append(acc / cnt if cnt else np.full(D, np.nan))
+                    acc, cnt, seen_tokens = np.zeros(D, np.float32), 0, False
+                    continue
+                seen_tokens = True
+                j = self._index.get(w)
+                if j is not None:
+                    acc = acc + self.vectors[j]
+                    cnt += 1
+            if seen_tokens:   # flush only an unterminated trailing sentence
+                rows.append(acc / cnt if cnt else np.full(D, np.nan))
+            out = np.stack(rows)
+        return Frame.from_numpy({f"C{i + 1}": out[:, i] for i in range(D)})
+
+    def to_frame(self) -> Frame:
+        """Word → vector frame (Word2VecModel.toFrame)."""
+        cols = {"Word": np.asarray(self.vocab, dtype=object)}
+        for i in range(self.vectors.shape[1]):
+            cols[f"V{i + 1}"] = self.vectors[:, i]
+        return Frame.from_numpy(cols, categorical=["Word"])
+
+    def _score_raw(self, frame: Frame):
+        raise NotImplementedError("use transform()/find_synonyms()")
+
+    def model_performance(self, frame: Frame):
+        return None
+
+
+@register
+class Word2VecEstimator(ModelBuilder):
+    """h2o-py H2OWord2vecEstimator surface
+    (h2o-py/h2o/estimators/word2vec.py)."""
+
+    algo = "word2vec"
+    supervised = False
+
+    DEFAULTS = dict(
+        vec_size=100, window_size=5, sent_sample_rate=1e-3, epochs=5,
+        min_word_freq=5, init_learning_rate=0.025, seed=-1,
+        batch_size=4096, ignored_columns=None,
+    )
+
+    def __init__(self, **params):
+        merged = dict(self.DEFAULTS)
+        unknown = set(params) - set(merged)
+        if unknown:
+            raise ValueError(f"unknown Word2Vec params: {sorted(unknown)}")
+        merged.update(params)
+        super().__init__(**merged)
+
+    def resolve_x(self, frame, x, y):
+        return list(frame.names)   # the words column is the input
+
+    def _fit(self, frame: Frame, x: Sequence[str], y: Optional[str],
+             job, validation_frame: Optional[Frame] = None) -> Model:
+        p = self.params
+        from h2o3_tpu.models.generic import _frame_raw_columns
+        words = _frame_raw_columns(frame, [frame.names[0]])[frame.names[0]]
+        # vocabulary over non-NA tokens
+        toks = [w for w in words
+                if isinstance(w, str)]
+        uniq, counts = np.unique(np.asarray(toks, dtype=object),
+                                 return_counts=True)
+        keep = counts >= int(p["min_word_freq"])
+        vocab = [str(u) for u in uniq[keep]]
+        vcount = counts[keep].astype(np.int64)
+        if len(vocab) < 2:
+            raise ValueError("word2vec needs >= 2 vocabulary words "
+                             "(after min_word_freq)")
+        index = {w: i for i, w in enumerate(vocab)}
+        total = vcount.sum()
+
+        # sentences → id sequences with frequent-word subsampling
+        # (WordVectorTrainer sent_sample_rate semantics)
+        rng = np.random.RandomState(int(p["seed"]) if int(p["seed"]) >= 0
+                                    else 0xABCD)
+        samp = float(p["sent_sample_rate"])
+        freq = vcount / total
+        keep_prob = (np.minimum(1.0, (np.sqrt(freq / samp) + 1) * samp / freq)
+                     if samp > 0 else np.ones_like(freq))
+        sentences: List[List[int]] = []
+        cur: List[int] = []
+        for w in words:
+            if not isinstance(w, str):
+                if cur:
+                    sentences.append(cur)
+                cur = []
+                continue
+            j = index.get(w)
+            if j is None:
+                continue
+            if keep_prob[j] >= 1.0 or rng.rand() < keep_prob[j]:
+                cur.append(j)
+        if cur:
+            sentences.append(cur)
+
+        P, C, M = _build_huffman(vcount)
+        V, D = len(vocab), int(p["vec_size"])
+        key = jax.random.PRNGKey(abs(int(p["seed"])) or 7)
+        W_in = (jax.random.uniform(key, (V, D), jnp.float32) - 0.5) / D
+        W_out = jnp.zeros((max(V - 1, 1), D), jnp.float32)
+        P_dev, C_dev, M_dev = (jnp.asarray(P), jnp.asarray(C, jnp.float32),
+                               jnp.asarray(M))
+
+        # (center, context) pair list per epoch
+        win = int(p["window_size"])
+        centers, contexts = [], []
+        for sent in sentences:
+            L = len(sent)
+            for i, c in enumerate(sent):
+                for j in range(max(0, i - win), min(L, i + win + 1)):
+                    if j != i:
+                        centers.append(c)
+                        contexts.append(sent[j])
+        if not centers:
+            raise ValueError("no training pairs (sentences too short?)")
+        centers = np.asarray(centers, np.int32)
+        contexts = np.asarray(contexts, np.int32)
+
+        B = int(p["batch_size"])
+        lr0 = float(p["init_learning_rate"])
+        epochs = int(p["epochs"])
+        n_pairs = len(centers)
+        steps_total = max(epochs * ((n_pairs + B - 1) // B), 1)
+        step = 0
+        loss_hist = []
+        for ep in range(epochs):
+            perm = rng.permutation(n_pairs)
+            for s in range(0, n_pairs, B):
+                idx = perm[s: s + B]
+                if len(idx) < B:    # pad to static shape (repeat wraps)
+                    idx = np.concatenate([idx, perm[: B - len(idx)]])
+                lr = lr0 * max(1.0 - step / steps_total, 1e-4)
+                W_in, W_out, loss = _sgd_step(
+                    W_in, W_out, jnp.asarray(centers[idx]),
+                    P_dev[contexts[idx]], C_dev[contexts[idx]],
+                    M_dev[contexts[idx]], jnp.float32(lr))
+                step += 1
+            loss_hist.append(float(loss))
+            job.update(1.0 / epochs, f"epoch {ep + 1}/{epochs}")
+
+        output = {"category": "WordEmbedding", "response": None,
+                  "names": list(frame.names), "domain": None,
+                  "vocab_size": V, "vec_size": D,
+                  "epoch_loss": loss_hist}
+        return Word2VecModel(p, output, np.asarray(W_in), vocab)

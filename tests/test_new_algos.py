@@ -1,0 +1,205 @@
+"""TargetEncoder, Word2Vec, PSVM, Aggregator, Infogram, SegmentModels."""
+
+import numpy as np
+import pytest
+
+import h2o3_tpu
+from tests.conftest import make_classification
+
+
+# ---------------------------------------------------------------- te
+
+def _te_frame(n=2000, seed=0):
+    r = np.random.RandomState(seed)
+    g = np.array(["a", "b", "c", "d"], object)[r.randint(0, 4, n)]
+    base = {"a": 0.2, "b": 0.5, "c": 0.7, "d": 0.9}
+    p = np.asarray([base[v] for v in g])
+    y = (r.rand(n) < p).astype(int)
+    folds = r.randint(0, 3, n).astype(float)
+    return h2o3_tpu.Frame.from_numpy(
+        {"g": g, "x": r.randn(n), "fold": folds,
+         "y": np.array(["no", "yes"], object)[y]},
+        categorical=["g", "y"])
+
+
+def test_target_encoder_plain():
+    from h2o3_tpu.models.targetencoder import TargetEncoderEstimator
+    fr = _te_frame()
+    m = TargetEncoderEstimator(noise=0.0).train(fr, y="y", x=["g"])
+    out = m.transform(fr)
+    assert "g_te" in out.names
+    te = out.col("g_te").to_numpy()
+    g = fr.col("g").to_numpy()
+    # level means should be close to the generating probabilities
+    m_a = te[np.asarray(fr.col("g").domain)[g.astype(int)] == "a"].mean()
+    m_d = te[np.asarray(fr.col("g").domain)[g.astype(int)] == "d"].mean()
+    assert m_a < 0.35 and m_d > 0.75
+
+
+def test_target_encoder_blending_pulls_to_prior():
+    from h2o3_tpu.models.targetencoder import TargetEncoderEstimator
+    fr = _te_frame()
+    plain = TargetEncoderEstimator(noise=0.0).train(fr, y="y", x=["g"])
+    blend = TargetEncoderEstimator(noise=0.0, blending=True,
+                                   inflection_point=1e6).train(
+        fr, y="y", x=["g"])
+    prior = blend.output["prior"]
+    tb = blend.transform(fr).col("g_te").to_numpy()
+    tp = plain.transform(fr).col("g_te").to_numpy()
+    # huge k → encodings collapse to the prior
+    assert np.abs(tb - prior).max() < 0.02
+    assert np.abs(tp - prior).max() > 0.1
+
+
+def test_target_encoder_kfold_excludes_own_fold():
+    from h2o3_tpu.models.targetencoder import TargetEncoderEstimator
+    fr = _te_frame()
+    m = TargetEncoderEstimator(noise=0.0, data_leakage_handling="kfold",
+                               fold_column="fold").train(fr, y="y", x=["g"])
+    tr = m.transform(fr, as_training=True)
+    ho = m.transform(fr, as_training=False)
+    a = tr.col("g_te").to_numpy()
+    b = ho.col("g_te").to_numpy()
+    assert not np.allclose(a, b)          # leakage handling changed values
+    assert np.abs(a - b).max() < 0.2      # but not wildly
+
+
+def test_target_encoder_loo():
+    from h2o3_tpu.models.targetencoder import TargetEncoderEstimator
+    fr = _te_frame(n=300)
+    m = TargetEncoderEstimator(noise=0.0, data_leakage_handling="loo").train(
+        fr, y="y", x=["g"])
+    tr = m.transform(fr, as_training=True).col("g_te").to_numpy()
+    ho = m.transform(fr, as_training=False).col("g_te").to_numpy()
+    assert not np.allclose(tr, ho)
+
+
+# ---------------------------------------------------------------- w2v
+
+def test_word2vec_synonyms_and_transform():
+    from h2o3_tpu.models.word2vec import Word2VecEstimator
+    r = np.random.RandomState(0)
+    # two topic clusters; words co-occur within topic
+    topics = [["cat", "dog", "pet", "fur"], ["car", "road", "wheel", "drive"]]
+    words = []
+    for _ in range(400):
+        t = topics[r.randint(2)]
+        for w in r.choice(t, 6):
+            words.append(w)
+        words.append(None)   # sentence boundary
+    fr = h2o3_tpu.Frame.from_numpy(
+        {"words": np.asarray(words, dtype=object)}, categorical=["words"])
+    m = Word2VecEstimator(vec_size=16, epochs=10, min_word_freq=2,
+                          window_size=3, sent_sample_rate=0.0,
+                          seed=42).train(fr)
+    assert m.output["vocab_size"] == 8
+    syn = m.find_synonyms("cat", count=3)
+    assert len(syn) == 3
+    # same-topic words should dominate the synonym list
+    assert sum(1 for w in syn if w in topics[0]) >= 2
+    # transform AVERAGE: one row per sentence
+    emb = m.transform(fr, aggregate_method="AVERAGE")
+    assert emb.nrows == 400   # NA-terminated input → one row per sentence
+    wv = m.to_frame()
+    assert wv.nrows == 8 and wv.ncols == 17
+
+
+# ---------------------------------------------------------------- psvm
+
+def test_psvm_separates_blobs():
+    from h2o3_tpu.models.psvm import PSVMEstimator
+    r = np.random.RandomState(1)
+    n = 600
+    X = np.concatenate([r.randn(n // 2, 2) + 2.0, r.randn(n // 2, 2) - 2.0])
+    y = np.array(["pos"] * (n // 2) + ["neg"] * (n // 2), dtype=object)
+    perm = r.permutation(n)
+    fr = h2o3_tpu.Frame.from_numpy(
+        {"x0": X[perm, 0], "x1": X[perm, 1], "y": y[perm]},
+        categorical=["y"])
+    m = PSVMEstimator(hyper_param=1.0, max_iterations=30).train(fr, y="y")
+    assert m.training_metrics["AUC"] > 0.95
+    assert 0 < m.output["svs_count"] < n
+    preds = m.predict(fr)
+    assert "decision_function" in preds.names
+
+
+def test_psvm_rejects_nonbinary():
+    from h2o3_tpu.models.psvm import PSVMEstimator
+    fr = h2o3_tpu.Frame.from_numpy({"x": np.arange(10.0),
+                                    "y": np.arange(10.0)})
+    with pytest.raises(ValueError):
+        PSVMEstimator().train(fr, y="y")
+
+
+# ---------------------------------------------------------------- aggregator
+
+def test_aggregator_compresses():
+    from h2o3_tpu.models.aggregator import AggregatorEstimator
+    r = np.random.RandomState(0)
+    X = r.randn(3000, 3)
+    fr = h2o3_tpu.Frame.from_numpy({f"x{i}": X[:, i] for i in range(3)})
+    m = AggregatorEstimator(target_num_exemplars=100,
+                            rel_tol_num_exemplars=0.7).train(fr)
+    agg = m.aggregated_frame
+    assert agg.nrows <= 100
+    assert agg.nrows >= 10
+    counts = agg.col("counts").to_numpy()
+    assert counts.sum() == 3000   # every row absorbed exactly once
+
+
+# ---------------------------------------------------------------- infogram
+
+def test_infogram_core_ranks_signal():
+    from h2o3_tpu.models.infogram import InfogramEstimator
+    X, y = make_classification(n=1500, f=6, informative=2)
+    cols = {f"x{i}": X[:, i] for i in range(6)}
+    cols["y"] = np.array(["no", "yes"], object)[y]
+    fr = h2o3_tpu.Frame.from_numpy(cols, categorical=["y"])
+    m = InfogramEstimator(ntrees=5, max_depth=3, seed=1).train(fr, y="y")
+    table = m.output["infogram_table"]
+    top2 = {r["column"] for r in table[:2]}
+    assert top2 <= {"x0", "x1", "x2", "x3"}   # informative features rank high
+    sf = m.get_admissible_score_frame()
+    assert sf.nrows == 6
+
+
+def test_infogram_fair_flags_proxy():
+    from h2o3_tpu.models.infogram import InfogramEstimator
+    r = np.random.RandomState(0)
+    n = 1500
+    prot = r.randn(n)                 # "protected" numeric attribute
+    proxy = prot + 0.1 * r.randn(n)   # near-copy of protected
+    clean = r.randn(n)                # independent signal
+    logit = prot * 1.5 + clean * 1.5
+    y = (r.rand(n) < 1 / (1 + np.exp(-logit))).astype(int)
+    fr = h2o3_tpu.Frame.from_numpy(
+        {"prot": prot, "proxy": proxy, "clean": clean,
+         "y": np.array(["no", "yes"], object)[y]}, categorical=["y"])
+    m = InfogramEstimator(protected_columns=["prot"], ntrees=5, max_depth=3,
+                          seed=1).train(fr, y="y")
+    t = {r["column"]: r for r in m.output["infogram_table"]}
+    # clean adds information beyond protected; proxy adds ~none
+    assert t["clean"]["cmi"] > t["proxy"]["cmi"]
+
+
+# ---------------------------------------------------------------- segments
+
+def test_train_segments():
+    from h2o3_tpu.ml.segments import train_segments
+    from h2o3_tpu.models.gbm import GBMEstimator
+    X, y = make_classification(n=1200, f=4)
+    seg = np.array(["s1", "s2"], object)[(np.arange(1200) % 2)]
+    cols = {f"x{i}": X[:, i] for i in range(4)}
+    cols["seg"] = seg
+    cols["y"] = np.array(["no", "yes"], object)[y]
+    fr = h2o3_tpu.Frame.from_numpy(cols, categorical=["seg", "y"])
+    sm = train_segments(GBMEstimator, dict(ntrees=3, max_depth=3, seed=1),
+                        fr, segment_columns=["seg"], y="y")
+    assert len(sm.results) == 2
+    assert all(r["status"] == "SUCCEEDED" for r in sm.results)
+    res = sm.as_frame()
+    assert res.nrows == 2
+    # each segment model is retrievable and scores
+    from h2o3_tpu.core.kv import DKV
+    m0 = DKV.get(sm.results[0]["model_key"])
+    assert m0.training_metrics["AUC"] > 0.5
