@@ -22,6 +22,8 @@ from h2o3_tpu.version import __version__
 from h2o3_tpu.core.cloud import init, cluster_info, shutdown
 from h2o3_tpu.frame.frame import Frame
 from h2o3_tpu.io.parser import import_file, parse_raw, upload_numpy
+from h2o3_tpu.io.persist import (load_frame, load_model, persist_manager,
+                                 save_frame, save_model)
 from h2o3_tpu.core.kv import DKV
 
 __all__ = [
@@ -34,4 +36,9 @@ __all__ = [
     "parse_raw",
     "upload_numpy",
     "DKV",
+    "save_frame",
+    "load_frame",
+    "save_model",
+    "load_model",
+    "persist_manager",
 ]

@@ -58,6 +58,20 @@ def import_file(path: str, destination_frame: Optional[str] = None,
     if not paths:
         raise FileNotFoundError(path)
 
+    # CSV goes through the native multithreaded tokenizer
+    # (h2o3_tpu/native/csv_parser.cpp — the water/parser CsvParser role);
+    # anything else (parquet, zip containers, unknown extensions) and any
+    # native-parse failure fall back to pandas.
+    if all(f.endswith((".csv", ".csv.gz")) for f in paths):
+        parsed = _parse_csv_native(paths, col_types)
+        if parsed is not None:
+            cols, cats, domains = parsed
+            fr = Frame.from_numpy(cols, categorical=cats, domains=domains,
+                                  key=destination_frame)
+            log.info("parsed %s (native) -> %s (%d x %d)", path, fr.key,
+                     fr.nrows, fr.ncols)
+            return fr
+
     import pandas as pd
     frames = []
     for f in paths:
@@ -75,6 +89,93 @@ def import_file(path: str, destination_frame: Optional[str] = None,
     fr = Frame.from_pandas(df, key=destination_frame)
     log.info("parsed %s -> %s (%d x %d)", path, fr.key, fr.nrows, fr.ncols)
     return fr
+
+
+def _parse_csv_native(paths: List[str],
+                      col_types: Optional[Dict[str, str]]):
+    """Multi-file native CSV parse; returns (cols, categorical names) or
+    None to fall back. Gzip members are decompressed into the buffer
+    (the tokenizer parses bytes, like the reference's ZipUtil front)."""
+    from h2o3_tpu.native import parse_csv_bytes
+    import gzip
+    all_cols: Dict[str, List[np.ndarray]] = {}
+    all_doms: Dict[str, List[List[str]]] = {}
+    for f in paths:
+        try:
+            if f.endswith(".gz"):
+                data = gzip.open(f, "rb").read()
+            else:
+                data = open(f, "rb").read()
+        except OSError:
+            return None
+        res = parse_csv_bytes(data, decode=False)
+        if res is None:
+            return None
+        cols, domains = res
+        for name, arr in cols.items():
+            all_cols.setdefault(name, []).append(arr)
+        for name, dom in domains.items():
+            all_doms.setdefault(name, []).append(dom)
+
+    # consistency across files: every file must agree on each column's
+    # type (all-categorical or all-numeric) and supply every column —
+    # type drift is pandas-concat territory, fall back
+    nfiles = len(paths)
+    for name, parts in all_cols.items():
+        if len(parts) != nfiles:
+            return None
+        ndoms = len(all_doms.get(name, []))
+        if ndoms not in (0, nfiles):
+            return None
+
+    merged: Dict[str, np.ndarray] = {}
+    domains: Dict[str, List[str]] = {}
+    for name, parts in all_cols.items():
+        if name in all_doms:
+            # multi-file categorical: unify domains and renumber codes
+            # (the ParseDataset cloud-wide domain-unification role)
+            doms = all_doms[name]
+            global_dom = sorted(set().union(*[set(d) for d in doms]))
+            lut = {lvl: i for i, lvl in enumerate(global_dom)}
+            out_parts = []
+            for codes, dom in zip(parts, doms):
+                remap = np.asarray([lut[lvl] for lvl in dom] or [0],
+                                   dtype=np.int32)
+                c = np.where(codes >= 0, remap[np.maximum(codes, 0)], -1)
+                out_parts.append(c.astype(np.int32))
+            merged[name] = (out_parts[0] if len(out_parts) == 1
+                            else np.concatenate(out_parts))
+            domains[name] = global_dom
+        else:
+            merged[name] = parts[0] if len(parts) == 1 else np.concatenate(parts)
+
+    # honor explicit client types (POST /3/ParseSetup column_types)
+    for c, t in (col_types or {}).items():
+        if c not in merged:
+            continue
+        if t in ("enum", "categorical") and c not in domains:
+            vals = merged[c]
+            import pandas as pd
+            strs = np.asarray(
+                [None if (isinstance(v, float) and np.isnan(v)) else str(v)
+                 for v in vals], dtype=object)
+            codes, uniq = pd.factorize(strs, sort=True)
+            merged[c] = codes.astype(np.int32)
+            domains[c] = [str(u) for u in uniq]
+        elif t in ("numeric", "real", "int") and c in domains:
+            dom = np.asarray(domains.pop(c))
+
+            def _tonum(s):
+                try:
+                    return float(s)
+                except (TypeError, ValueError):
+                    return np.nan
+            lut = np.asarray([_tonum(s) for s in dom])
+            codes = merged[c]
+            merged[c] = np.where(codes >= 0,
+                                 lut[np.maximum(codes, 0)]
+                                 if len(lut) else np.nan, np.nan)
+    return merged, sorted(domains), domains
 
 
 def parse_raw(text: str, destination_frame: Optional[str] = None) -> Frame:

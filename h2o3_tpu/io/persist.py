@@ -1,0 +1,262 @@
+"""Persist — pluggable byte-storage drivers + binary Frame/Model export.
+
+Reference: water/persist/PersistManager.java:1 with Persist{FS,NFS,Hex,
+EagerHTTP} drivers and the separate h2o-persist-{s3,hdfs,gcs} modules;
+binary Frame export is water/fvec/persist/FramePersist.java; model
+binary export/import is water/api's SaveModel/LoadModel on top of Iced
+serialization.
+
+TPU-native shape: drivers resolve a URI scheme to read/write byte blobs
+(file:// and bare paths; hex:// = the node's ice/spill dir; http(s)://
+read-only; s3://+gs:// raise with instructions unless a driver module
+registers itself — this environment has no egress). Frames serialize as
+one npz of dtype-narrowed columns + a JSON header (the chunk layout is
+reconstructed by the mesh on load, so a frame saved on an 8-device mesh
+loads fine on 1 device and vice versa). Models serialize via pickle with
+every jax.Array lowered to numpy so checkpoints are device-independent
+(the Iced/AutoBuffer role).
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import os
+import pickle
+import tempfile
+from typing import Callable, Dict, List, Optional
+
+import numpy as np
+
+from h2o3_tpu.utils.log import get_logger
+
+log = get_logger("h2o3_tpu.persist")
+
+
+# ------------------------------------------------------------------ drivers
+
+class PersistDriver:
+    scheme = ""
+
+    def read(self, uri: str) -> bytes:
+        raise NotImplementedError
+
+    def write(self, uri: str, data: bytes) -> None:
+        raise NotImplementedError
+
+    def exists(self, uri: str) -> bool:
+        raise NotImplementedError
+
+    def delete(self, uri: str) -> None:
+        raise NotImplementedError
+
+    def list(self, uri: str) -> List[str]:
+        raise NotImplementedError
+
+
+class _FileDriver(PersistDriver):
+    scheme = "file"
+
+    def _path(self, uri: str) -> str:
+        return uri[7:] if uri.startswith("file://") else uri
+
+    def read(self, uri: str) -> bytes:
+        with open(self._path(uri), "rb") as f:
+            return f.read()
+
+    def write(self, uri: str, data: bytes) -> None:
+        p = self._path(uri)
+        os.makedirs(os.path.dirname(os.path.abspath(p)), exist_ok=True)
+        tmp = p + ".tmp"
+        with open(tmp, "wb") as f:
+            f.write(data)
+        os.replace(tmp, p)   # atomic publish (PersistFS atomicity contract)
+
+    def exists(self, uri: str) -> bool:
+        return os.path.exists(self._path(uri))
+
+    def delete(self, uri: str) -> None:
+        p = self._path(uri)
+        if os.path.exists(p):
+            os.remove(p)
+
+    def list(self, uri: str) -> List[str]:
+        p = self._path(uri)
+        if not os.path.isdir(p):
+            return []
+        return sorted(os.path.join(p, f) for f in os.listdir(p))
+
+
+class _IceDriver(_FileDriver):
+    """hex:// — the node's ice (spill/checkpoint) directory
+    (water/persist/PersistHex.java role)."""
+
+    scheme = "hex"
+
+    def __init__(self):
+        self.root = os.environ.get(
+            "H2O3_TPU_ICE_DIR",
+            os.path.join(tempfile.gettempdir(), "h2o3_tpu_ice"))
+
+    def _path(self, uri: str) -> str:
+        rel = uri[6:] if uri.startswith("hex://") else uri
+        return os.path.join(self.root, rel)
+
+
+class _HTTPDriver(PersistDriver):
+    """Read-only HTTP(S) ingest (water/persist/PersistEagerHTTP)."""
+
+    scheme = "http"
+
+    def read(self, uri: str) -> bytes:
+        from urllib.request import urlopen
+        with urlopen(uri, timeout=60) as r:
+            return r.read()
+
+    def exists(self, uri: str) -> bool:
+        from urllib.request import Request, urlopen
+        try:
+            with urlopen(Request(uri, method="HEAD"), timeout=30) as r:
+                return 200 <= r.status < 400
+        except Exception:
+            return False
+
+    def write(self, uri: str, data: bytes) -> None:
+        raise IOError("HTTP persist is read-only")
+
+    def delete(self, uri: str) -> None:
+        raise IOError("HTTP persist is read-only")
+
+    def list(self, uri: str) -> List[str]:
+        return [uri]
+
+
+class PersistManager:
+    """Scheme → driver dispatch (water/persist/PersistManager.java:1)."""
+
+    def __init__(self):
+        self._drivers: Dict[str, PersistDriver] = {}
+        fd = _FileDriver()
+        self.register(fd)
+        self.register(_IceDriver())
+        http = _HTTPDriver()
+        self._drivers["http"] = http
+        self._drivers["https"] = http
+        self._default = fd
+
+    def register(self, driver: PersistDriver) -> None:
+        self._drivers[driver.scheme] = driver
+
+    def driver_for(self, uri: str) -> PersistDriver:
+        if "://" in uri:
+            scheme = uri.split("://", 1)[0].lower()
+            d = self._drivers.get(scheme)
+            if d is None:
+                raise IOError(
+                    f"no persist driver for scheme '{scheme}://' — register "
+                    "one via persist_manager.register() (s3/gcs need egress "
+                    "+ credentials; this build ships file/hex/http)")
+            return d
+        return self._default
+
+    def read(self, uri: str) -> bytes:
+        return self.driver_for(uri).read(uri)
+
+    def write(self, uri: str, data: bytes) -> None:
+        self.driver_for(uri).write(uri, data)
+
+    def exists(self, uri: str) -> bool:
+        return self.driver_for(uri).exists(uri)
+
+    def delete(self, uri: str) -> None:
+        self.driver_for(uri).delete(uri)
+
+    def list(self, uri: str) -> List[str]:
+        return self.driver_for(uri).list(uri)
+
+
+persist_manager = PersistManager()
+
+
+# ------------------------------------------------------------------ frames
+
+_FRAME_MAGIC = "h2o3tpu-frame-v1"
+
+
+def save_frame(frame, uri: str) -> str:
+    """Binary frame export (water/fvec/persist/FramePersist.saveTo)."""
+    header = {"magic": _FRAME_MAGIC, "nrows": frame.nrows,
+              "names": list(frame.names), "types": {}, "domains": {}}
+    arrays = {}
+    for i, name in enumerate(frame.names):
+        c = frame.col(name)
+        header["types"][name] = c.type
+        if c.domain is not None:
+            header["domains"][name] = list(c.domain)
+        if c.type == "string":
+            arrays[f"c{i}"] = c.strings[: c.nrows].astype("U")
+        else:
+            arrays[f"c{i}"] = np.asarray(c.data)[: c.nrows]
+            arrays[f"m{i}"] = np.asarray(c.na_mask)[: c.nrows]
+    buf = io.BytesIO()
+    np.savez_compressed(buf, __header__=np.frombuffer(
+        json.dumps(header).encode(), dtype=np.uint8), **arrays)
+    persist_manager.write(uri, buf.getvalue())
+    return uri
+
+
+def load_frame(uri: str, key: Optional[str] = None):
+    """Binary frame import (FramePersist.loadFrom)."""
+    from h2o3_tpu.frame.frame import Frame
+    npz = np.load(io.BytesIO(persist_manager.read(uri)), allow_pickle=False)
+    header = json.loads(bytes(npz["__header__"]).decode())
+    if header.get("magic") != _FRAME_MAGIC:
+        raise IOError(f"{uri} is not an h2o3-tpu frame export")
+    cols: Dict[str, np.ndarray] = {}
+    domains: Dict[str, List[str]] = {}
+    cats: List[str] = []
+    for i, name in enumerate(header["names"]):
+        t = header["types"][name]
+        if t == "string":
+            cols[name] = npz[f"c{i}"].astype(object)
+        elif t == "categorical":
+            codes = npz[f"c{i}"].astype(np.int32)
+            codes = np.where(npz[f"m{i}"], -1, codes)
+            cols[name] = codes
+            domains[name] = header["domains"][name]
+            cats.append(name)
+        else:
+            v = npz[f"c{i}"].astype(np.float64)
+            v = np.where(npz[f"m{i}"], np.nan, v)
+            cols[name] = v
+    return Frame.from_numpy(cols, categorical=cats, domains=domains, key=key)
+
+
+# ------------------------------------------------------------------ models
+
+class _DeviceLoweringPickler(pickle.Pickler):
+    """Pickle with every jax.Array lowered to host numpy — checkpoints are
+    device-independent (the Iced/AutoBuffer serialization role)."""
+
+    def reducer_override(self, obj):
+        import jax
+        if isinstance(obj, jax.Array):
+            return (np.asarray, (np.asarray(obj),))
+        return NotImplemented
+
+
+def save_model(model, uri: str) -> str:
+    """Full binary model save (REST SaveModel role) — unlike MOJO export
+    this keeps params/metrics/output and is re-trainable via checkpoint."""
+    buf = io.BytesIO()
+    _DeviceLoweringPickler(buf, protocol=pickle.HIGHEST_PROTOCOL).dump(model)
+    persist_manager.write(uri, buf.getvalue())
+    return uri
+
+
+def load_model(uri: str):
+    """Binary model load (REST LoadModel role); re-registers in DKV."""
+    from h2o3_tpu.core.kv import DKV
+    model = pickle.loads(persist_manager.read(uri))
+    DKV.put(model.key, model)
+    return model

@@ -87,12 +87,25 @@ class GridSearch:
 
     def __init__(self, builder_cls, hyper_params: Dict[str, Sequence],
                  search_criteria: Optional[dict] = None, grid_id: str = None,
-                 **fixed_params):
+                 recovery_dir: Optional[str] = None, **fixed_params):
         self.builder_cls = builder_cls
         self.hyper_params = {k: list(v) for k, v in hyper_params.items()}
         self.criteria = dict(search_criteria or {"strategy": "Cartesian"})
         self.fixed = fixed_params
         self.grid_id = grid_id or make_key(f"grid_{builder_cls.algo}")
+        # hex/faulttolerance/Recovery.java:21-45 — when set, every trained
+        # model + the walk state snapshot to this dir so a fresh cluster
+        # can resume_grid() the remaining work
+        self.recovery_dir = recovery_dir
+        if recovery_dir:   # fail fast, not after the first model trains
+            import json as _json
+            for k, v in fixed_params.items():
+                try:
+                    _json.dumps(v)
+                except TypeError:
+                    raise ValueError(
+                        "recovery_dir requires JSON-serializable fixed "
+                        f"params; '{k}'={type(v).__name__} is not") from None
 
     def _combos(self) -> List[dict]:
         names = sorted(self.hyper_params)
@@ -110,11 +123,16 @@ class GridSearch:
 
     def train(self, training_frame, y: Optional[str] = None,
               x: Optional[Sequence[str]] = None,
-              validation_frame=None) -> Grid:
+              validation_frame=None, _skip_done: Optional[List] = None,
+              _prior_models: Optional[List] = None) -> Grid:
         combos = self._combos()
+        done = _skip_done or []
+        if done:
+            combos = [c for c in combos if c not in done]
         budget_s = float(self.criteria.get("max_runtime_secs", 0) or 0)
         t0 = time.time()
-        models, failures = [], []
+        models = list(_prior_models or [])
+        failures: List[dict] = []
         job = Job(f"grid {self.builder_cls.algo}", work=float(len(combos)))
         job.status = "RUNNING"
         for i, combo in enumerate(combos):
@@ -128,6 +146,8 @@ class GridSearch:
                             validation_frame=validation_frame)
                 m.output["grid_params"] = combo
                 models.append(m)
+                if self.recovery_dir:
+                    self._snapshot(m, combo, done, y, x)
             except Exception as e:   # failed combos recorded, walk continues
                 log.warning("grid combo %s failed: %s", combo, e)
                 failures.append({"params": combo, "error": str(e)})
@@ -136,3 +156,48 @@ class GridSearch:
         sort_metric = (self.criteria.get("sort_metric")
                        or (default_sort_metric(models[0]) if models else "mse"))
         return Grid(self.grid_id, models, failures, sort_metric)
+
+    # -- fault tolerance (hex/faulttolerance/Recovery onModel snapshots) --
+    def _snapshot(self, model, combo: dict, done: List[dict],
+                  y, x) -> None:
+        import json
+        import os
+        from h2o3_tpu.io.persist import persist_manager, save_model
+        d = self.recovery_dir
+        save_model(model, os.path.join(d, f"{model.key}.bin"))
+        done.append(combo)
+        self._model_files = getattr(self, "_model_files", [])
+        self._model_files.append(f"{model.key}.bin")
+        state = {
+            "grid_id": self.grid_id,
+            "algo": self.builder_cls.algo,
+            "fixed": self.fixed,   # validated JSON-serializable in __init__
+            "hyper_params": self.hyper_params,
+            "criteria": self.criteria,
+            "y": y, "x": list(x) if x else None,
+            "done": done,
+            "models": self._model_files,
+        }
+        persist_manager.write(os.path.join(d, "grid_state.json"),
+                              json.dumps(state).encode())
+
+
+def resume_grid(recovery_dir: str, training_frame, validation_frame=None) -> Grid:
+    """Resume an interrupted grid from its recovery snapshots
+    (hex/faulttolerance/Recovery.onDone re-run path + GridImportExport)."""
+    import json
+    import os
+    from h2o3_tpu.io.persist import load_model, persist_manager
+    from h2o3_tpu.models import get_builder
+    state = json.loads(persist_manager.read(
+        os.path.join(recovery_dir, "grid_state.json")).decode())
+    prior = [load_model(os.path.join(recovery_dir, f))
+             for f in state["models"]]
+    gs = GridSearch(get_builder(state["algo"]), state["hyper_params"],
+                    search_criteria=state["criteria"],
+                    grid_id=state["grid_id"], recovery_dir=recovery_dir,
+                    **state["fixed"])
+    gs._model_files = list(state["models"])   # keep prior snapshots listed
+    return gs.train(training_frame, y=state["y"], x=state["x"],
+                    validation_frame=validation_frame,
+                    _skip_done=list(state["done"]), _prior_models=prior)

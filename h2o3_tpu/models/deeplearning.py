@@ -244,6 +244,7 @@ class DeepLearningEstimator(ModelBuilder):
         stopping_rounds=5, stopping_metric="auto", stopping_tolerance=0.0,
         score_interval=5.0, train_samples_per_iteration=-2,
         use_all_factor_levels=False, max_w2=3.4e38, reproducible=False,
+        checkpoint=None,
     )
 
     def __init__(self, **params):
@@ -303,7 +304,25 @@ class DeepLearningEstimator(ModelBuilder):
         seed = int(p["seed"]) if int(p["seed"]) >= 0 else 0xD1
         key = jax.random.PRNGKey(seed)
         key, kinit = jax.random.split(key)
-        params_net = _init_params(kinit, sizes, act == "maxout")
+        if p.get("checkpoint") is not None:
+            # resume weights from a prior model (DeepLearningModelInfo
+            # checkpoint restart semantics)
+            from h2o3_tpu.core.kv import DKV
+            ck = p["checkpoint"]
+            prior = ck if isinstance(ck, DeepLearningModel) else DKV.get(str(ck))
+            if prior is None or prior.algo != "deeplearning":
+                raise ValueError(f"checkpoint model '{ck}' not found")
+            shapes = [tuple(np.asarray(l["W"]).shape) for l in prior.net]
+            want = [(sizes[i], sizes[i + 1] * (2 if act == "maxout"
+                                               and i < len(sizes) - 2 else 1))
+                    for i in range(len(sizes) - 1)]
+            if shapes != want:
+                raise ValueError("hidden layout cannot change across "
+                                 "checkpoint restart")
+            params_net = [{"W": jnp.asarray(l["W"]), "b": jnp.asarray(l["b"])}
+                          for l in prior.net]
+        else:
+            params_net = _init_params(kinit, sizes, act == "maxout")
 
         hd = p["hidden_dropout_ratios"]
         if hd is None:

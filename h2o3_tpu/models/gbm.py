@@ -191,7 +191,7 @@ class GBMEstimator(ModelBuilder):
         fold_assignment="auto",
         ignored_columns=None, tweedie_power=1.5, quantile_alpha=0.5,
         huber_alpha=0.9, stopping_rounds=0, stopping_metric="auto",
-        stopping_tolerance=1e-3, score_tree_interval=0,
+        stopping_tolerance=1e-3, score_tree_interval=0, checkpoint=None,
     )
 
     def __init__(self, **params):
@@ -216,7 +216,34 @@ class GBMEstimator(ModelBuilder):
         category = infer_category(frame, y)
         dist_name = self._resolve_distribution(category)
 
-        bm = bin_frame(frame, x, nbins=p["nbins"], nbins_cats=p["nbins_cats"])
+        # checkpoint restart (SharedTree _checkpoint via
+        # hex/util/CheckpointUtils + ReconstructTreeState): reuse the
+        # prior model's binning so its trees stay valid, resume margins
+        # from its predictions, and append trees up to the new ntrees.
+        ckpt: Optional[GBMModel] = None
+        ck = p.get("checkpoint")
+        if ck is not None:
+            from h2o3_tpu.core.kv import DKV
+            ckpt = ck if isinstance(ck, GBMModel) else DKV.get(str(ck))
+            if ckpt is None or ckpt.algo != "gbm":
+                raise ValueError(f"checkpoint model '{ck}' not found")
+            if ckpt.output["response"] != y:
+                raise ValueError("checkpoint response mismatch")
+            if list(ckpt.bm.names) != list(x):
+                raise ValueError("checkpoint feature set mismatch")
+            if ckpt.output["category"] != category:
+                raise ValueError("checkpoint model category mismatch "
+                                 f"({ckpt.output['category']} vs {category})")
+            if ckpt.dist_name != dist_name:
+                raise ValueError(
+                    "distribution cannot change across checkpoint restart "
+                    f"({ckpt.dist_name} vs {dist_name})")
+
+        if ckpt is not None:
+            bm = rebin_for_scoring(ckpt.bm, frame)
+        else:
+            bm = bin_frame(frame, x, nbins=p["nbins"],
+                           nbins_cats=p["nbins_cats"])
         w = frame.valid_weights()
         if p.get("weights_column"):
             wc = frame.col(p["weights_column"]).numeric_view()
@@ -239,6 +266,20 @@ class GBMEstimator(ModelBuilder):
         seed = int(p["seed"]) if int(p["seed"]) >= 0 else 0xDEC0DE
         key = jax.random.PRNGKey(seed)
         ntrees = int(p["ntrees"])
+        prior_T = 0
+        if ckpt is not None:
+            K_ck = (ckpt.output.get("nclasses", 1)
+                    if ckpt.output["category"] == ModelCategory.MULTINOMIAL
+                    else 1)
+            if ckpt.forest.feat.shape[1] != int(p["max_depth"]):
+                raise ValueError("max_depth cannot change across checkpoint "
+                                 "restart (reference non-modifiable param)")
+            prior_T = ckpt.forest.feat.shape[0] // K_ck
+            if ntrees <= prior_T:
+                raise ValueError(
+                    f"ntrees ({ntrees}) must exceed the checkpoint's "
+                    f"tree count ({prior_T})")
+            ntrees = ntrees - prior_T
         output = {"category": category, "response": y, "names": list(x),
                   "nclasses": rc.cardinality if rc.is_categorical else 1,
                   "domain": rc.domain}
@@ -280,13 +321,24 @@ class GBMEstimator(ModelBuilder):
             counts = np.bincount(yv[: frame.nrows], weights=w_host,
                                  minlength=K).astype(np.float64)
             pri = np.clip(counts / max(counts.sum(), 1e-12), 1e-10, 1.0)
-            f0 = np.log(pri).astype(np.float32)
-            margins = jnp.broadcast_to(jnp.asarray(f0)[None, :],
-                                       (bm.bins.shape[0], K)).astype(jnp.float32)
-            margins = jax.device_put(margins, row_sharding(mesh))
-            val_margins = (jnp.broadcast_to(jnp.asarray(f0)[None, :],
-                                            (vbm.bins.shape[0], K)).astype(jnp.float32)
-                           if vbm is not None else None)
+            if ckpt is not None:
+                f0 = ckpt.f0
+                margins = jax.device_put(ckpt._margins(bm).astype(jnp.float32),
+                                         row_sharding(mesh))
+            else:
+                f0 = np.log(pri).astype(np.float32)
+                margins = jnp.broadcast_to(
+                    jnp.asarray(f0)[None, :],
+                    (bm.bins.shape[0], K)).astype(jnp.float32)
+                margins = jax.device_put(margins, row_sharding(mesh))
+            if vbm is None:
+                val_margins = None
+            elif ckpt is not None:   # resume incl. the prior forest's part
+                val_margins = ckpt._margins(vbm).astype(jnp.float32)
+            else:
+                val_margins = jnp.broadcast_to(
+                    jnp.asarray(f0)[None, :],
+                    (vbm.bins.shape[0], K)).astype(jnp.float32)
             for t in range(ntrees):
                 key, sub = jax.random.split(key)
                 tr, margins, gains = _boost_step_multi(
@@ -314,6 +366,10 @@ class GBMEstimator(ModelBuilder):
                         break
             forest = Tree(*(jnp.concatenate([getattr(t, f) for t in trees])
                             for f in Tree._fields))
+            if ckpt is not None:
+                forest = Tree(*(jnp.concatenate([getattr(ckpt.forest, f),
+                                                 getattr(forest, f)])
+                                for f in Tree._fields))
             model = GBMModel(p, output, forest, bm, f0, "multinomial")
             probs = jax.nn.softmax(model._margins(bm), axis=1)
             model.training_metrics = mm.multinomial_metrics(
@@ -330,11 +386,20 @@ class GBMEstimator(ModelBuilder):
             y_dev = jax.device_put(yv, row_sharding(mesh))
             wn = np.asarray(w)
             mean_y = float((np.asarray(yv) * wn).sum() / max(wn.sum(), 1e-12))
-            f0 = np.float32(dist.init_margin(mean_y))
-            margin = jnp.full((bm.bins.shape[0],), f0, jnp.float32)
-            margin = jax.device_put(margin, row_sharding(mesh))
-            val_margin = (jnp.full((vbm.bins.shape[0],), f0, jnp.float32)
-                          if vbm is not None else None)
+            if ckpt is not None:
+                f0 = ckpt.f0
+                margin = jax.device_put(
+                    ckpt._margins(bm).astype(jnp.float32), row_sharding(mesh))
+            else:
+                f0 = np.float32(dist.init_margin(mean_y))
+                margin = jnp.full((bm.bins.shape[0],), f0, jnp.float32)
+                margin = jax.device_put(margin, row_sharding(mesh))
+            if vbm is None:
+                val_margin = None
+            elif ckpt is not None:   # resume incl. the prior forest's part
+                val_margin = ckpt._margins(vbm).astype(jnp.float32)
+            else:
+                val_margin = jnp.full((vbm.bins.shape[0],), f0, jnp.float32)
             for t in range(ntrees):
                 key, sub = jax.random.split(key)
                 tr, margin, gains = _boost_step(
@@ -357,6 +422,10 @@ class GBMEstimator(ModelBuilder):
                     if stopper.should_stop(dev):
                         break
             forest = stack_trees(trees)
+            if ckpt is not None:
+                forest = Tree(*(jnp.concatenate([getattr(ckpt.forest, f),
+                                                 getattr(forest, f)])
+                                for f in Tree._fields))
             model = GBMModel(p, output, forest, bm, f0, dist_name)
             if category == ModelCategory.BINOMIAL:
                 pfin = dist.link_inv(model._margins(bm))

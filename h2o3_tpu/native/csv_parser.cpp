@@ -1,0 +1,359 @@
+// Native CSV tokenizer — the water/parser hot loop, reimplemented for the
+// TPU host runtime.
+//
+// Reference behavior being reproduced (not copied — the reference is Java):
+//   - water/parser/CsvParser.java: per-byte tokenizer with quote handling
+//   - water/parser/ParseDataset.java:253: chunk-parallel parse, each worker
+//     tokenizes its byte range starting at the first line break past its
+//     offset (cross-chunk line stitching)
+//   - water/parser/ParseDataset.java:356-440: per-worker categorical
+//     interning followed by global domain unification + code renumbering
+//   - water/parser/ParseSetup.java: type guessing (numeric unless some
+//     non-missing field fails numeric parse)
+//
+// Two passes over the buffer: pass 1 infers column types + row count
+// (no allocation per field), pass 2 fills typed columns. Threads own
+// contiguous row blocks; categorical levels intern into per-thread maps
+// merged into one sorted global domain (sorted to match the Python
+// fallback's pandas.factorize(sort=True) ordering).
+//
+// C ABI (ctypes-consumed; see native/__init__.py):
+//   csv_parse(data, len, sep, header, nthreads) -> handle
+//   csv_nrows/csv_ncols/csv_colname/csv_coltype
+//   csv_numeric (double out, NaN=NA) / csv_codes (int32 out, -1=NA)
+//   csv_card/csv_level, csv_free
+
+#include <algorithm>
+#include <atomic>
+#include <cmath>
+#include <cstdlib>
+#include <cstring>
+#include <string>
+#include <thread>
+#include <unordered_map>
+#include <vector>
+
+namespace {
+
+struct Field { const char* p; long n; };
+
+static inline bool is_na_token(const char* p, long n) {
+  if (n == 0) return true;
+  if (n == 2 && (memcmp(p, "NA", 2) == 0 || memcmp(p, "na", 2) == 0))
+    return true;
+  if (n == 3 && (memcmp(p, "nan", 3) == 0 || memcmp(p, "NaN", 3) == 0 ||
+                 memcmp(p, "NAN", 3) == 0)) return true;
+  if (n == 4 && (memcmp(p, "null", 4) == 0 || memcmp(p, "NULL", 4) == 0))
+    return true;
+  return false;
+}
+
+static inline bool parse_double(const char* p, long n, double* out) {
+  // strtod needs NUL-termination; fields are short, copy to stack
+  char buf[64];
+  if (n <= 0 || n >= 63) return false;
+  memcpy(buf, p, n);
+  buf[n] = 0;
+  char* end = nullptr;
+  double v = strtod(buf, &end);
+  while (end && *end == ' ') end++;
+  if (end != buf + n) return false;
+  *out = v;
+  return true;
+}
+
+// Advance over one line from `p` (< limit), invoking cb(field_idx, ptr, len)
+// per field. Returns pointer past the line terminator. Handles quoted
+// fields with "" escapes; embedded newlines inside quotes are honored.
+template <typename F>
+static const char* scan_line(const char* p, const char* limit, char sep,
+                             F&& cb) {
+  int col = 0;
+  const char* fstart = p;
+  bool quoted = false;
+  const char* qstart = nullptr;
+  std::string unq;  // only used when a quoted field has "" escapes
+  bool has_esc = false;
+
+  // dispatch table: skip runs of ordinary bytes in a tight loop
+  bool special[256] = {};
+  special[(unsigned char)sep] = special['\n'] = special['\r'] =
+      special['"'] = true;
+
+  while (p < limit) {
+    if (!quoted) {
+      while (p < limit && !special[(unsigned char)*p]) p++;
+      if (p >= limit) break;
+    }
+    char c = *p;
+    if (quoted) {
+      if (c == '"') {
+        if (p + 1 < limit && p[1] == '"') { has_esc = true; p += 2; continue; }
+        quoted = false;
+      }
+      p++;
+      continue;
+    }
+    if (c == '"' && p == fstart) { quoted = true; qstart = p + 1; p++; continue; }
+    if (c == sep || c == '\n' || c == '\r') {
+      const char* fp = fstart;
+      long fn = p - fstart;
+      if (qstart) {  // strip quotes
+        fp = qstart;
+        fn = (p - 1) - qstart;           // closing quote
+        if (fn < 0) fn = 0;
+        if (has_esc) {                   // collapse "" -> "
+          unq.clear();
+          for (long i = 0; i < fn; i++) {
+            unq.push_back(fp[i]);
+            if (fp[i] == '"' && i + 1 < fn && fp[i + 1] == '"') i++;
+          }
+          fp = unq.data();
+          fn = (long)unq.size();
+        }
+      }
+      cb(col++, fp, fn);
+      if (c == sep) { p++; fstart = p; qstart = nullptr; has_esc = false; continue; }
+      // line end
+      if (c == '\r' && p + 1 < limit && p[1] == '\n') p++;
+      return p + 1;
+    }
+    p++;
+  }
+  // final line without terminator (same quote/escape handling as above)
+  const char* fp = qstart ? qstart : fstart;
+  long fn = qstart ? (p - 1) - qstart : p - fstart;
+  if (fn < 0) fn = 0;
+  if (qstart && has_esc) {
+    unq.clear();
+    for (long i = 0; i < fn; i++) {
+      unq.push_back(fp[i]);
+      if (fp[i] == '"' && i + 1 < fn && fp[i + 1] == '"') i++;
+    }
+    fp = unq.data();
+    fn = (long)unq.size();
+  }
+  cb(col++, fp, fn);
+  return p;
+}
+
+// first line start at/after `off` (0 stays 0); quotes are assumed not to
+// span worker boundaries for the split heuristic — the reference makes the
+// same chunk-boundary assumption (CsvParser cross-chunk stitching)
+static const char* next_line_start(const char* base, const char* limit,
+                                   long off) {
+  if (off <= 0) return base;
+  const char* p = base + off;
+  while (p < limit && *p != '\n') p++;
+  return p < limit ? p + 1 : limit;
+}
+
+struct ColData {
+  std::string name;
+  int type = 0;                     // 0 numeric, 1 categorical
+  std::vector<double> nums;
+  std::vector<int> codes;           // global codes after merge
+  std::vector<std::string> domain;  // sorted global domain
+};
+
+struct Parsed {
+  long nrows = 0;
+  std::vector<ColData> cols;
+};
+
+struct ThreadChunk {
+  const char* begin;
+  const char* end;
+  long nrows = 0;
+  // pass-2 storage
+  std::vector<std::vector<double>> nums;           // [ncols][rows]
+  std::vector<std::vector<int>> local_codes;       // [ncols][rows]
+  std::vector<std::unordered_map<std::string, int>> interns;  // per col
+  std::vector<std::vector<std::string>> local_levels;
+  std::vector<char> col_is_str;                    // pass-1 flags
+};
+
+}  // namespace
+
+extern "C" {
+
+void* csv_parse(const char* data, long len, char sep, int header,
+                int nthreads) {
+  auto* out = new Parsed();
+  const char* limit = data + len;
+  const char* body = data;
+
+  // header row
+  std::vector<std::string> names;
+  if (header) {
+    body = scan_line(data, limit, sep, [&](int, const char* p, long n) {
+      names.emplace_back(p, (size_t)n);
+    });
+  }
+  if (body >= limit) {  // empty body
+    for (auto& nm : names) {
+      out->cols.emplace_back();
+      out->cols.back().name = nm;
+    }
+    return out;
+  }
+
+  if (nthreads < 1) nthreads = 1;
+  long blen = limit - body;
+  std::vector<ThreadChunk> chunks((size_t)nthreads);
+  for (int t = 0; t < nthreads; t++) {
+    chunks[t].begin = next_line_start(body, limit, blen * t / nthreads);
+    chunks[t].end = next_line_start(body, limit, blen * (t + 1) / nthreads);
+  }
+  chunks[0].begin = body;
+
+  size_t ncols_guess = names.size();
+  if (!ncols_guess) {
+    // count fields of first line
+    size_t c = 0;
+    scan_line(body, limit, sep, [&](int, const char*, long) { c++; });
+    ncols_guess = c;
+  }
+  const size_t NC = ncols_guess;
+
+  // ---- pass 1: per-thread type inference + row counts ----
+  std::vector<std::thread> pool;
+  for (int t = 0; t < nthreads; t++) {
+    pool.emplace_back([&, t]() {
+      ThreadChunk& ch = chunks[t];
+      ch.col_is_str.assign(NC, 0);
+      const char* p = ch.begin;
+      while (p < ch.end) {
+        if (*p == '\n') { p++; continue; }                      // blank line
+        if (*p == '\r' && p + 1 < ch.end && p[1] == '\n') { p += 2; continue; }
+        p = scan_line(p, limit, sep, [&](int col, const char* fp, long fn) {
+          if ((size_t)col >= NC) return;
+          if (ch.col_is_str[col] || is_na_token(fp, fn)) return;
+          double v;
+          if (!parse_double(fp, fn, &v)) ch.col_is_str[col] = 1;
+        });
+        ch.nrows++;
+      }
+    });
+  }
+  for (auto& th : pool) th.join();
+  pool.clear();
+
+  std::vector<char> is_str(NC, 0);
+  long total_rows = 0;
+  for (auto& ch : chunks) {
+    total_rows += ch.nrows;
+    for (size_t j = 0; j < NC; j++) is_str[j] |= ch.col_is_str[j];
+  }
+
+  // ---- pass 2: typed fill with per-thread interning ----
+  for (int t = 0; t < nthreads; t++) {
+    pool.emplace_back([&, t]() {
+      ThreadChunk& ch = chunks[t];
+      ch.nums.assign(NC, {});
+      ch.local_codes.assign(NC, {});
+      ch.interns.assign(NC, {});
+      ch.local_levels.assign(NC, {});
+      for (size_t j = 0; j < NC; j++) {
+        if (is_str[j]) ch.local_codes[j].reserve((size_t)ch.nrows);
+        else ch.nums[j].reserve((size_t)ch.nrows);
+      }
+      const char* p = ch.begin;
+      long filled = 0;
+      while (p < ch.end) {
+        if (*p == '\n') { p++; continue; }                      // blank line
+        if (*p == '\r' && p + 1 < ch.end && p[1] == '\n') { p += 2; continue; }
+        long before = filled;
+        p = scan_line(p, limit, sep, [&](int col, const char* fp, long fn) {
+          if ((size_t)col >= NC) return;
+          if (is_str[col]) {
+            if (is_na_token(fp, fn)) { ch.local_codes[col].push_back(-1); return; }
+            std::string s(fp, (size_t)fn);
+            auto it = ch.interns[col].find(s);
+            int code;
+            if (it == ch.interns[col].end()) {
+              code = (int)ch.local_levels[col].size();
+              ch.interns[col].emplace(s, code);
+              ch.local_levels[col].push_back(std::move(s));
+            } else code = it->second;
+            ch.local_codes[col].push_back(code);
+          } else {
+            double v;
+            if (is_na_token(fp, fn) || !parse_double(fp, fn, &v))
+              v = NAN;
+            ch.nums[col].push_back(v);
+          }
+        });
+        filled = before + 1;
+        // short rows: pad missing trailing fields with NA
+        for (size_t j = 0; j < NC; j++) {
+          size_t want = (size_t)filled;
+          if (is_str[j]) while (ch.local_codes[j].size() < want)
+            ch.local_codes[j].push_back(-1);
+          else while (ch.nums[j].size() < want)
+            ch.nums[j].push_back(NAN);
+        }
+      }
+    });
+  }
+  for (auto& th : pool) th.join();
+
+  // ---- merge: global sorted domains + code remap (the ParseDataset
+  //      domain-unification step) ----
+  out->nrows = total_rows;
+  out->cols.resize(NC);
+  for (size_t j = 0; j < NC; j++) {
+    ColData& cd = out->cols[j];
+    cd.name = j < names.size() ? names[j] : ("C" + std::to_string(j + 1));
+    cd.type = is_str[j] ? 1 : 0;
+    if (!is_str[j]) {
+      cd.nums.reserve((size_t)total_rows);
+      for (auto& ch : chunks)
+        cd.nums.insert(cd.nums.end(), ch.nums[j].begin(), ch.nums[j].end());
+    } else {
+      std::vector<std::string> all;
+      for (auto& ch : chunks)
+        all.insert(all.end(), ch.local_levels[j].begin(),
+                   ch.local_levels[j].end());
+      std::sort(all.begin(), all.end());
+      all.erase(std::unique(all.begin(), all.end()), all.end());
+      std::unordered_map<std::string, int> global;
+      global.reserve(all.size() * 2);
+      for (size_t k = 0; k < all.size(); k++) global[all[k]] = (int)k;
+      cd.domain = std::move(all);
+      cd.codes.reserve((size_t)total_rows);
+      for (auto& ch : chunks) {
+        std::vector<int> remap(ch.local_levels[j].size());
+        for (size_t k = 0; k < remap.size(); k++)
+          remap[k] = global[ch.local_levels[j][k]];
+        for (int c : ch.local_codes[j])
+          cd.codes.push_back(c < 0 ? -1 : remap[(size_t)c]);
+      }
+    }
+  }
+  return out;
+}
+
+long csv_nrows(void* h) { return ((Parsed*)h)->nrows; }
+int csv_ncols(void* h) { return (int)((Parsed*)h)->cols.size(); }
+const char* csv_colname(void* h, int j) {
+  return ((Parsed*)h)->cols[(size_t)j].name.c_str();
+}
+int csv_coltype(void* h, int j) { return ((Parsed*)h)->cols[(size_t)j].type; }
+void csv_numeric(void* h, int j, double* outp) {
+  auto& v = ((Parsed*)h)->cols[(size_t)j].nums;
+  memcpy(outp, v.data(), v.size() * sizeof(double));
+}
+void csv_codes(void* h, int j, int* outp) {
+  auto& v = ((Parsed*)h)->cols[(size_t)j].codes;
+  memcpy(outp, v.data(), v.size() * sizeof(int));
+}
+int csv_card(void* h, int j) {
+  return (int)((Parsed*)h)->cols[(size_t)j].domain.size();
+}
+const char* csv_level(void* h, int j, int k) {
+  return ((Parsed*)h)->cols[(size_t)j].domain[(size_t)k].c_str();
+}
+void csv_free(void* h) { delete (Parsed*)h; }
+
+}  // extern "C"

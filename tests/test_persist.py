@@ -1,0 +1,144 @@
+"""Persist drivers, binary Frame/Model export, checkpoint restart,
+grid fault-tolerance recovery (hex/faulttolerance analogue)."""
+
+import os
+
+import numpy as np
+import pytest
+
+import h2o3_tpu
+from tests.conftest import make_classification
+
+
+def _frame(n=1500, seed=0):
+    X, y = make_classification(n=n, f=5, seed=seed)
+    cols = {f"x{i}": X[:, i] for i in range(5)}
+    cols["g"] = np.array(["u", "v", "w"], object)[
+        np.random.RandomState(seed).randint(0, 3, n)]
+    cols["y"] = np.array(["no", "yes"], object)[y]
+    return h2o3_tpu.Frame.from_numpy(cols, categorical=["g", "y"])
+
+
+def test_frame_save_load_roundtrip(tmp_path):
+    fr = _frame()
+    uri = str(tmp_path / "fr.h2o3")
+    h2o3_tpu.save_frame(fr, uri)
+    fr2 = h2o3_tpu.load_frame(uri)
+    assert fr2.shape == fr.shape
+    assert fr2.names == fr.names
+    assert fr2.col("g").domain == fr.col("g").domain
+    np.testing.assert_allclose(fr2.col("x0").to_numpy(),
+                               fr.col("x0").to_numpy(), rtol=1e-6)
+    np.testing.assert_array_equal(fr2.col("g").to_numpy(),
+                                  fr.col("g").to_numpy())
+
+
+def test_frame_save_load_with_nas(tmp_path):
+    x = np.array([1.0, np.nan, 3.0, np.nan])
+    fr = h2o3_tpu.Frame.from_numpy({"x": x})
+    uri = str(tmp_path / "na.h2o3")
+    h2o3_tpu.save_frame(fr, uri)
+    out = h2o3_tpu.load_frame(uri).col("x").to_numpy()
+    np.testing.assert_array_equal(np.isnan(out), np.isnan(x))
+
+
+def test_model_save_load_scores_identically(tmp_path):
+    from h2o3_tpu.models.gbm import GBMEstimator
+    fr = _frame()
+    m = GBMEstimator(ntrees=6, max_depth=3, seed=5).train(fr, y="y")
+    uri = str(tmp_path / "m.bin")
+    h2o3_tpu.save_model(m, uri)
+    m2 = h2o3_tpu.load_model(uri)
+    a = m.predict(fr).col("p1").to_numpy()
+    b = m2.predict(fr).col("p1").to_numpy()
+    np.testing.assert_allclose(a, b, atol=1e-6)
+    assert m2.training_metrics["AUC"] == m.training_metrics["AUC"]
+
+
+def test_hex_ice_driver(tmp_path, monkeypatch):
+    monkeypatch.setenv("H2O3_TPU_ICE_DIR", str(tmp_path / "ice"))
+    from h2o3_tpu.io.persist import PersistManager
+    pm = PersistManager()
+    pm.write("hex://spill/blob.bin", b"cold value")
+    assert pm.read("hex://spill/blob.bin") == b"cold value"
+    assert pm.exists("hex://spill/blob.bin")
+    pm.delete("hex://spill/blob.bin")
+    assert not pm.exists("hex://spill/blob.bin")
+
+
+def test_unknown_scheme_raises():
+    with pytest.raises(IOError, match="no persist driver"):
+        h2o3_tpu.persist_manager.read("s3://bucket/key")
+
+
+def test_gbm_checkpoint_restart_matches_full_run():
+    from h2o3_tpu.models.gbm import GBMEstimator
+    fr = _frame()
+    # 10-tree run in one shot vs 4 + checkpoint-restart to 10.
+    full = GBMEstimator(ntrees=10, max_depth=3, seed=5,
+                        sample_rate=1.0).train(fr, y="y")
+    part = GBMEstimator(ntrees=4, max_depth=3, seed=5,
+                        sample_rate=1.0).train(fr, y="y")
+    resumed = GBMEstimator(ntrees=10, max_depth=3, seed=5, sample_rate=1.0,
+                           checkpoint=part.key).train(fr, y="y")
+    assert resumed.forest.feat.shape[0] == 10
+    # resumed model must beat the 4-tree prefix on training deviance
+    assert (resumed.training_metrics["logloss"]
+            < part.training_metrics["logloss"] + 1e-9)
+    # and land in the same quality regime as the one-shot run
+    assert abs(resumed.training_metrics["AUC"]
+               - full.training_metrics["AUC"]) < 0.05
+
+
+def test_gbm_checkpoint_validations():
+    from h2o3_tpu.models.gbm import GBMEstimator
+    fr = _frame()
+    part = GBMEstimator(ntrees=4, max_depth=3, seed=5).train(fr, y="y")
+    with pytest.raises(ValueError, match="must exceed"):
+        GBMEstimator(ntrees=4, checkpoint=part.key, max_depth=3).train(
+            fr, y="y")
+    with pytest.raises(ValueError, match="max_depth"):
+        GBMEstimator(ntrees=8, checkpoint=part.key, max_depth=5).train(
+            fr, y="y")
+
+
+def test_dl_checkpoint_restart():
+    from h2o3_tpu.models.deeplearning import DeepLearningEstimator
+    fr = _frame()
+    part = DeepLearningEstimator(hidden=[8], epochs=1, seed=3).train(
+        fr, y="y")
+    resumed = DeepLearningEstimator(hidden=[8], epochs=1, seed=3,
+                                    checkpoint=part.key).train(fr, y="y")
+    assert resumed.training_metrics["logloss"] <= \
+        part.training_metrics["logloss"] * 1.2
+    with pytest.raises(ValueError, match="hidden layout"):
+        DeepLearningEstimator(hidden=[16], epochs=1,
+                              checkpoint=part.key).train(fr, y="y")
+
+
+def test_grid_recovery_resume(tmp_path):
+    from h2o3_tpu.ml.grid import GridSearch, resume_grid
+    from h2o3_tpu.models.gbm import GBMEstimator
+    fr = _frame()
+    d = str(tmp_path / "rec")
+    os.makedirs(d)
+    # simulate a crash after 2 of 4 combos: run a half grid with
+    # recovery on, then widen the recorded hyper space to the full grid
+    # (as if the walk died mid-way through it)
+    gs = GridSearch(GBMEstimator, {"max_depth": [2, 3],
+                                   "learn_rate": [0.1]},
+                    recovery_dir=d, ntrees=3, seed=7)
+    gs.train(fr, y="y")
+    import json
+    sp = os.path.join(d, "grid_state.json")
+    state = json.loads(open(sp).read())
+    assert len(state["done"]) == 2
+    state["hyper_params"] = {"max_depth": [2, 3], "learn_rate": [0.1, 0.3]}
+    open(sp, "w").write(json.dumps(state))
+    # resume on a "fresh cluster": finishes the remaining combos
+    grid = resume_grid(d, fr)
+    assert len(grid.models) == 4
+    done_params = [m.output["grid_params"] for m in grid.models]
+    assert len({frozenset(p.items()) for p in done_params}) == 4
+    state = json.loads(open(os.path.join(d, "grid_state.json")).read())
+    assert len(state["done"]) == 4
