@@ -47,20 +47,49 @@ def _sample_columns(k1, k2, F: int, rate: float):
 @partial(jax.jit, static_argnames=("tp", "dist", "sample_rate"))
 def _boost_step(bins, nb, y, w, margin, key, *, tp: TreeParams,
                 dist: Distribution, sample_rate: float):
-    """One boosting iteration, fully on device."""
+    """One boosting iteration, fully on device (per-tree loop path —
+    used when early stopping / validation tracking needs the host
+    between trees; otherwise _boost_scan fuses the whole loop)."""
+    return _boost_step_impl(bins, nb, y, w, margin, key, tp=tp, dist=dist,
+                            sample_rate=sample_rate)
+
+
+@partial(jax.jit, static_argnames=("tp", "dist", "sample_rate", "ntrees"))
+def _boost_scan(bins, nb, y, w, margin, key, *, tp: TreeParams,
+                dist: Distribution, sample_rate: float, ntrees: int):
+    """All ``ntrees`` boosting iterations as ONE compiled program.
+
+    ``lax.scan`` over per-tree PRNG keys removes the per-tree
+    host↔device round trip of the Python loop (the dominant overhead on
+    a remote-attached chip); static tree shapes make the stacked Tree
+    output exactly what predict_forest consumes.
+    """
+    keys = jax.random.split(key, ntrees)
+
+    def step(margin, k):
+        tree, margin, gains = _boost_step_impl(
+            bins, nb, y, w, margin, k, tp=tp, dist=dist,
+            sample_rate=sample_rate)
+        return margin, (tree, gains)
+
+    margin, (trees, gains) = jax.lax.scan(step, margin, keys)
+    return trees, margin, jnp.sum(gains, axis=0)
+
+
+def _boost_step_impl(bins, nb, y, w, margin, key, *, tp, dist, sample_rate):
+    """Unjitted body shared by _boost_step and _boost_scan."""
     mesh = get_mesh()
     g = dist.grad(y, margin)
     h = dist.hess(y, margin)
     kr, kc1, kc2 = jax.random.split(key, 3)
     ws = w
-    if sample_rate < 1.0:  # stochastic GBM row sampling (GBM sample_rate)
+    if sample_rate < 1.0:
         keep = jax.random.bernoulli(kr, sample_rate, shape=w.shape)
         ws = w * keep.astype(jnp.float32)
     F = bins.shape[1]
     col_mask = _sample_columns(kc1, kc2, F, tp.col_sample_rate)
     tree, nid, gains = grow_tree(bins, nb, ws, g, h, col_mask,
                                  params=tp, mesh=mesh)
-    # bake the shrinkage into stored leaves so scoring is a plain sum
     tree = tree._replace(leaf=tp.learn_rate * tree.leaf)
     margin = margin + tree.leaf[nid]
     return tree, margin, gains
@@ -400,28 +429,52 @@ class GBMEstimator(ModelBuilder):
                 val_margin = ckpt._margins(vbm).astype(jnp.float32)
             else:
                 val_margin = jnp.full((vbm.bins.shape[0],), f0, jnp.float32)
-            for t in range(ntrees):
-                key, sub = jax.random.split(key)
-                tr, margin, gains = _boost_step(
-                    bm.bins, bm.nbins, y_dev, w, margin, sub, tp=tp,
-                    dist=dist, sample_rate=float(p["sample_rate"]))
-                trees.append(tr)
-                gains_total += np.asarray(gains)
-                job.update(1.0 / ntrees, f"tree {t + 1}/{ntrees}")
-                if vbm is not None:
-                    val_margin = val_margin + predict_tree(tr, vbm.bins,
-                                                           bm.nbins_total)
-                if stopper.enabled and (t + 1) % score_interval == 0:
+            if not stopper.enabled and vbm is None:
+                # boosting loop as compiled scans over tree chunks — the
+                # per-tree host round trip (dominant on a remote chip)
+                # amortizes over CHUNK trees, while the inter-chunk
+                # job.update keeps progress reporting + cancellation live
+                CHUNK = 10
+                chunks = []
+                done = 0
+                while done < ntrees:
+                    k = min(CHUNK, ntrees - done)
+                    key, sub = jax.random.split(key)
+                    tr_k, margin, gains = _boost_scan(
+                        bm.bins, bm.nbins, y_dev, w, margin, sub, tp=tp,
+                        dist=dist, sample_rate=float(p["sample_rate"]),
+                        ntrees=k)
+                    chunks.append(tr_k)
+                    gains_total += np.asarray(gains)
+                    done += k
+                    job.update(k / ntrees, f"tree {done}/{ntrees}")
+                forest = (chunks[0] if len(chunks) == 1 else
+                          Tree(*(jnp.concatenate([getattr(c, f)
+                                                  for c in chunks])
+                                 for f in Tree._fields)))
+            else:
+                for t in range(ntrees):
+                    key, sub = jax.random.split(key)
+                    tr, margin, gains = _boost_step(
+                        bm.bins, bm.nbins, y_dev, w, margin, sub, tp=tp,
+                        dist=dist, sample_rate=float(p["sample_rate"]))
+                    trees.append(tr)
+                    gains_total += np.asarray(gains)
+                    job.update(1.0 / ntrees, f"tree {t + 1}/{ntrees}")
                     if vbm is not None:
-                        dev = float(jnp.sum(val_w * dist.deviance(val_y, val_margin))
-                                    / jnp.maximum(jnp.sum(val_w), 1e-12))
-                    else:
-                        dev = float(jnp.sum(w * dist.deviance(y_dev, margin))
-                                    / jnp.maximum(jnp.sum(w), 1e-12))
-                    scoring_history.append({"ntrees": t + 1, "deviance": dev})
-                    if stopper.should_stop(dev):
-                        break
-            forest = stack_trees(trees)
+                        val_margin = val_margin + predict_tree(
+                            tr, vbm.bins, bm.nbins_total)
+                    if stopper.enabled and (t + 1) % score_interval == 0:
+                        if vbm is not None:
+                            dev = float(jnp.sum(val_w * dist.deviance(val_y, val_margin))
+                                        / jnp.maximum(jnp.sum(val_w), 1e-12))
+                        else:
+                            dev = float(jnp.sum(w * dist.deviance(y_dev, margin))
+                                        / jnp.maximum(jnp.sum(w), 1e-12))
+                        scoring_history.append({"ntrees": t + 1, "deviance": dev})
+                        if stopper.should_stop(dev):
+                            break
+                forest = stack_trees(trees)
             if ckpt is not None:
                 forest = Tree(*(jnp.concatenate([getattr(ckpt.forest, f),
                                                  getattr(forest, f)])

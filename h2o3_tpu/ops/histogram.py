@@ -28,6 +28,15 @@ from jax.sharding import PartitionSpec as P
 
 from h2o3_tpu.parallel.mesh import DATA_AXIS, MODEL_AXIS
 
+# A Pallas kernel exists (ops/pallas_histogram) but measures ~2x slower
+# than the XLA formulation on v5e (the one-hot construction is VPU-bound
+# either way, and XLA fuses it into the matmul at larger row blocks than
+# fit VMEM). Opt in with H2O3_TPU_PALLAS_HIST=1 — read ONCE at import:
+# histogram() only runs at trace time inside jit-cached programs, so a
+# mid-process toggle could never take effect anyway.
+import os as _os
+_USE_PALLAS_FLAG = _os.environ.get("H2O3_TPU_PALLAS_HIST") == "1"
+
 
 def _block_hist(bins_blk, nid_blk, stats_blk, n_nodes: int, n_bins: int):
     """One row-block's [3L, FB] partial histogram via MXU matmul."""
@@ -91,13 +100,21 @@ def histogram(bins, nid, w, g, h, *, n_nodes: int, n_bins: int,
         nid = jnp.pad(nid, (0, pad))
         stats = jnp.pad(stats, ((0, pad), (0, 0)))
 
+    use_pallas = jax.default_backend() == "tpu" and _USE_PALLAS_FLAG
+
     @functools.partial(
         shard_map, mesh=mesh,
         in_specs=(P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS)),
         out_specs=P(), check_vma=False)
     def _task(bins_l, nid_l, stats_l):
-        hist = _local_histogram(bins_l, nid_l, stats_l, n_nodes, n_bins,
-                                block_rows)
+        if use_pallas:
+            from h2o3_tpu.ops.pallas_histogram import pallas_local_histogram
+            hist = pallas_local_histogram(bins_l, nid_l, stats_l,
+                                          n_nodes, n_bins,
+                                          block_rows=min(block_rows, 512))
+        else:
+            hist = _local_histogram(bins_l, nid_l, stats_l, n_nodes, n_bins,
+                                    block_rows)
         # psum over 'data' only: inputs are replicated over 'model', so
         # including it would scale every stat by the model-axis size
         return jax.lax.psum(hist, DATA_AXIS)
