@@ -43,9 +43,9 @@ def main():
     fr = h2o3_tpu.Frame.from_numpy(
         cols, categorical=[f"c{i}" for i in range(N_CAT)] + ["dep_delayed"])
 
-    # warmup: compile the fused 50-tree boosting scan (ntrees is a static
-    # arg of the compiled program, so the warmup must match the config)
-    GBMEstimator(ntrees=NTREES, max_depth=DEPTH, seed=1).train(
+    # warmup: the fused boosting path runs 10-tree scan chunks, so a
+    # 10-tree training compiles every program the 50-tree run uses
+    GBMEstimator(ntrees=10, max_depth=DEPTH, seed=1).train(
         fr, y="dep_delayed")
 
     t0 = time.time()

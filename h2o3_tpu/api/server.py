@@ -382,7 +382,30 @@ def _leaderboard(params, body, project=None):
 
 @route("GET", "/3/Timeline")
 def _timeline(params, body):
-    return {"events": []}
+    from h2o3_tpu.utils.timeline import snapshot
+    return {"events": snapshot(last=params.get("last"))}
+
+
+@route("GET", "/3/JStack")
+def _jstack(params, body):
+    """Thread stack dump (water/api/JStackHandler role)."""
+    import sys
+    import traceback
+    frames = sys._current_frames()
+    threads = {t.ident: t.name for t in threading.enumerate()}
+    out = []
+    for tid, frame in frames.items():
+        out.append({"thread": threads.get(tid, str(tid)),
+                    "stack": traceback.format_stack(frame)})
+    return {"traces": out}
+
+
+@route("GET", "/3/SelfBench")
+def _selfbench(params, body):
+    """Node capability probes (water/init/{Linpack,MemoryBandwidth,
+    NetworkBench} role)."""
+    from h2o3_tpu.core.selfcheck import run_self_bench
+    return run_self_bench()
 
 
 @route("GET", "/3/Logs/download")
@@ -423,6 +446,8 @@ class _Handler(BaseHTTPRequestHandler):
         elif body:
             params.update({k: v[0]
                            for k, v in urllib.parse.parse_qs(body).items()})
+        from h2o3_tpu.utils.timeline import record as _tl_record
+        _tl_record("rest", f"{method} {path}")
         for m, rx, fn in ROUTES:
             if m != method:
                 continue

@@ -51,6 +51,8 @@ class Job:
     def start(self, fn: Callable[["Job"], Any], background: bool = False) -> "Job":
         self.status = RUNNING
         self.start_time = time.time()
+        from h2o3_tpu.utils.timeline import record as _tl
+        _tl("job", f"start {self.description}", key=self.key)
 
         def _run():
             try:
@@ -58,8 +60,10 @@ class Job:
                 if self.dest and self.result is not None:
                     DKV.put(self.dest, self.result)
                 self.status = DONE
+                _tl("job", f"done {self.description}", key=self.key)
             except JobCancelledException:
                 self.status = CANCELLED
+                _tl("job", f"cancelled {self.description}", key=self.key)
             except Exception as e:  # noqa: BLE001 - job boundary
                 self.status = FAILED
                 self.exception = "".join(

@@ -36,13 +36,17 @@ class Frame:
     def from_numpy(arrays: Dict[str, np.ndarray],
                    categorical: Sequence[str] = (),
                    domains: Optional[Dict[str, List[str]]] = None,
+                   strings: Sequence[str] = (),
                    key: Optional[str] = None,
                    block: int = 8) -> "Frame":
         """Build a Frame from host columns (upload path, POST /3/ParseSetup).
 
         ``categorical`` forces listed columns to T_CAT; ``domains`` supplies
-        pre-interned level lists for integer-coded categorical columns.
+        pre-interned level lists for integer-coded categorical columns;
+        ``strings`` keeps listed columns as host-side T_STR (no interning
+        — the CStrChunk role, never entering math paths).
         """
+        from h2o3_tpu.frame.column import Column, T_STR
         names = list(arrays.keys())
         n = len(next(iter(arrays.values()))) if names else 0
         npad = mesh_mod.padded_rows(n, block=block)
@@ -50,6 +54,11 @@ class Frame:
         cols = []
         for name in names:
             v = np.asarray(arrays[name])
+            if name in strings:
+                cols.append(Column(name=name, type=T_STR, data=None,
+                                   na_mask=None, nrows=n,
+                                   strings=v.astype(object)))
+                continue
             dom = (domains or {}).get(name)
             if name in categorical and dom is None and v.dtype.kind not in "OUS":
                 import pandas as pd

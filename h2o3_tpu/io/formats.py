@@ -1,0 +1,119 @@
+"""Additional ingest formats — SVMLight and ARFF.
+
+Reference: water/parser/SVMLightParser.java and ARFFParser.java (both
+built-in parser types next to CSV; water/parser/ParseSetup.java
+auto-detects them from content). Both decode on the host into dense
+columns — the reference likewise densifies SVMLight into a Frame whose
+trailing columns are zero-filled.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from h2o3_tpu.frame.frame import Frame
+
+
+def parse_svmlight(text: str, key: Optional[str] = None) -> Frame:
+    """``label idx:val idx:val …`` lines → dense Frame with a C0
+    target column (1-based feature indices, reference SVMLightParser)."""
+    labels: List[float] = []
+    rows: List[Dict[int, float]] = []
+    max_idx = 0
+    for line in text.splitlines():
+        line = line.split("#", 1)[0].strip()
+        if not line:
+            continue
+        parts = line.split()
+        labels.append(float(parts[0]))
+        row: Dict[int, float] = {}
+        for tok in parts[1:]:
+            if tok.startswith("qid:"):
+                continue
+            idx, val = tok.split(":", 1)
+            i = int(idx)
+            if i < 1:
+                raise ValueError(f"SVMLight indices are 1-based, got {i}")
+            row[i] = float(val)
+            max_idx = max(max_idx, i)
+        rows.append(row)
+    n = len(rows)
+    dense = np.zeros((n, max_idx), dtype=np.float64)
+    for r, row in enumerate(rows):
+        for i, v in row.items():
+            dense[r, i - 1] = v
+    cols = {"C0": np.asarray(labels)}
+    for j in range(max_idx):
+        cols[f"C{j + 1}"] = dense[:, j]
+    return Frame.from_numpy(cols, key=key)
+
+
+_ARFF_ATTR = re.compile(r"@attribute\s+('?[^'\s]+'?)\s+(.+)", re.IGNORECASE)
+
+
+def parse_arff(text: str, key: Optional[str] = None) -> Frame:
+    """ARFF (@relation/@attribute/@data) → Frame with nominal attributes
+    interned as categoricals (reference ARFFParser)."""
+    names: List[str] = []
+    kinds: List[Tuple[str, Optional[List[str]]]] = []  # (numeric|nominal|string, levels)
+    data_lines: List[str] = []
+    in_data = False
+    for line in text.splitlines():
+        s = line.strip()
+        if not s or s.startswith("%"):
+            continue
+        low = s.lower()
+        if in_data:
+            data_lines.append(s)
+            continue
+        if low.startswith("@relation"):
+            continue
+        if low.startswith("@attribute"):
+            m = _ARFF_ATTR.match(s)
+            if not m:
+                raise ValueError(f"bad @attribute line: {s}")
+            name = m.group(1).strip("'")
+            spec = m.group(2).strip()
+            if spec.startswith("{"):
+                levels = [v.strip().strip("'") for v in
+                          spec.strip("{}").split(",")]
+                kinds.append(("nominal", levels))
+            elif spec.lower() in ("numeric", "real", "integer"):
+                kinds.append(("numeric", None))
+            elif spec.lower() == "string":
+                kinds.append(("string", None))
+            else:   # date etc → treat as string
+                kinds.append(("string", None))
+            names.append(name)
+            continue
+        if low.startswith("@data"):
+            in_data = True
+    if not in_data:
+        raise ValueError("no @data section")
+
+    n = len(data_lines)
+    cols: Dict[str, np.ndarray] = {}
+    raw = [ln.split(",") for ln in data_lines]
+    cats: List[str] = []
+    strs: List[str] = []
+    domains: Dict[str, List[str]] = {}
+    for j, (name, (kind, levels)) in enumerate(zip(names, kinds)):
+        vals = [r[j].strip().strip("'") if j < len(r) else "?" for r in raw]
+        if kind == "numeric":
+            cols[name] = np.asarray(
+                [np.nan if v == "?" else float(v) for v in vals])
+        elif kind == "nominal":
+            lut = {lvl: i for i, lvl in enumerate(levels)}
+            cols[name] = np.asarray(
+                [lut.get(v, -1) for v in vals], dtype=np.int32)
+            cats.append(name)
+            domains[name] = levels
+        else:
+            cols[name] = np.asarray(
+                [None if v == "?" else v for v in vals], dtype=object)
+            strs.append(name)
+    return Frame.from_numpy(cols, categorical=cats, domains=domains,
+                            strings=strs, key=key)

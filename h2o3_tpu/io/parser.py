@@ -58,6 +58,15 @@ def import_file(path: str, destination_frame: Optional[str] = None,
     if not paths:
         raise FileNotFoundError(path)
 
+    # SVMLight / ARFF (water/parser/{SVMLightParser,ARFFParser} roles)
+    if all(f.endswith((".svm", ".svmlight")) for f in paths):
+        from h2o3_tpu.io.formats import parse_svmlight
+        text = "\n".join(open(f).read() for f in paths)
+        return parse_svmlight(text, key=destination_frame)
+    if len(paths) == 1 and paths[0].endswith(".arff"):
+        from h2o3_tpu.io.formats import parse_arff
+        return parse_arff(open(paths[0]).read(), key=destination_frame)
+
     # CSV goes through the native multithreaded tokenizer
     # (h2o3_tpu/native/csv_parser.cpp — the water/parser CsvParser role);
     # anything else (parquet, zip containers, unknown extensions) and any

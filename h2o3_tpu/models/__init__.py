@@ -9,6 +9,9 @@ instantiate builders by name.
 from typing import Dict
 
 _REGISTRY: Dict[str, type] = {}
+_COMPLETE = False   # _REGISTRY may be partially filled by direct imports
+                    # of @register-decorated modules; only _auto_register
+                    # makes it complete
 
 
 def register(cls):
@@ -18,6 +21,8 @@ def register(cls):
 
 def _auto_register():
     """Populate the registry from the standard estimator modules."""
+    global _COMPLETE
+    _COMPLETE = True
     from h2o3_tpu.models.aggregator import AggregatorEstimator
     from h2o3_tpu.models.coxph import CoxPHEstimator
     from h2o3_tpu.models.deeplearning import DeepLearningEstimator
@@ -41,6 +46,7 @@ def _auto_register():
     from h2o3_tpu.models.targetencoder import TargetEncoderEstimator
     from h2o3_tpu.models.uplift import UpliftDRFEstimator
     from h2o3_tpu.models.word2vec import Word2VecEstimator
+    from h2o3_tpu.models.xgboost import XGBoostEstimator
     for cls in (AggregatorEstimator, ANOVAGLMEstimator, CoxPHEstimator,
                 DeepLearningEstimator,
                 DRFEstimator, GAMEstimator, GBMEstimator, GenericEstimator,
@@ -51,13 +57,13 @@ def _auto_register():
                 PSVMEstimator, RuleFitEstimator, SVDEstimator,
                 TargetEncoderEstimator,
                 ExtendedIsolationForestEstimator, UpliftDRFEstimator,
-                Word2VecEstimator):
+                Word2VecEstimator, XGBoostEstimator):
         _REGISTRY[cls.algo] = cls
 
 
 def get_builder(algo: str):
     """Builder class by algo name (ModelBuilder.make analogue)."""
-    if not _REGISTRY:
+    if not _COMPLETE:
         _auto_register()
     key = algo.lower().replace("_", "")
     if key not in _REGISTRY:
@@ -66,6 +72,6 @@ def get_builder(algo: str):
 
 
 def all_algos():
-    if not _REGISTRY:
+    if not _COMPLETE:
         _auto_register()
     return sorted(_REGISTRY)

@@ -429,7 +429,7 @@ class GBMEstimator(ModelBuilder):
                 val_margin = ckpt._margins(vbm).astype(jnp.float32)
             else:
                 val_margin = jnp.full((vbm.bins.shape[0],), f0, jnp.float32)
-            if not stopper.enabled and vbm is None:
+            if not stopper.enabled:   # vbm only exists when stopping is on
                 # boosting loop as compiled scans over tree chunks — the
                 # per-tree host round trip (dominant on a remote chip)
                 # amortizes over CHUNK trees, while the inter-chunk

@@ -26,7 +26,7 @@ import numpy as np
 from h2o3_tpu.frame.binning import BinnedMatrix, bin_frame, rebin_for_scoring
 from h2o3_tpu.frame.frame import Frame
 from h2o3_tpu.models.model import Model, ModelBuilder, ModelCategory
-from h2o3_tpu.models.tree import Tree, stack_trees
+from h2o3_tpu.models.tree import Tree, row_feature_values, stack_trees
 from h2o3_tpu.ops.segments import segment_sum
 from h2o3_tpu.parallel.mesh import get_mesh
 
@@ -68,7 +68,7 @@ def _grow_random_tree(bins, nb, w, key, *, depth: int, B: int):
         f_r = feats[d][nid]
         t_r = threshs[d][nid]
         nal_r = na_lefts[d][nid]
-        b_r = jnp.take_along_axis(bins, f_r[:, None], axis=1)[:, 0]
+        b_r = row_feature_values(bins, f_r)
         isna = b_r == (B - 1)
         goleft = jnp.where(is_splits[d][nid],
                            jnp.where(isna, nal_r, b_r <= t_r), True)
@@ -90,7 +90,7 @@ def _tree_path_length(tree: Tree, bins, B: int):
         f_r = tree.feat[d][nid]
         t_r = tree.thresh[d][nid]
         nal_r = tree.na_left[d][nid]
-        b_r = jnp.take_along_axis(bins, f_r[:, None], axis=1)[:, 0]
+        b_r = row_feature_values(bins, f_r)
         isna = b_r == (B - 1)
         goleft = jnp.where(isp_r, jnp.where(isna, nal_r, b_r <= t_r), True)
         nid = 2 * nid + jnp.where(goleft, 0, 1)

@@ -10,8 +10,10 @@ scalar math.
 
 from __future__ import annotations
 
+from functools import partial
 from typing import Dict, List, Optional
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 
@@ -20,13 +22,26 @@ from h2o3_tpu.parallel.mesh import get_mesh
 
 AUC_NBINS = 400  # hex/AUC2.java:24
 
+# Every metric runs ONE jitted device pass (the MetricBuilder-inside-
+# MRTask single sweep) and finishes scalars on host — un-jitted
+# shard_maps would re-lower per call, which dominates wall time on a
+# remote-attached chip.
 
-def _auc_histograms(p, y, w, mesh):
-    """Weighted positive/negative count per probability bin (AUC2 scheme)."""
-    bins = jnp.clip((p * AUC_NBINS).astype(jnp.int32), 0, AUC_NBINS - 1)
-    vals = jnp.stack([w * y, w * (1.0 - y)], axis=1)
-    hist = segment_sum(bins, vals, n_nodes=AUC_NBINS, mesh=mesh)
-    return np.asarray(hist[:, 0]), np.asarray(hist[:, 1])
+
+@partial(jax.jit, static_argnames=("mesh",))
+def _binomial_pass(p, y, w, *, mesh):
+    pc = jnp.clip(p, 1e-7, 1 - 1e-7)
+    sums = segment_sum(
+        jnp.zeros_like(y, jnp.int32),
+        jnp.stack([w,
+                   w * (p - y) ** 2,
+                   -w * (y * jnp.log(pc) + (1 - y) * jnp.log(1 - pc)),
+                   w * y], axis=1),
+        n_nodes=1, mesh=mesh)
+    bins = jnp.clip((pc * AUC_NBINS).astype(jnp.int32), 0, AUC_NBINS - 1)
+    hist = segment_sum(bins, jnp.stack([w * y, w * (1.0 - y)], axis=1),
+                       n_nodes=AUC_NBINS, mesh=mesh)
+    return sums[0], hist
 
 
 def _auc_from_hist(pos: np.ndarray, neg: np.ndarray) -> Dict[str, float]:
@@ -87,16 +102,10 @@ def binomial_metrics(p, y, w=None, mesh=None) -> ModelMetrics:
     p = jnp.asarray(p, jnp.float32)
     y = jnp.asarray(y, jnp.float32)
     w = jnp.ones_like(p) if w is None else jnp.asarray(w, jnp.float32)
-    pc = jnp.clip(p, 1e-7, 1 - 1e-7)
-    sums = segment_sum(
-        jnp.zeros_like(y, jnp.int32),
-        jnp.stack([w,
-                   w * (p - y) ** 2,
-                   -w * (y * jnp.log(pc) + (1 - y) * jnp.log(1 - pc)),
-                   w * y], axis=1),
-        n_nodes=1, mesh=mesh)
-    tot, sse, ll, pos = (float(x) for x in np.asarray(sums[0]))
-    pos_h, neg_h = _auc_histograms(pc, y, w, mesh)
+    sums, hist = _binomial_pass(p, y, w, mesh=mesh)
+    tot, sse, ll, pos = (float(x) for x in np.asarray(sums))
+    hist = np.asarray(hist)
+    pos_h, neg_h = hist[:, 0], hist[:, 1]
     roc = _auc_from_hist(pos_h, neg_h)
     t = roc["max_f1_threshold"]
     # confusion at max-F1 threshold (reference default criterion)
@@ -115,6 +124,24 @@ def binomial_metrics(p, y, w=None, mesh=None) -> ModelMetrics:
         positive_fraction=pos / max(tot, 1e-12))
 
 
+@partial(jax.jit, static_argnames=("mesh",))
+def _multinomial_pass(probs, y, w, *, mesh):
+    K = probs.shape[1]
+    py = jnp.clip(jnp.take_along_axis(probs, y[:, None], axis=1)[:, 0],
+                  1e-7, 1.0)
+    pred = jnp.argmax(probs, axis=1).astype(jnp.int32)
+    onehot_err = (pred != y).astype(jnp.float32)
+    sse = jnp.sum((probs - (jnp.arange(K)[None, :] == y[:, None])) ** 2,
+                  axis=1)
+    sums = segment_sum(
+        jnp.zeros_like(y), jnp.stack([w, -w * jnp.log(py), w * onehot_err,
+                                      w * sse], axis=1),
+        n_nodes=1, mesh=mesh)
+    cm = segment_sum((y * K + pred).astype(jnp.int32), w[:, None],
+                     n_nodes=K * K, mesh=mesh)
+    return sums[0], cm
+
+
 def multinomial_metrics(probs, y, w=None, mesh=None,
                         domain: Optional[List[str]] = None) -> ModelMetrics:
     """hex/ModelMetricsMultinomial.java: logloss, per-class error, CM."""
@@ -122,19 +149,8 @@ def multinomial_metrics(probs, y, w=None, mesh=None,
     K = probs.shape[1]
     y = jnp.asarray(y, jnp.int32)
     w = jnp.ones(probs.shape[0], jnp.float32) if w is None else jnp.asarray(w, jnp.float32)
-    py = jnp.clip(jnp.take_along_axis(probs, y[:, None], axis=1)[:, 0],
-                  1e-7, 1.0)
-    pred = jnp.argmax(probs, axis=1).astype(jnp.int32)
-    onehot_err = (pred != y).astype(jnp.float32)
-    sse = jnp.sum((probs - (jnp.arange(K)[None, :] == y[:, None])) ** 2, axis=1)
-    sums = segment_sum(
-        jnp.zeros_like(y), jnp.stack([w, -w * jnp.log(py), w * onehot_err,
-                                      w * sse], axis=1),
-        n_nodes=1, mesh=mesh)
-    tot, ll, err, sse_t = (float(x) for x in np.asarray(sums[0]))
-    # confusion matrix via segment over true*K+pred
-    cm = segment_sum((y * K + pred).astype(jnp.int32), w[:, None],
-                     n_nodes=K * K, mesh=mesh)
+    sums, cm = _multinomial_pass(probs, y, w, mesh=mesh)
+    tot, ll, err, sse_t = (float(x) for x in np.asarray(sums))
     cm = np.asarray(cm).reshape(K, K)
     row = cm.sum(axis=1)
     per_class_err = np.where(row > 0, 1.0 - np.diag(cm) / np.maximum(row, 1e-12), 0.0)
@@ -147,6 +163,20 @@ def multinomial_metrics(probs, y, w=None, mesh=None,
         domain=domain)
 
 
+@partial(jax.jit, static_argnames=("mesh",))
+def _regression_pass(pred, y, w, dev, *, mesh):
+    ok_log = (y > -1) & (pred > -1)
+    rmsle_term = jnp.where(ok_log,
+                           (jnp.log1p(jnp.maximum(pred, -1 + 1e-12))
+                            - jnp.log1p(jnp.maximum(y, -1 + 1e-12))) ** 2, 0.0)
+    sums = segment_sum(
+        jnp.zeros(y.shape[0], jnp.int32),
+        jnp.stack([w, w * (y - pred) ** 2, w * jnp.abs(y - pred),
+                   w * rmsle_term, w * y, w * y * y, w * dev], axis=1),
+        n_nodes=1, mesh=mesh)
+    return sums[0]
+
+
 def regression_metrics(pred, y, w=None, mesh=None,
                        deviance_fn=None) -> ModelMetrics:
     """hex/ModelMetricsRegression.java: MSE/MAE/RMSLE/deviance/R2."""
@@ -154,17 +184,12 @@ def regression_metrics(pred, y, w=None, mesh=None,
     pred = jnp.asarray(pred, jnp.float32)
     y = jnp.asarray(y, jnp.float32)
     w = jnp.ones_like(y) if w is None else jnp.asarray(w, jnp.float32)
-    ok_log = (y > -1) & (pred > -1)
-    rmsle_term = jnp.where(ok_log,
-                           (jnp.log1p(jnp.maximum(pred, -1 + 1e-12))
-                            - jnp.log1p(jnp.maximum(y, -1 + 1e-12))) ** 2, 0.0)
+    # deviance_fn is a fresh lambda per call — evaluate it outside the
+    # jitted pass so the pass's trace cache never misses
     dev = deviance_fn(y, pred) if deviance_fn is not None else (y - pred) ** 2
-    sums = segment_sum(
-        jnp.zeros(y.shape[0], jnp.int32),
-        jnp.stack([w, w * (y - pred) ** 2, w * jnp.abs(y - pred),
-                   w * rmsle_term, w * y, w * y * y, w * dev], axis=1),
-        n_nodes=1, mesh=mesh)
-    tot, sse, sae, sle, sy, syy, sdev = (float(x) for x in np.asarray(sums[0]))
+    sums = _regression_pass(pred, y, w, jnp.asarray(dev, jnp.float32),
+                            mesh=mesh)
+    tot, sse, sae, sle, sy, syy, sdev = (float(x) for x in np.asarray(sums))
     mse = sse / max(tot, 1e-12)
     var_y = syy / max(tot, 1e-12) - (sy / max(tot, 1e-12)) ** 2
     return ModelMetrics(

@@ -24,6 +24,7 @@ import numpy as np
 from h2o3_tpu.frame.binning import rebin_for_scoring
 from h2o3_tpu.frame.frame import Frame
 from h2o3_tpu.models.model import Model, ModelBuilder, ModelCategory, infer_category
+from h2o3_tpu.models.tree import row_feature_values
 from h2o3_tpu.utils.log import get_logger
 
 log = get_logger("h2o3_tpu.rulefit")
@@ -39,7 +40,7 @@ def _route_nids(tree, bins, B: int):
         t_r = tree.thresh[d][nid]
         nal_r = tree.na_left[d][nid]
         isp_r = tree.is_split[d][nid]
-        b_r = jnp.take_along_axis(bins, f_r[:, None], axis=1)[:, 0]
+        b_r = row_feature_values(bins, f_r)
         isna = b_r == (B - 1)
         goleft = jnp.where(isp_r, jnp.where(isna, nal_r, b_r <= t_r), True)
         nid = 2 * nid + jnp.where(goleft, 0, 1)

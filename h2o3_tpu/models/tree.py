@@ -55,6 +55,17 @@ class TreeParams:
     block_rows: int = 16384
 
 
+def row_feature_values(bins, f_r):
+    """``bins[i, f_r[i]]`` without a gather.
+
+    On TPU ``take_along_axis`` lowers to a gather (~11 ms per call on 1M
+    rows, v5e); the masked feature-sum is pure VPU broadcast work (<1 ms)
+    — this select runs once per tree level, so it dominates routing cost.
+    """
+    iota = jnp.arange(bins.shape[1], dtype=jnp.int32)
+    return jnp.sum(jnp.where(f_r[:, None] == iota[None, :], bins, 0), axis=1)
+
+
 def _best_splits(hist, nb, col_mask, params: TreeParams):
     """Vectorized DTree.findBestSplitPoint over all nodes of a level.
 
@@ -162,7 +173,7 @@ def grow_tree(bins, nb, w, g, h, col_mask, *, params: TreeParams, mesh,
         t_r = threshs[d][nid]
         nal_r = na_lefts[d][nid]
         isp_r = is_splits[d][nid]
-        b_r = jnp.take_along_axis(bins, f_r[:, None], axis=1)[:, 0]
+        b_r = row_feature_values(bins, f_r)
         isna = b_r == (B - 1)
         goleft = jnp.where(isp_r,
                            jnp.where(isna, nal_r, b_r <= t_r),
@@ -190,7 +201,7 @@ def predict_tree(tree: Tree, bins, B: int):
         t_r = tree.thresh[d][nid]
         nal_r = tree.na_left[d][nid]
         isp_r = tree.is_split[d][nid]
-        b_r = jnp.take_along_axis(bins, f_r[:, None], axis=1)[:, 0]
+        b_r = row_feature_values(bins, f_r)
         isna = b_r == (B - 1)
         goleft = jnp.where(isp_r, jnp.where(isna, nal_r, b_r <= t_r), True)
         nid = 2 * nid + jnp.where(goleft, 0, 1)

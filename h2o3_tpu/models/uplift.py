@@ -28,7 +28,8 @@ from h2o3_tpu.frame.binning import BinnedMatrix, bin_frame, rebin_for_scoring
 from h2o3_tpu.frame.frame import Frame
 from h2o3_tpu.models import metrics as mm
 from h2o3_tpu.models.model import Model, ModelBuilder, ModelCategory, adapt_domain
-from h2o3_tpu.models.tree import Tree, _mtries_mask, predict_forest, stack_trees
+from h2o3_tpu.models.tree import (Tree, _mtries_mask, predict_forest,
+                                  row_feature_values, stack_trees)
 from h2o3_tpu.ops.histogram import histogram
 from h2o3_tpu.ops.segments import segment_sum
 from h2o3_tpu.parallel.mesh import get_mesh
@@ -145,7 +146,7 @@ def _grow_uplift_tree(bins, nb, w, y, treat, key, *, depth: int, B: int,
         t_r = threshs[d][nid]
         nal_r = na_lefts[d][nid]
         isp_r = is_splits[d][nid]
-        b_r = jnp.take_along_axis(bins, f_r[:, None], axis=1)[:, 0]
+        b_r = row_feature_values(bins, f_r)
         isna = b_r == (B - 1)
         goleft = jnp.where(isp_r, jnp.where(isna, nal_r, b_r <= t_r), True)
         nid = 2 * nid + jnp.where(goleft, 0, 1)

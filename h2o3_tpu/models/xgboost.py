@@ -1,0 +1,100 @@
+"""XGBoost-compatible facade over the native histogram GBM.
+
+Reference: h2o-extensions/xgboost (hex/tree/xgboost/XGBoost.java:43) —
+in H2O the "XGBoost" algo converts Frames to DMatrix and drives the
+native C++ library over JNI with a Rabit allreduce tracker
+(rabit/RabitTrackerH2O.java). Per SURVEY §2.4 item 4 the whole native
+subsystem collapses on TPU: our hist-GBM already IS the
+histogram-method gradient booster with psum as the allreduce, so the
+extension reduces to a parameter-translation layer (the reference's
+own hist trees and ours share the XGBoost-style Newton-gain split
+criterion, models/tree.py).
+
+Param mapping (hex/schemas/XGBoostV3 names → GBM):
+  ntrees/nrounds → ntrees          eta/learn_rate → learn_rate
+  max_depth → max_depth            reg_lambda → reg_lambda
+  subsample/sample_rate → sample_rate
+  colsample_bytree/col_sample_rate_per_tree → col_sample_rate_per_tree
+  min_rows/min_child_weight → min_rows
+  max_bins → nbins                 gamma/min_split_improvement → m_s_i
+Accepted-but-inert knobs (booster variants, DART, GPU ids) follow the
+reference's behavior of ignoring what the backend doesn't support.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+from h2o3_tpu.frame.frame import Frame
+from h2o3_tpu.models import register
+from h2o3_tpu.models.gbm import GBMEstimator
+from h2o3_tpu.models.model import ModelBuilder
+from h2o3_tpu.utils.log import get_logger
+
+log = get_logger("h2o3_tpu.xgboost")
+
+_DIRECT = {"ntrees", "max_depth", "seed", "nfolds", "weights_column",
+           "fold_column", "fold_assignment", "ignored_columns",
+           "stopping_rounds", "stopping_metric", "stopping_tolerance",
+           "distribution", "min_rows", "learn_rate", "sample_rate",
+           "reg_lambda", "col_sample_rate_per_tree", "nbins"}
+
+_ALIASES = {
+    "nrounds": "ntrees",
+    "eta": "learn_rate",
+    "learn_rate": "learn_rate",
+    "subsample": "sample_rate",
+    "colsample_bytree": "col_sample_rate_per_tree",
+    "min_child_weight": "min_rows",
+    "max_bins": "nbins",
+    "gamma": "min_split_improvement",
+    "min_split_improvement": "min_split_improvement",
+    "reg_lambda": "reg_lambda",
+    "lambda_": "reg_lambda",
+}
+
+# accepted for wire compatibility, no effect on the TPU backend
+_INERT = {"booster", "tree_method", "grow_policy", "backend", "gpu_id",
+          "dmatrix_type", "categorical_encoding", "score_tree_interval",
+          "colsample_bylevel", "col_sample_rate", "reg_alpha",
+          "scale_pos_weight", "max_leaves", "sample_type",
+          "normalize_type", "rate_drop", "one_drop", "skip_drop",
+          "nthread", "save_matrix_directory", "calibrate_model",
+          "max_delta_step", "monotone_constraints", "interaction_constraints"}
+
+
+@register
+class XGBoostEstimator(ModelBuilder):
+    """h2o-py H2OXGBoostEstimator surface
+    (h2o-py/h2o/estimators/xgboost.py) mapped onto the native TPU GBM."""
+
+    algo = "xgboost"
+
+    def __init__(self, **params):
+        gbm_params = {}
+        ignored = []
+        for k, v in params.items():
+            if k in _ALIASES:
+                gbm_params[_ALIASES[k]] = v
+            elif k in _DIRECT:
+                gbm_params[k] = v
+            elif k in _INERT:
+                ignored.append(k)
+            else:
+                raise ValueError(f"unknown XGBoost param: {k}")
+        if ignored:
+            log.info("XGBoost params accepted but inert on TPU backend: %s",
+                     sorted(ignored))
+        self._gbm = GBMEstimator(**gbm_params)
+        super().__init__(**params)
+
+    def train(self, training_frame: Frame, y: Optional[str] = None,
+              x: Optional[Sequence[str]] = None,
+              validation_frame: Optional[Frame] = None,
+              background: bool = False, dest_key: Optional[str] = None):
+        model = self._gbm.train(training_frame, y=y, x=x,
+                                validation_frame=validation_frame,
+                                background=background, dest_key=dest_key)
+        if not background:
+            model.output["facade"] = "xgboost"
+        return model

@@ -108,7 +108,3 @@ def pallas_local_histogram(bins, nid, stats, n_nodes: int, n_bins: int,
         interpret=interpret,
     )(bins, nid.reshape(-1, 1), stats)
     return out.reshape(n_nodes, 3, F, n_bins).transpose(0, 2, 3, 1)
-
-
-def pallas_available() -> bool:
-    return jax.default_backend() == "tpu"

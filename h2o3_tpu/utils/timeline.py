@@ -1,0 +1,49 @@
+"""TimeLine — lock-free-ish ring buffer of runtime events.
+
+Reference: water/TimeLine.java:22 — an Unsafe-based ring recording every
+UDP/TCP packet cheaply, snapshotable cloud-wide via GET /3/Timeline
+(water/init/TimelineSnapshot.java). The TPU runtime has no packet layer
+to tap, so the recorded events are the runtime's own control-plane
+moments: REST requests, job lifecycle, parse/train milestones, and
+collective-heavy program dispatches. Recording must stay cheap enough
+to leave on always (the reference's design constraint).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import Dict, List, Optional
+
+_CAPACITY = 2048
+_events: deque = deque(maxlen=_CAPACITY)
+_lock = threading.Lock()
+_seq = 0
+
+
+def record(kind: str, what: str, **info) -> None:
+    """Append one event (TimeLine.record_IOclose-style cheap append)."""
+    global _seq
+    with _lock:
+        _seq += 1
+        _events.append({"seq": _seq, "ts_ms": int(time.time() * 1000),
+                        "kind": kind, "what": what, **info})
+
+
+def snapshot(last: Optional[int] = None) -> List[Dict]:
+    """Consistent copy of the ring (TimelineSnapshot role)."""
+    with _lock:
+        evs = list(_events)
+    try:
+        n = int(last) if last is not None else 0
+    except (TypeError, ValueError):
+        n = 0
+    if n > 0:
+        evs = evs[-n:]
+    return evs
+
+
+def clear() -> None:
+    with _lock:
+        _events.clear()

@@ -1,0 +1,84 @@
+"""XGBoost facade, SVMLight/ARFF ingest, self-bench, TimeLine."""
+
+import numpy as np
+import pytest
+
+import h2o3_tpu
+from tests.conftest import make_classification
+
+
+def test_xgboost_facade_param_translation(classif_frame):
+    from h2o3_tpu.models.xgboost import XGBoostEstimator
+    m = XGBoostEstimator(ntrees=8, eta=0.2, max_depth=4, subsample=0.9,
+                         colsample_bytree=0.8, min_child_weight=5,
+                         reg_lambda=2.0, booster="gbtree",
+                         tree_method="hist", seed=3).train(
+        classif_frame, y="y")
+    assert m.algo == "gbm"
+    assert m.output["facade"] == "xgboost"
+    assert m.params["learn_rate"] == 0.2
+    assert m.params["sample_rate"] == 0.9
+    assert m.params["min_rows"] == 5
+    assert m.training_metrics["AUC"] > 0.7
+
+
+def test_xgboost_facade_registry():
+    from h2o3_tpu.models import get_builder
+    assert get_builder("xgboost").algo == "xgboost"
+    with pytest.raises(ValueError):
+        get_builder("xgboost")(definitely_not_a_param=1)
+
+
+def test_svmlight_parse(tmp_path):
+    p = tmp_path / "t.svm"
+    p.write_text("1 1:0.5 3:2.0\n-1 2:1.5 # comment\n1 qid:7 1:1.0 4:4.0\n")
+    fr = h2o3_tpu.import_file(str(p))
+    assert fr.shape == (3, 5)   # C0 label + C1..C4
+    np.testing.assert_array_equal(fr.col("C0").to_numpy(), [1, -1, 1])
+    np.testing.assert_array_equal(fr.col("C3").to_numpy(), [2.0, 0.0, 0.0])
+    np.testing.assert_array_equal(fr.col("C4").to_numpy(), [0.0, 0.0, 4.0])
+
+
+def test_arff_parse(tmp_path):
+    p = tmp_path / "t.arff"
+    p.write_text("""% comment
+@relation demo
+@attribute sepal numeric
+@attribute color {red, green, blue}
+@attribute note string
+@data
+5.1,red,'hello'
+4.9,blue,?
+?,green,world
+""")
+    fr = h2o3_tpu.import_file(str(p))
+    assert fr.shape == (3, 3)
+    assert fr.col("color").domain == ["red", "green", "blue"]
+    x = fr.col("sepal").to_numpy()
+    assert np.isnan(x[2]) and x[0] == pytest.approx(5.1)
+    assert fr.col("note").type == "string"
+
+
+def test_self_bench_probes():
+    from h2o3_tpu.core.selfcheck import run_self_bench
+    out = run_self_bench(sizes={"matmul": 256, "membw": 1 << 18,
+                                "transfer": 1 << 18})
+    assert out["matmul_f32_gflops"] > 0
+    assert out["hbm_read_gbps"] > 0
+    assert out["h2d_gbps"] > 0 and out["d2h_gbps"] > 0
+
+
+def test_timeline_records_jobs(classif_frame):
+    from h2o3_tpu.utils import timeline
+    from h2o3_tpu.models.gbm import GBMEstimator
+    timeline.clear()
+    GBMEstimator(ntrees=2, max_depth=2, seed=1).train(classif_frame, y="y")
+    evs = timeline.snapshot()
+    kinds = [(e["kind"], e["what"].split()[0]) for e in evs]
+    assert ("job", "start") in kinds and ("job", "done") in kinds
+    # ring keeps order and caps capacity
+    for _ in range(3000):
+        timeline.record("test", "x")
+    evs = timeline.snapshot()
+    assert len(evs) == 2048
+    assert evs[-1]["seq"] > evs[0]["seq"]
