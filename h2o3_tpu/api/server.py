@@ -216,7 +216,7 @@ def _builders(params, body):
                      "parameters": [
                          {"name": k, "default_value": d,
                           "type": type(d).__name__}
-                         for k, d in cls.DEFAULTS.items()]}
+                         for k, d in getattr(cls, "DEFAULTS", {}).items()]}
     return {"model_builders": out}
 
 
@@ -233,7 +233,7 @@ def _train(params, body, algo=None):
     if not isinstance(fr, Frame):
         raise KeyError(f"training_frame {frame_key} not found")
     vf = DKV.get(str(valid_key)) if valid_key else None
-    known = set(cls.DEFAULTS)
+    known = cls.accepted_params()
     builder_params = {k: v for k, v in p.items() if k in known}
     if ignored is not None:
         builder_params["ignored_columns"] = ignored

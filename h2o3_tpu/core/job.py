@@ -68,6 +68,8 @@ class Job:
                 self.status = FAILED
                 self.exception = "".join(
                     traceback.format_exception(type(e), e, e.__traceback__))
+                _tl("job", f"failed {self.description}", key=self.key,
+                    error=str(e)[:200])
                 log.error("job %s failed: %s", self.key, e)
                 if not background:
                     raise

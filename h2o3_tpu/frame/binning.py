@@ -16,6 +16,7 @@ and never win a split because their counts are zero.
 from __future__ import annotations
 
 import dataclasses
+from functools import partial
 from typing import List, Optional, Sequence
 
 import jax
@@ -23,6 +24,35 @@ import jax.numpy as jnp
 import numpy as np
 
 from h2o3_tpu.frame.frame import Frame
+
+
+@partial(jax.jit, static_argnames=("B", "is_cat_t", "nb_t", "has_remap_t"))
+def _bin_device(datas, nas, remaps, edges, *, B: int, is_cat_t: tuple,
+                nb_t: tuple, has_remap_t: tuple):
+    """All columns → one [Npad, F] int32 bin matrix in ONE compiled
+    program (the per-column eager version re-dispatched ~6 ops/column
+    through the runtime, dominating cold parse+train time)."""
+    cols = []
+    for i, is_cat in enumerate(is_cat_t):
+        na = nas[i]
+        if is_cat:
+            code = datas[i].astype(jnp.int32)
+            if has_remap_t[i]:
+                code = remaps[i][jnp.clip(code, 0, remaps[i].shape[0] - 1)]
+                na = na | (code < 0)
+                code = jnp.maximum(code, 0)
+            nb_i = nb_t[i]
+            b = jnp.where(code >= nb_i, code % nb_i, code)
+            b = jnp.where(na, B - 1, b)
+        else:
+            x = jnp.where(na, jnp.nan, datas[i].astype(jnp.float32))
+            # bin = #edges <= x; vectorized compare-reduce (MXU-friendly,
+            # no gather) — the hot loop of ScoreBuildHistogram2's bin()
+            b = jnp.sum((x[:, None] >= edges[i][None, :]).astype(jnp.int32),
+                        axis=1)
+            b = jnp.where(na, B - 1, b)
+        cols.append(b.astype(jnp.int32))
+    return jnp.stack(cols, axis=1)
 
 
 @dataclasses.dataclass
@@ -116,35 +146,32 @@ def bin_frame(frame: Frame, features: Sequence[str], nbins: int = 64,
     edges_dev = jax.device_put(edges)
     nb_dev = jax.device_put(nb)
 
-    bins_cols = []
+    # one jitted pass over all columns (retraces per frame schema only)
+    datas, nas, remaps = [], [], []
+    has_remap = []
     for i, c in enumerate(cols):
-        if is_cat[i]:
-            code = c.data.astype(jnp.int32)
-            na_extra = c.na_mask
-            if train_domains is not None and train_domains[i] is not None \
-                    and c.domain != train_domains[i]:
-                lut = {lvl: j for j, lvl in enumerate(train_domains[i])}
-                mapping = np.array([lut.get(lvl, -1) for lvl in (c.domain or [])],
-                                   dtype=np.int32)
-                if len(mapping) == 0:
-                    mapping = np.array([-1], dtype=np.int32)
-                code = jax.device_put(mapping)[jnp.clip(code, 0, len(mapping) - 1)]
-                na_extra = na_extra | (code < 0)
-                code = jnp.maximum(code, 0)
-                card = max(len(train_domains[i]), 1)
-            else:
-                card = max(c.cardinality, 1)
-            b = jnp.where(nb[i] < card, jnp.mod(code, nb[i]), code)
-            b = jnp.where(na_extra, B - 1, b)
+        datas.append(c.data)
+        nas.append(c.na_mask)
+        if is_cat[i] and train_domains is not None \
+                and train_domains[i] is not None \
+                and c.domain != train_domains[i]:
+            lut = {lvl: j for j, lvl in enumerate(train_domains[i])}
+            mapping = np.array([lut.get(lvl, -1) for lvl in (c.domain or [])],
+                               dtype=np.int32)
+            if len(mapping) == 0:
+                mapping = np.array([-1], dtype=np.int32)
+            remaps.append(jnp.asarray(mapping))
+            has_remap.append(True)
         else:
-            x = c.numeric_view()
-            # bin = #edges <= x ; vectorized compare-reduce (MXU-friendly,
-            # no gather) — the hot loop of ScoreBuildHistogram2's bin()
-            b = jnp.sum((x[:, None] >= edges_dev[i][None, :]).astype(jnp.int32),
-                        axis=1)
-        b = jnp.where(c.na_mask, B - 1, b)
-        bins_cols.append(b.astype(jnp.int32))
-    bins = jnp.stack(bins_cols, axis=1) if F else jnp.zeros((frame.nrows_padded, 0), jnp.int32)
+            remaps.append(jnp.zeros((1,), jnp.int32))
+            has_remap.append(False)
+    if F:
+        bins = _bin_device(tuple(datas), tuple(nas), tuple(remaps),
+                           edges_dev, B=B, is_cat_t=tuple(bool(v) for v in is_cat),
+                           nb_t=tuple(int(v) for v in nb),
+                           has_remap_t=tuple(has_remap))
+    else:
+        bins = jnp.zeros((frame.nrows_padded, 0), jnp.int32)
     if sharding is not None:
         from h2o3_tpu.parallel.mesh import row_sharding
         bins = jax.device_put(bins, row_sharding())

@@ -51,7 +51,24 @@ def parse_svmlight(text: str, key: Optional[str] = None) -> Frame:
     return Frame.from_numpy(cols, key=key)
 
 
-_ARFF_ATTR = re.compile(r"@attribute\s+('?[^'\s]+'?)\s+(.+)", re.IGNORECASE)
+_ARFF_ATTR = re.compile(r"@attribute\s+('[^']+'|\S+)\s+(.+)", re.IGNORECASE)
+
+
+def _split_arff_row(line: str) -> List[str]:
+    """Comma split honoring single-quoted values (reference ARFFParser
+    quoting rules) — `5.1,'a, b',x` → three fields."""
+    out, cur, q = [], [], False
+    for ch in line:
+        if ch == "'":
+            q = not q
+            cur.append(ch)
+        elif ch == "," and not q:
+            out.append("".join(cur))
+            cur = []
+        else:
+            cur.append(ch)
+    out.append("".join(cur))
+    return out
 
 
 def parse_arff(text: str, key: Optional[str] = None) -> Frame:
@@ -96,7 +113,7 @@ def parse_arff(text: str, key: Optional[str] = None) -> Frame:
 
     n = len(data_lines)
     cols: Dict[str, np.ndarray] = {}
-    raw = [ln.split(",") for ln in data_lines]
+    raw = [_split_arff_row(ln) for ln in data_lines]
     cats: List[str] = []
     strs: List[str] = []
     domains: Dict[str, List[str]] = {}

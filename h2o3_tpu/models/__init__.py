@@ -22,7 +22,6 @@ def register(cls):
 def _auto_register():
     """Populate the registry from the standard estimator modules."""
     global _COMPLETE
-    _COMPLETE = True
     from h2o3_tpu.models.aggregator import AggregatorEstimator
     from h2o3_tpu.models.coxph import CoxPHEstimator
     from h2o3_tpu.models.deeplearning import DeepLearningEstimator
@@ -59,6 +58,8 @@ def _auto_register():
                 ExtendedIsolationForestEstimator, UpliftDRFEstimator,
                 Word2VecEstimator, XGBoostEstimator):
         _REGISTRY[cls.algo] = cls
+    _COMPLETE = True   # only after every import succeeded — a transient
+                       # ImportError must not poison the registry
 
 
 def get_builder(algo: str):

@@ -86,6 +86,7 @@ class GenericEstimator(ModelBuilder):
 
     algo = "generic"
     supervised = False
+    DEFAULTS = {"path": None, "model_key": None}
 
     def __init__(self, **params):
         if "path" not in params and "model_key" not in params:

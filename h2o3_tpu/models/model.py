@@ -171,6 +171,12 @@ class ModelBuilder:
         self.params = params
         self._job: Optional[Job] = None
 
+    @classmethod
+    def accepted_params(cls) -> set:
+        """Parameter names this builder accepts (REST schema filter);
+        DEFAULTS-based by convention, overridable by facades."""
+        return set(getattr(cls, "DEFAULTS", {}))
+
     # -- subclass contract --------------------------------------------
     def _fit(self, frame: Frame, x: Sequence[str], y: Optional[str],
              job: Job, validation_frame: Optional[Frame] = None) -> Model:

@@ -70,6 +70,10 @@ class XGBoostEstimator(ModelBuilder):
 
     algo = "xgboost"
 
+    @classmethod
+    def accepted_params(cls) -> set:
+        return _DIRECT | set(_ALIASES) | _INERT
+
     def __init__(self, **params):
         gbm_params = {}
         ignored = []

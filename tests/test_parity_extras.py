@@ -59,6 +59,38 @@ def test_arff_parse(tmp_path):
     assert fr.col("note").type == "string"
 
 
+def test_arff_quoted_names_and_values(tmp_path):
+    p = tmp_path / "q.arff"
+    p.write_text("""@relation q
+@attribute 'sepal length' numeric
+@attribute label {x, y}
+@attribute note string
+@data
+5.1,x,'a, b'
+4.2,y,plain
+""")
+    fr = h2o3_tpu.import_file(str(p))
+    assert "sepal length" in fr.names
+    assert fr.col("sepal length").to_numpy()[0] == pytest.approx(5.1)
+    assert fr.col("note").to_numpy()[0] == "a, b"
+
+
+def test_xgboost_over_rest(classif_frame):
+    """The facade must be drivable through POST /3/ModelBuilders/xgboost
+    with XGBoost-style params actually applied."""
+    from h2o3_tpu.api.server import ROUTES
+    train = next(fn for m, rx, fn in ROUTES
+                 if m == "POST" and rx.match("/3/ModelBuilders/xgboost"))
+    out = train({"training_frame": classif_frame.key,
+                 "response_column": "y", "ntrees": 4, "eta": 0.3,
+                 "max_depth": 3, "booster": "gbtree"}, "", algo="xgboost")
+    from h2o3_tpu.core.kv import DKV
+    job = DKV.get(out["job"]["key"]).join()
+    assert job.status == "DONE", job.exception
+    m = job.result
+    assert m.params["learn_rate"] == 0.3 and m.params["ntrees"] == 4
+
+
 def test_self_bench_probes():
     from h2o3_tpu.core.selfcheck import run_self_bench
     out = run_self_bench(sizes={"matmul": 256, "membw": 1 << 18,
