@@ -212,11 +212,12 @@ def _builders(params, body):
     out = {}
     for algo in all_algos():
         cls = get_builder(algo)
+        defaults = getattr(cls, "DEFAULTS", {})
         out[algo] = {"algo": algo, "algo_full_name": cls.__name__,
                      "parameters": [
-                         {"name": k, "default_value": d,
-                          "type": type(d).__name__}
-                         for k, d in getattr(cls, "DEFAULTS", {}).items()]}
+                         {"name": k, "default_value": defaults.get(k),
+                          "type": type(defaults.get(k)).__name__}
+                         for k in sorted(cls.accepted_params())]}
     return {"model_builders": out}
 
 

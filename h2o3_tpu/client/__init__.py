@@ -1,0 +1,335 @@
+"""h2o-py-compatible client — the `import h2o` surface over REST.
+
+Reference: h2o-py (~156K LoC): h2o.init/connect (h2o-py/h2o/h2o.py:49,
+138), H2OFrame as a lazy server-side object addressed by key
+(h2o-py/h2o/frame.py), and one estimator class per algorithm GENERATED
+from REST schema metadata by h2o-bindings/bin/gen_python.py.
+
+Same architecture here, compressed: `connect()` attaches to (or starts)
+a server; `H2OFrame` proxies a server-side frame; estimator classes are
+generated at connect time from GET /3/ModelBuilders metadata — the
+gen_python.py codegen step executed live instead of checked in. Usage:
+
+    from h2o3_tpu import client as h2o
+    h2o.init()
+    fr = h2o.import_file("data.csv")
+    m = h2o.estimators.H2OGradientBoostingEstimator(ntrees=20)
+    m.train(y="target", training_frame=fr)
+    m.predict(fr)
+"""
+
+from __future__ import annotations
+
+import json
+import time
+import types
+import urllib.parse
+import urllib.request
+from typing import Any, Dict, List, Optional
+
+_conn: Optional["H2OConnection"] = None
+
+
+class H2OConnection:
+    """REST transport (h2o-py/h2o/backend/connection.py role)."""
+
+    def __init__(self, url: str):
+        self.url = url.rstrip("/")
+
+    def request(self, method: str, urlpath: str, **params) -> dict:
+        url = f"{self.url}{urlpath}"
+        data = None
+        if method == "POST":
+            data = urllib.parse.urlencode(
+                {k: (json.dumps(v) if isinstance(v, (list, dict)) else v)
+                 for k, v in params.items() if v is not None}).encode()
+        elif params:
+            url += "?" + urllib.parse.urlencode(
+                {k: v for k, v in params.items() if v is not None})
+        req = urllib.request.Request(url, data=data, method=method)
+        if data:
+            req.add_header("Content-Type",
+                           "application/x-www-form-urlencoded")
+        with urllib.request.urlopen(req, timeout=600) as resp:
+            return json.loads(resp.read())
+
+    def wait_job(self, key: str, timeout: float = 3600) -> dict:
+        """Poll GET /3/Jobs/{key} (the h2o-py progress loop)."""
+        t0 = time.time()
+        while time.time() - t0 < timeout:
+            j = self.request("GET", f"/3/Jobs/{key}")["jobs"][0]
+            if j["status"] == "FAILED":
+                raise RuntimeError(j.get("exception") or "job failed")
+            if j["status"] == "CANCELLED":
+                raise RuntimeError(f"job {key} was cancelled")
+            if j["status"] == "DONE":
+                return j
+            time.sleep(0.2)
+        raise TimeoutError(key)
+
+
+def connection() -> H2OConnection:
+    if _conn is None:
+        raise RuntimeError("no connection — call h2o.init() / h2o.connect()")
+    return _conn
+
+
+def connect(url: str = "http://127.0.0.1:54321") -> H2OConnection:
+    """Attach to a running server (h2o.connect)."""
+    global _conn
+    _conn = H2OConnection(url)
+    _conn.request("GET", "/3/Cloud")
+    _generate_estimators()
+    return _conn
+
+
+def init(url: Optional[str] = None, start_local: bool = True,
+         port: int = 0) -> H2OConnection:
+    """h2o.init: attach, or boot an in-process cloud + server."""
+    if url is None and start_local:
+        import h2o3_tpu
+        from h2o3_tpu.api.server import start_server
+        h2o3_tpu.init()
+        actual = start_server(port=port, background=True)
+        url = f"http://127.0.0.1:{actual}"
+    if url is None:
+        raise ValueError("init(start_local=False) needs url=<server url>")
+    return connect(url)
+
+
+def cluster_status() -> dict:
+    return connection().request("GET", "/3/Cloud")
+
+
+# ------------------------------------------------------------------ frame
+
+class H2OFrame:
+    """Proxy for a server-side frame (h2o-py/h2o/frame.py role —
+    operations go through REST/Rapids, data stays on the cluster)."""
+
+    def __init__(self, key: str):
+        self.frame_id = key
+        self._meta: Optional[dict] = None
+
+    def _info(self) -> dict:
+        # frame shape/schema is immutable server-side (mutations produce
+        # new keys via Rapids), so cache after one fetch like h2o-py
+        if self._meta is None:
+            self._meta = connection().request(
+                "GET", f"/3/Frames/{self.frame_id}")
+        return self._meta
+
+    @property
+    def nrows(self) -> int:
+        return self._info()["frames"][0]["rows"]
+
+    @property
+    def ncols(self) -> int:
+        return self._info()["frames"][0]["num_columns"]
+
+    @property
+    def names(self) -> List[str]:
+        return [c["label"] for c in self._info()["frames"][0]["columns"]]
+
+    @property
+    def shape(self):
+        f = self._info()["frames"][0]
+        return (f["rows"], f["num_columns"])
+
+    def summary(self) -> dict:
+        return connection().request(
+            "GET", f"/3/Frames/{self.frame_id}/summary")
+
+    def rapids(self, expr: str) -> dict:
+        """Ship a Rapids expression (h2o-py/h2o/expr.py ExprNode)."""
+        return connection().request("POST", "/99/Rapids", ast=expr)
+
+    def __getitem__(self, col: str) -> "H2OFrame":
+        out = self.rapids(f'(cols_py {self.frame_id} "{col}")')
+        if "key" not in out:
+            raise KeyError(out.get("error")
+                           or f"selection '{col}' did not yield a frame")
+        return H2OFrame(out["key"]["name"])
+
+    def __repr__(self):
+        return f"<H2OFrame {self.frame_id} {self.shape}>"
+
+
+def _key_name(v) -> str:
+    """Key fields arrive as either a bare string or a KeyV3 dict
+    ({'name': ..., 'type': ...}) depending on the endpoint."""
+    return v["name"] if isinstance(v, dict) else str(v)
+
+
+def import_file(path: str, destination_frame: Optional[str] = None) -> H2OFrame:
+    """h2o.import_file: ImportFiles → ParseSetup → Parse → poll job."""
+    c = connection()
+    c.request("POST", "/3/ImportFiles", path=path)
+    setup = c.request("POST", "/3/ParseSetup", source_frames=[path])
+    # h2o-py adopts ParseSetup's suggested destination when none is given
+    destination_frame = destination_frame or setup["destination_frame"]
+    out = c.request("POST", "/3/Parse", source_frames=[path],
+                    destination_frame=destination_frame,
+                    separator=setup.get("separator"))
+    job = out["job"]
+    c.wait_job(_key_name(job["key"]))
+    return H2OFrame(_key_name(job["dest"]))
+
+
+# ------------------------------------------------------------------ model
+
+class H2OModel:
+    """Proxy for a trained server-side model."""
+
+    def __init__(self, model_id: str):
+        self.model_id = model_id
+
+    def _info(self) -> dict:
+        return connection().request("GET", f"/3/Models/{self.model_id}")
+
+    @property
+    def algo(self) -> str:
+        return self._info()["models"][0]["algo"]
+
+    @property
+    def params(self) -> dict:
+        return self._info()["models"][0]["params"]
+
+    def metrics(self, kind: str = "training_metrics") -> dict:
+        return self._info()["models"][0][kind] or {}
+
+    def auc(self) -> float:
+        return self.metrics()["AUC"]
+
+    def logloss(self) -> float:
+        return self.metrics()["logloss"]
+
+    def predict(self, frame: H2OFrame) -> H2OFrame:
+        out = connection().request(
+            "POST",
+            f"/3/Predictions/models/{self.model_id}/frames/{frame.frame_id}")
+        return H2OFrame(out["predictions_frame"]["name"])
+
+    def __repr__(self):
+        return f"<H2OModel {self.model_id}>"
+
+
+class _GeneratedEstimator:
+    """Base of runtime-generated estimator classes (the gen_python.py
+    codegen output, produced live from /3/ModelBuilders metadata)."""
+
+    algo: str = ""
+    _param_names: List[str] = []
+
+    def __init__(self, **params):
+        unknown = set(params) - set(self._param_names)
+        if unknown:
+            raise ValueError(f"unknown {self.algo} params: {sorted(unknown)}")
+        self._params = params
+        self._model: Optional[H2OModel] = None
+
+    def train(self, y: Optional[str] = None,
+              training_frame: Optional[H2OFrame] = None,
+              x: Optional[List[str]] = None,
+              validation_frame: Optional[H2OFrame] = None,
+              model_id: Optional[str] = None) -> H2OModel:
+        c = connection()
+        body = dict(self._params)
+        body["training_frame"] = training_frame.frame_id
+        if y is not None:
+            body["response_column"] = y
+        if validation_frame is not None:
+            body["validation_frame"] = validation_frame.frame_id
+        if model_id:
+            body["model_id"] = model_id
+        out = c.request("POST", f"/3/ModelBuilders/{self.algo}", **body)
+        job = c.wait_job(_key_name(out["job"]["key"]))
+        self._model = H2OModel(_key_name(job["dest"]))
+        return self._model
+
+    # delegate everything model-ish to the trained model
+    def __getattr__(self, item):
+        if self._model is not None:
+            return getattr(self._model, item)
+        raise AttributeError(item)
+
+
+# canonical h2o-py class names per algo (gen_python.py naming table)
+_CLASS_NAMES = {
+    "gbm": "H2OGradientBoostingEstimator",
+    "drf": "H2ORandomForestEstimator",
+    "glm": "H2OGeneralizedLinearEstimator",
+    "deeplearning": "H2ODeepLearningEstimator",
+    "kmeans": "H2OKMeansEstimator",
+    "pca": "H2OPrincipalComponentAnalysisEstimator",
+    "svd": "H2OSingularValueDecompositionEstimator",
+    "glrm": "H2OGeneralizedLowRankEstimator",
+    "naivebayes": "H2ONaiveBayesEstimator",
+    "isolationforest": "H2OIsolationForestEstimator",
+    "extendedisolationforest": "H2OExtendedIsolationForestEstimator",
+    "upliftdrf": "H2OUpliftRandomForestEstimator",
+    "coxph": "H2OCoxProportionalHazardsEstimator",
+    "gam": "H2OGeneralizedAdditiveEstimator",
+    "rulefit": "H2ORuleFitEstimator",
+    "psvm": "H2OSupportVectorMachineEstimator",
+    "word2vec": "H2OWord2vecEstimator",
+    "isotonicregression": "H2OIsotonicRegressionEstimator",
+    "modelselection": "H2OModelSelectionEstimator",
+    "anovaglm": "H2OANOVAGLMEstimator",
+    "targetencoder": "H2OTargetEncoderEstimator",
+    "xgboost": "H2OXGBoostEstimator",
+    "aggregator": "H2OAggregatorEstimator",
+    "infogram": "H2OInfogram",
+    "generic": "H2OGenericEstimator",
+}
+
+estimators = types.SimpleNamespace()
+
+
+def _generate_estimators() -> None:
+    """The gen_python.py step: one estimator class per algo, param list
+    from the live REST schema metadata."""
+    meta = connection().request("GET", "/3/ModelBuilders")["model_builders"]
+    for algo, info in meta.items():
+        cls_name = _CLASS_NAMES.get(algo, f"H2O{algo.title()}Estimator")
+        pnames = [p["name"] for p in info["parameters"]]
+        cls = type(cls_name, (_GeneratedEstimator,),
+                   {"algo": algo, "_param_names": pnames,
+                    "__doc__": f"Generated from /3/ModelBuilders[{algo}]"})
+        setattr(estimators, cls_name, cls)
+
+
+class H2OAutoML:
+    """h2o-py H2OAutoML shim (POST /99/AutoMLBuilder + leaderboard)."""
+
+    def __init__(self, max_models: int = 10, max_runtime_secs: float = 0,
+                 seed: int = -1, project_name: Optional[str] = None,
+                 **kw):
+        self.spec = {"max_models": max_models,
+                     "max_runtime_secs": max_runtime_secs, "seed": seed,
+                     "project_name": project_name or "automl", **kw}
+        self.leader: Optional[H2OModel] = None
+
+    def train(self, y: str, training_frame: H2OFrame,
+              x: Optional[List[str]] = None) -> H2OModel:
+        c = connection()
+        out = c.request(
+            "POST", "/99/AutoMLBuilder",
+            build_control={"project_name": self.spec["project_name"],
+                           "stopping_criteria": {
+                               "max_models": self.spec["max_models"],
+                               "max_runtime_secs": self.spec["max_runtime_secs"],
+                               "seed": self.spec["seed"]}},
+            input_spec={"training_frame": training_frame.frame_id,
+                        "response_column": y})
+        c.wait_job(_key_name(out["job"]["key"]))
+        lb = self.leaderboard
+        self.leader = H2OModel(lb[0]["model_id"]) if lb else None
+        return self.leader
+
+    @property
+    def leaderboard(self) -> List[dict]:
+        out = connection().request(
+            "GET", f"/99/Leaderboards/{self.spec['project_name']}")
+        return out.get("leaderboard_table") or [
+            {"model_id": k} for k in out.get("models", [])]

@@ -1,0 +1,81 @@
+"""h2o-py-compatible client over real HTTP — the full wire contract:
+connect → import_file → generated estimator → train → predict → AutoML."""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+import h2o3_tpu
+from h2o3_tpu.api.server import start_server, stop_server
+from h2o3_tpu import client as h2o
+
+
+@pytest.fixture(scope="module")
+def conn():
+    port = start_server(port=0, background=True)
+    c = h2o.connect(f"http://127.0.0.1:{port}")
+    yield c
+    stop_server()
+
+
+@pytest.fixture(scope="module")
+def csv_path(tmp_path_factory):
+    r = np.random.RandomState(0)
+    n = 3000
+    df = pd.DataFrame({
+        "x0": r.randn(n), "x1": r.randn(n),
+        "g": np.array(["a", "b", "c"], object)[r.randint(0, 3, n)],
+    })
+    logit = df.x0 * 1.3 + (df.g == "b") - df.x1
+    df["target"] = np.array(["no", "yes"], object)[
+        (r.rand(n) < 1 / (1 + np.exp(-logit))).astype(int)]
+    p = tmp_path_factory.mktemp("d") / "c.csv"
+    df.to_csv(p, index=False)
+    return str(p)
+
+
+def test_client_import_and_frame(conn, csv_path):
+    fr = h2o.import_file(csv_path)
+    assert fr.shape == (3000, 4)
+    assert set(fr.names) == {"x0", "x1", "g", "target"}
+    sub = fr["x0"]
+    assert sub.shape[1] == 1
+
+
+def test_client_generated_estimators_exist(conn):
+    names = [n for n in vars(h2o.estimators) if n.startswith("H2O")]
+    assert "H2OGradientBoostingEstimator" in names
+    assert "H2OXGBoostEstimator" in names
+    assert len(names) >= 20
+
+
+def test_client_train_predict(conn, csv_path):
+    fr = h2o.import_file(csv_path)
+    est = h2o.estimators.H2OGradientBoostingEstimator(ntrees=8, max_depth=3,
+                                                      seed=4)
+    m = est.train(y="target", training_frame=fr)
+    assert m.algo == "gbm"
+    assert m.auc() > 0.7
+    preds = m.predict(fr)
+    assert preds.nrows == 3000
+    assert "p1" in preds.names
+
+
+def test_client_unknown_param_rejected(conn):
+    with pytest.raises(ValueError, match="unknown gbm params"):
+        h2o.estimators.H2OGradientBoostingEstimator(bogus_knob=1)
+
+
+def test_client_xgboost_facade(conn, csv_path):
+    fr = h2o.import_file(csv_path)
+    m = h2o.estimators.H2OXGBoostEstimator(ntrees=5, eta=0.3).train(
+        y="target", training_frame=fr)
+    assert m.auc() > 0.65
+
+
+def test_client_automl(conn, csv_path):
+    fr = h2o.import_file(csv_path)
+    aml = h2o.H2OAutoML(max_models=2, seed=1, project_name="clienttest")
+    leader = aml.train(y="target", training_frame=fr)
+    assert leader is not None
+    assert len(aml.leaderboard) >= 2
