@@ -350,6 +350,9 @@ def _automl(params, body):
     if isinstance(y, dict):
         y = y.get("column_name")
     fr = DKV.get(str(frame_key))
+    ignored = inp.get("ignored_columns")
+    x_cols = ([n for n in fr.names if n not in set(ignored) and n != y]
+              if ignored and isinstance(fr, Frame) else None)
     aml = H2OAutoML(
         max_models=int(crit.get("max_models") or p.get("max_models") or 0),
         max_runtime_secs=float(crit.get("max_runtime_secs")
@@ -362,7 +365,7 @@ def _automl(params, body):
     job = Job("automl", dest=aml.project_name)
 
     def _run(j):
-        aml.train(y=y, training_frame=fr)
+        aml.train(y=y, training_frame=fr, x=x_cols)
         j.update(1.0, "done")
         DKV.put(f"leaderboard_{aml.project_name}_result", aml)
         return aml

@@ -183,9 +183,14 @@ class H2OModel:
 
     def __init__(self, model_id: str):
         self.model_id = model_id
+        self._meta: Optional[dict] = None
 
     def _info(self) -> dict:
-        return connection().request("GET", f"/3/Models/{self.model_id}")
+        # trained models are immutable — cache like H2OFrame._info
+        if self._meta is None:
+            self._meta = connection().request(
+                "GET", f"/3/Models/{self.model_id}")
+        return self._meta
 
     @property
     def algo(self) -> str:
@@ -228,16 +233,24 @@ class _GeneratedEstimator:
         self._params = params
         self._model: Optional[H2OModel] = None
 
-    def train(self, y: Optional[str] = None,
+    def train(self, x: Optional[List[str]] = None, y: Optional[str] = None,
               training_frame: Optional[H2OFrame] = None,
-              x: Optional[List[str]] = None,
               validation_frame: Optional[H2OFrame] = None,
               model_id: Optional[str] = None) -> H2OModel:
+        """h2o-py argument order: train(x, y, training_frame)."""
+        if not isinstance(training_frame, H2OFrame):
+            raise ValueError("training_frame must be an H2OFrame "
+                             "(h2o-py order is train(x, y, training_frame))")
         c = connection()
         body = dict(self._params)
         body["training_frame"] = training_frame.frame_id
         if y is not None:
             body["response_column"] = y
+        if x is not None:
+            # the wire contract expresses predictor choice as exclusion
+            keep = set(x) | ({y} if y else set())
+            body["ignored_columns"] = [n for n in training_frame.names
+                                       if n not in keep]
         if validation_frame is not None:
             body["validation_frame"] = validation_frame.frame_id
         if model_id:
@@ -310,18 +323,29 @@ class H2OAutoML:
                      "project_name": project_name or "automl", **kw}
         self.leader: Optional[H2OModel] = None
 
-    def train(self, y: str, training_frame: H2OFrame,
-              x: Optional[List[str]] = None) -> H2OModel:
+    def train(self, x: Optional[List[str]] = None, y: str = None,
+              training_frame: H2OFrame = None) -> H2OModel:
         c = connection()
-        out = c.request(
-            "POST", "/99/AutoMLBuilder",
-            build_control={"project_name": self.spec["project_name"],
-                           "stopping_criteria": {
-                               "max_models": self.spec["max_models"],
-                               "max_runtime_secs": self.spec["max_runtime_secs"],
-                               "seed": self.spec["seed"]}},
-            input_spec={"training_frame": training_frame.frame_id,
-                        "response_column": y})
+        build_control = {"project_name": self.spec["project_name"],
+                         "stopping_criteria": {
+                             "max_models": self.spec["max_models"],
+                             "max_runtime_secs": self.spec["max_runtime_secs"],
+                             "seed": self.spec["seed"]}}
+        if self.spec.get("nfolds") is not None:
+            build_control["nfolds"] = self.spec["nfolds"]
+        build_models = {k: self.spec[k]
+                        for k in ("include_algos", "exclude_algos")
+                        if self.spec.get(k) is not None}
+        input_spec = {"training_frame": training_frame.frame_id,
+                      "response_column": y}
+        if x is not None:
+            keep = set(x) | {y}
+            input_spec["ignored_columns"] = [
+                n for n in training_frame.names if n not in keep]
+        out = c.request("POST", "/99/AutoMLBuilder",
+                        build_control=build_control,
+                        input_spec=input_spec,
+                        build_models=build_models or None)
         c.wait_job(_key_name(out["job"]["key"]))
         lb = self.leaderboard
         self.leader = H2OModel(lb[0]["model_id"]) if lb else None

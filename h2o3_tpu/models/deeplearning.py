@@ -104,14 +104,13 @@ def _loss(params, X, y, w, key, *, act, category, input_dropout,
     return data_loss / wsum + reg
 
 
-@partial(jax.jit, static_argnames=("act", "category", "input_dropout",
-                                   "hidden_dropout", "l1", "l2", "nclasses",
-                                   "adaptive", "rho", "epsilon", "nesterov"))
-def _train_step(params, opt_state, lr, X, y, w, key, *, act, category,
-                input_dropout, hidden_dropout, l1, l2, nclasses,
-                adaptive, rho, epsilon, nesterov):
+def _train_step_impl(params, opt_state, lr, X, y, w, key, *, act, category,
+                     input_dropout, hidden_dropout, l1, l2, nclasses,
+                     adaptive, rho, epsilon, nesterov, mu_now=None):
     """One minibatch step. XLA's gradient psum over the sharded batch is
-    the cross-replica model averaging (DeepLearningTask.java:164-176)."""
+    the cross-replica model averaging (DeepLearningTask.java:164-176).
+    ``mu_now`` overrides the momentum carried in opt_state (the fused
+    multi-step path computes the ramp per step on device)."""
     grads = jax.grad(_loss)(params, X, y, w, key, act=act, category=category,
                             input_dropout=input_dropout,
                             hidden_dropout=hidden_dropout, l1=l1, l2=l2,
@@ -131,7 +130,7 @@ def _train_step(params, opt_state, lr, X, y, w, key, *, act, category,
                 pk, sk = upd(p[k], g[k], s[k])
             else:
                 # Nesterov momentum SGD (reference momentum_start/stable)
-                mu = s[k]["mu"]
+                mu = s[k]["mu"] if mu_now is None else mu_now
                 v = mu * s[k]["v"] - lr * g[k]
                 pk = (p[k] + mu * v - lr * g[k]) if nesterov else (p[k] + v)
                 sk = {"v": v, "mu": mu}
@@ -140,6 +139,44 @@ def _train_step(params, opt_state, lr, X, y, w, key, *, act, category,
         new_params.append(np_)
         new_state.append(ns_)
     return new_params, new_state
+
+
+_STEP_STATICS = ("act", "category", "input_dropout", "hidden_dropout",
+                 "l1", "l2", "nclasses", "adaptive", "rho", "epsilon",
+                 "nesterov")
+
+
+@partial(jax.jit, static_argnames=_STEP_STATICS + (
+    "nsteps", "batch", "n", "rate", "rate_annealing",
+    "momentum_start", "momentum_stable", "momentum_ramp"))
+def _train_steps_fused(params, opt_state, X, y, w, key, step0, *,
+                       nsteps, batch, n, rate, rate_annealing,
+                       momentum_start, momentum_stable, momentum_ramp,
+                       **step_kwargs):
+    """``nsteps`` minibatch steps as one compiled scan — batch indices
+    drawn on device, lr/momentum schedules computed per step. Removes
+    the per-step host round trip (the dominant cost on a remote chip),
+    the HOGWILD-free analogue of the reference's per-node inner loop
+    (hex/deeplearning/DeepLearningTask.java)."""
+
+    def body(carry, i):
+        params, opt_state, key = carry
+        key, kidx, kstep = jax.random.split(key, 3)
+        idx = jax.random.randint(kidx, (batch,), 0, n)
+        step = step0 + i
+        lr = jnp.float32(rate) / (1.0 + rate_annealing * step * batch)
+        ramp = jnp.minimum(1.0, step * batch / max(momentum_ramp, 1.0))
+        mu_now = jnp.float32(momentum_start
+                             + (momentum_stable - momentum_start) * ramp)
+        params, opt_state = _train_step_impl(
+            params, opt_state, lr, X[idx], y[idx], w[idx], kstep,
+            mu_now=mu_now, **step_kwargs)
+        return (params, opt_state, key), None
+
+    (params, opt_state, key), _ = jax.lax.scan(
+        body, (params, opt_state, key),
+        jnp.arange(nsteps, dtype=jnp.float32))
+    return params, opt_state, key
 
 
 class DeepLearningModel(Model):
@@ -362,32 +399,30 @@ class DeepLearningEstimator(ModelBuilder):
                            epsilon=float(p["epsilon"]),
                            nesterov=bool(p["nesterov_accelerated_gradient"]))
         scoring_history = []
-        for step in range(total_steps):
-            idx = jnp.asarray(rng.randint(0, n, size=batch))
-            # device-side gather + reshard; rows never visit the host
-            Xb = jax.device_put(Xh[idx], row_sharding(mesh))
-            yb = jax.device_put(y_dev[idx], row_sharding(mesh))
-            wb = jax.device_put(w[idx], row_sharding(mesh))
-            lr = (float(p["rate"])
-                  / (1.0 + float(p["rate_annealing"]) * step * batch))
-            if not adaptive:
-                ramp = min(1.0, step * batch / max(p["momentum_ramp"], 1.0))
-                mu_now = (p["momentum_start"]
-                          + (p["momentum_stable"] - p["momentum_start"]) * ramp)
-                for s in opt_state:
-                    for k in ("W", "b"):
-                        s[k]["mu"] = jnp.float32(mu_now)
-            key, sub = jax.random.split(key)
-            params_net, opt_state = _train_step(
-                params_net, opt_state, jnp.float32(lr), Xb, yb, wb, sub,
-                **step_kwargs)
-            job.update(1.0 / total_steps, f"step {step + 1}/{total_steps}")
-            if stopper.enabled and (step + 1) % score_every == 0:
+        sched = dict(nsteps=0, batch=batch, n=n,
+                     rate=float(p["rate"]),
+                     rate_annealing=float(p["rate_annealing"]),
+                     momentum_start=float(p["momentum_start"]),
+                     momentum_stable=float(p["momentum_stable"]),
+                     momentum_ramp=float(p["momentum_ramp"]))
+        # fused multi-step chunks: score/cancel boundaries between chunks
+        chunk = score_every if stopper.enabled else min(total_steps, 200)
+        done = 0
+        while done < total_steps:
+            k = min(chunk, total_steps - done)
+            sched["nsteps"] = k
+            params_net, opt_state, key = _train_steps_fused(
+                params_net, opt_state, Xh, y_dev, w, key,
+                jnp.float32(done), **sched, **step_kwargs)
+            done += k
+            job.update(k / total_steps, f"step {done}/{total_steps}")
+            if stopper.enabled:
+                key, sub = jax.random.split(key)
                 lv = float(_loss(params_net, Xh, y_dev, w, sub, act=act,
                                  category=cat_mode, input_dropout=0.0,
                                  hidden_dropout=tuple([0.0] * len(hidden)),
                                  l1=0.0, l2=0.0, nclasses=out_dim))
-                scoring_history.append({"step": step + 1, "loss": lv})
+                scoring_history.append({"step": done, "loss": lv})
                 if stopper.should_stop(lv):
                     break
 

@@ -61,6 +61,21 @@ def test_client_train_predict(conn, csv_path):
     assert "p1" in preds.names
 
 
+def test_client_x_subsets_predictors(conn, csv_path):
+    fr = h2o.import_file(csv_path)
+    est = h2o.estimators.H2OGradientBoostingEstimator(ntrees=4, max_depth=3,
+                                                      seed=2)
+    m = est.train(x=["x0"], y="target", training_frame=fr)
+    info = m._info()["models"][0]
+    assert info["output"]["names"] == ["x0"]
+    # h2o-py positional order train(x, y, training_frame) works too
+    m2 = h2o.estimators.H2OGradientBoostingEstimator(ntrees=3, seed=2).train(
+        ["x0", "x1"], "target", fr)
+    assert set(m2._info()["models"][0]["output"]["names"]) == {"x0", "x1"}
+    with pytest.raises(ValueError, match="training_frame"):
+        est.train(y="target")
+
+
 def test_client_unknown_param_rejected(conn):
     with pytest.raises(ValueError, match="unknown gbm params"):
         h2o.estimators.H2OGradientBoostingEstimator(bogus_knob=1)
