@@ -358,7 +358,8 @@ def _automl(params, body):
         max_runtime_secs=float(crit.get("max_runtime_secs")
                                or p.get("max_runtime_secs") or 3600),
         seed=int(crit.get("seed") or p.get("seed") or -1),
-        nfolds=int(ctl.get("nfolds") or p.get("nfolds") or 5),
+        nfolds=int(next(v for v in (ctl.get("nfolds"), p.get("nfolds"), 5)
+                        if v is not None)),
         include_algos=bm.get("include_algos"),
         exclude_algos=bm.get("exclude_algos"),
         project_name=ctl.get("project_name") or p.get("project_name"))

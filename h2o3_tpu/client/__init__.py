@@ -247,10 +247,17 @@ class _GeneratedEstimator:
         if y is not None:
             body["response_column"] = y
         if x is not None:
-            # the wire contract expresses predictor choice as exclusion
+            # the wire contract expresses predictor choice as exclusion;
+            # accept h2o-py's str / list-of-str / list-of-int forms
+            names = training_frame.names
+            if isinstance(x, str):
+                x = [x]
+            x = [names[i] if isinstance(i, int) else i for i in x]
+            unknown = [c for c in x if c not in names]
+            if unknown:
+                raise ValueError(f"x columns not in frame: {unknown}")
             keep = set(x) | ({y} if y else set())
-            body["ignored_columns"] = [n for n in training_frame.names
-                                       if n not in keep]
+            body["ignored_columns"] = [n for n in names if n not in keep]
         if validation_frame is not None:
             body["validation_frame"] = validation_frame.frame_id
         if model_id:
@@ -325,6 +332,9 @@ class H2OAutoML:
 
     def train(self, x: Optional[List[str]] = None, y: str = None,
               training_frame: H2OFrame = None) -> H2OModel:
+        if not isinstance(training_frame, H2OFrame):
+            raise ValueError("training_frame must be an H2OFrame "
+                             "(h2o-py order is train(x, y, training_frame))")
         c = connection()
         build_control = {"project_name": self.spec["project_name"],
                          "stopping_criteria": {

@@ -99,6 +99,75 @@ def mojo_artifacts(model) -> Tuple[dict, Dict[str, np.ndarray]]:
             arrays[f"W{i}"] = np.asarray(layer["W"])
             arrays[f"b{i}"] = np.asarray(layer["b"])
         return meta, arrays
+    if algo in ("pca", "svd"):
+        meta["standardize"] = model.transform == "standardize"
+        meta["use_all_factor_levels"] = bool(model.use_all_levels)
+        meta["names"] = list(model.features)
+        meta["feature_domains"] = [list(d) if d is not None else None
+                                   for d in model.di_stats["domains"]]
+        arrays = {
+            "num_means": np.asarray(model.di_stats["num_means"]),
+            "num_sigmas": np.asarray(model.di_stats["num_sigmas"]),
+        }
+        if algo == "pca":
+            arrays["eigvecs"] = np.asarray(model.eigvecs)
+        else:
+            arrays["v"] = np.asarray(model.V)
+            arrays["d"] = np.asarray(model.output["d"])
+        return meta, arrays
+    if algo == "isotonicregression":
+        meta["out_of_bounds"] = str(model.params.get("out_of_bounds",
+                                                     "clip"))
+        arrays = {"thresholds_x": np.asarray(model.tx),
+                  "thresholds_y": np.asarray(model.ty)}
+        return meta, arrays
+    if algo == "coxph":
+        meta["names"] = list(model.features)
+        meta["feature_domains"] = [list(d) if d is not None else None
+                                   for d in model.di_stats["domains"]]
+        meta["standardize"] = False
+        meta["use_all_factor_levels"] = False
+        meta["eta_mean"] = float(model.output["eta_mean"])
+        arrays = {
+            "coef": np.asarray(model.coef),
+            "num_means": np.asarray(model.di_stats["num_means"]),
+            "num_sigmas": np.asarray(model.di_stats["num_sigmas"]),
+        }
+        return meta, arrays
+    if algo == "naivebayes":
+        s = model.stats
+        meta["num_names"] = list(s["num_names"])
+        meta["cat_names"] = list(s["cat_names"])
+        meta["cat_domains"] = [list(d) for d in s["cat_domains"]]
+        meta["min_sdev"] = float(model.params.get("min_sdev") or 1e-3)
+        meta["eps_sdev"] = float(model.params.get("eps_sdev") or 0.0)
+        meta["min_prob"] = float(model.params.get("min_prob") or 1e-3)
+        arrays = {"priors": np.asarray(s["priors"]),
+                  "num_mu": np.asarray(s["num_mu"]),
+                  "num_sd": np.asarray(s["num_sd"])}
+        for j, tab in enumerate(s["cat_tables"]):
+            arrays[f"cat_table_{j}"] = np.asarray(tab)
+        return meta, arrays
+    if algo == "upliftdrf":
+        tmeta, arrays = _tree_artifacts(model)
+        meta.update(tmeta)
+        arrays["leaf_pt"] = np.asarray(model.leaf_pt)
+        arrays["leaf_pc"] = np.asarray(model.leaf_pc)
+        return meta, arrays
+    if algo == "extendedisolationforest":
+        meta["names"] = list(model.features)
+        meta["c_norm"] = float(model.c_norm)
+        f = model.forest
+        arrays = {"ext_normals": np.asarray(f.normals),
+                  "ext_offsets": np.asarray(f.offsets),
+                  "ext_is_split": np.asarray(f.is_split),
+                  "ext_leaf": np.asarray(f.leaf),
+                  "col_means": np.asarray(model.means)}
+        return meta, arrays
+    if algo == "word2vec":
+        meta["vocab"] = list(model.vocab)
+        arrays = {"vectors": np.asarray(model.vectors)}
+        return meta, arrays
     if algo == "kmeans":
         meta["standardize"] = bool(model.standardize)
         meta["use_all_factor_levels"] = True

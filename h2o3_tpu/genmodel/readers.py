@@ -213,6 +213,153 @@ class KMeansMojoModel(MojoModel):
         return {"predict": d2.argmin(axis=1).astype(np.int32)}
 
 
+class PcaMojoModel(MojoModel):
+    def predict(self, data):
+        X = design_matrix(self.meta, self.arrays, data)
+        if self.algo == "pca":
+            scores = X @ self.arrays["eigvecs"]
+            return {f"PC{i + 1}": scores[:, i]
+                    for i in range(scores.shape[1])}
+        proj = X @ self.arrays["v"]
+        u = proj / np.maximum(self.arrays["d"][None, :], 1e-12)
+        return {f"u{i + 1}": u[:, i] for i in range(u.shape[1])}
+
+
+class IsotonicMojoModel(MojoModel):
+    def predict(self, data):
+        x = np.asarray(data[self.names[0]], dtype=np.float64)
+        tx, ty = self.arrays["thresholds_x"], self.arrays["thresholds_y"]
+        pred = np.interp(np.clip(x, tx[0], tx[-1]), tx, ty)
+        pred[np.isnan(x)] = np.nan
+        if str(self.meta.get("out_of_bounds", "clip")).lower() == "na":
+            pred[(x < tx[0]) | (x > tx[-1])] = np.nan
+        return {"predict": pred}
+
+
+class CoxPHMojoModel(MojoModel):
+    def predict(self, data):
+        X = design_matrix(self.meta, self.arrays, data)
+        lp = X @ self.arrays["coef"] - self.meta["eta_mean"]
+        return {"lp": lp}
+
+
+class NaiveBayesMojoModel(MojoModel):
+    def predict(self, data):
+        priors = self.arrays["priors"]
+        K = len(priors)
+        num_names = self.meta["num_names"]
+        cat_names = self.meta["cat_names"]
+        n = len(np.asarray(data[(num_names + cat_names)[0]]))
+        ll = np.log(np.maximum(priors, 1e-12))[None, :].repeat(n, 0)
+        min_sd = max(self.meta["min_sdev"], 1e-6)
+        eps = self.meta["eps_sdev"]
+        for j, name in enumerate(num_names):
+            x = np.asarray(data[name], dtype=np.float64)
+            mu = self.arrays["num_mu"][j]
+            sd = np.maximum(self.arrays["num_sd"][j], min_sd) + eps
+            t = (x[:, None] - mu[None, :]) / sd[None, :]
+            contrib = -0.5 * t * t - np.log(sd)[None, :]
+            ll += np.where(np.isnan(x)[:, None], 0.0, contrib)
+        min_p = max(self.meta["min_prob"], 1e-10)
+        for j, name in enumerate(cat_names):
+            dom = self.meta["cat_domains"][j]
+            lut = {lvl: i for i, lvl in enumerate(dom)}
+            v = np.asarray(data[name])
+            codes = np.array([lut.get(str(x), -1) if x is not None else -1
+                              for x in v], dtype=np.int64)
+            probs = np.maximum(self.arrays[f"cat_table_{j}"], min_p)
+            contrib = np.log(probs[:, np.maximum(codes, 0)]).T
+            ll += np.where((codes < 0)[:, None], 0.0, contrib)
+        p = np.exp(ll - ll.max(axis=1, keepdims=True))
+        p = p / p.sum(axis=1, keepdims=True)
+        # _class_output only consults the threshold in the 2-class case
+        return _class_output(p, self.meta.get("default_threshold", 0.5),
+                             self.domain)
+
+
+class UpliftDrfMojoModel(SharedTreeMojoModel):
+    def predict(self, data):
+        B = int(self.meta["nbins_total"])
+        bins = bin_raw(self.meta, self.arrays, data)
+        pt = walk_forest({**self.arrays,
+                          "tree_leaf": self.arrays["leaf_pt"]},
+                         bins, B).mean(axis=0)
+        pc = walk_forest({**self.arrays,
+                          "tree_leaf": self.arrays["leaf_pc"]},
+                         bins, B).mean(axis=0)
+        return {"uplift_predict": pt - pc, "p_y1_ct1": pt, "p_y1_ct0": pc}
+
+
+class ExtIsoForMojoModel(MojoModel):
+    def predict(self, data):
+        names = self.names
+        means = self.arrays["col_means"]
+        X = np.stack([np.asarray(data[n], dtype=np.float64)
+                      for n in names], axis=1)
+        for j in range(X.shape[1]):
+            X[np.isnan(X[:, j]), j] = means[j]
+        normals = self.arrays["ext_normals"]     # [T, D, L, F]
+        offsets = self.arrays["ext_offsets"]
+        is_split = self.arrays["ext_is_split"].astype(bool)
+        leaf = self.arrays["ext_leaf"]
+        T, D = normals.shape[0], normals.shape[1]
+        n = X.shape[0]
+        tot = np.zeros(n)
+        for t in range(T):
+            nid = np.zeros(n, dtype=np.int64)
+            plen = np.zeros(n)
+            for d in range(D):
+                isp = is_split[t, d][nid]
+                plen += isp
+                Wr = normals[t, d][nid]
+                proj = (X * Wr).sum(axis=1)
+                goleft = np.where(isp, proj < offsets[t, d][nid], True)
+                nid = 2 * nid + np.where(goleft, 0, 1)
+            tot += plen + leaf[t][nid]
+        ml = tot / T
+        c = max(float(self.meta["c_norm"]), 1e-12)
+        return {"anomaly_score": 2.0 ** (-ml / c), "mean_length": ml}
+
+
+class Word2VecMojoModel(MojoModel):
+    def predict(self, data):
+        """Embed a words column: NaN/None rows delimit sequences only in
+        transform()-style use; here NONE semantics (one vector per word,
+        NaN row for unknown)."""
+        vocab = self.meta["vocab"]
+        index = {w: i for i, w in enumerate(vocab)}
+        vec = self.arrays["vectors"]
+        words = np.asarray(data[self.names[0]] if self.names
+                           else data["words"])
+        D = vec.shape[1]
+        out = np.full((len(words), D), np.nan)
+        for i, w in enumerate(words):
+            j = index.get(w if isinstance(w, str) else None)
+            if j is not None:
+                out[i] = vec[j]
+        return {f"V{i + 1}": out[:, i] for i in range(D)}
+
+    def find_synonyms(self, word: str, count: int = 20):
+        vec = self.arrays["vectors"]
+        index = {w: i for i, w in enumerate(self.meta["vocab"])}
+        if word not in index:
+            return {}
+        v = vec[index[word]]
+        sims = vec @ v / np.maximum(
+            np.linalg.norm(vec, axis=1) * max(np.linalg.norm(v), 1e-12),
+            1e-12)
+        order = np.argsort(-sims)
+        out = {}
+        for i in order:
+            w = self.meta["vocab"][i]
+            if w == word:
+                continue
+            out[w] = float(sims[i])
+            if len(out) >= count:
+                break
+        return out
+
+
 _READERS = {
     "gbm": GbmMojoModel,
     "drf": DrfMojoModel,
@@ -220,4 +367,12 @@ _READERS = {
     "glm": GlmMojoModel,
     "deeplearning": DeepLearningMojoModel,
     "kmeans": KMeansMojoModel,
+    "pca": PcaMojoModel,
+    "svd": PcaMojoModel,
+    "isotonicregression": IsotonicMojoModel,
+    "coxph": CoxPHMojoModel,
+    "naivebayes": NaiveBayesMojoModel,
+    "upliftdrf": UpliftDrfMojoModel,
+    "extendedisolationforest": ExtIsoForMojoModel,
+    "word2vec": Word2VecMojoModel,
 }

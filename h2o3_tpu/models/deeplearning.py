@@ -159,17 +159,26 @@ def _train_steps_fused(params, opt_state, X, y, w, key, step0, *,
     the HOGWILD-free analogue of the reference's per-node inner loop
     (hex/deeplearning/DeepLearningTask.java)."""
 
+    from h2o3_tpu.parallel.mesh import row_sharding
+
     def body(carry, i):
         params, opt_state, key = carry
         key, kidx, kstep = jax.random.split(key, 3)
         idx = jax.random.randint(kidx, (batch,), 0, n)
+        # the gathered batch must stay row-sharded: without the
+        # constraint GSPMD may replicate the full sharded dataset to
+        # serve the random gather, and the gradient psum over the
+        # 'data' axis would average a replicated batch
+        Xb = jax.lax.with_sharding_constraint(X[idx], row_sharding())
+        yb = jax.lax.with_sharding_constraint(y[idx], row_sharding())
+        wb = jax.lax.with_sharding_constraint(w[idx], row_sharding())
         step = step0 + i
         lr = jnp.float32(rate) / (1.0 + rate_annealing * step * batch)
         ramp = jnp.minimum(1.0, step * batch / max(momentum_ramp, 1.0))
         mu_now = jnp.float32(momentum_start
                              + (momentum_stable - momentum_start) * ramp)
         params, opt_state = _train_step_impl(
-            params, opt_state, lr, X[idx], y[idx], w[idx], kstep,
+            params, opt_state, lr, Xb, yb, wb, kstep,
             mu_now=mu_now, **step_kwargs)
         return (params, opt_state, key), None
 
@@ -391,7 +400,6 @@ class DeepLearningEstimator(ModelBuilder):
         score_every = max(1, total_steps // 10)
 
         Xh = di.X   # already device, row-sharded
-        rng = np.random.RandomState(seed & 0x7FFFFFFF)
         step_kwargs = dict(act=act, category=cat_mode, input_dropout=in_drop,
                            hidden_dropout=hd, l1=float(p["l1"]),
                            l2=float(p["l2"]), nclasses=out_dim,

@@ -62,7 +62,13 @@ class NaiveBayesModel(Model):
         ll = self._loglik(frame)
         p = np.exp(ll - ll.max(axis=1, keepdims=True))
         p = p / p.sum(axis=1, keepdims=True)
-        out = {"predict": p.argmax(axis=1).astype(np.int32)}
+        if p.shape[1] == 2:
+            # binomial labels honor the default threshold like every other
+            # binomial model (reference BigScore threshold semantics)
+            t = self.output.get("default_threshold", 0.5)
+            out = {"predict": (p[:, 1] >= t).astype(np.int32)}
+        else:
+            out = {"predict": p.argmax(axis=1).astype(np.int32)}
         for k in range(p.shape[1]):
             out[f"p{k}"] = p[:, k]
         return out
