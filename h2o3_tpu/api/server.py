@@ -385,6 +385,49 @@ def _leaderboard(params, body, project=None):
             "leaderboard_table": aml.leaderboard.as_table()}
 
 
+@route("GET", "/")
+def _index(params, body):
+    """Minimal landing page (the h2o-web Flow-serving role: the node
+    itself answers a browser with a live cluster view)."""
+    info = cloud_mod.cluster_info()
+    frames = sum(1 for k in DKV.keys() if isinstance(DKV.get(k), Frame))
+    models = sum(1 for k in DKV.keys() if isinstance(DKV.get(k), Model))
+    html = f"""<!doctype html><html><head><title>h2o3-tpu</title></head>
+<body style="font-family:monospace">
+<h2>h2o3-tpu cloud '{info["cloud_name"]}'</h2>
+<p>{info["cloud_size"]} device(s) on {info["platform"]} —
+healthy: {info["cloud_healthy"]}</p>
+<p>{frames} frame(s), {models} model(s),
+{len(all_algos())} algorithms registered</p>
+<p>REST: <a href="/3/Cloud">/3/Cloud</a> ·
+<a href="/3/Frames">/3/Frames</a> ·
+<a href="/3/Models">/3/Models</a> ·
+<a href="/3/ModelBuilders">/3/ModelBuilders</a> ·
+<a href="/3/Jobs">/3/Jobs</a> ·
+<a href="/3/Timeline">/3/Timeline</a> ·
+<a href="/3/SelfBench">/3/SelfBench</a></p>
+</body></html>"""
+    return {"__html__": html}
+
+
+@route("GET", "/3/WaterMeterCpuTicks")
+def _water_meter(params, body):
+    """Per-core cpu tick counters (water/util/WaterMeterCpuTicks.java).
+    Wire layout per LinuxProcFileReader: [user+nice, system, other(io),
+    idle]."""
+    ticks = []
+    try:
+        with open("/proc/stat") as f:
+            for line in f:
+                if line.startswith("cpu") and line[3].isdigit():
+                    p = line.split()   # cpuN user nice system idle iowait…
+                    ticks.append([int(p[1]) + int(p[2]), int(p[3]),
+                                  int(p[5]), int(p[4])])
+    except OSError:
+        pass
+    return {"cpu_ticks": ticks}
+
+
 @route("GET", "/3/Timeline")
 def _timeline(params, body):
     from h2o3_tpu.utils.timeline import snapshot
@@ -472,9 +515,14 @@ class _Handler(BaseHTTPRequestHandler):
                            "error_url": path, "msg": str(e),
                            "exception_msg": str(e)}
                     code = 500
-                payload = json.dumps(out, default=_json_default).encode()
+                if isinstance(out, dict) and "__html__" in out:
+                    payload = out["__html__"].encode()
+                    ctype = "text/html; charset=utf-8"
+                else:
+                    payload = json.dumps(out, default=_json_default).encode()
+                    ctype = "application/json"
                 self.send_response(code)
-                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Type", ctype)
                 self.send_header("Content-Length", str(len(payload)))
                 self.end_headers()
                 self.wfile.write(payload)

@@ -996,6 +996,285 @@ def _substring(env, x, start, end=("num", 1e9)):
 # ---------------------------------------------------------------- env
 
 
+# ---- matching / introspection (ast/prims/{mungers,misc}) -------------
+
+@prim("match")
+def _match(env, x, table, nomatch=("num", float("nan")), *rest):
+    """Value → 1-based index into ``table`` (AstMatch semantics)."""
+    f = _as_frame(env.ev(x))
+    tbl = env.ev(table)
+    if isinstance(tbl, tuple) and tbl[0] == "list":
+        tbl = [t[1] for t in tbl[1]]
+    elif not isinstance(tbl, (list, np.ndarray)):
+        tbl = [tbl]
+    nm = env.ev(nomatch)
+    lut = {str(v): i + 1 for i, v in enumerate(tbl)}
+    out = {}
+    for n in f.names:
+        c = f.col(n)
+        if c.is_categorical:
+            dom_map = np.asarray([lut.get(lvl, np.nan)
+                                  for lvl in (c.domain or [])] + [np.nan])
+            codes = _cat_codes(f, n)
+            vals = dom_map[np.where(codes < 0, len(dom_map) - 1, codes)]
+        else:
+            vals = np.asarray([lut.get(str(v), np.nan)
+                               for v in c.to_numpy()])
+        out[n] = np.where(np.isnan(vals), nm, vals)
+    return _rebuild(f, out, keep_domains=False)
+
+
+@prim("h2o.which")
+def _which(env, x):
+    """Row numbers (0-based) where the predicate column is non-zero;
+    NA predicate rows are excluded (R which() semantics)."""
+    f = _as_frame(env.ev(x))
+    v = _col_np(f, f.names[0])
+    hit = np.where(~np.isnan(v) & (v != 0))[0]
+    return Frame.from_numpy({"which": hit.astype(np.float64)})
+
+
+def _which_extreme(best_of):
+    def fn(env, x, na_rm=("num", 1), axis=("num", 0)):
+        """idxmax/idxmin (h2o-py frame.py): axis=0 → per-column max-row
+        index (1-row frame); axis=1 → per-row argmax across columns.
+        All-NaN slices yield NA instead of raising."""
+        f = _as_frame(env.ev(x))
+        ax = int(env.ev(axis))
+        M = np.stack([_col_np(f, n) for n in f.names], axis=1)
+        fill = -np.inf if best_of == "max" else np.inf
+        Mf = np.where(np.isnan(M), fill, M)
+        pick = np.argmax(Mf, axis=ax) if best_of == "max" \
+            else np.argmin(Mf, axis=ax)
+        all_na = np.isnan(M).all(axis=ax)
+        out = np.where(all_na, np.nan, pick.astype(float))
+        name = f"which.{best_of}"
+        if ax == 0:
+            return Frame.from_numpy({n: np.asarray([out[j]])
+                                     for j, n in enumerate(f.names)})
+        return Frame.from_numpy({name: out})
+    return fn
+
+
+PRIMS["which.max"] = PRIMS["which_max"] = _which_extreme("max")
+PRIMS["which.min"] = PRIMS["which_min"] = _which_extreme("min")
+
+
+@prim("levels")
+def _levels(env, x):
+    f = _as_frame(env.ev(x))
+    dom = f.col(f.names[0]).domain or []
+    return Frame.from_numpy({"levels": np.asarray(dom, dtype=object)},
+                            categorical=["levels"])
+
+
+@prim("nlevels")
+def _nlevels(env, x):
+    f = _as_frame(env.ev(x))
+    return float(f.col(f.names[0]).cardinality)
+
+
+@prim("is.factor")
+def _is_factor(env, x):
+    f = _as_frame(env.ev(x))
+    return float(all(f.col(n).is_categorical for n in f.names))
+
+
+@prim("is.numeric")
+def _is_numeric(env, x):
+    f = _as_frame(env.ev(x))
+    return float(all(f.col(n).is_numeric for n in f.names))
+
+
+@prim("is.character")
+def _is_character(env, x):
+    f = _as_frame(env.ev(x))
+    return float(all(f.col(n).type == "string" for n in f.names))
+
+
+@prim("anyfactor")
+def _anyfactor(env, x):
+    f = _as_frame(env.ev(x))
+    return float(any(f.col(n).is_categorical for n in f.names))
+
+
+@prim("any.na")
+def _any_na(env, x):
+    f = _as_frame(env.ev(x))
+    for n in f.names:
+        c = f.col(n)
+        if c.type == "string":
+            if any(v is None for v in c.to_numpy()):
+                return 1.0
+        elif bool(np.asarray(c.na_mask)[: f.nrows].any()):
+            return 1.0
+    return 0.0
+
+
+@prim("cor")
+def _cor(env, x, y=None, use=("str", "everything"), *rest):
+    """Pearson correlation (AstCorrelation). use='everything' propagates
+    NaN; 'complete.obs'/'all.obs' drop NA rows first."""
+    fx = _as_frame(env.ev(x))
+    fy = _as_frame(env.ev(y)) if y is not None else fx
+    mode = str(env.ev(use)).lower()
+    a = np.stack([_col_np(fx, n) for n in fx.names], axis=1)
+    b = np.stack([_col_np(fy, n) for n in fy.names], axis=1)
+    if mode != "everything":
+        ok = ~(np.isnan(a).any(axis=1) | np.isnan(b).any(axis=1))
+        a, b = a[ok], b[ok]
+    am = a - a.mean(axis=0)
+    bm = b - b.mean(axis=0)
+    cov = am.T @ bm / max(len(a) - 1, 1)
+    sa = a.std(axis=0, ddof=1)
+    sb = b.std(axis=0, ddof=1)
+    cmat = cov / np.maximum(np.outer(sa, sb), 1e-300)
+    if cmat.size == 1:
+        return float(cmat[0, 0])
+    return Frame.from_numpy({n: cmat[:, j] for j, n in enumerate(fy.names)})
+
+
+@prim("skewness")
+def _skewness(env, x, na_rm=("num", 1)):
+    f = _as_frame(env.ev(x))
+    v = _col_np(f, f.names[0])
+    v = v[~np.isnan(v)]
+    s = v.std(ddof=1)
+    return float(((v - v.mean()) ** 3).mean() / max(s ** 3, 1e-300))
+
+
+@prim("kurtosis")
+def _kurtosis(env, x, na_rm=("num", 1)):
+    f = _as_frame(env.ev(x))
+    v = _col_np(f, f.names[0])
+    v = v[~np.isnan(v)]
+    s = v.std(ddof=1)
+    return float(((v - v.mean()) ** 4).mean() / max(s ** 4, 1e-300))
+
+
+@prim("strsplit")
+def _strsplit(env, x, pattern):
+    """Split a string/cat column → multi-column frame (AstStrSplit)."""
+    f = _as_frame(env.ev(x))
+    pat = env.ev(pattern)
+    c = f.col(f.names[0])
+    if c.is_categorical:
+        dom = np.asarray(c.domain or [], dtype=object)
+        codes = _cat_codes(f, f.names[0])
+        vals = [None if k < 0 else dom[k] for k in codes]
+    else:
+        vals = list(c.to_numpy())
+    def _split(v):
+        if not isinstance(v, str):
+            return []
+        p = _re.split(pat, v)
+        while p and p[-1] == "":   # Java String.split drops trailing empties
+            p.pop()
+        return p
+
+    parts = [_split(v) for v in vals]
+    width = max((len(p) for p in parts), default=1)
+    out = {}
+    for j in range(width):
+        out[f"C{j + 1}"] = np.asarray(
+            [p[j] if j < len(p) else None for p in parts], dtype=object)
+    return Frame.from_numpy(out, categorical=list(out))
+
+
+@prim("countmatches")
+def _countmatches(env, x, patterns):
+    f = _as_frame(env.ev(x))
+    pats = env.ev(patterns)
+    if isinstance(pats, tuple) and pats[0] == "list":
+        pats = [p[1] for p in pats[1]]
+    elif not isinstance(pats, list):
+        pats = [pats]
+    c = f.col(f.names[0])
+    if c.is_categorical:
+        dom = np.asarray(c.domain or [], dtype=object)
+        codes = _cat_codes(f, f.names[0])
+        vals = [None if k < 0 else dom[k] for k in codes]
+    else:
+        vals = list(c.to_numpy())
+    cnt = np.asarray([np.nan if not isinstance(v, str)
+                      else float(sum(v.count(str(p)) for p in pats))
+                      for v in vals])
+    return Frame.from_numpy({f.names[0]: cnt})
+
+
+@prim("entropy")
+def _entropy(env, x):
+    """Per-string Shannon entropy over characters (AstEntropy)."""
+    f = _as_frame(env.ev(x))
+    c = f.col(f.names[0])
+    vals = c.to_numpy() if not c.is_categorical else [
+        None if k < 0 else (c.domain or [])[k] for k in _cat_codes(f, f.names[0])]
+
+    def ent(s):
+        if not isinstance(s, str) or not s:
+            return np.nan
+        _, cnt = np.unique(list(s), return_counts=True)
+        p = cnt / cnt.sum()
+        return float(-(p * np.log2(p)).sum())
+
+    return Frame.from_numpy({f.names[0]: np.asarray([ent(v) for v in vals])})
+
+
+@prim("difflag1")
+def _difflag1(env, x):
+    """First difference x[i] - x[i-1] (ast/prims/timeseries AstDiffLag1)."""
+    f = _as_frame(env.ev(x))
+    v = _col_np(f, f.names[0])
+    out = np.empty_like(v)
+    out[0] = np.nan
+    out[1:] = v[1:] - v[:-1]
+    return Frame.from_numpy({f.names[0]: out})
+
+
+def _timeop(extract):
+    def fn(env, x):
+        f = _as_frame(env.ev(x))
+        import datetime as _dt
+        out = {}
+        for n in f.names:
+            ms = _col_np(f, n)
+            vals = np.full(len(ms), np.nan)
+            ok = ~np.isnan(ms)
+            vals[ok] = [extract(_dt.datetime.fromtimestamp(
+                m / 1000.0, _dt.timezone.utc)) for m in ms[ok]]
+            out[n] = vals
+        return _rebuild(f, out, keep_domains=False)
+    return fn
+
+
+PRIMS["year"] = _timeop(lambda d: d.year)
+PRIMS["month"] = _timeop(lambda d: d.month)
+PRIMS["day"] = _timeop(lambda d: d.day)
+PRIMS["hour"] = _timeop(lambda d: d.hour)
+PRIMS["minute"] = _timeop(lambda d: d.minute)
+PRIMS["second"] = _timeop(lambda d: d.second)
+PRIMS["dayOfWeek"] = _timeop(lambda d: d.weekday())
+PRIMS["week"] = _timeop(lambda d: d.isocalendar()[1])
+
+
+@prim("relevel")
+def _relevel(env, x, level):
+    """Move ``level`` to the front of the domain (AstRelevel)."""
+    f = _as_frame(env.ev(x))
+    lvl = str(env.ev(level))
+    n = f.names[0]
+    c = f.col(n)
+    dom = list(c.domain or [])
+    if lvl not in dom:
+        raise ValueError(f"level '{lvl}' not in domain")
+    new_dom = [lvl] + [d for d in dom if d != lvl]
+    remap = np.asarray([new_dom.index(d) for d in dom])
+    codes = _cat_codes(f, n)
+    new_codes = np.where(codes < 0, -1, remap[np.maximum(codes, 0)])
+    return Frame.from_numpy({n: new_codes.astype(np.int32)},
+                            categorical=[n], domains={n: new_dom})
+
+
 class Env:
     """Evaluation environment (water/rapids/Env.java)."""
 

@@ -1,0 +1,105 @@
+"""New Rapids prims: match/which/levels/cor/strsplit/time ops/etc."""
+
+import numpy as np
+import pytest
+
+import h2o3_tpu
+from h2o3_tpu.rapids import rapids
+
+
+@pytest.fixture()
+def fr():
+    return h2o3_tpu.Frame.from_numpy(
+        {"g": np.asarray(["a", "b", "c", "a", None], dtype=object),
+         "x": np.asarray([1.0, 2.0, 3.0, 4.0, 5.0]),
+         "y": np.asarray([2.0, 4.0, 6.0, 8.0, 10.0]),
+         "t": np.asarray([0.0, 86400000.0, 90000000.0, 3600000.0,
+                          1234567890000.0])},
+        categorical=["g"], key="rapx")
+
+
+def test_match_and_levels(fr):
+    out = rapids('(match (cols_py rapx "g") ["b" "c"] NaN 0)')
+    v = out.col("g").to_numpy()
+    assert np.isnan(v[0]) and v[1] == 1 and v[2] == 2 and np.isnan(v[4])
+    lv = rapids('(levels (cols_py rapx "g"))')
+    assert list(lv.col("levels").to_numpy().astype(str)) == ["0", "1", "2"] \
+        or lv.nrows == 3
+    assert rapids('(nlevels (cols_py rapx "g"))') == 3
+    assert rapids('(is.factor (cols_py rapx "g"))') == 1.0
+    assert rapids('(is.numeric (cols_py rapx "x"))') == 1.0
+    assert rapids('(anyfactor rapx)') == 1.0
+    assert rapids('(any.na rapx)') == 1.0
+
+
+def test_which_ops(fr):
+    w = rapids('(h2o.which (> (cols_py rapx "x") 2.5))')
+    np.testing.assert_array_equal(w.col("which").to_numpy(), [2, 3, 4])
+    # axis=1: per-row argmax; axis=0 (h2o-py idxmax default): per-column
+    wm = rapids('(which.max (cols_py rapx ["x" "y"]) 1 1)')
+    np.testing.assert_array_equal(wm.col("which.max").to_numpy(),
+                                  [1, 1, 1, 1, 1])
+    wc = rapids('(which.max (cols_py rapx ["x" "y"]) 1 0)')
+    assert wc.nrows == 1
+    assert wc.col("x").to_numpy()[0] == 4   # max of x sits in row 4
+
+
+def test_which_excludes_na():
+    h2o3_tpu.Frame.from_numpy({"v": np.asarray([1.0, 0.0, np.nan, 2.0])},
+                              key="whichna")
+    w = rapids('(h2o.which (cols_py whichna "v"))')
+    np.testing.assert_array_equal(w.col("which").to_numpy(), [0, 3])
+
+
+def test_cor(fr):
+    c = rapids('(cor (cols_py rapx "x") (cols_py rapx "y") "everything" '
+               '"Pearson")')
+    assert c == pytest.approx(1.0)
+
+
+def test_skew_kurt(fr):
+    s = rapids('(skewness (cols_py rapx "x") 1)')
+    assert abs(s) < 0.5
+    k = rapids('(kurtosis (cols_py rapx "x") 1)')
+    assert k > 0
+
+
+def test_strsplit_countmatches_entropy():
+    h2o3_tpu.Frame.from_numpy(
+        {"s": np.asarray(["a_b", "c_d_e", None], dtype=object)},
+        categorical=["s"], key="strf")
+    sp = rapids('(strsplit (cols_py strf "s") "_")')
+    assert sp.ncols == 3
+    assert sp.col("C1").domain is not None
+    cm = rapids('(countmatches (cols_py strf "s") ["_"])')
+    v = cm.col("s").to_numpy()
+    assert v[0] == 1 and v[1] == 2 and np.isnan(v[2])
+    en = rapids('(entropy (cols_py strf "s"))')
+    assert en.col("s").to_numpy()[0] > 0
+
+
+def test_time_ops(fr):
+    yr = rapids('(year (cols_py rapx "t"))').col("t").to_numpy()
+    assert yr[0] == 1970 and yr[4] == 2009
+    dw = rapids('(dayOfWeek (cols_py rapx "t"))').col("t").to_numpy()
+    assert dw[0] == 3   # 1970-01-01 was a Thursday (weekday()==3)
+    hh = rapids('(hour (cols_py rapx "t"))').col("t").to_numpy()
+    assert hh[3] == 1
+
+
+def test_difflag1(fr):
+    d = rapids('(difflag1 (cols_py rapx "x"))').col("x").to_numpy()
+    assert np.isnan(d[0])
+    np.testing.assert_array_equal(d[1:], [1, 1, 1, 1])
+
+
+def test_relevel(fr):
+    out = rapids('(relevel (cols_py rapx "g") "c")')
+    c = out.col("g")
+    assert c.domain[0] == "c"
+    # row values preserved under the new coding
+    dom = np.asarray(c.domain + [None], dtype=object)
+    codes = np.asarray(c.data)[: out.nrows].astype(int)
+    na = np.asarray(c.na_mask)[: out.nrows]
+    vals = dom[np.where(na, len(c.domain), codes)]
+    assert list(vals[:4]) == ["a", "b", "c", "a"] and vals[4] is None

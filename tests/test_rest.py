@@ -51,6 +51,18 @@ def _wait_job(port, key, timeout=300):
     raise TimeoutError(key)
 
 
+def test_landing_page_and_meters(port):
+    url = f"http://127.0.0.1:{port}/"
+    with urllib.request.urlopen(url) as resp:
+        assert resp.status == 200
+        assert "text/html" in resp.headers["Content-Type"]
+        body = resp.read().decode()
+        assert "h2o3-tpu cloud" in body
+    st, j = _req(port, "GET", "/3/WaterMeterCpuTicks")
+    assert st == 200
+    assert isinstance(j["cpu_ticks"], list)
+
+
 def test_cloud_up(port):
     st, j = _req(port, "GET", "/3/Cloud")
     assert st == 200
