@@ -45,18 +45,20 @@ def _sample_columns(k1, k2, F: int, rate: float):
 
 
 @partial(jax.jit, static_argnames=("tp", "dist", "sample_rate"))
-def _boost_step(bins, nb, y, w, margin, key, *, tp: TreeParams,
-                dist: Distribution, sample_rate: float):
+def _boost_step(bins, nb, y, w, margin, key, constraints=None, *,
+                tp: TreeParams, dist: Distribution, sample_rate: float):
     """One boosting iteration, fully on device (per-tree loop path —
     used when early stopping / validation tracking needs the host
     between trees; otherwise _boost_scan fuses the whole loop)."""
     return _boost_step_impl(bins, nb, y, w, margin, key, tp=tp, dist=dist,
-                            sample_rate=sample_rate)
+                            sample_rate=sample_rate,
+                            constraints=constraints)
 
 
 @partial(jax.jit, static_argnames=("tp", "dist", "sample_rate", "ntrees"))
-def _boost_scan(bins, nb, y, w, margin, key, *, tp: TreeParams,
-                dist: Distribution, sample_rate: float, ntrees: int):
+def _boost_scan(bins, nb, y, w, margin, key, constraints=None, *,
+                tp: TreeParams, dist: Distribution, sample_rate: float,
+                ntrees: int):
     """All ``ntrees`` boosting iterations as ONE compiled program.
 
     ``lax.scan`` over per-tree PRNG keys removes the per-tree
@@ -69,14 +71,15 @@ def _boost_scan(bins, nb, y, w, margin, key, *, tp: TreeParams,
     def step(margin, k):
         tree, margin, gains = _boost_step_impl(
             bins, nb, y, w, margin, k, tp=tp, dist=dist,
-            sample_rate=sample_rate)
+            sample_rate=sample_rate, constraints=constraints)
         return margin, (tree, gains)
 
     margin, (trees, gains) = jax.lax.scan(step, margin, keys)
     return trees, margin, jnp.sum(gains, axis=0)
 
 
-def _boost_step_impl(bins, nb, y, w, margin, key, *, tp, dist, sample_rate):
+def _boost_step_impl(bins, nb, y, w, margin, key, *, tp, dist, sample_rate,
+                     constraints=None):
     """Unjitted body shared by _boost_step and _boost_scan."""
     mesh = get_mesh()
     g = dist.grad(y, margin)
@@ -89,7 +92,8 @@ def _boost_step_impl(bins, nb, y, w, margin, key, *, tp, dist, sample_rate):
     F = bins.shape[1]
     col_mask = _sample_columns(kc1, kc2, F, tp.col_sample_rate)
     tree, nid, gains = grow_tree(bins, nb, ws, g, h, col_mask,
-                                 params=tp, mesh=mesh)
+                                 params=tp, mesh=mesh,
+                                 constraints=constraints)
     tree = tree._replace(leaf=tp.learn_rate * tree.leaf)
     margin = margin + tree.leaf[nid]
     return tree, margin, gains
@@ -221,6 +225,7 @@ class GBMEstimator(ModelBuilder):
         ignored_columns=None, tweedie_power=1.5, quantile_alpha=0.5,
         huber_alpha=0.9, stopping_rounds=0, stopping_metric="auto",
         stopping_tolerance=1e-3, score_tree_interval=0, checkpoint=None,
+        monotone_constraints=None,
     )
 
     def __init__(self, **params):
@@ -291,6 +296,31 @@ class GBMEstimator(ModelBuilder):
             min_split_improvement=float(p["min_split_improvement"]),
             col_sample_rate=float(p["col_sample_rate_per_tree"]),
             nbins_total=bm.nbins_total)
+
+        # monotone constraints (GBM.java monotone_constraints; numeric
+        # features only, like the reference's validation)
+        constraints = None
+        mc = p.get("monotone_constraints") or {}
+        if isinstance(mc, (list, tuple)):
+            # h2o-py serializes this as KeyValue pairs
+            # ([{'key': col, 'value': ±1}, ...], water/api/schemas3/KeyValueV3)
+            mc = {kv["key"]: kv["value"] for kv in mc}
+        if mc:
+            unknown_cols = set(mc) - set(x)
+            if unknown_cols:
+                raise ValueError(f"monotone_constraints columns not in "
+                                 f"predictors: {sorted(unknown_cols)}")
+            bad = [c for c in mc if frame.col(c).is_categorical]
+            if bad:
+                raise ValueError("monotone_constraints require numeric "
+                                 f"columns; categorical: {sorted(bad)}")
+            if category == ModelCategory.MULTINOMIAL:
+                raise ValueError("monotone_constraints are not supported "
+                                 "for multinomial distributions")
+            arr = np.zeros(len(x), np.int8)
+            for c, d in mc.items():
+                arr[x.index(c)] = int(np.sign(d))
+            constraints = jnp.asarray(arr)
 
         seed = int(p["seed"]) if int(p["seed"]) >= 0 else 0xDEC0DE
         key = jax.random.PRNGKey(seed)
@@ -441,7 +471,8 @@ class GBMEstimator(ModelBuilder):
                     k = min(CHUNK, ntrees - done)
                     key, sub = jax.random.split(key)
                     tr_k, margin, gains = _boost_scan(
-                        bm.bins, bm.nbins, y_dev, w, margin, sub, tp=tp,
+                        bm.bins, bm.nbins, y_dev, w, margin, sub,
+                        constraints, tp=tp,
                         dist=dist, sample_rate=float(p["sample_rate"]),
                         ntrees=k)
                     chunks.append(tr_k)
@@ -456,7 +487,8 @@ class GBMEstimator(ModelBuilder):
                 for t in range(ntrees):
                     key, sub = jax.random.split(key)
                     tr, margin, gains = _boost_step(
-                        bm.bins, bm.nbins, y_dev, w, margin, sub, tp=tp,
+                        bm.bins, bm.nbins, y_dev, w, margin, sub,
+                        constraints, tp=tp,
                         dist=dist, sample_rate=float(p["sample_rate"]))
                     trees.append(tr)
                     gains_total += np.asarray(gains)

@@ -66,12 +66,18 @@ def row_feature_values(bins, f_r):
     return jnp.sum(jnp.where(f_r[:, None] == iota[None, :], bins, 0), axis=1)
 
 
-def _best_splits(hist, nb, col_mask, params: TreeParams):
+def _best_splits(hist, nb, col_mask, params: TreeParams,
+                 constraints=None, lo=None, hi=None):
     """Vectorized DTree.findBestSplitPoint over all nodes of a level.
 
     hist: [L, F, B, 3] of {w, g, h}; col_mask [F] (per-tree sampling) or
-    [L, F] (per-node mtries, DRF). Returns per-node best
-    (gain, feat, thresh, na_left).
+    [L, F] (per-node mtries, DRF). With ``constraints`` ([F] in
+    {-1,0,+1}) and per-node value bounds lo/hi ([L]), splits on
+    constrained features must order their (bound-clipped) child Newton
+    values per the constraint direction — the monotone-constraints
+    contract of the reference GBM (hex/tree/DHistogram constraints +
+    hex/tree/Constraints). Returns per-node best
+    (gain, feat, thresh, na_left, left_val, right_val).
     """
     lam = params.reg_lambda
     B = hist.shape[2]
@@ -84,21 +90,34 @@ def _best_splits(hist, nb, col_mask, params: TreeParams):
     tw = cw[:, :, -1] + naw
     tg = cg[:, :, -1] + nag
     th = ch[:, :, -1] + nah
+    if lo is None:
+        lo = jnp.full((hist.shape[0],), -jnp.inf, jnp.float32)
+        hi = jnp.full((hist.shape[0],), jnp.inf, jnp.float32)
 
     def gain(gl, hl, gr, hr):
         return (gl * gl / (hl + lam) + gr * gr / (hr + lam)
                 - tg[:, :, None] ** 2 / (th[:, :, None] + lam))
+
+    def child_vals(gl, hl, gr, hr):
+        lv = jnp.clip(-gl / (hl + lam), lo[:, None, None], hi[:, None, None])
+        rv = jnp.clip(-gr / (hr + lam), lo[:, None, None], hi[:, None, None])
+        return lv, rv
 
     def masked_gain(wl, gl, hl):
         wr = tw[:, :, None] - wl
         gr = tg[:, :, None] - gl
         hr = th[:, :, None] - hl
         ok = (wl >= params.min_rows) & (wr >= params.min_rows)
-        return jnp.where(ok, gain(gl, hl, gr, hr), -jnp.inf)
+        lv, rv = child_vals(gl, hl, gr, hr)
+        if constraints is not None:
+            c = constraints[None, :, None].astype(jnp.float32)
+            ok = ok & (c * (rv - lv) >= 0)
+        return jnp.where(ok, gain(gl, hl, gr, hr), -jnp.inf), lv, rv
 
-    g_nar = masked_gain(cw, cg, ch)                         # NA → right
-    g_nal = masked_gain(cw + naw[:, :, None], cg + nag[:, :, None],
-                        ch + nah[:, :, None])               # NA → left
+    g_nar, lv_nar, rv_nar = masked_gain(cw, cg, ch)         # NA → right
+    g_nal, lv_nal, rv_nal = masked_gain(
+        cw + naw[:, :, None], cg + nag[:, :, None],
+        ch + nah[:, :, None])                               # NA → left
     # threshold validity: t <= nb[f]-2 (splitting at last real bin is void)
     t_ids = jnp.arange(B - 1, dtype=jnp.int32)
     valid_t = t_ids[None, :] <= (nb[:, None] - 2)           # [F, B-1]
@@ -115,7 +134,11 @@ def _best_splits(hist, nb, col_mask, params: TreeParams):
     na_left = (best % 2).astype(bool)
     best_t = ((best // 2) % (B - 1)).astype(jnp.int32)
     best_f = (best // (2 * (B - 1))).astype(jnp.int32)
-    return best_gain, best_f, best_t, na_left
+    lvals = jnp.stack([lv_nar, lv_nal], axis=-1).reshape(L, -1)
+    rvals = jnp.stack([rv_nar, rv_nal], axis=-1).reshape(L, -1)
+    best_lv = jnp.take_along_axis(lvals, best[:, None], axis=1)[:, 0]
+    best_rv = jnp.take_along_axis(rvals, best[:, None], axis=1)[:, 0]
+    return best_gain, best_f, best_t, na_left, best_lv, best_rv
 
 
 def _mtries_mask(key, L: int, F: int, mtries: int):
@@ -128,13 +151,16 @@ def _mtries_mask(key, L: int, F: int, mtries: int):
 
 
 def grow_tree(bins, nb, w, g, h, col_mask, *, params: TreeParams, mesh,
-              mtries: int = 0, key=None):
+              mtries: int = 0, key=None, constraints=None):
     """Grow one tree; returns (Tree, final_leaf_id_per_row).
 
     bins [Npad, F] int32 row-sharded; w zero on padding rows; col_mask [F]
     bool (per-tree column sampling, reference col_sample_rate_per_tree).
     mtries > 0 additionally samples exactly-mtries columns per NODE per
-    level (DRF semantics) using `key`.
+    level (DRF semantics) using `key`. ``constraints`` [F] in {-1,0,+1}
+    activates monotone constraints: per-node value bounds propagate to
+    children through the split midpoint and leaves are clipped into
+    them (the reference's hex/tree/Constraints machinery).
     """
     D = params.max_depth
     B = params.nbins_total
@@ -148,6 +174,8 @@ def grow_tree(bins, nb, w, g, h, col_mask, *, params: TreeParams, mesh,
     na_lefts = jnp.zeros((D, Lmax), bool)
     is_splits = jnp.zeros((D, Lmax), bool)
     gain_by_feat = jnp.zeros((F,), jnp.float32)  # relative varimp (hex/VarImp)
+    lo = jnp.full((1,), -jnp.inf, jnp.float32)
+    hi = jnp.full((1,), jnp.inf, jnp.float32)
 
     for d in range(D):
         L = 2 ** d
@@ -157,7 +185,8 @@ def grow_tree(bins, nb, w, g, h, col_mask, *, params: TreeParams, mesh,
         if mtries > 0 and mtries < F:
             key, sub = jax.random.split(key)
             cm = _mtries_mask(sub, L, F, mtries) & col_mask[None, :]
-        bg, bf, bt, bnal = _best_splits(hist, nb, cm, params)
+        bg, bf, bt, bnal, blv, brv = _best_splits(
+            hist, nb, cm, params, constraints=constraints, lo=lo, hi=hi)
         split = bg > params.min_split_improvement
         feats = feats.at[d, :L].set(jnp.where(split, bf, 0))
         threshs = threshs.at[d, :L].set(jnp.where(split, bt, B))
@@ -168,6 +197,20 @@ def grow_tree(bins, nb, w, g, h, col_mask, *, params: TreeParams, mesh,
             * (bf[:, None] == jnp.arange(F, dtype=jnp.int32)[None, :]),
             axis=0)
 
+        # bound propagation (Constraints.childBounds role): on a
+        # constrained split the midpoint of the child values caps the
+        # low side / high side; unconstrained splits inherit
+        if constraints is not None:
+            c_split = constraints[bf].astype(jnp.float32) * split
+            mid = 0.5 * (blv + brv)
+            lo_l = lo
+            hi_l = jnp.where(c_split > 0, jnp.minimum(hi, mid), hi)
+            lo_l = jnp.where(c_split < 0, jnp.maximum(lo, mid), lo_l)
+            lo_r = jnp.where(c_split > 0, jnp.maximum(lo, mid), lo)
+            hi_r = jnp.where(c_split < 0, jnp.minimum(hi, mid), hi)
+            # interleave children: node l → children 2l, 2l+1
+            lo = jnp.stack([lo_l, lo_r], axis=1).reshape(-1)
+            hi = jnp.stack([hi_l, hi_r], axis=1).reshape(-1)
         # route rows (the reference's DecidedNode assignment pass)
         f_r = feats[d][nid]
         t_r = threshs[d][nid]
@@ -187,6 +230,8 @@ def grow_tree(bins, nb, w, g, h, col_mask, *, params: TreeParams, mesh,
                              block_rows=params.block_rows)
     G, H = leaf_stats[:, 1], leaf_stats[:, 2]
     leaf = jnp.where(leaf_stats[:, 0] > 0, -G / (H + params.reg_lambda), 0.0)
+    if constraints is not None:
+        leaf = jnp.clip(leaf, lo, hi)   # leaves honor propagated bounds
     tree = Tree(feats, threshs, na_lefts, is_splits, leaf)
     return tree, nid, gain_by_feat
 

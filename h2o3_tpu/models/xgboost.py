@@ -51,6 +51,7 @@ _ALIASES = {
     "min_split_improvement": "min_split_improvement",
     "reg_lambda": "reg_lambda",
     "lambda_": "reg_lambda",
+    "monotone_constraints": "monotone_constraints",
 }
 
 # accepted for wire compatibility, no effect on the TPU backend
@@ -60,7 +61,7 @@ _INERT = {"booster", "tree_method", "grow_policy", "backend", "gpu_id",
           "scale_pos_weight", "max_leaves", "sample_type",
           "normalize_type", "rate_drop", "one_drop", "skip_drop",
           "nthread", "save_matrix_directory", "calibrate_model",
-          "max_delta_step", "monotone_constraints", "interaction_constraints"}
+          "max_delta_step", "interaction_constraints"}
 
 
 @register

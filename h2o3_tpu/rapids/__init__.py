@@ -1152,6 +1152,16 @@ def _kurtosis(env, x, na_rm=("num", 1)):
     return float(((v - v.mean()) ** 4).mean() / max(s ** 4, 1e-300))
 
 
+def _str_values(f: Frame, name: str):
+    """Column → list of Python strings (None for NA) for string prims."""
+    c = f.col(name)
+    if c.is_categorical:
+        dom = np.asarray(c.domain or [], dtype=object)
+        return [None if k < 0 or k >= len(dom) else dom[k]
+                for k in _cat_codes(f, name)]
+    return list(c.to_numpy())
+
+
 @prim("strsplit")
 def _strsplit(env, x, pattern):
     """Split a string/cat column → multi-column frame (AstStrSplit)."""
@@ -1189,13 +1199,7 @@ def _countmatches(env, x, patterns):
         pats = [p[1] for p in pats[1]]
     elif not isinstance(pats, list):
         pats = [pats]
-    c = f.col(f.names[0])
-    if c.is_categorical:
-        dom = np.asarray(c.domain or [], dtype=object)
-        codes = _cat_codes(f, f.names[0])
-        vals = [None if k < 0 else dom[k] for k in codes]
-    else:
-        vals = list(c.to_numpy())
+    vals = _str_values(f, f.names[0])
     cnt = np.asarray([np.nan if not isinstance(v, str)
                       else float(sum(v.count(str(p)) for p in pats))
                       for v in vals])
@@ -1206,9 +1210,7 @@ def _countmatches(env, x, patterns):
 def _entropy(env, x):
     """Per-string Shannon entropy over characters (AstEntropy)."""
     f = _as_frame(env.ev(x))
-    c = f.col(f.names[0])
-    vals = c.to_numpy() if not c.is_categorical else [
-        None if k < 0 else (c.domain or [])[k] for k in _cat_codes(f, f.names[0])]
+    vals = _str_values(f, f.names[0])
 
     def ent(s):
         if not isinstance(s, str) or not s:
