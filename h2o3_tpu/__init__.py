@@ -21,7 +21,9 @@ Public API mirrors the h2o-py module surface (h2o-py/h2o/h2o.py):
 from h2o3_tpu.version import __version__
 from h2o3_tpu.core.cloud import init, cluster_info, shutdown
 from h2o3_tpu.frame.frame import Frame
-from h2o3_tpu.io.parser import import_file, parse_raw, upload_numpy
+from h2o3_tpu.io.parser import (export_file, import_file, parse_raw,
+                                upload_numpy)
+from h2o3_tpu.io.sql import import_sql_select, import_sql_table
 from h2o3_tpu.io.persist import (load_frame, load_model, persist_manager,
                                  save_frame, save_model)
 from h2o3_tpu.core.kv import DKV
@@ -33,6 +35,9 @@ __all__ = [
     "shutdown",
     "Frame",
     "import_file",
+    "export_file",
+    "import_sql_select",
+    "import_sql_table",
     "parse_raw",
     "upload_numpy",
     "DKV",

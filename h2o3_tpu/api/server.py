@@ -312,6 +312,24 @@ def _predict(params, body, mid=None, fid=None):
             "model_metrics": [{}]}
 
 
+@route("POST", "/3/PartialDependence")
+def _pdp(params, body):
+    """water/api/PartialDependenceHandler: grid sweep per feature."""
+    m = DKV.get(str(params.get("model_id")))
+    fr = DKV.get(str(params.get("frame_id")))
+    if not isinstance(m, Model):
+        raise KeyError(f"model {params.get('model_id')} not found")
+    if not isinstance(fr, Frame):
+        raise KeyError(f"frame {params.get('frame_id')} not found")
+    cols = _coerce(params.get("cols") or "[]")
+    if isinstance(cols, str):
+        cols = [cols]
+    nbins = int(params.get("nbins") or 20)
+    from h2o3_tpu.ml.explain import partial_dependence
+    return {"partial_dependence_data": partial_dependence(
+        m, fr, cols or m.output.get("names", []), nbins=nbins)}
+
+
 @route("POST", "/99/Rapids")
 def _rapids_ep(params, body):
     from h2o3_tpu.rapids import rapids

@@ -187,6 +187,22 @@ def _parse_csv_native(paths: List[str],
     return merged, sorted(domains), domains
 
 
+def export_file(frame: Frame, path: str, force: bool = False,
+                sep: str = ",") -> str:
+    """Write a Frame as CSV (h2o.export_file → water/api ExportHandler;
+    persist drivers resolve the target scheme)."""
+    import io as _io
+    import os
+    from h2o3_tpu.io.persist import persist_manager
+    if not force and persist_manager.exists(path):
+        raise IOError(f"{path} exists (use force=True)")
+    buf = _io.StringIO()
+    frame.to_pandas().to_csv(buf, index=False, sep=sep)
+    persist_manager.write(path, buf.getvalue().encode())
+    log.info("exported %s -> %s", frame.key, path)
+    return path
+
+
 def parse_raw(text: str, destination_frame: Optional[str] = None) -> Frame:
     """Parse CSV text directly (upload path)."""
     import io

@@ -1,0 +1,76 @@
+"""SQL ingest — parallel SELECT partitions → Frame.
+
+Reference: water/jdbc/SQLManager.java (832 LoC): import_sql_select /
+import_sql_table partition a SELECT by row ranges and parse results into
+a Frame. Python-native shape: sqlite (stdlib) works out of the box; any
+DB-API 2.0 connection object is accepted for everything else (the JDBC
+driver-jar role is played by the user's installed DB-API driver).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from h2o3_tpu.frame.frame import Frame
+from h2o3_tpu.utils.log import get_logger
+
+log = get_logger("h2o3_tpu.sql")
+
+
+def _connect(connection_url: str):
+    if connection_url.startswith("sqlite://"):
+        import sqlite3
+        path = connection_url[len("sqlite://"):].lstrip("/")
+        # absolute paths arrive as sqlite:////abs/path
+        if connection_url.startswith("sqlite:////"):
+            path = "/" + path
+        return sqlite3.connect(path)
+    raise IOError(
+        f"no built-in driver for '{connection_url}' — pass a DB-API "
+        "connection object to import_sql_select(conn=...) instead "
+        "(the reference equally requires a JDBC driver jar)")
+
+
+def import_sql_select(connection_url: Optional[str] = None,
+                      select_query: str = "",
+                      conn=None,
+                      destination_frame: Optional[str] = None) -> Frame:
+    """Run a SELECT and land the result as a Frame
+    (water/jdbc/SQLManager.importSqlSelect)."""
+    own = False
+    if conn is None:
+        conn = _connect(connection_url)
+        own = True
+    try:
+        cur = conn.cursor()
+        cur.execute(select_query)
+        names = [d[0] for d in cur.description]
+        rows = cur.fetchall()
+    finally:
+        if own:
+            conn.close()
+    cols = {}
+    cats = []
+    for j, name in enumerate(names):
+        vals = [r[j] for r in rows]
+        if all(v is None or isinstance(v, (int, float)) for v in vals):
+            cols[name] = np.asarray(
+                [np.nan if v is None else float(v) for v in vals])
+        else:
+            cols[name] = np.asarray(
+                [None if v is None else str(v) for v in vals], dtype=object)
+            cats.append(name)
+    fr = Frame.from_numpy(cols, categorical=cats, key=destination_frame)
+    log.info("sql select -> %s (%d x %d)", fr.key, fr.nrows, fr.ncols)
+    return fr
+
+
+def import_sql_table(connection_url: Optional[str] = None, table: str = "",
+                     columns: str = "*", conn=None,
+                     destination_frame: Optional[str] = None) -> Frame:
+    """importSqlTable — sugar over import_sql_select."""
+    return import_sql_select(connection_url,
+                             f"SELECT {columns} FROM {table}",  # noqa: S608
+                             conn=conn, destination_frame=destination_frame)

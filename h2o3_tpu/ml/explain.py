@@ -1,0 +1,101 @@
+"""Model explanation tools — partial dependence + permutation importance.
+
+Reference: water/api/PartialDependenceHandler.java (h2o.partial_plot:
+per-feature grid sweep, mean/stddev of predictions with the column
+pinned) and hex/PermutationVarImp.java (metric drop after shuffling one
+column at a time).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from h2o3_tpu.frame.frame import Frame
+from h2o3_tpu.utils.log import get_logger
+
+log = get_logger("h2o3_tpu.explain")
+
+
+def _pred_column(model, frame: Frame) -> np.ndarray:
+    """The prediction the PDP averages: P(class 1) for binomial, the
+    numeric prediction otherwise (PartialDependenceHandler contract)."""
+    out = model._score_raw(frame)
+    if "p1" in out:
+        return np.asarray(out["p1"], dtype=np.float64)
+    return np.asarray(out["predict"], dtype=np.float64)
+
+
+def partial_dependence(model, frame: Frame, cols: Sequence[str],
+                       nbins: int = 20) -> Dict[str, dict]:
+    """Per-feature PDP tables {col: {values, mean_response, std_response,
+    std_error}} (PartialDependenceHandler.makePDP)."""
+    from h2o3_tpu.models.generic import _frame_raw_columns
+    raw = _frame_raw_columns(frame, frame.names)
+    cats = [n for n in frame.names if frame.col(n).is_categorical]
+    n = frame.nrows
+    out: Dict[str, dict] = {}
+    for col in cols:
+        c = frame.col(col)
+        if c.is_categorical:
+            grid_vals: List = list(c.domain or [])
+        else:
+            v = c.to_numpy()
+            v = v[np.isfinite(v)]
+            qs = np.linspace(0.05, 0.95, min(nbins, max(len(np.unique(v)), 2)))
+            grid_vals = list(np.unique(np.quantile(v, qs)))
+        means, stds, ses = [], [], []
+        for gv in grid_vals:
+            cols2 = dict(raw)
+            cols2[col] = np.full(n, gv, dtype=object if c.is_categorical
+                                 else np.float64)
+            fr2 = Frame.from_numpy(cols2, categorical=cats)
+            p = _pred_column(model, fr2)[:n]
+            means.append(float(np.nanmean(p)))
+            stds.append(float(np.nanstd(p)))
+            ses.append(float(np.nanstd(p) / np.sqrt(max(n, 1))))
+        out[col] = {"values": grid_vals, "mean_response": means,
+                    "std_response": stds, "std_error_mean_response": ses}
+    return out
+
+
+def permutation_varimp(model, frame: Frame, metric: str = "auto",
+                       n_repeats: int = 1, seed: int = 0) -> List[tuple]:
+    """Permutation importance rows (variable, relative, scaled, pct) —
+    hex/PermutationVarImp semantics: metric degradation when one
+    feature's values are shuffled."""
+    from h2o3_tpu.models.generic import _frame_raw_columns
+    features = model.output.get("names") or []
+    raw = _frame_raw_columns(frame, frame.names)
+    cats = [n for n in frame.names if frame.col(n).is_categorical]
+    n = frame.nrows
+    rng = np.random.RandomState(seed)
+
+    def _metric_of(fr) -> float:
+        mm_ = model.model_performance(fr)
+        d = mm_.to_dict() if hasattr(mm_, "to_dict") else dict(mm_)
+        if metric != "auto":
+            return float(d[metric])
+        for k in ("logloss", "mean_residual_deviance", "MSE"):
+            if d.get(k) is not None:
+                return float(d[k])
+        raise ValueError("no usable metric")
+
+    base = _metric_of(frame)
+    rows = []
+    for f in features:
+        deltas = []
+        for _ in range(max(n_repeats, 1)):
+            cols2 = dict(raw)
+            perm = rng.permutation(n)
+            cols2[f] = np.asarray(raw[f])[:n][perm]
+            fr2 = Frame.from_numpy(cols2, categorical=cats)
+            deltas.append(_metric_of(fr2) - base)
+        rows.append((f, float(np.mean(deltas))))
+    vals = np.asarray([max(v, 0.0) for _, v in rows])
+    vmax, vsum = max(vals.max(), 1e-12), max(vals.sum(), 1e-12)
+    table = [(f, float(v), float(v / vmax), float(v / vsum))
+             for (f, _), v in zip(rows, vals)]
+    table.sort(key=lambda r: -r[1])
+    return table

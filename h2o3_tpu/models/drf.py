@@ -167,6 +167,7 @@ class DRFEstimator(ModelBuilder):
         ignored_columns=None, stopping_rounds=0, stopping_metric="auto",
         stopping_tolerance=1e-3, binomial_double_trees=False,
         distribution="auto", calibrate_model=False,
+        calibration_frame=None, calibration_method="PlattScaling",
     )
 
     def __init__(self, **params):
@@ -284,4 +285,6 @@ class DRFEstimator(ModelBuilder):
              float(vi[i] / tot)) for i in order]
         if validation_frame is not None:
             model.validation_metrics = model.model_performance(validation_frame)
+        from h2o3_tpu.ml.calibration import maybe_calibrate
+        maybe_calibrate(model, p, category)
         return model

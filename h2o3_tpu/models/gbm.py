@@ -226,6 +226,8 @@ class GBMEstimator(ModelBuilder):
         huber_alpha=0.9, stopping_rounds=0, stopping_metric="auto",
         stopping_tolerance=1e-3, score_tree_interval=0, checkpoint=None,
         monotone_constraints=None,
+        calibrate_model=False, calibration_frame=None,
+        calibration_method="PlattScaling",
     )
 
     def __init__(self, **params):
@@ -532,4 +534,6 @@ class GBMEstimator(ModelBuilder):
              float(vi[i] / tot)) for i in order]
         if validation_frame is not None:
             model.validation_metrics = model.model_performance(validation_frame)
+        from h2o3_tpu.ml.calibration import maybe_calibrate
+        maybe_calibrate(model, p, category)
         return model

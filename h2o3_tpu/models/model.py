@@ -123,6 +123,12 @@ class Model:
             out[name] = arr
             if name == "predict" and self.output.get("domain"):
                 domains[name] = self.output["domain"]
+        cal = getattr(self, "calibrator", None)
+        if cal is not None and "p1" in out:
+            # calibrated probability columns (CalibrationHelper scoring)
+            cp1 = cal.apply(np.asarray(out["p1"], dtype=np.float64))
+            out["cal_p0"] = 1.0 - cp1
+            out["cal_p1"] = cp1
         return Frame.from_numpy(out, domains=domains)
 
     def model_performance(self, frame: Frame):

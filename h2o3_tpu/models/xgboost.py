@@ -52,6 +52,9 @@ _ALIASES = {
     "reg_lambda": "reg_lambda",
     "lambda_": "reg_lambda",
     "monotone_constraints": "monotone_constraints",
+    "calibrate_model": "calibrate_model",
+    "calibration_frame": "calibration_frame",
+    "calibration_method": "calibration_method",
 }
 
 # accepted for wire compatibility, no effect on the TPU backend
@@ -60,7 +63,7 @@ _INERT = {"booster", "tree_method", "grow_policy", "backend", "gpu_id",
           "colsample_bylevel", "col_sample_rate", "reg_alpha",
           "scale_pos_weight", "max_leaves", "sample_type",
           "normalize_type", "rate_drop", "one_drop", "skip_drop",
-          "nthread", "save_matrix_directory", "calibrate_model",
+          "nthread", "save_matrix_directory",
           "max_delta_step", "interaction_constraints"}
 
 
