@@ -45,18 +45,21 @@ def _sample_columns(k1, k2, F: int, rate: float):
 
 
 @partial(jax.jit, static_argnames=("tp", "dist", "sample_rate"))
-def _boost_step(bins, nb, y, w, margin, key, constraints=None, *,
+def _boost_step(bins, nb, y, w, margin, key, constraints=None,
+                interaction_sets=None, *,
                 tp: TreeParams, dist: Distribution, sample_rate: float):
     """One boosting iteration, fully on device (per-tree loop path —
     used when early stopping / validation tracking needs the host
     between trees; otherwise _boost_scan fuses the whole loop)."""
     return _boost_step_impl(bins, nb, y, w, margin, key, tp=tp, dist=dist,
                             sample_rate=sample_rate,
-                            constraints=constraints)
+                            constraints=constraints,
+                            interaction_sets=interaction_sets)
 
 
 @partial(jax.jit, static_argnames=("tp", "dist", "sample_rate", "ntrees"))
-def _boost_scan(bins, nb, y, w, margin, key, constraints=None, *,
+def _boost_scan(bins, nb, y, w, margin, key, constraints=None,
+                interaction_sets=None, *,
                 tp: TreeParams, dist: Distribution, sample_rate: float,
                 ntrees: int):
     """All ``ntrees`` boosting iterations as ONE compiled program.
@@ -71,7 +74,8 @@ def _boost_scan(bins, nb, y, w, margin, key, constraints=None, *,
     def step(margin, k):
         tree, margin, gains = _boost_step_impl(
             bins, nb, y, w, margin, k, tp=tp, dist=dist,
-            sample_rate=sample_rate, constraints=constraints)
+            sample_rate=sample_rate, constraints=constraints,
+            interaction_sets=interaction_sets)
         return margin, (tree, gains)
 
     margin, (trees, gains) = jax.lax.scan(step, margin, keys)
@@ -79,7 +83,7 @@ def _boost_scan(bins, nb, y, w, margin, key, constraints=None, *,
 
 
 def _boost_step_impl(bins, nb, y, w, margin, key, *, tp, dist, sample_rate,
-                     constraints=None):
+                     constraints=None, interaction_sets=None):
     """Unjitted body shared by _boost_step and _boost_scan."""
     mesh = get_mesh()
     g = dist.grad(y, margin)
@@ -93,14 +97,16 @@ def _boost_step_impl(bins, nb, y, w, margin, key, *, tp, dist, sample_rate,
     col_mask = _sample_columns(kc1, kc2, F, tp.col_sample_rate)
     tree, nid, gains = grow_tree(bins, nb, ws, g, h, col_mask,
                                  params=tp, mesh=mesh,
-                                 constraints=constraints)
+                                 constraints=constraints,
+                                 interaction_sets=interaction_sets)
     tree = tree._replace(leaf=tp.learn_rate * tree.leaf)
     margin = margin + tree.leaf[nid]
     return tree, margin, gains
 
 
 @partial(jax.jit, static_argnames=("tp", "sample_rate", "n_class"))
-def _boost_step_multi(bins, nb, y_int, w, margins, key, *, tp: TreeParams,
+def _boost_step_multi(bins, nb, y_int, w, margins, key,
+                      interaction_sets=None, *, tp: TreeParams,
                       sample_rate: float, n_class: int):
     """One multinomial iteration: K trees on softmax gradients."""
     mesh = get_mesh()
@@ -120,7 +126,8 @@ def _boost_step_multi(bins, nb, y_int, w, margins, key, *, tp: TreeParams,
         gk = p[:, k] - yk
         hk = p[:, k] * (1.0 - p[:, k])
         tree, nid, gains = grow_tree(bins, nb, ws, gk, hk, col_mask,
-                                     params=tp, mesh=mesh)
+                                     params=tp, mesh=mesh,
+                                     interaction_sets=interaction_sets)
         tree = tree._replace(leaf=tp.learn_rate * tree.leaf)
         new_margins = new_margins.at[:, k].add(tree.leaf[nid])
         trees.append(tree)
@@ -225,7 +232,7 @@ class GBMEstimator(ModelBuilder):
         ignored_columns=None, tweedie_power=1.5, quantile_alpha=0.5,
         huber_alpha=0.9, stopping_rounds=0, stopping_metric="auto",
         stopping_tolerance=1e-3, score_tree_interval=0, checkpoint=None,
-        monotone_constraints=None,
+        monotone_constraints=None, interaction_constraints=None,
         calibrate_model=False, calibration_frame=None,
         calibration_method="PlattScaling",
     )
@@ -324,6 +331,25 @@ class GBMEstimator(ModelBuilder):
                 arr[x.index(c)] = int(np.sign(d))
             constraints = jnp.asarray(arr)
 
+        # interaction constraints (GBM interaction_constraints;
+        # hex/tree/GlobalInteractionConstraints): listed groups may
+        # interact internally; unlisted features become singleton sets
+        interaction_sets = None
+        ic = p.get("interaction_constraints")
+        if ic:
+            unknown_cols = {c for grp in ic for c in grp} - set(x)
+            if unknown_cols:
+                raise ValueError("interaction_constraints columns not in "
+                                 f"predictors: {sorted(unknown_cols)}")
+            listed = {c for grp in ic for c in grp}
+            groups = [list(grp) for grp in ic]
+            groups += [[c] for c in x if c not in listed]
+            S = np.zeros((len(groups), len(x)), bool)
+            for si, grp in enumerate(groups):
+                for c in grp:
+                    S[si, x.index(c)] = True
+            interaction_sets = jnp.asarray(S)
+
         seed = int(p["seed"]) if int(p["seed"]) >= 0 else 0xDEC0DE
         key = jax.random.PRNGKey(seed)
         ntrees = int(p["ntrees"])
@@ -403,7 +429,8 @@ class GBMEstimator(ModelBuilder):
             for t in range(ntrees):
                 key, sub = jax.random.split(key)
                 tr, margins, gains = _boost_step_multi(
-                    bm.bins, bm.nbins, y_dev, w, margins, sub, tp=tp,
+                    bm.bins, bm.nbins, y_dev, w, margins, sub,
+                    interaction_sets, tp=tp,
                     sample_rate=float(p["sample_rate"]), n_class=K)
                 trees.append(tr)
                 gains_total += np.asarray(gains)
@@ -474,7 +501,7 @@ class GBMEstimator(ModelBuilder):
                     key, sub = jax.random.split(key)
                     tr_k, margin, gains = _boost_scan(
                         bm.bins, bm.nbins, y_dev, w, margin, sub,
-                        constraints, tp=tp,
+                        constraints, interaction_sets, tp=tp,
                         dist=dist, sample_rate=float(p["sample_rate"]),
                         ntrees=k)
                     chunks.append(tr_k)
@@ -490,7 +517,7 @@ class GBMEstimator(ModelBuilder):
                     key, sub = jax.random.split(key)
                     tr, margin, gains = _boost_step(
                         bm.bins, bm.nbins, y_dev, w, margin, sub,
-                        constraints, tp=tp,
+                        constraints, interaction_sets, tp=tp,
                         dist=dist, sample_rate=float(p["sample_rate"]))
                     trees.append(tr)
                     gains_total += np.asarray(gains)

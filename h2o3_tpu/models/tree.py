@@ -151,7 +151,8 @@ def _mtries_mask(key, L: int, F: int, mtries: int):
 
 
 def grow_tree(bins, nb, w, g, h, col_mask, *, params: TreeParams, mesh,
-              mtries: int = 0, key=None, constraints=None):
+              mtries: int = 0, key=None, constraints=None,
+              interaction_sets=None):
     """Grow one tree; returns (Tree, final_leaf_id_per_row).
 
     bins [Npad, F] int32 row-sharded; w zero on padding rows; col_mask [F]
@@ -161,6 +162,11 @@ def grow_tree(bins, nb, w, g, h, col_mask, *, params: TreeParams, mesh,
     activates monotone constraints: per-node value bounds propagate to
     children through the split midpoint and leaves are clipped into
     them (the reference's hex/tree/Constraints machinery).
+    ``interaction_sets`` [S, F] bool activates interaction constraints
+    (GBM interaction_constraints / hex/tree/GlobalInteractionConstraints):
+    once a node splits on feature f, its subtree may only use features
+    sharing an interaction set with every feature on the path — tracked
+    as a per-node allowed mask.
     """
     D = params.max_depth
     B = params.nbins_total
@@ -176,6 +182,8 @@ def grow_tree(bins, nb, w, g, h, col_mask, *, params: TreeParams, mesh,
     gain_by_feat = jnp.zeros((F,), jnp.float32)  # relative varimp (hex/VarImp)
     lo = jnp.full((1,), -jnp.inf, jnp.float32)
     hi = jnp.full((1,), jnp.inf, jnp.float32)
+    allowed = jnp.ones((1, F), bool)   # per-node feature set (interactions)
+    pair_allow = None                  # lazy [F, F] compatibility matrix
 
     for d in range(D):
         L = 2 ** d
@@ -185,6 +193,8 @@ def grow_tree(bins, nb, w, g, h, col_mask, *, params: TreeParams, mesh,
         if mtries > 0 and mtries < F:
             key, sub = jax.random.split(key)
             cm = _mtries_mask(sub, L, F, mtries) & col_mask[None, :]
+        if interaction_sets is not None:
+            cm = (cm if cm.ndim == 2 else cm[None, :]) & allowed
         bg, bf, bt, bnal, blv, brv = _best_splits(
             hist, nb, cm, params, constraints=constraints, lo=lo, hi=hi)
         split = bg > params.min_split_improvement
@@ -196,6 +206,21 @@ def grow_tree(bins, nb, w, g, h, col_mask, *, params: TreeParams, mesh,
             jnp.where(split, jnp.maximum(bg, 0.0), 0.0)[:, None]
             * (bf[:, None] == jnp.arange(F, dtype=jnp.int32)[None, :]),
             axis=0)
+
+        # interaction-set propagation (XGBoost/GlobalInteractionConstraints
+        # rule): children may use any feature sharing a set with the
+        # split feature, intersected with the path's allowance.
+        # pair_allow[i, j] = features i and j share a set — one [F, F]
+        # precompute, then a per-level [L, F] gather.
+        if interaction_sets is not None:
+            if pair_allow is None:
+                pair_allow = jnp.einsum(
+                    "sf,sg->fg", interaction_sets.astype(jnp.float32),
+                    interaction_sets.astype(jnp.float32)) > 0
+            child_allow = pair_allow[bf]                   # [L, F]
+            child_allow = allowed & jnp.where(split[:, None], child_allow,
+                                              True)
+            allowed = jnp.repeat(child_allow, 2, axis=0)   # children 2l,2l+1
 
         # bound propagation (Constraints.childBounds role): on a
         # constrained split the midpoint of the child values caps the

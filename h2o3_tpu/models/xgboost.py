@@ -55,6 +55,7 @@ _ALIASES = {
     "calibrate_model": "calibrate_model",
     "calibration_frame": "calibration_frame",
     "calibration_method": "calibration_method",
+    "interaction_constraints": "interaction_constraints",
 }
 
 # accepted for wire compatibility, no effect on the TPU backend
@@ -64,7 +65,7 @@ _INERT = {"booster", "tree_method", "grow_policy", "backend", "gpu_id",
           "scale_pos_weight", "max_leaves", "sample_type",
           "normalize_type", "rate_drop", "one_drop", "skip_drop",
           "nthread", "save_matrix_directory",
-          "max_delta_step", "interaction_constraints"}
+          "max_delta_step"}
 
 
 @register

@@ -1,0 +1,89 @@
+"""GBM interaction constraints (GlobalInteractionConstraints parity)."""
+
+import numpy as np
+import pytest
+
+import h2o3_tpu
+
+
+def _tree_paths_features(model):
+    """Set of features used on each root-to-node path of every tree."""
+    feat = np.asarray(model.forest.feat)       # [T, D, Lmax]
+    is_split = np.asarray(model.forest.is_split)
+    T, D, _ = feat.shape
+    paths = []
+    for t in range(T):
+        # walk all nodes level by level, tracking path feature sets
+        node_feats = {0: set()}
+        for d in range(D):
+            nxt = {}
+            for node, fs in node_feats.items():
+                if node < is_split.shape[2] and is_split[t, d, node]:
+                    f = int(feat[t, d, node])
+                    nf = fs | {f}
+                    paths.append(nf)
+                    nxt[2 * node] = nf
+                    nxt[2 * node + 1] = nf
+                else:
+                    nxt[2 * node] = fs
+            node_feats = nxt
+    return paths
+
+
+def test_interaction_constraints_respected():
+    from h2o3_tpu.models.gbm import GBMEstimator
+    r = np.random.RandomState(0)
+    n = 3000
+    X = r.randn(n, 4)
+    # response needs x0*x1 and x2*x3 interactions
+    y = X[:, 0] * X[:, 1] + X[:, 2] * X[:, 3] + 0.1 * r.randn(n)
+    cols = {f"x{i}": X[:, i] for i in range(4)}
+    cols["y"] = y
+    fr = h2o3_tpu.Frame.from_numpy(cols)
+    m = GBMEstimator(ntrees=10, max_depth=4, seed=1,
+                     interaction_constraints=[["x0", "x1"],
+                                              ["x2", "x3"]]).train(fr, y="y")
+    allowed = [{0, 1}, {2, 3}]
+    for path in _tree_paths_features(m):
+        assert any(path <= a for a in allowed), f"path {path} crosses sets"
+
+
+def test_interaction_constraints_unlisted_singleton():
+    from h2o3_tpu.models.gbm import GBMEstimator
+    r = np.random.RandomState(1)
+    n = 2000
+    X = r.randn(n, 3)
+    y = X[:, 0] * X[:, 1] + X[:, 2] + 0.1 * r.randn(n)
+    fr = h2o3_tpu.Frame.from_numpy(
+        {**{f"x{i}": X[:, i] for i in range(3)}, "y": y})
+    m = GBMEstimator(ntrees=8, max_depth=3, seed=1,
+                     interaction_constraints=[["x0", "x1"]]).train(fr, y="y")
+    # x2 unlisted → singleton: may never share a path with x0/x1
+    for path in _tree_paths_features(m):
+        assert path <= {0, 1} or path <= {2}, f"bad path {path}"
+
+
+def test_interaction_constraints_multinomial():
+    from h2o3_tpu.models.gbm import GBMEstimator
+    r = np.random.RandomState(2)
+    n = 2400
+    X = r.randn(n, 4)
+    cls = (X[:, 0] * X[:, 1] > 0).astype(int) + (X[:, 2] > 0.8).astype(int)
+    fr = h2o3_tpu.Frame.from_numpy(
+        {**{f"x{i}": X[:, i] for i in range(4)},
+         "y": np.array(["a", "b", "c"], object)[cls]}, categorical=["y"])
+    m = GBMEstimator(ntrees=6, max_depth=3, seed=1,
+                     interaction_constraints=[["x0", "x1"],
+                                              ["x2", "x3"]]).train(fr, y="y")
+    allowed = [{0, 1}, {2, 3}]
+    for path in _tree_paths_features(m):
+        assert any(path <= a for a in allowed), f"path {path} crosses sets"
+
+
+def test_interaction_constraints_validation():
+    from h2o3_tpu.models.gbm import GBMEstimator
+    fr = h2o3_tpu.Frame.from_numpy({"a": np.arange(100.0),
+                                    "y": np.arange(100.0)})
+    with pytest.raises(ValueError, match="not in predictors"):
+        GBMEstimator(ntrees=2, interaction_constraints=[["zz"]]).train(
+            fr, y="y")
