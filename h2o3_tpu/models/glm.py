@@ -365,6 +365,9 @@ class GLMEstimator(ModelBuilder):
                   "nclasses": rc.cardinality if rc.is_categorical else 1}
 
         if category == ModelCategory.MULTINOMIAL:
+            if p.get("compute_p_values"):
+                raise ValueError("compute_p_values is not supported for "
+                                 "multinomial GLM (reference restriction)")
             K = rc.cardinality
             yv = np.asarray(rc.data)[: frame.nrows].astype(np.int32)
             resp_na = np.asarray(rc.na_mask)[: frame.nrows]
@@ -405,6 +408,10 @@ class GLMEstimator(ModelBuilder):
 
         alpha = float(p["alpha"] if p["alpha"] is not None else 0.5)
         lambdas = _lambda_path(p, X1, y_dev, w, nobs, alpha, mesh)
+        if p.get("compute_p_values") and any(l != 0.0 for l in lambdas):
+            # fail before the (possibly long) lambda-path fit
+            raise ValueError("compute_p_values requires no regularization "
+                             "(lambda = 0)")
         solver = str(p["solver"]).lower()
         if solver == "auto":
             solver = "irlsm" if alpha > 0 or len(lambdas) > 1 else "irlsm"
@@ -426,6 +433,14 @@ class GLMEstimator(ModelBuilder):
         coef = best
 
         output["lambda_best"] = float(lambdas[-1])
+
+        if p.get("compute_p_values"):
+            # std errors / z / p from the Fisher information at the MLE
+            # (GLM.java compute_p_values; lambda==0 validated up front)
+            output["coefficients_table"] = _p_values_table(
+                X1, y_dev, w, jnp.asarray(coef, jnp.float32), fam,
+                di.coef_names + ["Intercept"], nobs)
+
         model = GLMModel(p, output, coef, fam, stats_of(di), list(x))
         mu = fam.linkinv(X1 @ jnp.asarray(coef, jnp.float32))
         if category == ModelCategory.BINOMIAL:
@@ -461,6 +476,56 @@ def _lambda_path(p, X1, y, w, nobs, alpha, mesh) -> List[float]:
     lam_min = lam_max * float(p["lambda_min_ratio"])
     n = int(p["nlambdas"])
     return list(np.exp(np.linspace(np.log(lam_max), np.log(lam_min), n)))
+
+
+def _p_values_table(X1, y, w, coef, fam: Family, names, nobs: float):
+    """Wald inference rows (name, coefficient, std_error, z_value,
+    p_value) — hex/glm GLMModel coefficients table with p-values.
+
+    Fisher information = X'WX with the IRLS variance weights at the
+    fitted coefficients; gaussian uses the t distribution with the
+    moment-estimated dispersion, other families the normal (z) with
+    dispersion 1 (binomial/poisson) or the Pearson estimate (gamma/
+    tweedie), matching the reference's computePValues path."""
+    eta = X1 @ coef
+    mu = fam.linkinv(eta)
+    name = fam.name
+    # general GLM Fisher weight: (dmu/deta)^2 / Var(mu) — exact for every
+    # family × link combination Family supports
+    dmu = fam.dmu_deta(eta, mu)
+    vw = dmu * dmu / jnp.maximum(fam.variance(mu), 1e-12)
+    wi = w * vw
+    info = (X1 * wi[:, None]).T @ X1
+    info_h = np.asarray(info, dtype=np.float64)
+    P = info_h.shape[0]
+    try:
+        cov = np.linalg.inv(info_h + 1e-10 * np.eye(P))
+    except np.linalg.LinAlgError:
+        cov = np.linalg.pinv(info_h)
+    dof = max(nobs - P, 1.0)
+    if name == "gaussian":
+        resid = np.asarray(y - mu, dtype=np.float64)
+        wh = np.asarray(w, dtype=np.float64)
+        dispersion = float((wh * resid ** 2).sum() / dof)
+    elif name in ("binomial", "poisson"):
+        dispersion = 1.0
+    else:   # gamma/tweedie: Pearson estimate over Var(mu)
+        resid = np.asarray(y - mu, dtype=np.float64)
+        var = np.maximum(np.asarray(fam.variance(mu), dtype=np.float64),
+                         1e-12)
+        wh = np.asarray(w, dtype=np.float64)
+        dispersion = float((wh * resid ** 2 / var).sum() / dof)
+    se = np.sqrt(np.maximum(np.diag(cov) * dispersion, 0.0))
+    ch = np.asarray(coef, dtype=np.float64)
+    z = np.where(se > 0, ch / np.maximum(se, 1e-300), np.inf)
+    from scipy import stats as _st
+    if name == "gaussian":
+        pv = 2.0 * _st.t.sf(np.abs(z), df=dof)
+    else:
+        pv = 2.0 * _st.norm.sf(np.abs(z))
+    return [{"name": nm, "coefficient": float(c), "std_error": float(s),
+             "z_value": float(zz), "p_value": float(pp)}
+            for nm, c, s, zz, pp in zip(names, ch, se, z, pv)]
 
 
 def _finish(model: GLMModel, frame: Frame, validation_frame):

@@ -142,3 +142,50 @@ def test_glm_with_categoricals_and_nas():
     assert m.training_metrics["r2"] > 0.9
     coefs = m.coefficients
     assert "g.b" in coefs and "g.c" in coefs  # first level dropped
+
+
+def test_glm_p_values_match_statsmodels_style():
+    """compute_p_values: Wald inference vs a closed-form OLS check."""
+    import h2o3_tpu
+    from h2o3_tpu.models.glm import GLMEstimator
+    r = np.random.RandomState(7)
+    n = 2000
+    x0, x1 = r.randn(n), r.randn(n)
+    noise_col = r.randn(n)
+    y = 3.0 * x0 + 0.0 * noise_col + 1.0 + 0.5 * r.randn(n)
+    fr = h2o3_tpu.Frame.from_numpy({"x0": x0, "x1": x1,
+                                    "noise": noise_col, "y": y})
+    m = GLMEstimator(family="gaussian", lambda_=0.0, standardize=False,
+                     compute_p_values=True).train(fr, y="y")
+    tbl = {row["name"]: row for row in m.output["coefficients_table"]}
+    # strong predictor: tiny p-value; pure noise: large p-value
+    assert tbl["x0"]["p_value"] < 1e-10
+    assert tbl["noise"]["p_value"] > 0.01
+    # OLS closed-form std error comparison for x0
+    X = np.stack([x0, x1, noise_col, np.ones(n)], axis=1)
+    beta = np.linalg.lstsq(X, y, rcond=None)[0]
+    resid = y - X @ beta
+    s2 = (resid ** 2).sum() / (n - 4)
+    se = np.sqrt(np.diag(s2 * np.linalg.inv(X.T @ X)))
+    assert tbl["x0"]["std_error"] == pytest.approx(se[0], rel=0.15)
+
+    with pytest.raises(ValueError, match="regularization"):
+        GLMEstimator(family="gaussian", lambda_=0.5,
+                     compute_p_values=True).train(fr, y="y")
+
+
+def test_glm_p_values_binomial():
+    import h2o3_tpu
+    from h2o3_tpu.models.glm import GLMEstimator
+    r = np.random.RandomState(3)
+    n = 3000
+    x0, noise = r.randn(n), r.randn(n)
+    pr = 1 / (1 + np.exp(-(1.5 * x0)))
+    y = np.array(["a", "b"], object)[(r.rand(n) < pr).astype(int)]
+    fr = h2o3_tpu.Frame.from_numpy({"x0": x0, "noise": noise, "y": y},
+                                   categorical=["y"])
+    m = GLMEstimator(family="binomial", lambda_=0.0,
+                     compute_p_values=True).train(fr, y="y")
+    tbl = {row["name"]: row for row in m.output["coefficients_table"]}
+    assert tbl["x0"]["p_value"] < 1e-8
+    assert tbl["noise"]["p_value"] > 0.01
