@@ -28,6 +28,8 @@ import urllib.request
 from typing import Any, Dict, List, Optional
 
 _conn: Optional["H2OConnection"] = None
+import itertools as _it
+_expr_counter = _it.count()
 
 
 class H2OConnection:
@@ -150,6 +152,70 @@ class H2OFrame:
             raise KeyError(out.get("error")
                            or f"selection '{col}' did not yield a frame")
         return H2OFrame(out["key"]["name"])
+
+    # ---- expression building (h2o-py expr.py ExprNode role): every
+    # operator ships a Rapids string; results are new server frames ----
+    def _expr(self, op: str, other=None, rev: bool = False) -> "H2OFrame":
+        me = self.frame_id
+        key = f"py_expr_{next(_expr_counter)}"   # unique per expression
+        if other is None:
+            ast = f"(tmp= {key} ({op} {me}))"
+        else:
+            rhs = other.frame_id if isinstance(other, H2OFrame) else repr(
+                other) if isinstance(other, str) else str(other)
+            a, b = (rhs, me) if rev else (me, rhs)
+            ast = f"(tmp= {key} ({op} {a} {b}))"
+        out = self.rapids(ast)
+        if "key" not in out:
+            raise ValueError(out.get("error") or f"rapids op {op} failed")
+        return H2OFrame(out["key"]["name"])
+
+    def __add__(self, o): return self._expr("+", o)
+    def __radd__(self, o): return self._expr("+", o, rev=True)
+    def __sub__(self, o): return self._expr("-", o)
+    def __rsub__(self, o): return self._expr("-", o, rev=True)
+    def __mul__(self, o): return self._expr("*", o)
+    def __rmul__(self, o): return self._expr("*", o, rev=True)
+    def __truediv__(self, o): return self._expr("/", o)
+    def __rtruediv__(self, o): return self._expr("/", o, rev=True)
+    def __lt__(self, o): return self._expr("<", o)
+    def __le__(self, o): return self._expr("<=", o)
+    def __gt__(self, o): return self._expr(">", o)
+    def __ge__(self, o): return self._expr(">=", o)
+    def __eq__(self, o):                                # noqa: PLW1641
+        if not isinstance(o, (H2OFrame, int, float, str)):
+            return NotImplemented
+        return self._expr("==", o)
+
+    def __ne__(self, o):
+        if not isinstance(o, (H2OFrame, int, float, str)):
+            return NotImplemented
+        return self._expr("!=", o)
+    __hash__ = None   # frames are mutable proxies, not hashable
+
+    def log(self): return self._expr("log")
+    def exp(self): return self._expr("exp")
+    def sqrt(self): return self._expr("sqrt")
+    def abs(self): return self._expr("abs")
+
+    def _scalar(self, op: str) -> float:
+        out = self.rapids(f"({op} {self.frame_id} 1)")
+        if isinstance(out, dict) and "scalar" in out:
+            return out["scalar"]
+        raise ValueError(out.get("error") if isinstance(out, dict) else out)
+
+    def mean(self): return self._scalar("mean")
+    def sum(self): return self._scalar("sum")
+    def min(self): return self._scalar("min")
+    def max(self): return self._scalar("max")
+
+    def head(self, rows: int = 10) -> List[dict]:
+        """First rows as dicts (fresh fetch honoring row_count)."""
+        f = connection().request("GET", f"/3/Frames/{self.frame_id}",
+                                 row_count=rows)["frames"][0]
+        cols = f["columns"]
+        n = min(rows, len(cols[0]["data"]) if cols else 0)
+        return [{c["label"]: c["data"][i] for c in cols} for i in range(n)]
 
     def __repr__(self):
         return f"<H2OFrame {self.frame_id} {self.shape}>"

@@ -144,9 +144,9 @@ def _irls_iter(X1, coef, y, w, l1, l2, family: str, link: str,
     return new_coef, delta, dev
 
 
-@partial(jax.jit, static_argnames=("family", "link", "use_l1", "max_iter"))
-def _irls_solve(X1, coef, y, w, l1, l2, beta_eps, family: str, link: str,
-                tweedie_power, *, use_l1: bool, max_iter: int):
+@partial(jax.jit, static_argnames=("family", "link", "use_l1"))
+def _irls_solve(X1, coef, y, w, l1, l2, beta_eps, max_iter, family: str,
+                link: str, tweedie_power, *, use_l1: bool):
     """The whole IRLS loop as one compiled ``while_loop`` — per-iteration
     host syncs (one device round trip each) previously dominated GLM
     wall time on a remote-attached chip."""
@@ -320,8 +320,9 @@ class GLMEstimator(ModelBuilder):
         coef = jnp.asarray(coef0, jnp.float32)
         coef = _irls_solve(X1, coef, yv, w, jnp.float32(l1),
                            jnp.float32(l2), jnp.float32(beta_eps),
+                           jnp.int32(max_iter),
                            fam.name, fam.link, jnp.float32(fam.p),
-                           use_l1=l1 > 0, max_iter=int(max_iter))
+                           use_l1=l1 > 0)
         return np.asarray(coef)
 
     def _fit_lbfgs(self, X1, yv, w, fam: Family, l2: float,

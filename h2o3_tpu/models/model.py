@@ -206,7 +206,11 @@ class ModelBuilder:
               x: Optional[Sequence[str]] = None,
               validation_frame: Optional[Frame] = None,
               background: bool = False,
-              dest_key: Optional[str] = None) -> Model:
+              dest_key: Optional[str] = None,
+              custom_metric_func=None) -> Model:
+        """``custom_metric_func`` is the water/udf CFunc role: a callable
+        ``fn(y_values, preds_dict, weights) -> float`` evaluated on the
+        training frame and attached to training_metrics as 'custom'."""
         x = self.resolve_x(training_frame, x, y)
         nfolds = int(self.params.get("nfolds") or 0)
         job = Job(f"{self.algo} train", work=1.0)
@@ -220,6 +224,18 @@ class ModelBuilder:
             else:
                 model = self._fit(training_frame, x, y, j,
                                   validation_frame=validation_frame)
+            if custom_metric_func is not None and y is not None:
+                yv = training_frame.col(y).to_numpy()   # enum → float codes
+                preds = model._score_raw(training_frame)
+                wv = np.ones(training_frame.nrows)
+                wc = self.params.get("weights_column")
+                if wc and wc in training_frame:
+                    wv = np.nan_to_num(training_frame.col(wc).to_numpy())
+                val = float(custom_metric_func(yv, preds, wv))
+                if model.training_metrics is not None and \
+                        hasattr(model.training_metrics, "extra"):
+                    model.training_metrics.extra["custom"] = val
+                model.output["custom_metric"] = val
             model.output["run_time"] = time.time() - t0
             if dest_key:   # REST model_id: rename into the requested key
                 DKV.remove(model.key)

@@ -94,3 +94,39 @@ def test_client_automl(conn, csv_path):
     leader = aml.train(y="target", training_frame=fr)
     assert leader is not None
     assert len(aml.leaderboard) >= 2
+
+
+def test_client_frame_expressions(conn, csv_path):
+    fr = h2o.import_file(csv_path)
+    x0 = fr["x0"]
+    doubled = x0 * 2.0
+    assert doubled.mean() == pytest.approx(x0.mean() * 2.0, rel=1e-5)
+    shifted = 1.0 + x0
+    assert shifted.mean() == pytest.approx(x0.mean() + 1.0, rel=1e-4)
+    mask = x0 > 0
+    frac = mask.mean()
+    assert 0.3 < frac < 0.7
+    assert x0.abs().min() >= 0
+    rows = fr.head(3)
+    assert len(rows) == 3 and "target" in rows[0]
+
+
+def test_custom_metric_func(conn, csv_path):
+    """water/udf CFunc role via the in-process API."""
+    import h2o3_tpu
+    import numpy as np
+    from h2o3_tpu.models.gbm import GBMEstimator
+    from tests.conftest import make_classification
+    X, y = make_classification(n=800, f=4)
+    cols = {f"x{i}": X[:, i] for i in range(4)}
+    cols["y"] = np.array(["no", "yes"], object)[y]
+    frame = h2o3_tpu.Frame.from_numpy(cols, categorical=["y"])
+
+    def brier(yv, preds, w):
+        ok = ~np.isnan(yv)
+        return float(np.mean((preds["p1"][ok] - yv[ok]) ** 2))
+
+    m = GBMEstimator(ntrees=5, max_depth=3, seed=1).train(
+        frame, y="y", custom_metric_func=brier)
+    assert 0 < m.output["custom_metric"] < 0.25
+    assert m.training_metrics["custom"] == m.output["custom_metric"]
