@@ -52,7 +52,7 @@ class TreeParams:
     min_split_improvement: float = 1e-5
     col_sample_rate: float = 1.0     # per-split column sampling is per-tree here
     nbins_total: int = 65            # B incl. NA bin
-    block_rows: int = 16384
+    block_rows: int = 4096
 
 
 def row_feature_values(bins, f_r):
