@@ -312,6 +312,21 @@ def _predict(params, body, mid=None, fid=None):
             "model_metrics": [{}]}
 
 
+@route("POST", r"/3/ModelMetrics/models/(?P<mid>[^/]+)/frames/(?P<fid>[^/]+)")
+def _model_metrics(params, body, mid=None, fid=None):
+    """Score a frame and return its metrics (water/api/ModelMetricsHandler
+    — the model_performance(test_data) wire call)."""
+    m = DKV.get(mid)
+    fr = DKV.get(fid)
+    if not isinstance(m, Model):
+        raise KeyError(f"model {mid} not found")
+    if not isinstance(fr, Frame):
+        raise KeyError(f"frame {fid} not found")
+    mm_ = m.model_performance(fr)
+    d = mm_.to_dict() if hasattr(mm_, "to_dict") else dict(mm_ or {})
+    return {"model_metrics": [d]}
+
+
 @route("POST", "/3/PartialDependence")
 def _pdp(params, body):
     """water/api/PartialDependenceHandler: grid sweep per feature."""

@@ -125,6 +125,13 @@ class DRFModel(Model):
             out[f"p{k}"] = p[:, k]
         return out
 
+
+    def predict_leaf_node_assignment(self, frame: Frame) -> Frame:
+        """Per-tree terminal node ids (h2o-py predict_leaf_node_assignment
+        with type=Node_ID); per-class columns T{t}.C{k} for multinomial."""
+        from h2o3_tpu.models.tree import leaf_assignment_frame
+        return leaf_assignment_frame(self, frame)
+
     def model_performance(self, frame: Frame):
         y = self.output["response"]
         bm = rebin_for_scoring(self.bm, frame)

@@ -263,6 +263,18 @@ def grow_tree(bins, nb, w, g, h, col_mask, *, params: TreeParams, mesh,
 
 def predict_tree(tree: Tree, bins, B: int):
     """Route binned rows through one tree → leaf values [N]."""
+    return tree.leaf[_route(tree, bins, B)]
+
+
+def stack_trees(trees) -> Tree:
+    """Stack per-iteration Trees into [T, ...] arrays for scan-predict."""
+    return Tree(*(jnp.stack([getattr(t, f) for t in trees])
+                  for f in Tree._fields))
+
+
+def _route(tree: Tree, bins, B: int):
+    """Terminal node id per row for one tree — the single routing
+    implementation shared by scoring and leaf assignment."""
     N = bins.shape[0]
     D = tree.feat.shape[0]
     nid = jnp.zeros((N,), jnp.int32)
@@ -275,13 +287,39 @@ def predict_tree(tree: Tree, bins, B: int):
         isna = b_r == (B - 1)
         goleft = jnp.where(isp_r, jnp.where(isna, nal_r, b_r <= t_r), True)
         nid = 2 * nid + jnp.where(goleft, 0, 1)
-    return tree.leaf[nid]
+    return nid
 
 
-def stack_trees(trees) -> Tree:
-    """Stack per-iteration Trees into [T, ...] arrays for scan-predict."""
-    return Tree(*(jnp.stack([getattr(t, f) for t in trees])
-                  for f in Tree._fields))
+@partial(jax.jit, static_argnames=("B",))
+def leaf_assignments(stacked: Tree, bins, B: int):
+    """Per-tree terminal leaf id for every row [N, T] — the
+    predict_leaf_node_assignment path (hex/Model.java scoreLeafNode
+    /h2o-py predict_leaf_node_assignment with type Node_ID)."""
+
+    def step(_, tree):
+        return None, _route(tree, bins, B)
+
+    _, out = jax.lax.scan(step, None, stacked)
+    return out.T          # [N, T]
+
+
+def leaf_assignment_frame(model, frame):
+    """Shared GBM/DRF predict_leaf_node_assignment: columns are T{t} for
+    single-output forests and T{t}.C{k} per class for stacked per-class
+    forests (h2o naming)."""
+    from h2o3_tpu.frame.binning import rebin_for_scoring
+    from h2o3_tpu.frame.frame import Frame
+    bm = rebin_for_scoring(model.bm, frame)
+    ids = np.asarray(leaf_assignments(model.forest, bm.bins,
+                                      model.bm.nbins_total))[: frame.nrows]
+    K = (model.output.get("nclasses", 1)
+         if model.output.get("category") == "Multinomial" else 1)
+    cols = {}
+    for j in range(ids.shape[1]):
+        name = (f"T{j + 1}" if K <= 1
+                else f"T{j // K + 1}.C{j % K + 1}")
+        cols[name] = ids[:, j].astype(np.float64)
+    return Frame.from_numpy(cols)
 
 
 @partial(jax.jit, static_argnames=("B",))

@@ -108,3 +108,21 @@ def test_sql_ingest(tmp_path):
     assert fr2.shape == (2, 1)
     with pytest.raises(IOError, match="no built-in driver"):
         h2o3_tpu.import_sql_select("postgres://h/db", "SELECT 1")
+
+
+def test_leaf_node_assignment(gbm_and_frame):
+    m, fr = gbm_and_frame
+    la = m.predict_leaf_node_assignment(fr)
+    assert la.nrows == fr.nrows
+    assert la.ncols == 12     # one column per tree
+    v = la.col("T1").to_numpy()
+    assert v.min() >= 0 and v.max() < 2 ** 3   # depth-3 leaves
+
+
+def test_model_metrics_endpoint(gbm_and_frame):
+    from h2o3_tpu.api.server import ROUTES
+    m, fr = gbm_and_frame
+    h = next(fn for mth, rx, fn in ROUTES
+             if mth == "POST" and rx.match(f"/3/ModelMetrics/models/{m.key}/frames/{fr.key}"))
+    out = h({}, "", mid=m.key, fid=fr.key)
+    assert out["model_metrics"][0]["AUC"] > 0.5
