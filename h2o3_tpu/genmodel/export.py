@@ -164,6 +164,18 @@ def mojo_artifacts(model) -> Tuple[dict, Dict[str, np.ndarray]]:
                   "ext_leaf": np.asarray(f.leaf),
                   "col_means": np.asarray(model.means)}
         return meta, arrays
+    if algo == "glrm":
+        meta["standardize"] = model.transform == "standardize"
+        meta["use_all_factor_levels"] = True
+        meta["names"] = list(model.features)
+        meta["feature_domains"] = [list(d) if d is not None else None
+                                   for d in model.di_stats["domains"]]
+        arrays = {
+            "archetypes": np.asarray(model.Y),
+            "num_means": np.asarray(model.di_stats["num_means"]),
+            "num_sigmas": np.asarray(model.di_stats["num_sigmas"]),
+        }
+        return meta, arrays
     if algo == "word2vec":
         meta["vocab"] = list(model.vocab)
         arrays = {"vectors": np.asarray(model.vectors)}

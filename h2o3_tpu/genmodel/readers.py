@@ -321,6 +321,51 @@ class ExtIsoForMojoModel(MojoModel):
         return {"anomaly_score": 2.0 ** (-ml / c), "mean_length": ml}
 
 
+class GlrmMojoModel(MojoModel):
+    def predict(self, data):
+        """Project rows onto the archetypes: ridge solve of X ≈ A·Y with
+        NA cells excluded per row (hex/genmodel/algos/glrm scoring role)."""
+        X = design_matrix(self.meta, self.arrays, data)
+        Y = self.arrays["archetypes"]            # [k, P]
+        k = Y.shape[0]
+        lam = 1e-6
+        # NA mask in the expanded space: numeric NAs were mean-imputed by
+        # design_matrix, so recover them from the raw columns
+        n = X.shape[0]
+        ok = np.ones_like(X, dtype=bool)
+        col_idx = 0
+        domains = self.meta.get("feature_domains") or [None] * len(self.names)
+        for i, name in enumerate(self.names):
+            dom = domains[i]
+            # widths must mirror design_matrix exactly (card floor of 1)
+            width = max(len(dom), 1) if dom is not None else 1
+            v = np.asarray(data[name])
+            if dom is None:
+                isna = np.isnan(v.astype(np.float64))
+            else:
+                # same missing test design_matrix applies: None, NaN, or
+                # a level outside the training domain all encode to -1
+                domset = set(dom)
+                isna = np.asarray([
+                    x is None
+                    or (isinstance(x, float) and np.isnan(x))
+                    or str(x) not in domset
+                    for x in v])
+            ok[isna, col_idx: col_idx + width] = False
+            col_idx += width
+        A = np.zeros((n, k))
+        G_full = Y @ Y.T + lam * np.eye(k)
+        full = ok.all(axis=1)
+        if full.any():
+            A[full] = np.linalg.solve(G_full, Y @ X[full].T).T
+        for r in np.where(~full)[0]:
+            m = ok[r]
+            Ym = Y[:, m]
+            A[r] = np.linalg.solve(Ym @ Ym.T + lam * np.eye(k),
+                                   Ym @ X[r, m])
+        return {f"Arch{i + 1}": A[:, i] for i in range(k)}
+
+
 class Word2VecMojoModel(MojoModel):
     def predict(self, data):
         """Embed a words column: NaN/None rows delimit sequences only in
@@ -375,4 +420,5 @@ _READERS = {
     "upliftdrf": UpliftDrfMojoModel,
     "extendedisolationforest": ExtIsoForMojoModel,
     "word2vec": Word2VecMojoModel,
+    "glrm": GlrmMojoModel,
 }

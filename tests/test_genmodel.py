@@ -231,3 +231,13 @@ def test_generic_without_frame(tmp_path, classif_frame):
     gm = GenericEstimator(path=path).train()
     out = gm.predict(classif_frame)
     assert "p1" in out.names
+
+
+def test_glrm_mojo(tmp_path):
+    from h2o3_tpu.models.glrm import GLRMEstimator
+    r = np.random.RandomState(8)
+    W = r.randn(300, 2) @ r.randn(2, 5)
+    W[r.rand(*W.shape) < 0.05] = np.nan    # missing cells
+    fr = h2o3_tpu.Frame.from_numpy({f"x{i}": W[:, i] for i in range(5)})
+    m = GLRMEstimator(k=2, max_iterations=30, seed=1).train(fr)
+    _roundtrip(m, fr, tmp_path, atol=1e-3)
