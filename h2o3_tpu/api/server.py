@@ -304,7 +304,19 @@ def _predict(params, body, mid=None, fid=None):
     if not isinstance(fr, Frame):
         raise KeyError(f"frame {fid} not found")
     dest = params.get("predictions_frame") or f"predictions_{mid}_{fid}"
-    preds = m.predict(fr)
+    def _flag(name):
+        return str(params.get(name, "")).lower() in ("1", "true", "yes")
+    for flag, meth in (("leaf_node_assignment", "predict_leaf_node_assignment"),
+                       ("predict_contributions", "predict_contributions")):
+        if _flag(flag):
+            fn = getattr(m, meth, None)
+            if fn is None:
+                raise ValueError(f"{flag} is not supported for "
+                                 f"algo '{m.algo}'")
+            preds = fn(fr)
+            break
+    else:
+        preds = m.predict(fr)
     DKV.remove(preds.key)
     preds.key = str(dest)
     DKV.put(preds.key, preds)

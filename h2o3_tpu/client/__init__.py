@@ -275,11 +275,22 @@ class H2OModel:
     def logloss(self) -> float:
         return self.metrics()["logloss"]
 
-    def predict(self, frame: H2OFrame) -> H2OFrame:
+    def _predict_request(self, frame: H2OFrame, **flags) -> H2OFrame:
         out = connection().request(
             "POST",
-            f"/3/Predictions/models/{self.model_id}/frames/{frame.frame_id}")
+            f"/3/Predictions/models/{self.model_id}/frames/{frame.frame_id}",
+            **flags)
         return H2OFrame(out["predictions_frame"]["name"])
+
+    def predict(self, frame: H2OFrame) -> H2OFrame:
+        return self._predict_request(frame)
+
+    def predict_leaf_node_assignment(self, frame: H2OFrame) -> H2OFrame:
+        return self._predict_request(frame, leaf_node_assignment="true")
+
+    def predict_contributions(self, frame: H2OFrame) -> H2OFrame:
+        """TreeSHAP feature contributions + BiasTerm (h2o-py surface)."""
+        return self._predict_request(frame, predict_contributions="true")
 
     def __repr__(self):
         return f"<H2OModel {self.model_id}>"

@@ -21,6 +21,7 @@ def _tree_artifacts(model) -> Tuple[dict, Dict[str, np.ndarray]]:
         "tree_na_left": np.asarray(f.na_left),
         "tree_is_split": np.asarray(f.is_split),
         "tree_leaf": np.asarray(f.leaf),
+        "tree_leaf_w": np.asarray(f.leaf_w),
         "edges": np.asarray(bm.edges),
         "nbins": np.asarray(bm.nbins),
         "is_cat": np.asarray(bm.is_cat),

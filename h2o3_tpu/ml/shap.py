@@ -1,0 +1,171 @@
+"""TreeSHAP — exact per-feature prediction contributions for forests.
+
+Reference: H2O's predict_contributions (hex/Model.java contributions API,
+h2o-genmodel tree SHAP in hex/genmodel/algos/tree/TreeSHAP.java — the
+Lundberg & Lee "Consistent Individualized Feature Attribution for Tree
+Ensembles" algorithm over CompressedTree node weights). Output frame has
+one column per feature plus BiasTerm; rows sum to the raw (link-space)
+prediction — the same local-accuracy contract the reference guarantees.
+
+TPU-land redesign: our trees are complete binary trees of static depth
+(models/tree.py), so node covers pool up from the stored per-leaf
+training weights (Tree.leaf_w) instead of being walked out of a
+serialized node table. The path recursion (EXTEND/UNWIND) runs on the
+host but VECTORIZED over all rows at once — the per-row hot/cold
+indicator is the only row-dependent quantity, so every path-weight
+update is one numpy broadcast over [N] instead of the reference's
+per-row Java recursion.
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+import numpy as np
+
+
+def _tree_shap_one(feat, thresh, na_left, is_split, leaf, leaf_w,
+                   bins, B: int, phi: np.ndarray) -> float:
+    """Accumulate one tree's contributions into phi [N, F]; returns the
+    tree's expected value (its BiasTerm share)."""
+    D = feat.shape[0]
+    N = bins.shape[0]
+    # covers[d][l] = training weight reaching node (d, l), pooled from leaves
+    covers = [leaf_w.reshape(1 << d, -1).sum(axis=1) for d in range(D)]
+    covers.append(leaf_w)
+    root_cover = max(float(covers[0][0]), 1e-30)
+
+    # path state: ds/zs host scalars, os/W per-row
+    P = D + 2
+    ones = np.ones(N, np.float32)
+
+    def extend(ds, zs, os, W, ln, pz, po, pi):
+        ds[ln], zs[ln], os[ln] = pi, pz, po
+        W[:, ln] = 1.0 if ln == 0 else 0.0
+        for i in range(ln - 1, -1, -1):
+            W[:, i + 1] += po * W[:, i] * ((i + 1.0) / (ln + 1.0))
+            W[:, i] *= pz * ((ln - i) / (ln + 1.0))
+
+    def unwound_sum(zs, os, W, ln, i):
+        """Σ weights of the path with element i unwound (leaf use)."""
+        o_i, z_i = os[i], zs[i]
+        hot = o_i != 0
+        o_safe = np.where(hot, o_i, 1.0)
+        n = W[:, ln - 1].copy()
+        total = np.zeros(N, np.float32)
+        for j in range(ln - 2, -1, -1):
+            w_hot = n * ln / ((j + 1.0) * o_safe)
+            w_cold = W[:, j] * (ln / (z_i * (ln - 1.0 - j)))
+            total += np.where(hot, w_hot, w_cold)
+            n = W[:, j] - w_hot * (z_i * (ln - 1.0 - j) / ln)
+        return total
+
+    def unwind(ds, zs, os, W, ln, i):
+        """Remove path element i in place (repeated-feature case)."""
+        o_i, z_i = os[i], zs[i]
+        hot = o_i != 0
+        o_safe = np.where(hot, o_i, 1.0)
+        n = W[:, ln - 1].copy()
+        for j in range(ln - 2, -1, -1):
+            w_hot = n * ln / ((j + 1.0) * o_safe)
+            w_cold = W[:, j] * (ln / (z_i * (ln - 1.0 - j)))
+            n = W[:, j] - w_hot * (z_i * (ln - 1.0 - j) / ln)
+            W[:, j] = np.where(hot, w_hot, w_cold)
+        for j in range(i, ln - 1):
+            ds[j], zs[j], os[j] = ds[j + 1], zs[j + 1], os[j + 1]
+
+    def recurse(d, l, ds, zs, os, W, ln, pz, po, pi):
+        ds, zs, os = list(ds), list(zs), list(os)
+        W = W.copy()
+        extend(ds, zs, os, W, ln, pz, po, pi)
+        ln += 1
+        terminal = d == D or not is_split[d, l]
+        if terminal:
+            v = float(leaf[l << (D - d)])
+            for i in range(1, ln):
+                s = unwound_sum(zs, os, W, ln, i)
+                phi[:, ds[i]] += s * (os[i] - zs[i]) * v
+            return
+        f = int(feat[d, l])
+        b = bins[:, f]
+        gl = np.where(b == B - 1, bool(na_left[d, l]),
+                      b <= thresh[d, l]).astype(np.float32)
+        r_j = max(float(covers[d][l]), 1e-30)
+        r_l = float(covers[d + 1][2 * l])
+        r_r = float(covers[d + 1][2 * l + 1])
+        iz, io = 1.0, ones
+        for k in range(1, ln):
+            if ds[k] == f:
+                iz, io = zs[k], os[k]
+                unwind(ds, zs, os, W, ln, k)
+                ln -= 1
+                break
+        recurse(d + 1, 2 * l, ds, zs, os, W, ln, iz * r_l / r_j, io * gl, f)
+        recurse(d + 1, 2 * l + 1, ds, zs, os, W, ln,
+                iz * r_r / r_j, io * (1.0 - gl), f)
+
+    ds = [0] * P
+    zs = [0.0] * P
+    os = [ones] * P
+    W = np.zeros((N, P), np.float32)
+    recurse(0, 0, ds, zs, os, W, 0, 1.0, ones, -1)
+    return float((leaf_w * leaf).sum() / root_cover)
+
+
+def forest_contributions(forest, bins: np.ndarray, B: int,
+                         scale: float = 1.0,
+                         row_block: int = 262144) -> np.ndarray:
+    """SHAP contributions of a stacked forest → [N, F+1] (last = bias).
+
+    forest: models/tree.py Tree with leading tree axis; bins [N, F] host
+    int bin codes (rebin_for_scoring output); scale multiplies every
+    tree's output (1/T for DRF vote averaging).
+    """
+    feat = np.asarray(forest.feat)
+    thresh = np.asarray(forest.thresh)
+    na_left = np.asarray(forest.na_left)
+    is_split = np.asarray(forest.is_split)
+    leaf = np.asarray(forest.leaf, np.float64) * scale
+    leaf_w = np.asarray(forest.leaf_w, np.float64)
+    T = feat.shape[0]
+    N, F = bins.shape
+    out = np.zeros((N, F + 1), np.float64)
+    for lo in range(0, N, row_block):
+        hi = min(N, lo + row_block)
+        blk = np.ascontiguousarray(bins[lo:hi])
+        phi = np.zeros((hi - lo, F), np.float32)
+        bias = 0.0
+        for t in range(T):
+            bias += _tree_shap_one(feat[t], thresh[t], na_left[t],
+                                   is_split[t], leaf[t], leaf_w[t],
+                                   blk, B, phi)
+        out[lo:hi, :F] = phi
+        out[lo:hi, F] = bias
+    return out
+
+
+def contributions_frame(model, frame, forest=None, scale: float = 1.0,
+                        bias_offset: float = 0.0):
+    """Shared GBM/DRF predict_contributions → Frame(features…, BiasTerm).
+
+    Only Regression and Binomial models are supported — the reference's
+    contract (hex/Model.java rejects multinomial contributions).
+    """
+    from h2o3_tpu.frame.binning import rebin_for_scoring
+    from h2o3_tpu.frame.frame import Frame
+
+    cat = str(model.output.get("category"))
+    if cat not in ("Regression", "Binomial"):
+        raise ValueError(
+            "predict_contributions supports only regression and binomial "
+            f"models (got {cat})")
+    bm = rebin_for_scoring(model.bm, frame)
+    bins = np.asarray(bm.bins)[: frame.nrows]
+    phi = forest_contributions(forest if forest is not None else model.forest,
+                               bins, model.bm.nbins_total, scale=scale)
+    phi[:, -1] += bias_offset
+    names = list(model.output["names"])
+    cols: Dict[str, np.ndarray] = {
+        n: phi[:, j] for j, n in enumerate(names)}
+    cols["BiasTerm"] = phi[:, -1]
+    return Frame.from_numpy(cols)

@@ -132,6 +132,12 @@ class DRFModel(Model):
         from h2o3_tpu.models.tree import leaf_assignment_frame
         return leaf_assignment_frame(self, frame)
 
+    def predict_contributions(self, frame: Frame) -> Frame:
+        """TreeSHAP contributions; rows sum to the (unclipped) averaged
+        vote — the reference DRF contributions contract."""
+        from h2o3_tpu.ml.shap import contributions_frame
+        return contributions_frame(self, frame, scale=1.0 / self.ntrees)
+
     def model_performance(self, frame: Frame):
         y = self.output["response"]
         bm = rebin_for_scoring(self.bm, frame)

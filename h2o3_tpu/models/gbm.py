@@ -187,6 +187,15 @@ class GBMModel(Model):
         from h2o3_tpu.models.tree import leaf_assignment_frame
         return leaf_assignment_frame(self, frame)
 
+    def predict_contributions(self, frame: Frame) -> Frame:
+        """TreeSHAP contributions (h2o-py predict_contributions): feature
+        columns + BiasTerm, summing to the raw link-space margin."""
+        from h2o3_tpu.ml.shap import contributions_frame
+        bias = (float(self.f0)
+                if self.output["category"] != ModelCategory.MULTINOMIAL
+                else 0.0)
+        return contributions_frame(self, frame, bias_offset=bias)
+
     def model_performance(self, frame: Frame):
         y = self.output["response"]
         bm = rebin_for_scoring(self.bm, frame)

@@ -75,7 +75,7 @@ def _grow_random_tree(bins, nb, w, key, *, depth: int, B: int):
         nid = 2 * nid + jnp.where(goleft, 0, 1)
     leaf_cnt = segment_sum(nid, w[:, None], n_nodes=2 ** depth, mesh=mesh)[:, 0]
     leaf = _avg_path_correction(leaf_cnt)
-    return Tree(feats, threshs, na_lefts, is_splits, leaf)
+    return Tree(feats, threshs, na_lefts, is_splits, leaf, leaf_cnt)
 
 
 def _tree_path_length(tree: Tree, bins, B: int):

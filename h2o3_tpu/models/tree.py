@@ -41,6 +41,10 @@ class Tree(NamedTuple):
     na_left: jax.Array    # [D, Lmax] bool
     is_split: jax.Array   # [D, Lmax] bool
     leaf: jax.Array       # [2^D] float32 leaf values
+    leaf_w: jax.Array     # [2^D] float32 training row weight per leaf
+                          # (node covers for TreeSHAP pool up from these;
+                          # the reference stores them as node weights in
+                          # hex/tree/CompressedTree for contributions)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -257,7 +261,7 @@ def grow_tree(bins, nb, w, g, h, col_mask, *, params: TreeParams, mesh,
     leaf = jnp.where(leaf_stats[:, 0] > 0, -G / (H + params.reg_lambda), 0.0)
     if constraints is not None:
         leaf = jnp.clip(leaf, lo, hi)   # leaves honor propagated bounds
-    tree = Tree(feats, threshs, na_lefts, is_splits, leaf)
+    tree = Tree(feats, threshs, na_lefts, is_splits, leaf, leaf_stats[:, 0])
     return tree, nid, gain_by_feat
 
 

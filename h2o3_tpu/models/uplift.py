@@ -157,7 +157,8 @@ def _grow_uplift_tree(bins, nb, w, y, treat, key, *, depth: int, B: int,
                        n_nodes=nleaf, mesh=mesh)
     p_t = _smooth_p(st_t[:, 1], st_t[:, 0])
     p_c = _smooth_p(st_c[:, 1], st_c[:, 0])
-    tree = Tree(feats, threshs, na_lefts, is_splits, p_t - p_c)
+    tree = Tree(feats, threshs, na_lefts, is_splits, p_t - p_c,
+                st_t[:, 0] + st_c[:, 0])
     return tree, p_t, p_c
 
 

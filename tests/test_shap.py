@@ -1,0 +1,129 @@
+"""TreeSHAP predict_contributions — exactness + local accuracy.
+
+Oracle 1 (local accuracy): contributions + BiasTerm sum to the raw
+link-space margin for every row (hex/Model.java contributions contract).
+Oracle 2 (exactness): brute-force Shapley values computed by enumerating
+all feature subsets with the tree conditional expectation (the EXPVALUE
+recursion of Lundberg et al.) on small forests.
+"""
+
+import itertools
+import math
+
+import numpy as np
+import pytest
+
+import h2o3_tpu
+from h2o3_tpu.frame.binning import rebin_for_scoring
+from h2o3_tpu.frame.frame import Frame
+from h2o3_tpu.ml.shap import forest_contributions
+from h2o3_tpu.models.gbm import GBMEstimator
+from h2o3_tpu.models.drf import DRFEstimator
+
+
+def _rand_frame(n=400, F=4, seed=3, binary=False):
+    r = np.random.RandomState(seed)
+    cols = {f"x{i}": r.randn(n) for i in range(F)}
+    raw = cols["x0"] * 2.0 + np.sin(cols["x1"]) + 0.3 * r.randn(n)
+    if binary:
+        cols["y"] = np.where(raw > 0, "yes", "no")
+    else:
+        cols["y"] = raw
+    return Frame.from_numpy(cols)
+
+
+def _brute_tree_shap(feat, thresh, na_left, is_split, leaf, leaf_w,
+                     bins_row, B, F):
+    """Exact Shapley via subset enumeration + EXPVALUE recursion."""
+    D = feat.shape[0]
+    covers = [leaf_w.reshape(1 << d, -1).sum(axis=1) for d in range(D)]
+    covers.append(leaf_w)
+
+    def expv(d, l, S):
+        if d == D or not is_split[d, l]:
+            return float(leaf[l << (D - d)])
+        f = int(feat[d, l])
+        left, right = 2 * l, 2 * l + 1
+        if f in S:
+            b = bins_row[f]
+            gl = bool(na_left[d, l]) if b == B - 1 else b <= thresh[d, l]
+            return expv(d + 1, left if gl else right, S)
+        rl, rr = float(covers[d + 1][left]), float(covers[d + 1][right])
+        rj = max(rl + rr, 1e-30)
+        return (rl * expv(d + 1, left, S) + rr * expv(d + 1, right, S)) / rj
+
+    phi = np.zeros(F)
+    feats = list(range(F))
+    for i in feats:
+        rest = [f for f in feats if f != i]
+        for k in range(F):
+            wgt = math.factorial(k) * math.factorial(F - k - 1) / math.factorial(F)
+            for S in itertools.combinations(rest, k):
+                phi[i] += wgt * (expv(0, 0, set(S) | {i}) - expv(0, 0, set(S)))
+    return phi
+
+
+@pytest.fixture(scope="module")
+def gbm_reg():
+    fr = _rand_frame()
+    m = GBMEstimator(ntrees=4, max_depth=3, learn_rate=0.3, seed=7,
+                     min_rows=5.0)
+    return fr, m.train(y="y", training_frame=fr)
+
+
+def test_local_accuracy_regression(gbm_reg):
+    fr, model = gbm_reg
+    contrib = model.predict_contributions(fr)
+    names = list(model.output["names"]) + ["BiasTerm"]
+    assert list(contrib.names) == names
+    total = sum(contrib.col(n).to_numpy() for n in names)
+    pred = model.predict(fr).col("predict").to_numpy()
+    np.testing.assert_allclose(total, pred, rtol=1e-4, atol=1e-4)
+
+
+def test_exact_vs_bruteforce(gbm_reg):
+    fr, model = gbm_reg
+    bm = rebin_for_scoring(model.bm, fr)
+    bins = np.asarray(bm.bins)[: fr.nrows]
+    B = model.bm.nbins_total
+    rows = bins[:6]
+    phi = forest_contributions(model.forest, rows, B)
+    F = bins.shape[1]
+    fo = [np.asarray(getattr(model.forest, f)) for f in
+          ("feat", "thresh", "na_left", "is_split", "leaf", "leaf_w")]
+    for r in range(rows.shape[0]):
+        want = np.zeros(F)
+        for t in range(fo[0].shape[0]):
+            want += _brute_tree_shap(*(a[t] for a in fo), rows[r], B, F)
+        np.testing.assert_allclose(phi[r, :F], want, rtol=1e-4, atol=1e-5)
+
+
+def test_local_accuracy_binomial():
+    fr = _rand_frame(binary=True, seed=11)
+    model = GBMEstimator(ntrees=5, max_depth=3, seed=5).train(
+        y="y", training_frame=fr)
+    contrib = model.predict_contributions(fr)
+    total = sum(contrib.col(n).to_numpy() for n in contrib.names)
+    p1 = model.predict(fr).col("p1").to_numpy()
+    logit = np.log(np.clip(p1, 1e-12, 1) / np.clip(1 - p1, 1e-12, 1))
+    np.testing.assert_allclose(total, logit, rtol=1e-3, atol=1e-3)
+
+
+def test_drf_contributions_sum():
+    fr = _rand_frame(seed=19)
+    model = DRFEstimator(ntrees=6, max_depth=4, seed=5).train(
+        y="y", training_frame=fr)
+    contrib = model.predict_contributions(fr)
+    total = sum(contrib.col(n).to_numpy() for n in contrib.names)
+    pred = model.predict(fr).col("predict").to_numpy()
+    np.testing.assert_allclose(total, pred, rtol=1e-4, atol=1e-4)
+
+
+def test_multinomial_rejected():
+    r = np.random.RandomState(2)
+    fr = Frame.from_numpy({"a": r.randn(200),
+                           "y": r.choice(["u", "v", "w"], 200)})
+    model = GBMEstimator(ntrees=2, max_depth=2).train(y="y",
+                                                      training_frame=fr)
+    with pytest.raises(ValueError, match="regression and binomial"):
+        model.predict_contributions(fr)
