@@ -324,6 +324,31 @@ def _predict(params, body, mid=None, fid=None):
             "model_metrics": [{}]}
 
 
+@route("GET", r"/3/Models/(?P<mid>[^/]+)/mojo")
+def _model_mojo(params, body, mid=None):
+    """Stream the MOJO zip (h2o-py download_mojo GET endpoint)."""
+    from h2o3_tpu.genmodel.export import mojo_artifacts
+    from h2o3_tpu.genmodel.mojo import mojo_bytes
+    m = DKV.get(mid)
+    if not isinstance(m, Model):
+        raise KeyError(f"model {mid} not found")
+    return {"__bytes__": mojo_bytes(*mojo_artifacts(m)),
+            "__ctype__": "application/zip"}
+
+
+@route("GET", r"/3/Models\.java/(?P<mid>[^/]+)")
+def _model_pojo(params, body, mid=None):
+    """Generated-source scorer download (water/api Models.java POJO
+    endpoint shape; a stdlib-Python module here)."""
+    from h2o3_tpu.genmodel.pojo import pojo_source
+    m = DKV.get(mid)
+    if not isinstance(m, Model):
+        raise KeyError(f"model {mid} not found")
+    src = pojo_source(m, modname=str(mid))
+    return {"__bytes__": src.encode(),
+            "__ctype__": "text/plain; charset=utf-8"}
+
+
 @route("POST", r"/3/ModelMetrics/models/(?P<mid>[^/]+)/frames/(?P<fid>[^/]+)")
 def _model_metrics(params, body, mid=None, fid=None):
     """Score a frame and return its metrics (water/api/ModelMetricsHandler
@@ -560,7 +585,10 @@ class _Handler(BaseHTTPRequestHandler):
                            "error_url": path, "msg": str(e),
                            "exception_msg": str(e)}
                     code = 500
-                if isinstance(out, dict) and "__html__" in out:
+                if isinstance(out, dict) and "__bytes__" in out:
+                    payload = out["__bytes__"]
+                    ctype = out.get("__ctype__", "application/octet-stream")
+                elif isinstance(out, dict) and "__html__" in out:
                     payload = out["__html__"].encode()
                     ctype = "text/html; charset=utf-8"
                 else:

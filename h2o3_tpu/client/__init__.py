@@ -292,6 +292,22 @@ class H2OModel:
         """TreeSHAP feature contributions + BiasTerm (h2o-py surface)."""
         return self._predict_request(frame, predict_contributions="true")
 
+    def _download(self, urlpath: str, path: str) -> str:
+        req = urllib.request.Request(f"{connection().url}{urlpath}")
+        with urllib.request.urlopen(req, timeout=600) as resp:
+            payload = resp.read()
+        with open(path, "wb") as fh:
+            fh.write(payload)
+        return path
+
+    def download_mojo(self, path: str) -> str:
+        """Fetch the MOJO zip (h2o-py model.download_mojo)."""
+        return self._download(f"/3/Models/{self.model_id}/mojo", path)
+
+    def download_pojo(self, path: str) -> str:
+        """Fetch the generated-source scorer (h2o-py h2o.download_pojo)."""
+        return self._download(f"/3/Models.java/{self.model_id}", path)
+
     def __repr__(self):
         return f"<H2OModel {self.model_id}>"
 

@@ -92,3 +92,10 @@ class EasyPredictModelWrapper:
         out = self._score(row)
         return AnomalyDetectionPrediction(score=float(out["predict"]),
                                           normalized_score=float(out["predict"]))
+
+    def predict_contributions(self, row: dict) -> Dict[str, float]:
+        """Per-feature TreeSHAP contributions + BiasTerm
+        (EasyPredictModelWrapper.predictContributions role)."""
+        batch = {k: np.asarray([v]) for k, v in row.items()}
+        out = self.model.predict_contributions(batch)
+        return {k: float(v[0]) for k, v in out.items()}

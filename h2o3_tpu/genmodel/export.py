@@ -193,4 +193,24 @@ def mojo_artifacts(model) -> Tuple[dict, Dict[str, np.ndarray]]:
             "num_sigmas": np.asarray(model.di_stats["num_sigmas"]),
         }
         return meta, arrays
+    if algo == "rulefit":
+        # composite MOJO: per-depth rule forests + the sparse GLM head
+        # (reference hex/rulefit RuleFitMojoWriter bundles both parts)
+        glm_meta, glm_arrays = mojo_artifacts(model.glm_model)
+        meta["glm"] = glm_meta
+        meta["rules"] = [{"model": r["model"], "tree": int(r["tree"]),
+                          "lo": int(r["lo"]), "hi": int(r["hi"]),
+                          "name": r["name"]} for r in model.rules]
+        meta["linear_cols"] = list(model.linear_cols)
+        meta["winsor"] = {n: [float(lo), float(hi)]
+                          for n, (lo, hi) in model.winsor.items()}
+        meta["n_tree_models"] = len(model.tree_models)
+        arrays = {f"glm_{k}": v for k, v in glm_arrays.items()}
+        for i, tm in enumerate(model.tree_models):
+            tmeta, tarrays = _tree_artifacts(tm)
+            meta[f"tm{i}_nbins_total"] = tmeta["nbins_total"]
+            meta[f"tm{i}_feature_domains"] = tmeta["feature_domains"]
+            meta[f"tm{i}_names"] = list(tm.bm.names)
+            arrays.update({f"tm{i}_{k}": v for k, v in tarrays.items()})
+        return meta, arrays
     raise ValueError(f"MOJO export not supported for algo '{algo}'")

@@ -27,15 +27,23 @@ import numpy as np
 MOJO_FORMAT_VERSION = "1.0"
 
 
-def write_mojo(path: str, meta: dict, arrays: Dict[str, np.ndarray]) -> str:
-    """Write a MOJO zip: meta.json + arrays.npz."""
+def mojo_bytes(meta: dict, arrays: Dict[str, np.ndarray]) -> bytes:
+    """Render a MOJO zip (meta.json + arrays.npz) in memory."""
     meta = dict(meta)
     meta["mojo_version"] = MOJO_FORMAT_VERSION
+    npz = io.BytesIO()
+    np.savez_compressed(npz, **{k: np.asarray(v) for k, v in arrays.items()})
     buf = io.BytesIO()
-    np.savez_compressed(buf, **{k: np.asarray(v) for k, v in arrays.items()})
-    with zipfile.ZipFile(path, "w", compression=zipfile.ZIP_DEFLATED) as z:
+    with zipfile.ZipFile(buf, "w", compression=zipfile.ZIP_DEFLATED) as z:
         z.writestr("meta.json", json.dumps(meta, indent=1))
-        z.writestr("arrays.npz", buf.getvalue())
+        z.writestr("arrays.npz", npz.getvalue())
+    return buf.getvalue()
+
+
+def write_mojo(path: str, meta: dict, arrays: Dict[str, np.ndarray]) -> str:
+    """Write a MOJO zip: meta.json + arrays.npz."""
+    with open(path, "wb") as fh:
+        fh.write(mojo_bytes(meta, arrays))
     return path
 
 
@@ -117,22 +125,33 @@ def walk_forest(arrays: Dict[str, np.ndarray], bins: np.ndarray,
     na_left = arrays["tree_na_left"].astype(bool)
     is_split = arrays["tree_is_split"].astype(bool)
     leaf = arrays["tree_leaf"]        # [T, 2^D]
-    T, D, _ = feat.shape
-    n = bins.shape[0]
-    out = np.zeros((T, n), dtype=np.float64)
+    T = feat.shape[0]
+    out = np.zeros((T, bins.shape[0]), dtype=np.float64)
     for t in range(T):
-        nid = np.zeros(n, dtype=np.int64)
-        for d in range(D):
-            f_r = feat[t, d][nid]
-            t_r = thresh[t, d][nid]
-            nal = na_left[t, d][nid]
-            isp = is_split[t, d][nid]
-            b_r = bins[np.arange(n), f_r]
-            isna = b_r == (B - 1)
-            goleft = np.where(isp, np.where(isna, nal, b_r <= t_r), True)
-            nid = 2 * nid + np.where(goleft, 0, 1)
+        nid = route_tree_nids(feat[t], thresh[t], na_left[t], is_split[t],
+                              bins, B)
         out[t] = leaf[t][nid]
     return out
+
+
+def route_tree_nids(feat, thresh, na_left, is_split, bins: np.ndarray,
+                    B: int) -> np.ndarray:
+    """Terminal leaf id per row for ONE tree [D, L] (RuleFit rule
+    membership is a leaf-id range check — models/rulefit.py _route_nids
+    twin on the host)."""
+    D = feat.shape[0]
+    n = bins.shape[0]
+    nid = np.zeros(n, dtype=np.int64)
+    for d in range(D):
+        f_r = feat[d][nid]
+        t_r = thresh[d][nid]
+        nal = na_left[d][nid]
+        isp = is_split[d][nid]
+        b_r = bins[np.arange(n), f_r]
+        isna = b_r == (B - 1)
+        goleft = np.where(isp, np.where(isna, nal, b_r <= t_r), True)
+        nid = 2 * nid + np.where(goleft, 0, 1)
+    return nid
 
 
 def walk_forest_pathlen(arrays: Dict[str, np.ndarray], bins: np.ndarray,

@@ -104,6 +104,36 @@ class SharedTreeMojoModel(MojoModel):
         bins = bin_raw(self.meta, self.arrays, data)
         return walk_forest(self.arrays, bins, B)   # [T_total, N]
 
+    def predict_contributions(self, data) -> Dict[str, np.ndarray]:
+        """Offline TreeSHAP (hex/genmodel/algos/tree/TreeSHAP.java role):
+        feature columns + BiasTerm, summing to the raw margin."""
+        import types
+
+        from h2o3_tpu.ml.shap import forest_contributions
+        cat = self.category
+        if cat not in ("Regression", "Binomial"):
+            raise ValueError(
+                "predict_contributions supports only regression and "
+                f"binomial models (got {cat})")
+        if "tree_leaf_w" not in self.arrays:
+            raise ValueError("MOJO lacks node weights "
+                             "(exported before TreeSHAP support)")
+        B = int(self.meta["nbins_total"])
+        bins = bin_raw(self.meta, self.arrays, data)
+        forest = types.SimpleNamespace(
+            feat=self.arrays["tree_feat"], thresh=self.arrays["tree_thresh"],
+            na_left=self.arrays["tree_na_left"],
+            is_split=self.arrays["tree_is_split"],
+            leaf=self.arrays["tree_leaf"], leaf_w=self.arrays["tree_leaf_w"])
+        T = forest.feat.shape[0]
+        scale = 1.0 / T if self.algo == "drf" else 1.0
+        phi = forest_contributions(forest, bins, B, scale=scale)
+        if self.algo == "gbm":
+            phi[:, -1] += float(np.asarray(self.meta["f0"], np.float64))
+        out = {n: phi[:, j] for j, n in enumerate(self.names)}
+        out["BiasTerm"] = phi[:, -1]
+        return out
+
 
 class GbmMojoModel(SharedTreeMojoModel):
     def predict(self, data):
@@ -405,6 +435,48 @@ class Word2VecMojoModel(MojoModel):
         return out
 
 
+class RuleFitMojoModel(MojoModel):
+    """Composite offline scorer: rebuild the rule/linear feature columns
+    from the bundled rule forests, then score the bundled GLM head."""
+
+    def _features(self, data) -> Dict[str, np.ndarray]:
+        from h2o3_tpu.genmodel.mojo import route_tree_nids
+        feats: Dict[str, np.ndarray] = {}
+        rules = self.meta["rules"]
+        for i in range(int(self.meta["n_tree_models"])):
+            sub_meta = {"names": self.meta[f"tm{i}_names"],
+                        "nbins_total": self.meta[f"tm{i}_nbins_total"],
+                        "feature_domains": self.meta[f"tm{i}_feature_domains"]}
+            pre = f"tm{i}_"
+            sub_arrays = {k[len(pre):]: v for k, v in self.arrays.items()
+                          if k.startswith(pre)}
+            B = int(sub_meta["nbins_total"])
+            bins = bin_raw(sub_meta, sub_arrays, data)
+            my_rules = [r for r in rules if r["model"] == i]
+            by_tree: Dict[int, list] = {}
+            for r in my_rules:
+                by_tree.setdefault(int(r["tree"]), []).append(r)
+            for t, rl in sorted(by_tree.items()):
+                nid = route_tree_nids(
+                    sub_arrays["tree_feat"][t], sub_arrays["tree_thresh"][t],
+                    sub_arrays["tree_na_left"][t].astype(bool),
+                    sub_arrays["tree_is_split"][t].astype(bool), bins, B)
+                for r in rl:
+                    feats[r["name"]] = ((nid >= r["lo"]) & (nid < r["hi"])
+                                        ).astype(np.float64)
+        for n in self.meta.get("linear_cols") or []:
+            lo, hi = self.meta["winsor"][n]
+            v = np.asarray(data[n], dtype=np.float64)
+            feats[f"linear.{n}"] = np.clip(v, lo, hi)
+        return feats
+
+    def predict(self, data):
+        glm_arrays = {k[4:]: v for k, v in self.arrays.items()
+                      if k.startswith("glm_")}
+        head = GlmMojoModel(self.meta["glm"], glm_arrays)
+        return head.predict(self._features(data))
+
+
 _READERS = {
     "gbm": GbmMojoModel,
     "drf": DrfMojoModel,
@@ -421,4 +493,5 @@ _READERS = {
     "extendedisolationforest": ExtIsoForMojoModel,
     "word2vec": Word2VecMojoModel,
     "glrm": GlrmMojoModel,
+    "rulefit": RuleFitMojoModel,
 }

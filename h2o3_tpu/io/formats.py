@@ -134,3 +134,89 @@ def parse_arff(text: str, key: Optional[str] = None) -> Frame:
             strs.append(name)
     return Frame.from_numpy(cols, categorical=cats, domains=domains,
                             strings=strs, key=key)
+
+
+def parse_xlsx(path: str, key: Optional[str] = None) -> Frame:
+    """XLSX ingest via the stdlib (zipfile + ElementTree) — the
+    spreadsheet parser slot of the reference (water/parser/XlsParser.java;
+    the modern OOXML container replaces the legacy BIFF stream, which is
+    gated off in this build — no xlrd in the image).
+
+    First worksheet only; row 1 becomes the header when every cell in it
+    is text, else columns are named C1..Cn (ParseSetup header-guess
+    rule). Text columns intern as categoricals like the CSV path.
+    """
+    import xml.etree.ElementTree as ET
+    import zipfile
+
+    NS = "{http://schemas.openxmlformats.org/spreadsheetml/2006/main}"
+    with zipfile.ZipFile(path) as z:
+        shared: List[str] = []
+        if "xl/sharedStrings.xml" in z.namelist():
+            root = ET.fromstring(z.read("xl/sharedStrings.xml"))
+            for si in root.findall(f"{NS}si"):
+                shared.append("".join(t.text or ""
+                                      for t in si.iter(f"{NS}t")))
+        sheet_names = sorted(n for n in z.namelist()
+                             if re.fullmatch(r"xl/worksheets/sheet\d+\.xml", n))
+        if not sheet_names:
+            raise ValueError(f"{path}: no worksheets found")
+        root = ET.fromstring(z.read(sheet_names[0]))
+
+    def col_index(ref: str) -> int:
+        j = 0
+        for ch in ref:
+            if ch.isalpha():
+                j = j * 26 + (ord(ch.upper()) - ord("A") + 1)
+            else:
+                break
+        return j - 1
+
+    rows: List[Dict[int, object]] = []
+    ncols = 0
+    for row_el in root.iter(f"{NS}row"):
+        row: Dict[int, object] = {}
+        for ci, c in enumerate(row_el.findall(f"{NS}c")):
+            ref = c.get("r")
+            j = col_index(ref) if ref else ci
+            t = c.get("t")
+            v_el = c.find(f"{NS}v")
+            if t == "inlineStr":
+                is_el = c.find(f"{NS}is")
+                val = "".join(tt.text or "" for tt in is_el.iter(f"{NS}t")) \
+                    if is_el is not None else None
+            elif v_el is None or v_el.text is None:
+                val = None
+            elif t == "s":
+                val = shared[int(v_el.text)]
+            elif t == "b":
+                val = float(int(v_el.text))
+            elif t in ("str", "e"):
+                val = v_el.text
+            else:
+                val = float(v_el.text)
+            if val is not None:
+                row[j] = val
+                ncols = max(ncols, j + 1)
+        rows.append(row)
+    if not rows or ncols == 0:
+        raise ValueError(f"{path}: empty worksheet")
+
+    header = rows[0]
+    has_header = (len(header) == ncols
+                  and all(isinstance(v, str) for v in header.values()))
+    names = ([str(header[j]) for j in range(ncols)] if has_header
+             else [f"C{j + 1}" for j in range(ncols)])
+    body = rows[1:] if has_header else rows
+    cols: Dict[str, np.ndarray] = {}
+    cats: List[str] = []
+    for j, name in enumerate(names):
+        vals = [r.get(j) for r in body]
+        if all(v is None or isinstance(v, float) for v in vals):
+            cols[name] = np.asarray(
+                [np.nan if v is None else v for v in vals], dtype=np.float64)
+        else:
+            cols[name] = np.asarray(
+                [None if v is None else str(v) for v in vals], dtype=object)
+            cats.append(name)
+    return Frame.from_numpy(cols, categorical=cats, key=key)

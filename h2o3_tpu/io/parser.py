@@ -29,8 +29,36 @@ log = get_logger("h2o3_tpu.parse")
 
 
 def parse_setup(path: str, nrows_sample: int = 1000) -> dict:
-    """Schema guess on a sample (ParseSetup.guessSetup)."""
+    """Schema guess on a sample (ParseSetup.guessSetup).
+
+    CSV guesses from a pandas sample; non-CSV formats (xlsx, parquet,
+    ARFF, SVMLight) guess by running their real parser and discarding
+    the frame — the reference likewise runs format-specific setup on
+    sample chunks (water/parser/ParseSetup.java)."""
     import pandas as pd
+    if path.endswith((".parquet", ".pq")):
+        # schema only — no data read (multi-GB files must not be parsed
+        # twice just to report types)
+        import pyarrow.parquet as pq
+        schema = pq.ParquetFile(path).schema_arrow
+        types = {f.name: ("categorical" if f.type in ("string", "large_string")
+                          or str(f.type).startswith("dict") else "numeric")
+                 for f in schema}
+        return {"columns": list(types), "types": types, "separator": ",",
+                "header": True}
+    if path.endswith((".xlsx", ".arff", ".svm", ".svmlight")):
+        # host text/spreadsheet formats (small by nature): run the real
+        # parser and discard — the reference likewise runs format-specific
+        # setup on sample chunks (water/parser/ParseSetup.java)
+        from h2o3_tpu.core.kv import DKV
+        fr = import_file(path)
+        cols = list(fr.names)
+        types = {n: ("categorical" if fr.col(n).is_categorical else
+                     "string" if fr.col(n).type == "string" else "numeric")
+                 for n in cols}
+        DKV.remove(fr.key)
+        return {"columns": cols, "types": types, "separator": ",",
+                "header": True}
     sample = pd.read_csv(path, nrows=nrows_sample)
     types = {}
     for c in sample.columns:
@@ -66,6 +94,16 @@ def import_file(path: str, destination_frame: Optional[str] = None,
     if len(paths) == 1 and paths[0].endswith(".arff"):
         from h2o3_tpu.io.formats import parse_arff
         return parse_arff(open(paths[0]).read(), key=destination_frame)
+    if len(paths) == 1 and paths[0].endswith((".xlsx", ".xls")):
+        if paths[0].endswith(".xls"):
+            raise ValueError(
+                "legacy BIFF .xls is not supported in this build "
+                "(no xlrd); save as .xlsx or .csv")
+        from h2o3_tpu.io.formats import parse_xlsx
+        fr = parse_xlsx(paths[0], key=destination_frame)
+        log.info("parsed %s (xlsx) -> %s (%d x %d)", path, fr.key,
+                 fr.nrows, fr.ncols)
+        return fr
 
     # CSV goes through the native multithreaded tokenizer
     # (h2o3_tpu/native/csv_parser.cpp — the water/parser CsvParser role);

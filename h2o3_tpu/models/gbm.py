@@ -191,10 +191,8 @@ class GBMModel(Model):
         """TreeSHAP contributions (h2o-py predict_contributions): feature
         columns + BiasTerm, summing to the raw link-space margin."""
         from h2o3_tpu.ml.shap import contributions_frame
-        bias = (float(self.f0)
-                if self.output["category"] != ModelCategory.MULTINOMIAL
-                else 0.0)
-        return contributions_frame(self, frame, bias_offset=bias)
+        # contributions_frame rejects multinomial, so f0 is always scalar
+        return contributions_frame(self, frame, bias_offset=float(self.f0))
 
     def model_performance(self, frame: Frame):
         y = self.output["response"]

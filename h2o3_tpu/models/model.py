@@ -142,6 +142,12 @@ class Model:
         meta, arrays = mojo_artifacts(self)
         return write_mojo(path, meta, arrays)
 
+    def download_pojo(self, path: str) -> str:
+        """Export a standalone source-code scorer (Model.toJava POJO
+        role; a stdlib-only Python module here — see genmodel/pojo.py)."""
+        from h2o3_tpu.genmodel.pojo import export_pojo
+        return export_pojo(self, path)
+
     @property
     def default_metrics(self):
         return (self.cross_validation_metrics or self.validation_metrics

@@ -130,3 +130,31 @@ def test_custom_metric_func(conn, csv_path):
         frame, y="y", custom_metric_func=brier)
     assert 0 < m.output["custom_metric"] < 0.25
     assert m.training_metrics["custom"] == m.output["custom_metric"]
+
+
+def test_client_mojo_pojo_download(tmp_path):
+    """REST download endpoints: MOJO zip scores offline, POJO source
+    imports with stdlib only."""
+    import numpy as np
+    from h2o3_tpu import client as h2o
+    from h2o3_tpu.genmodel import load_mojo
+    h2o.init()
+    r = np.random.RandomState(4)
+    import csv
+    p = str(tmp_path / "c.csv")
+    with open(p, "w", newline="") as fh:
+        w = csv.writer(fh)
+        w.writerow(["a", "b", "y"])
+        for _ in range(300):
+            a, b = r.randn(2)
+            w.writerow([a, b, "t" if a + b > 0 else "f"])
+    fr = h2o.import_file(p)
+    m = h2o.estimators.H2OGradientBoostingEstimator(
+        ntrees=5, max_depth=3).train(y="y", training_frame=fr)
+    zp = m.download_mojo(str(tmp_path / "m.zip"))
+    mojo = load_mojo(zp)
+    out = mojo.predict({"a": np.array([1.0]), "b": np.array([1.0])})
+    assert 0.0 <= float(out["p1"][0]) <= 1.0
+    pp = m.download_pojo(str(tmp_path / "m_pojo.py"))
+    src = open(pp).read()
+    assert "score0" in src and "import numpy" not in src

@@ -241,3 +241,92 @@ def test_glrm_mojo(tmp_path):
     fr = h2o3_tpu.Frame.from_numpy({f"x{i}": W[:, i] for i in range(5)})
     m = GLRMEstimator(k=2, max_iterations=30, seed=1).train(fr)
     _roundtrip(m, fr, tmp_path, atol=1e-3)
+
+
+def test_rulefit_mojo(classif_frame, tmp_path):
+    from h2o3_tpu.models.rulefit import RuleFitEstimator
+    m = RuleFitEstimator(seed=11, min_rule_length=2, max_rule_length=3,
+                         rule_generation_ntrees=12).train(classif_frame, y="y")
+    _roundtrip(m, classif_frame, tmp_path)
+
+
+def test_mojo_contributions_match_incluster(regress_frame, tmp_path):
+    """Offline TreeSHAP must equal the in-cluster contributions
+    (testdir_javapredict role for predictContributions)."""
+    from h2o3_tpu.models.gbm import GBMEstimator
+    m = GBMEstimator(ntrees=6, max_depth=3, seed=3).train(regress_frame, y="y")
+    path = str(tmp_path / "gbm_shap.zip")
+    m.download_mojo(path)
+    mojo = load_mojo(path)
+    offline = mojo.predict_contributions(_raw_cols(regress_frame, mojo.names))
+    incluster = m.predict_contributions(regress_frame)
+    for name in incluster.names:
+        np.testing.assert_allclose(
+            offline[name], incluster.col(name).to_numpy(),
+            rtol=1e-4, atol=1e-5)
+    # EasyPredict single-row surface
+    row = {n: 0.05 * i for i, n in enumerate(mojo.names)}
+    contrib = EasyPredictModelWrapper(mojo).predict_contributions(row)
+    assert "BiasTerm" in contrib
+
+
+def _load_pojo(path):
+    import importlib.util
+    spec = importlib.util.spec_from_file_location("pojo_mod", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_pojo_gbm_binomial(classif_frame, tmp_path):
+    """Generated-source scorer (POJO role) must match in-cluster scoring
+    and import with zero non-stdlib dependencies."""
+    from h2o3_tpu.models.gbm import GBMEstimator
+    m = GBMEstimator(ntrees=8, max_depth=3, seed=5).train(classif_frame, y="y")
+    path = str(tmp_path / "gbm_pojo.py")
+    m.download_pojo(path)
+    src = open(path).read()
+    assert "import numpy" not in src and "import jax" not in src
+    mod = _load_pojo(path)
+    raw = _raw_cols(classif_frame, mod.NAMES)
+    incluster = m._score_raw(classif_frame)
+    n = classif_frame.nrows
+    for i in range(0, n, max(1, n // 25)):
+        row = {k: raw[k][i] for k in raw}
+        out = mod.score0(row)
+        assert abs(out["p1"] - incluster["p1"][i]) < 1e-5
+
+
+def test_pojo_gbm_regression_and_drf(regress_frame, classif_frame, tmp_path):
+    from h2o3_tpu.models.gbm import GBMEstimator
+    from h2o3_tpu.models.drf import DRFEstimator
+    gm = GBMEstimator(ntrees=6, max_depth=3, seed=5).train(regress_frame, y="y")
+    gp = _load_pojo(gm.download_pojo(str(tmp_path / "g.py")))
+    raw = _raw_cols(regress_frame, gp.NAMES)
+    want = gm._score_raw(regress_frame)["predict"]
+    for i in range(0, regress_frame.nrows, 97):
+        assert abs(gp.score0({k: raw[k][i] for k in raw})["predict"]
+                   - want[i]) < 1e-4
+    dm = DRFEstimator(ntrees=6, max_depth=4, seed=5).train(classif_frame, y="y")
+    dp = _load_pojo(dm.download_pojo(str(tmp_path / "d.py")))
+    raw = _raw_cols(classif_frame, dp.NAMES)
+    want = dm._score_raw(classif_frame)["p1"]
+    for i in range(0, classif_frame.nrows, 97):
+        assert abs(dp.score0({k: raw[k][i] for k in raw})["p1"]
+                   - want[i]) < 1e-5
+
+
+def test_pojo_glm(tmp_path):
+    from h2o3_tpu.models.glm import GLMEstimator
+    r = np.random.RandomState(5)
+    fr = h2o3_tpu.Frame.from_numpy({
+        "a": r.randn(300), "b": r.randn(300),
+        "c": r.choice(["p", "q", "r"], 300),
+        "y": r.randn(300)})
+    m = GLMEstimator(family="gaussian", lambda_=0.0).train(fr, y="y")
+    mod = _load_pojo(m.download_pojo(str(tmp_path / "glm.py")))
+    raw = _raw_cols(fr, mod.NAMES)
+    want = m._score_raw(fr)["predict"]
+    for i in range(0, 300, 29):
+        assert abs(mod.score0({k: raw[k][i] for k in raw})["predict"]
+                   - want[i]) < 1e-4

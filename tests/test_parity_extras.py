@@ -114,3 +114,50 @@ def test_timeline_records_jobs(classif_frame):
     evs = timeline.snapshot()
     assert len(evs) == 2048
     assert evs[-1]["seq"] > evs[0]["seq"]
+
+
+def test_xlsx_parse(tmp_path):
+    """Stdlib XLSX ingest: header row, shared strings, inline strings,
+    missing cells -> NA, text column interned as categorical."""
+    import zipfile
+    p = str(tmp_path / "t.xlsx")
+    ct = ('<?xml version="1.0"?><Types xmlns="http://schemas.openxmlformats'
+          '.org/package/2006/content-types"><Default Extension="xml" '
+          'ContentType="application/xml"/></Types>')
+    wb = ('<?xml version="1.0"?><workbook xmlns="http://schemas.openxml'
+          'formats.org/spreadsheetml/2006/main"><sheets><sheet name="S1" '
+          'sheetId="1"/></sheets></workbook>')
+    ss = ('<?xml version="1.0"?><sst xmlns="http://schemas.openxmlformats'
+          '.org/spreadsheetml/2006/main" count="4" uniqueCount="4">'
+          '<si><t>age</t></si><si><t>city</t></si><si><t>sf</t></si>'
+          '<si><t>nyc</t></si></sst>')
+    sheet = ('<?xml version="1.0"?><worksheet xmlns="http://schemas.openxml'
+             'formats.org/spreadsheetml/2006/main"><sheetData>'
+             '<row r="1"><c r="A1" t="s"><v>0</v></c>'
+             '<c r="B1" t="s"><v>1</v></c></row>'
+             '<row r="2"><c r="A2"><v>31.5</v></c>'
+             '<c r="B2" t="s"><v>2</v></c></row>'
+             '<row r="3"><c r="A3"><v>44</v></c>'
+             '<c r="B3" t="s"><v>3</v></c></row>'
+             '<row r="4"><c r="B4" t="inlineStr"><is><t>sf</t></is></c>'
+             '</row></sheetData></worksheet>')
+    with zipfile.ZipFile(p, "w") as z:
+        z.writestr("[Content_Types].xml", ct)
+        z.writestr("xl/workbook.xml", wb)
+        z.writestr("xl/sharedStrings.xml", ss)
+        z.writestr("xl/worksheets/sheet1.xml", sheet)
+    fr = h2o3_tpu.import_file(p)
+    assert fr.names == ["age", "city"]
+    assert fr.nrows == 3
+    age = fr.col("age").to_numpy()
+    assert age[0] == 31.5 and age[1] == 44 and np.isnan(age[2])
+    c = fr.col("city")
+    assert c.is_categorical
+    assert [c.domain[i] for i in np.asarray(c.data)[:3]] == ["sf", "nyc", "sf"]
+
+
+def test_xls_gated(tmp_path):
+    p = tmp_path / "legacy.xls"
+    p.write_bytes(b"\xd0\xcf\x11\xe0junk")
+    with pytest.raises(ValueError, match="xlsx"):
+        h2o3_tpu.import_file(str(p))
