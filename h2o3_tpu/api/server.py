@@ -109,6 +109,14 @@ def _ping(params, body):
     return {"status": "running"}
 
 
+@route("GET", "/3/Cleaner")
+def _cleaner_status(params, body):
+    """Spill/restore counters + HBM pressure (the Cleaner observability
+    the reference exposes via water meters)."""
+    from h2o3_tpu.core.cleaner import cleaner
+    return cleaner.status()
+
+
 @route("GET", "/3/About")
 def _about(params, body):
     info = cloud_mod.cluster_info()

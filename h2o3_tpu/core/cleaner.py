@@ -1,0 +1,193 @@
+"""Cleaner — LRU spill of cold frames from HBM to the ice directory.
+
+Reference: water/Cleaner.java:12 (spill logic lines 85-162): a background
+thread watches heap pressure and swaps the least-recently-used Values to
+disk ("ice"); DKV.get transparently reloads them.
+
+TPU-land redesign: the scarce resource is device HBM, and the only large
+DKV residents are Frames (models hold comparatively small forests /
+coefficient blocks). The Cleaner ranks frames by last DKV access, spills
+the coldest to ``hex://spill/`` (the node ice dir, io/persist.py) as
+compressed npz, and swaps a `SpilledFrame` stub into the DKV; `DKV.get`
+restores stubs on touch. Pressure is read from the accelerator's own
+`memory_stats()` (bytes_in_use / bytes_limit) when the backend exposes
+it, else from the sum of tracked frame nbytes against a configured
+budget.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import List, Optional
+
+from h2o3_tpu.utils.log import get_logger
+
+log = get_logger("h2o3_tpu.cleaner")
+
+
+class SpilledFrame:
+    """DKV stub for a frame currently living on ice (Value swapped to
+    disk, water/Value.java isPersisted role)."""
+
+    def __init__(self, key: str, uri: str, nrows: int, names: List[str],
+                 nbytes: int):
+        self.key = key
+        self.uri = uri
+        self.nrows = nrows
+        self.names = names
+        self.nbytes = nbytes
+
+    def restore(self):
+        from h2o3_tpu.io.persist import load_frame
+        fr = load_frame(self.uri, key=self.key)
+        log.info("restored %s from %s", self.key, self.uri)
+        return fr
+
+    def __repr__(self):
+        return f"<SpilledFrame {self.key} @ {self.uri}>"
+
+
+def _frame_nbytes(fr) -> int:
+    total = 0
+    for n in fr.names:
+        c = fr.col(n)
+        if c.data is not None:
+            total += c.data.nbytes + (c.na_mask.nbytes
+                                      if c.na_mask is not None else 0)
+    return total
+
+
+def device_memory_stats() -> Optional[dict]:
+    """bytes_in_use / bytes_limit of device 0, when the backend reports
+    them (TPU runtimes do; CPU returns None)."""
+    import jax
+    try:
+        stats = jax.local_devices()[0].memory_stats()
+    except Exception:
+        return None
+    if not stats or "bytes_in_use" not in stats:
+        return None
+    return {"bytes_in_use": int(stats["bytes_in_use"]),
+            "bytes_limit": int(stats.get("bytes_limit", 0))}
+
+
+class Cleaner:
+    """LRU frame spiller (the Cleaner thread, water/Cleaner.java)."""
+
+    def __init__(self, threshold: float = 0.85,
+                 ice_prefix: str = "hex://spill"):
+        self.threshold = threshold
+        self.ice_prefix = ice_prefix
+        self._thread: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+        self.spilled_count = 0
+        self.restored_count = 0
+
+    # -- policy --------------------------------------------------------
+    def pressure(self) -> float:
+        """Fraction of HBM in use (0 when the backend can't say)."""
+        stats = device_memory_stats()
+        if not stats or not stats.get("bytes_limit"):
+            return 0.0
+        return stats["bytes_in_use"] / stats["bytes_limit"]
+
+    def _lru_frames(self):
+        """(atime, key, frame) for every in-memory DKV frame, coldest
+        first."""
+        from h2o3_tpu.core.kv import DKV
+        from h2o3_tpu.frame.frame import Frame
+        out = []
+        for key in list(DKV.keys()):
+            v = DKV.get_raw(key)
+            if isinstance(v, Frame):
+                out.append((DKV.atime(key), key, v))
+        out.sort(key=lambda t: t[0])
+        return out
+
+    # -- mechanics -----------------------------------------------------
+    def spill(self, key: str) -> Optional[SpilledFrame]:
+        """Swap one frame to ice and stub it in the DKV.
+
+        Returns None if the key changed or vanished while the frame was
+        being written to ice (the stub must never clobber a newer put —
+        compare-and-swap like the reference's home-node arbitration)."""
+        from h2o3_tpu.core.kv import DKV
+        from h2o3_tpu.io.persist import persist_manager, save_frame
+        fr = DKV.get_raw(key)
+        if isinstance(fr, SpilledFrame) or fr is None:
+            return fr
+        uri = f"{self.ice_prefix}/{key}.npz"
+        save_frame(fr, uri)
+        stub = SpilledFrame(key, uri, fr.nrows, list(fr.names),
+                            _frame_nbytes(fr))
+        if not DKV.replace_if(key, fr, stub):
+            # concurrent put/remove won — discard the stale spill file
+            try:
+                persist_manager.delete(uri)
+            except Exception:
+                pass
+            return None
+        self.spilled_count += 1
+        log.info("spilled %s (%.1f MB) to %s", key,
+                 stub.nbytes / 1e6, uri)
+        return stub
+
+    def spill_coldest(self, n: int = 1, exclude: Optional[set] = None
+                      ) -> List[str]:
+        """Spill the n least-recently-used frames; returns spilled keys."""
+        exclude = exclude or set()
+        done: List[str] = []
+        for _, key, _fr in self._lru_frames():
+            if key in exclude:
+                continue
+            if self.spill(key) is not None:
+                done.append(key)
+            if len(done) >= n:
+                break
+        return done
+
+    def step(self) -> List[str]:
+        """One pressure check: spill coldest frames while above the
+        threshold (Cleaner.java main loop body)."""
+        spilled: List[str] = []
+        while self.pressure() > self.threshold:
+            batch = self.spill_coldest(1, exclude=set(spilled))
+            if not batch:
+                break
+            spilled += batch
+        return spilled
+
+    # -- thread --------------------------------------------------------
+    def start(self, interval: float = 5.0) -> None:
+        if self._thread is not None:
+            return
+        self._stop.clear()
+
+        def loop():
+            while not self._stop.wait(interval):
+                try:
+                    self.step()
+                except Exception as e:      # never kill the process
+                    log.warning("cleaner step failed: %s", e)
+
+        self._thread = threading.Thread(target=loop, name="Cleaner",
+                                        daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2)
+            self._thread = None
+
+    def status(self) -> dict:
+        stats = device_memory_stats() or {}
+        return {"pressure": self.pressure(),
+                "threshold": self.threshold,
+                "spilled": self.spilled_count,
+                "restored": self.restored_count,
+                **stats}
+
+
+cleaner = Cleaner()

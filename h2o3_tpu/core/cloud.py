@@ -60,6 +60,15 @@ def init(backend: Optional[str] = None,
     _STARTED = True
     info = cluster_info()
     log.info("cloud up: %s", info)
+    # Cleaner thread (water/Cleaner.java): opt-in — spilling mid-test
+    # would make timings nondeterministic, so default off like the
+    # reference's -cleaner flag family
+    import os
+    if os.environ.get("H2O3_TPU_SPILL") == "1":
+        from h2o3_tpu.core.cleaner import cleaner
+        cleaner.start()
+        log.info("cleaner started (threshold %.0f%%)",
+                 cleaner.threshold * 100)
     return info
 
 

@@ -31,20 +31,60 @@ def make_key(prefix: str) -> str:
 class _DKV:
     def __init__(self) -> None:
         self._store: Dict[str, Any] = {}
+        self._atime: Dict[str, float] = {}
         self._lock = threading.RLock()
 
     def put(self, key: str, value: Any) -> str:
+        import time
         with self._lock:
             self._store[key] = value
+            self._atime[key] = time.monotonic()
         return key
 
     def get(self, key: str) -> Optional[Any]:
+        import time
+        with self._lock:
+            v = self._store.get(key)
+            if v is not None:
+                self._atime[key] = time.monotonic()
+        # transparent un-spill (Value swap-in, water/Value.java role);
+        # outside the lock: restore does file IO + device_put
+        from h2o3_tpu.core.cleaner import SpilledFrame, cleaner
+        while isinstance(v, SpilledFrame):
+            fr = v.restore()
+            cleaner.restored_count += 1
+            with self._lock:
+                # CAS: another thread may have restored or re-spilled it
+                cur = self._store.get(key)
+                if cur is v:
+                    self._store[key] = fr
+                    return fr
+                v = cur     # retry until we hold a live value
+        return v
+
+    def get_raw(self, key: str) -> Optional[Any]:
+        """Fetch without un-spilling or touching the access clock
+        (Cleaner internals only)."""
         with self._lock:
             return self._store.get(key)
+
+    def replace_if(self, key: str, expect: Any, value: Any) -> bool:
+        """Compare-and-swap: store value only if the key still holds
+        ``expect`` (water/Atomic home-node CAS role)."""
+        with self._lock:
+            if self._store.get(key) is not expect:
+                return False
+            self._store[key] = value
+            return True
+
+    def atime(self, key: str) -> float:
+        with self._lock:
+            return self._atime.get(key, 0.0)
 
     def remove(self, key: str) -> None:
         with self._lock:
             self._store.pop(key, None)
+            self._atime.pop(key, None)
 
     def keys(self, prefix: str = "") -> Iterator[str]:
         with self._lock:
@@ -54,6 +94,7 @@ class _DKV:
         """Test helper — analogue of water/runner/CleanAllKeysTask.java."""
         with self._lock:
             self._store.clear()
+            self._atime.clear()
 
     def __len__(self) -> int:
         with self._lock:

@@ -115,7 +115,11 @@ def bin_frame(frame: Frame, features: Sequence[str], nbins: int = 64,
     is_cat = np.array([c.is_categorical for c in cols], dtype=bool)
     domains = [c.domain for c in cols]
 
-    # per-feature edges / cardinalities (host, once)
+    # per-feature edges / cardinalities (host, once); batch the
+    # device→host fetches of every numeric column into one round trip
+    if edges_override is None:
+        from h2o3_tpu.frame.column import prefetch_host
+        prefetch_host([c for i, c in enumerate(cols) if not is_cat[i]])
     edge_list: List[np.ndarray] = []
     nb = np.zeros((F,), dtype=np.int32)
     for i, c in enumerate(cols):

@@ -62,13 +62,41 @@ class Column:
         return jnp.where(self.na_mask, jnp.nan, x)
 
     def to_numpy(self) -> np.ndarray:
-        """Host copy, logical rows only, NaN/None for NAs."""
+        """Host copy, logical rows only, NaN/None for NAs.
+
+        Cached: columns are immutable (mutation makes new columns), and
+        on a remote-attached chip every device→host fetch costs a full
+        tunnel round trip (~100 ms) regardless of size — one batched
+        fetch of (data, mask), then reuse.
+        """
         if self.type == T_STR:
             return self.strings[: self.nrows]
-        x = np.asarray(self.data)[: self.nrows].astype(np.float64)
-        m = np.asarray(self.na_mask)[: self.nrows]
-        x[m] = np.nan
-        return x
+        host = getattr(self, "_host_cache", None)
+        if host is None:
+            data, mask = jax.device_get((self.data, self.na_mask))
+            x = data[: self.nrows].astype(np.float64)
+            x[mask[: self.nrows]] = np.nan
+            host = x
+            object.__setattr__(self, "_host_cache", host)
+        return host.copy()   # callers may mutate their view
+
+
+def prefetch_host(cols: List["Column"]) -> None:
+    """Fill the host caches of many columns with ONE device→host fetch.
+
+    N sequential to_numpy calls cost N tunnel round trips (~100 ms each
+    on a remote-attached chip); jax.device_get on the whole pytree
+    batches them into one transfer.
+    """
+    todo = [c for c in cols
+            if c.type != T_STR and getattr(c, "_host_cache", None) is None]
+    if not todo:
+        return
+    fetched = jax.device_get([(c.data, c.na_mask) for c in todo])
+    for c, (data, mask) in zip(todo, fetched):
+        x = data[: c.nrows].astype(np.float64)
+        x[mask[: c.nrows]] = np.nan
+        object.__setattr__(c, "_host_cache", x)
 
 
 def column_from_numpy(name: str, values: np.ndarray, nrows_padded: int,

@@ -198,7 +198,8 @@ def parse_xlsx(path: str, key: Optional[str] = None) -> Frame:
             if val is not None:
                 row[j] = val
                 ncols = max(ncols, j + 1)
-        rows.append(row)
+        if row:   # skip styled-but-empty rows (cells with no <v>)
+            rows.append(row)
     if not rows or ncols == 0:
         raise ValueError(f"{path}: empty worksheet")
 

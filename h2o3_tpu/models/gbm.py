@@ -191,7 +191,10 @@ class GBMModel(Model):
         """TreeSHAP contributions (h2o-py predict_contributions): feature
         columns + BiasTerm, summing to the raw link-space margin."""
         from h2o3_tpu.ml.shap import contributions_frame
-        # contributions_frame rejects multinomial, so f0 is always scalar
+        if self.output["category"] == ModelCategory.MULTINOMIAL:
+            raise ValueError("predict_contributions supports only "
+                             "regression and binomial models "
+                             "(got Multinomial)")
         return contributions_frame(self, frame, bias_offset=float(self.f0))
 
     def model_performance(self, frame: Frame):
@@ -507,7 +510,7 @@ class GBMEstimator(ModelBuilder):
                 # per-tree host round trip (dominant on a remote chip)
                 # amortizes over CHUNK trees, while the inter-chunk
                 # job.update keeps progress reporting + cancellation live
-                CHUNK = 10
+                CHUNK = 25
                 chunks = []
                 done = 0
                 while done < ntrees:

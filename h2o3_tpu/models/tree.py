@@ -189,10 +189,30 @@ def grow_tree(bins, nb, w, g, h, col_mask, *, params: TreeParams, mesh,
     allowed = jnp.ones((1, F), bool)   # per-node feature set (interactions)
     pair_allow = None                  # lazy [F, F] compatibility matrix
 
+    prev_hist = None
     for d in range(D):
         L = 2 ** d
-        hist = histogram(bins, nid, w, g, h, n_nodes=L, n_bins=B,
-                         mesh=mesh, block_rows=params.block_rows)
+        if prev_hist is None:
+            hist = histogram(bins, nid, w, g, h, n_nodes=L, n_bins=B,
+                             mesh=mesh, block_rows=params.block_rows)
+        else:
+            # sibling subtraction: histogram only the LEFT children (even
+            # node slots), derive right = parent − left. Halves the
+            # histogram matmul at every level ≥ 1 (the LightGBM/XGBoost
+            # smaller-child trick, made static-shape by always picking
+            # left; the reference recomputes both children,
+            # hex/tree/ScoreBuildHistogram2.java).
+            even = (nid % 2 == 0).astype(jnp.float32)
+            lh = histogram(bins, nid >> 1, w * even, g, h, n_nodes=L // 2,
+                           n_bins=B, mesh=mesh, block_rows=params.block_rows)
+            rh = prev_hist - lh
+            # f32 cancellation guard: w and h are nonnegative sums, so
+            # clamp tiny negative residue (|err| ≲ parent·2^-23); g may
+            # be legitimately negative and stays as computed
+            rh = rh.at[..., 0].set(jnp.maximum(rh[..., 0], 0.0))
+            rh = rh.at[..., 2].set(jnp.maximum(rh[..., 2], 0.0))
+            hist = jnp.stack([lh, rh], axis=1).reshape(L, *lh.shape[1:])
+        prev_hist = hist
         cm = col_mask
         if mtries > 0 and mtries < F:
             key, sub = jax.random.split(key)
