@@ -463,6 +463,14 @@ def _leaderboard(params, body, project=None):
             "leaderboard_table": aml.leaderboard.as_table()}
 
 
+@route("GET", r"/flow(/index\.html)?/?")
+def _flow(params, body, **_):
+    """The Flow notebook UI (h2o-web role) — served from the node at
+    /flow/index.html like the reference."""
+    from h2o3_tpu.api.flow import FLOW_HTML
+    return {"__html__": FLOW_HTML}
+
+
 @route("GET", "/")
 def _index(params, body):
     """Minimal landing page (the h2o-web Flow-serving role: the node
@@ -477,6 +485,7 @@ def _index(params, body):
 healthy: {info["cloud_healthy"]}</p>
 <p>{frames} frame(s), {models} model(s),
 {len(all_algos())} algorithms registered</p>
+<p><a href="/flow/index.html"><b>Open Flow (notebook UI)</b></a></p>
 <p>REST: <a href="/3/Cloud">/3/Cloud</a> ·
 <a href="/3/Frames">/3/Frames</a> ·
 <a href="/3/Models">/3/Models</a> ·

@@ -44,6 +44,15 @@ class SpilledFrame:
         log.info("restored %s from %s", self.key, self.uri)
         return fr
 
+    def discard(self) -> None:
+        """Best-effort removal of the ice file (restore won / key
+        removed) so spills don't accumulate on disk."""
+        from h2o3_tpu.io.persist import persist_manager
+        try:
+            persist_manager.delete(self.uri)
+        except Exception:
+            pass
+
     def __repr__(self):
         return f"<SpilledFrame {self.key} @ {self.uri}>"
 
@@ -117,7 +126,10 @@ class Cleaner:
         fr = DKV.get_raw(key)
         if isinstance(fr, SpilledFrame) or fr is None:
             return fr
-        uri = f"{self.ice_prefix}/{key}.npz"
+        from urllib.parse import quote
+        # keys come from user-supplied destination_frame strings: encode
+        # so '..'/'/' cannot escape the ice directory
+        uri = f"{self.ice_prefix}/{quote(key, safe='')}.npz"
         save_frame(fr, uri)
         stub = SpilledFrame(key, uri, fr.nrows, list(fr.names),
                             _frame_nbytes(fr))
@@ -149,13 +161,16 @@ class Cleaner:
 
     def step(self) -> List[str]:
         """One pressure check: spill coldest frames while above the
-        threshold (Cleaner.java main loop body)."""
+        threshold (Cleaner.java main loop body). One LRU scan per step —
+        stubs drop out of _lru_frames on the next scan anyway."""
         spilled: List[str] = []
-        while self.pressure() > self.threshold:
-            batch = self.spill_coldest(1, exclude=set(spilled))
-            if not batch:
+        if self.pressure() <= self.threshold:
+            return spilled
+        for _, key, _fr in self._lru_frames():
+            if self.spill(key) is not None:
+                spilled.append(key)
+            if self.pressure() <= self.threshold:
                 break
-            spilled += batch
         return spilled
 
     # -- thread --------------------------------------------------------

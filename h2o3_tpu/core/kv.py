@@ -58,8 +58,10 @@ class _DKV:
                 cur = self._store.get(key)
                 if cur is v:
                     self._store[key] = fr
-                    return fr
-                v = cur     # retry until we hold a live value
+            if cur is v:
+                v.discard()     # reclaim the ice file
+                return fr
+            v = cur             # retry until we hold a live value
         return v
 
     def get_raw(self, key: str) -> Optional[Any]:
@@ -83,8 +85,10 @@ class _DKV:
 
     def remove(self, key: str) -> None:
         with self._lock:
-            self._store.pop(key, None)
+            v = self._store.pop(key, None)
             self._atime.pop(key, None)
+        if v is not None and type(v).__name__ == "SpilledFrame":
+            v.discard()     # drop the orphaned ice file with the key
 
     def keys(self, prefix: str = "") -> Iterator[str]:
         with self._lock:

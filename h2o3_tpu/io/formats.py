@@ -198,8 +198,12 @@ def parse_xlsx(path: str, key: Optional[str] = None) -> Frame:
             if val is not None:
                 row[j] = val
                 ncols = max(ncols, j + 1)
-        if row:   # skip styled-but-empty rows (cells with no <v>)
-            rows.append(row)
+        rows.append(row)
+    # trim TRAILING styled-but-empty rows only (Excel writers emit them
+    # below the data); interior blank rows stay as all-NA rows, matching
+    # pandas.read_excel row alignment
+    while rows and not rows[-1]:
+        rows.pop()
     if not rows or ncols == 0:
         raise ValueError(f"{path}: empty worksheet")
 

@@ -144,44 +144,60 @@ class _ArrowFsDriver(PersistDriver):
         self.scheme = scheme
         self._fs = None
 
-    def _filesystem(self):
+    def _filesystem(self, uri: str = ""):
+        if self.scheme == "hdfs":
+            # per-authority connections: hdfs://namenode:8020/user/x must
+            # connect to that namenode, not a global default
+            from urllib.parse import urlsplit
+
+            from pyarrow import fs as pafs
+            auth = urlsplit(uri).netloc or "default"
+            if self._fs is None:
+                self._fs = {}
+            if auth not in self._fs:
+                self._fs[auth] = pafs.HadoopFileSystem.from_uri(
+                    f"hdfs://{auth}")
+            return self._fs[auth]
         if self._fs is None:
             from pyarrow import fs as pafs
             if self.scheme == "s3":
                 self._fs = pafs.S3FileSystem()
             elif self.scheme in ("gs", "gcs"):
                 self._fs = pafs.GcsFileSystem()
-            elif self.scheme == "hdfs":
-                self._fs = pafs.HadoopFileSystem.from_uri("hdfs://default")
             else:
                 raise IOError(f"unknown arrow fs scheme {self.scheme}")
         return self._fs
 
     def _path(self, uri: str) -> str:
-        return uri.split("://", 1)[1]
+        rest = uri.split("://", 1)[1]
+        if self.scheme == "hdfs":
+            # drop the authority: the path starts at the first '/'
+            slash = rest.find("/")
+            return rest[slash:] if slash >= 0 else "/"
+        return rest     # s3/gs: bucket is the path prefix
 
     def read(self, uri: str) -> bytes:
-        with self._filesystem().open_input_stream(self._path(uri)) as f:
+        with self._filesystem(uri).open_input_stream(self._path(uri)) as f:
             return f.read()
 
     def write(self, uri: str, data: bytes) -> None:
-        with self._filesystem().open_output_stream(self._path(uri)) as f:
+        with self._filesystem(uri).open_output_stream(self._path(uri)) as f:
             f.write(data)
 
     def exists(self, uri: str) -> bool:
         from pyarrow import fs as pafs
-        info = self._filesystem().get_file_info(self._path(uri))
+        info = self._filesystem(uri).get_file_info(self._path(uri))
         return info.type != pafs.FileType.NotFound
 
     def delete(self, uri: str) -> None:
-        self._filesystem().delete_file(self._path(uri))
+        self._filesystem(uri).delete_file(self._path(uri))
 
     def list(self, uri: str) -> List[str]:
         from pyarrow import fs as pafs
         sel = pafs.FileSelector(self._path(uri), recursive=False,
                                 allow_not_found=True)
         return [f"{self.scheme}://{i.path}"
-                for i in self._filesystem().get_file_info(sel)]
+                for i in self._filesystem(uri).get_file_info(sel)]
 
 
 class PersistManager:

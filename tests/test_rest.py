@@ -169,3 +169,17 @@ def test_jobs_listing_and_delete(port):
     assert st == 200
     st, j = _req(port, "GET", "/3/Frames/rapids_rest")
     assert st == 404
+
+
+def test_flow_ui_served(port):
+    """/flow/index.html serves the notebook app; landing page links it
+    (h2o-web Flow-serving role)."""
+    import urllib.request
+    base = f"http://127.0.0.1:{port}"
+    html = urllib.request.urlopen(base + "/flow/index.html",
+                                  timeout=30).read().decode()
+    assert "runCell" in html and "importFiles" in html
+    html2 = urllib.request.urlopen(base + "/flow", timeout=30).read().decode()
+    assert "runCell" in html2
+    root = urllib.request.urlopen(base + "/", timeout=30).read().decode()
+    assert "/flow/index.html" in root
