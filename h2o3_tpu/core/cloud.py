@@ -30,8 +30,8 @@ _STARTED = False
 
 
 def init(backend: Optional[str] = None,
-         data_axis: int = 0,
-         model_axis: int = 1,
+         data_axis: Optional[int] = None,
+         model_axis: Optional[int] = None,
          coordinator_address: Optional[str] = None,
          num_processes: Optional[int] = None,
          process_id: Optional[int] = None,
@@ -45,6 +45,13 @@ def init(backend: Optional[str] = None,
     water/init/NetworkInit.java:62-174).
     """
     global _STARTED
+    if (_STARTED and backend is None and coordinator_address is None
+            and data_axis is None and model_axis is None):
+        # cloud already formed and no explicit backend/mesh re-shape
+        # requested: attach, don't reform (h2o.init attaches to a
+        # running cluster; silently re-detecting devices here could
+        # swap the session's mesh to a different backend mid-flight)
+        return cluster_info()
     cfg = _config.Config.from_env(backend=backend, data_axis=data_axis,
                                   model_axis=model_axis, **kwargs)
     _config.ARGS = cfg

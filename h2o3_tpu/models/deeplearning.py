@@ -86,6 +86,14 @@ def _forward(params, X, act: str, *, key=None, input_dropout=0.0,
     return h
 
 
+@partial(jax.jit, static_argnames=("act",))
+def _forward_scoring(params, X, act: str):
+    """Jitted inference forward — scoring paths must never run the
+    layer loop eagerly (per-op dispatch through a remote-chip tunnel is
+    100x the fused program cost)."""
+    return _forward(params, X, act)
+
+
 def _loss(params, X, y, w, key, *, act, category, input_dropout,
           hidden_dropout, l1, l2, nclasses):
     out = _forward(params, X, act, key=key, input_dropout=input_dropout,
@@ -210,7 +218,7 @@ class DeepLearningModel(Model):
 
     def _raw_out(self, frame: Frame):
         di = self._design(frame)
-        return _forward(self.net, di.X, self.act)
+        return _forward_scoring(self.net, di.X, self.act)
 
     def _score_raw(self, frame: Frame) -> Dict[str, np.ndarray]:
         out = self._raw_out(frame)
@@ -246,7 +254,7 @@ class DeepLearningModel(Model):
         cat = self.output["category"]
         if self.params.get("autoencoder"):
             di = self._design(frame)
-            out = _forward(self.net, di.X, self.act)
+            out = _forward_scoring(self.net, di.X, self.act)
             mse = float(jnp.sum(w * jnp.mean((out - di.X) ** 2, axis=1))
                         / jnp.maximum(jnp.sum(w), 1e-12))
             return mm.ModelMetrics("AutoEncoder", int(jnp.sum(w)), mse)

@@ -77,3 +77,69 @@ def gram(X, w, z, *, mesh, block_rows: int = 8192):
                 jax.lax.psum(ws, DATA_AXIS))
 
     return _task(X, wz)
+
+
+def gram_model_sharded(X, w, z, *, mesh, block_rows: int = 8192):
+    """Model-axis-sharded Gram: X columns sharded over 'model', rows over
+    'data'; the X'X cross-block products stream around the model axis as
+    a ppermute ring (the collective-matmul recipe — each device holds one
+    column block, receives its neighbours' blocks one hop at a time, and
+    never materializes the full-width matrix).
+
+    This is the TP-like axis SURVEY §2.4 item 6 reserves for wide one-hot
+    GLM feature spaces (the reference's sharded-Gram analogue of
+    hex/gram/Gram.java over very wide DataInfo expansions).
+
+    Returns (XtWX [P, P] sharded over columns, XtWz [P], wsum) — all
+    psum-reduced over 'data'.
+    """
+    from h2o3_tpu.parallel.mesh import MODEL_AXIS
+    nmodel = mesh.shape[MODEL_AXIS]
+    ndata = mesh.shape[DATA_AXIS]
+    N, Pdim = X.shape
+    P0 = Pdim
+    if nmodel == 1:
+        return gram(X, w, z, mesh=mesh, block_rows=block_rows)
+    if Pdim % nmodel != 0:
+        padc = nmodel - Pdim % nmodel
+        X = jnp.pad(X, ((0, 0), (0, padc)))
+        Pdim += padc
+    wz = jnp.stack([w, w * z], axis=1)
+    if N % ndata != 0:
+        pad = ndata - N % ndata
+        X = jnp.pad(X, ((0, pad), (0, 0)))
+        wz = jnp.pad(wz, ((0, pad), (0, 0)))
+    Pm = Pdim // nmodel
+
+    @functools.partial(
+        shard_map, mesh=mesh,
+        in_specs=(P(DATA_AXIS, MODEL_AXIS), P(DATA_AXIS)),
+        out_specs=(P(None, MODEL_AXIS), P(MODEL_AXIS), P()),
+        check_vma=False)
+    def _task(X_l, wz_l):
+        # X_l: [N/d, Pm] — this rank's column block; ring-stream the
+        # other ranks' blocks to fill the [P, Pm] column slab of X'WX
+        my = jax.lax.axis_index(MODEL_AXIS)
+        wX = X_l * wz_l[:, 0:1]
+        out = jnp.zeros((Pdim, Pm), jnp.float32)
+        Y = X_l
+        src = my
+        perm = [(i, (i - 1) % nmodel) for i in range(nmodel)]
+        for _hop in range(nmodel):
+            # block (src, my) of the Gram: Y holds rank `src`'s columns
+            blk = jax.lax.dot_general(
+                Y.T, wX, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)      # [Pm, Pm]
+            out = jax.lax.dynamic_update_slice(out, blk, (src * Pm, 0))
+            Y = jax.lax.ppermute(Y, MODEL_AXIS, perm)
+            src = (src + 1) % nmodel
+        xtz = X_l.T @ wz_l[:, 1]
+        ws = jnp.sum(wz_l[:, 0])
+        return (jax.lax.psum(out, DATA_AXIS),
+                jax.lax.psum(xtz, DATA_AXIS),
+                jax.lax.psum(ws, (DATA_AXIS, MODEL_AXIS)) / nmodel)
+
+    xtx, xtz, ws = _task(X, wz)
+    # drop the nmodel-alignment padding: callers solve [P0, P0] normal
+    # equations and a zero row/col would make them singular
+    return xtx[:P0, :P0], xtz[:P0], ws

@@ -97,6 +97,8 @@ def parse(expr: str):
     return _Parser(expr).parse()
 
 
+
+
 # ---------------------------------------------------------------- session
 
 
@@ -1318,3 +1320,445 @@ def rapids(expr: str, session: Optional[Session] = None):
     """Parse + evaluate one Rapids expression (POST /99/Rapids)."""
     session = session or _default_session()
     return Env(session).ev(parse(expr))
+
+
+# ------------------------------------------------------- extended prims
+# (matrix, advmath, repeaters, filters, reshape — the remaining
+# water/rapids/ast/prims families; wire names match the reference)
+
+def _as_pylist(env, node):
+    """('list', [...]) AST → python values; scalar → [scalar]."""
+    if isinstance(node, tuple) and node[0] == "list":
+        return [x[1] if isinstance(x, tuple) else x for x in node[1]]
+    v = env.ev(node)
+    return None if v is None else [v]
+
+
+def _num_matrix(f: Frame) -> np.ndarray:
+    return np.stack([_col_np(f, n) for n in f.names], axis=1)
+
+
+@prim("t")
+def _transpose(env, fr):
+    """matrix/AstTranspose."""
+    f = _as_frame(env.ev(fr))
+    M = _num_matrix(f).T
+    return Frame.from_numpy({f"C{i + 1}": M[:, i] for i in range(M.shape[1])})
+
+
+@prim("x")
+def _mmult(env, l, r):
+    """matrix/AstMMult: frame-as-matrix product."""
+    A = _num_matrix(_as_frame(env.ev(l)))
+    B = _num_matrix(_as_frame(env.ev(r)))
+    M = A @ B
+    return Frame.from_numpy({f"C{i + 1}": M[:, i] for i in range(M.shape[1])})
+
+
+@prim("hist")
+def _hist(env, fr, breaks=("str", "sturges")):
+    """advmath/AstHist: breaks/counts/mids frame (h2o-py frame.hist)."""
+    f = _as_frame(env.ev(fr))
+    v = _col_np(f, f.names[0])
+    v = v[~np.isnan(v)]
+    b = breaks[1] if isinstance(breaks, tuple) and breaks[0] in ("num", "str") \
+        else breaks
+    lst = _as_pylist(env, breaks) if isinstance(breaks, tuple) and \
+        breaks[0] == "list" else None
+    if lst is not None:
+        edges = np.asarray(lst, np.float64)
+    elif isinstance(b, (int, float)) and not isinstance(b, bool):
+        edges = np.linspace(v.min(), v.max(), int(b) + 1) if v.size else \
+            np.array([0.0, 1.0])
+    else:   # sturges / rice / sqrt / doane / scott / fd
+        rule = str(b).lower()
+        n = max(v.size, 1)
+        if rule == "rice":
+            k = int(np.ceil(2 * n ** (1 / 3)))
+        elif rule == "sqrt":
+            k = int(np.ceil(np.sqrt(n)))
+        else:   # sturges default
+            k = int(np.ceil(np.log2(n))) + 1
+        edges = np.linspace(v.min(), v.max(), max(k, 1) + 1) if v.size else \
+            np.array([0.0, 1.0])
+    counts, edges = np.histogram(v, bins=edges)
+    widths = np.diff(edges)
+    dens = counts / np.maximum(widths * max(v.size, 1), 1e-300)
+    mids = 0.5 * (edges[:-1] + edges[1:])
+    pad = lambda a: np.concatenate([[np.nan], a])
+    return Frame.from_numpy({
+        "breaks": edges.astype(np.float64),
+        "counts": pad(counts.astype(np.float64)),
+        "mids_true": pad(mids), "mids": pad(mids),
+        "density": pad(dens)})
+
+
+@prim("cut")
+def _cut(env, fr, breaks, labels=None, include_lowest=("num", 0),
+         right=("num", 1), dig_lab=("num", 3)):
+    """mungers/AstCut: numeric → categorical by bin edges."""
+    f = _as_frame(env.ev(fr))
+    edges = np.asarray(_as_pylist(env, breaks), np.float64)
+    labs = _as_pylist(env, labels) if labels is not None else None
+    inc_low = bool(env.ev(include_lowest))
+    rgt = bool(env.ev(right))
+    dig = int(env.ev(dig_lab))
+    v = _col_np(f, f.names[0])
+    if labs:
+        dom = [str(x) for x in labs]
+    elif rgt:
+        dom = [f"({round(edges[i], dig)}, {round(edges[i + 1], dig)}]"
+               for i in range(len(edges) - 1)]
+    else:
+        dom = [f"[{round(edges[i], dig)}, {round(edges[i + 1], dig)})"
+               for i in range(len(edges) - 1)]
+    if rgt:
+        codes = np.searchsorted(edges, v, side="left") - 1
+        if inc_low:
+            codes[v == edges[0]] = 0
+    else:
+        codes = np.searchsorted(edges, v, side="right") - 1
+    codes = codes.astype(np.int32)
+    bad = np.isnan(v) | (codes < 0) | (codes >= len(dom))
+    codes[bad] = -1
+    return Frame.from_numpy({f.names[0]: codes}, categorical=[f.names[0]],
+                            domains={f.names[0]: dom})
+
+
+@prim("h2o.fillna", "fillna")
+def _fillna(env, fr, method=("str", "forward"), axis=("num", 0),
+            maxlen=("num", 1)):
+    """mungers/AstFillNA: directional NA fill with a run cap."""
+    f = _as_frame(env.ev(fr))
+    meth = str(env.ev(method)).lower()
+    ax = int(env.ev(axis))
+    cap = int(env.ev(maxlen))
+    out, cats, doms = {}, [], {}
+    strs = {}
+    if ax == 0:     # along rows, per column
+        for n in f.names:
+            c = f.col(n)
+            if c.type == "string":
+                strs[n] = c.to_numpy()      # strings pass through
+                continue
+            v = (_cat_codes(f, n).astype(np.float64) if c.is_categorical
+                 else _col_np(f, n).copy())
+            if c.is_categorical:
+                v[v < 0] = np.nan
+            rng = range(len(v)) if meth == "forward" else \
+                range(len(v) - 1, -1, -1)
+            last, run = np.nan, 0
+            for i in rng:
+                if np.isnan(v[i]):
+                    if not np.isnan(last) and run < cap:
+                        v[i] = last
+                        run += 1
+                else:
+                    last, run = v[i], 0
+            if c.is_categorical:
+                codes = np.where(np.isnan(v), -1, v).astype(np.int32)
+                out[n] = codes
+                cats.append(n)
+                doms[n] = c.domain
+            else:
+                out[n] = v
+        out.update(strs)
+    else:           # along columns, per row (numeric columns only)
+        num_names = [n for n in f.names if f.col(n).type != "string"
+                     and not f.col(n).is_categorical]
+        strs = {n: f.col(n).to_numpy() for n in f.names
+                if f.col(n).type == "string"}
+        M = np.stack([_col_np(f, n) for n in num_names], axis=1)
+        cols_rng = range(M.shape[1]) if meth == "forward" else \
+            range(M.shape[1] - 1, -1, -1)
+        for r_ in range(M.shape[0]):
+            last, run = np.nan, 0
+            for j in cols_rng:
+                if np.isnan(M[r_, j]):
+                    if not np.isnan(last) and run < cap:
+                        M[r_, j] = last
+                        run += 1
+                else:
+                    last, run = M[r_, j], 0
+        for j, n in enumerate(num_names):
+            out[n] = M[:, j]
+        # categoricals and strings cross rows untouched in axis=1 mode
+        for n in f.names:
+            c = f.col(n)
+            if c.is_categorical:
+                out[n] = _cat_codes(f, n)
+                cats.append(n)
+                doms[n] = c.domain
+        out.update(strs)
+    return Frame.from_numpy(out, categorical=cats, domains=doms,
+                            strings=list(strs))
+
+
+@prim("kfold_column")
+def _kfold_column(env, fr, nfolds, seed=("num", -1)):
+    """advmath/AstKFold: uniform random fold ids."""
+    f = _as_frame(env.ev(fr))
+    k = int(env.ev(nfolds))
+    s = int(env.ev(seed))
+    r = np.random.RandomState(s if s >= 0 else 0xF01D)
+    return Frame.from_numpy(
+        {"fold": r.randint(0, k, f.nrows).astype(np.float64)})
+
+
+@prim("modulo_kfold_column")
+def _modulo_kfold(env, fr, nfolds):
+    f = _as_frame(env.ev(fr))
+    k = int(env.ev(nfolds))
+    return Frame.from_numpy(
+        {"fold": (np.arange(f.nrows) % k).astype(np.float64)})
+
+
+@prim("stratified_kfold_column")
+def _strat_kfold(env, fr, nfolds, seed=("num", -1)):
+    """advmath/AstStratifiedKFold: per-class round-robin after shuffle —
+    every fold sees ~the same class distribution."""
+    f = _as_frame(env.ev(fr))
+    k = int(env.ev(nfolds))
+    s = int(env.ev(seed))
+    r = np.random.RandomState(s if s >= 0 else 0x5F01D)
+    y = _cat_codes(f, f.names[0]) if f.col(f.names[0]).is_categorical \
+        else _col_np(f, f.names[0])
+    fold = np.zeros(f.nrows, np.float64)
+    for cls in np.unique(y[~np.isnan(np.asarray(y, np.float64))]):
+        idx = np.where(y == cls)[0]
+        r.shuffle(idx)
+        fold[idx] = np.arange(len(idx)) % k
+    return Frame.from_numpy({"fold": fold})
+
+
+@prim("h2o.random_stratified_split")
+def _strat_split(env, fr, test_frac=("num", 0.25), seed=("num", -1)):
+    """advmath/AstStratifiedSplit: per-class train/test tagging."""
+    f = _as_frame(env.ev(fr))
+    frac = float(env.ev(test_frac))
+    s = int(env.ev(seed))
+    r = np.random.RandomState(s if s >= 0 else 0x57A7)
+    y = _cat_codes(f, f.names[0]) if f.col(f.names[0]).is_categorical \
+        else _col_np(f, f.names[0])
+    codes = np.zeros(f.nrows, np.int32)
+    for cls in np.unique(y):
+        idx = np.where(y == cls)[0]
+        r.shuffle(idx)
+        ntest = int(round(len(idx) * frac))
+        codes[idx[:ntest]] = 1
+    return Frame.from_numpy({"test_train_split": codes},
+                            categorical=["test_train_split"],
+                            domains={"test_train_split": ["train", "test"]})
+
+
+@prim("seq_len")
+def _seq_len(env, n):
+    """repeaters/AstSeqLen: 1..n."""
+    return Frame.from_numpy(
+        {"C1": np.arange(1, int(env.ev(n)) + 1, dtype=np.float64)})
+
+
+@prim("seq")
+def _seq(env, fro, to, by=("num", 1)):
+    a, b, st = float(env.ev(fro)), float(env.ev(to)), float(env.ev(by))
+    # extend the stop by half a step IN the step direction so the
+    # endpoint is included for both signs (R-style seq)
+    return Frame.from_numpy(
+        {"C1": np.arange(a, b + st / 2, st, dtype=np.float64)})
+
+
+@prim("rep_len")
+def _rep_len(env, x, length):
+    n = int(env.ev(length))
+    v = env.ev(x)
+    if isinstance(v, Frame):
+        a = _col_np(v, v.names[0])
+        return Frame.from_numpy(
+            {"C1": np.resize(a, n).astype(np.float64)})
+    return Frame.from_numpy({"C1": np.full(n, float(v))})
+
+
+@prim("distance")
+def _distance(env, l, r, measure=("str", "l2")):
+    """advmath/AstDistance: pairwise row distances [n_l x n_r]."""
+    A = _num_matrix(_as_frame(env.ev(l)))
+    B = _num_matrix(_as_frame(env.ev(r)))
+    m = str(env.ev(measure)).lower()
+    if m in ("l2", "euclidean"):
+        D = np.sqrt(np.maximum(
+            (A ** 2).sum(1)[:, None] + (B ** 2).sum(1)[None, :]
+            - 2 * A @ B.T, 0.0))
+    elif m == "l1":
+        D = np.abs(A[:, None, :] - B[None, :, :]).sum(axis=2)
+    elif m in ("cosine", "cosine_sq"):
+        na = np.linalg.norm(A, axis=1)
+        nb = np.linalg.norm(B, axis=1)
+        C = (A @ B.T) / np.maximum(na[:, None] * nb[None, :], 1e-300)
+        D = C ** 2 if m == "cosine_sq" else C
+    else:
+        raise ValueError(f"unknown distance measure '{m}'")
+    return Frame.from_numpy({f"C{i + 1}": D[:, i] for i in range(D.shape[1])})
+
+
+@prim("dropdup")
+def _dropdup(env, fr, cols_sel, keep=("str", "first")):
+    """filters/dropduplicates AstDropDuplicatesByColumns."""
+    f = _as_frame(env.ev(fr))
+    names = _resolve_cols(f, cols_sel)
+    kp = str(env.ev(keep)).lower()
+    keyarr = np.stack(
+        [(_cat_codes(f, n).astype(np.float64)
+          if f.col(n).is_categorical else _col_np(f, n)) for n in names],
+        axis=1)
+    seen = {}
+    order = range(f.nrows) if kp == "first" else range(f.nrows - 1, -1, -1)
+    nan_mask = np.isnan(keyarr)
+    key_vals = np.where(nan_mask, 0.0, keyarr)
+    for i in order:
+        # NaN != NaN, so carry the NA pattern separately to make
+        # NA-keyed duplicates compare equal
+        key = (tuple(key_vals[i].tolist()), tuple(nan_mask[i].tolist()))
+        seen.setdefault(key, i)
+    idx = np.array(sorted(seen.values()), dtype=np.int64)
+    return _take_rows(f, idx)
+
+
+@prim("grep")
+def _grep(env, fr, regex, ignore_case=("num", 0), invert=("num", 0),
+          output_logical=("num", 0)):
+    """string/AstGrep: match rows of a string/categorical column."""
+    f = _as_frame(env.ev(fr))
+    pat = str(env.ev(regex))
+    flags = _re.IGNORECASE if env.ev(ignore_case) else 0
+    rx = _re.compile(pat, flags)
+    c = f.col(f.names[0])
+    if c.is_categorical:
+        dom = c.domain or []
+        dom_hit = np.array([bool(rx.search(s)) for s in dom])
+        codes = _cat_codes(f, f.names[0])
+        hit = np.where(codes >= 0, dom_hit[np.maximum(codes, 0)], False)
+    else:
+        hit = np.array([bool(rx.search(str(v))) if v is not None else False
+                        for v in c.to_numpy()])
+    if env.ev(invert):
+        hit = ~hit
+    if env.ev(output_logical):
+        return Frame.from_numpy({"C1": hit.astype(np.float64)})
+    return Frame.from_numpy(
+        {"C1": np.where(hit)[0].astype(np.float64)})
+
+
+def _strip_prim(side):
+    def fn(env, fr, chars=("str", " ")):
+        f = _as_frame(env.ev(fr))
+        cs = str(env.ev(chars))
+        out, cats, doms = {}, [], {}
+        for n in f.names:
+            c = f.col(n)
+            if c.is_categorical:
+                dom = [s.lstrip(cs) if side == "l" else s.rstrip(cs)
+                       for s in (c.domain or [])]
+                # re-intern: stripping may merge levels
+                uniq = sorted(set(dom))
+                remap = np.array([uniq.index(d) for d in dom], np.int32)
+                codes = _cat_codes(f, n)
+                out[n] = np.where(codes >= 0, remap[np.maximum(codes, 0)],
+                                  -1).astype(np.int32)
+                cats.append(n)
+                doms[n] = uniq
+            elif c.type == "string":
+                out[n] = np.array(
+                    [None if v is None else
+                     (v.lstrip(cs) if side == "l" else v.rstrip(cs))
+                     for v in c.to_numpy()], dtype=object)
+            else:
+                out[n] = _col_np(f, n)
+        return Frame.from_numpy(out, categorical=cats, domains=doms)
+    return fn
+
+
+PRIMS["lstrip"] = _strip_prim("l")
+PRIMS["rstrip"] = _strip_prim("r")
+
+
+@prim("melt")
+def _melt(env, fr, id_vars, value_vars=None, var_name=("str", "variable"),
+          value_name=("str", "value"), skipna=("num", 0)):
+    """mungers/AstMelt: wide → long."""
+    f = _as_frame(env.ev(fr))
+    ids = _resolve_cols(f, id_vars)
+    vals = _resolve_cols(f, value_vars) if value_vars is not None and \
+        not (isinstance(value_vars, tuple) and value_vars[1] is None) else \
+        [n for n in f.names if n not in ids]
+    vname = str(env.ev(var_name))
+    vvalue = str(env.ev(value_name))
+    skip = bool(env.ev(skipna))
+    n = f.nrows
+    id_cols = {k: [] for k in ids}
+    var_codes, values = [], []
+    id_data = {k: (_cat_codes(f, k) if f.col(k).is_categorical
+                   else _col_np(f, k)) for k in ids}
+    for vi, vn in enumerate(vals):
+        col = _col_np(f, vn)
+        keep = ~np.isnan(col) if skip else np.ones(n, bool)
+        for k in ids:
+            id_cols[k].append(np.asarray(id_data[k])[keep])
+        var_codes.append(np.full(keep.sum(), vi, np.int32))
+        values.append(col[keep])
+    out, cats, doms = {}, [], {}
+    for k in ids:
+        merged = np.concatenate(id_cols[k])
+        if f.col(k).is_categorical:
+            out[k] = merged.astype(np.int32)
+            cats.append(k)
+            doms[k] = f.col(k).domain
+        else:
+            out[k] = merged.astype(np.float64)
+    out[vname] = np.concatenate(var_codes)
+    cats.append(vname)
+    doms[vname] = list(vals)
+    out[vvalue] = np.concatenate(values)
+    return Frame.from_numpy(out, categorical=cats, domains=doms)
+
+
+@prim("pivot")
+def _pivot(env, fr, index, column, value):
+    """mungers/AstPivot: long → wide (first value per cell)."""
+    f = _as_frame(env.ev(fr))
+    inames = _resolve_cols(f, index)
+    cname = _resolve_cols(f, column)[0]
+    vname = _resolve_cols(f, value)[0]
+    iname = inames[0]
+    icol_cat = f.col(iname).is_categorical
+    ivals = _cat_codes(f, iname) if icol_cat else _col_np(f, iname)
+    cc = f.col(cname)
+    if cc.is_categorical:
+        levels = list(cc.domain or [])
+        ccode = _cat_codes(f, cname)
+    else:
+        raw = _col_np(f, cname)
+        lv = np.unique(raw[~np.isnan(raw)])
+        levels = [str(x) for x in lv]
+        ccode = np.searchsorted(lv, raw)
+    vvals = _col_np(f, vname)
+    uniq = np.unique(np.asarray(ivals, np.float64))
+    uniq = uniq[~np.isnan(uniq)]
+    pos = {u: i for i, u in enumerate(uniq)}
+    M = np.full((len(uniq), len(levels)), np.nan)
+    for i in range(f.nrows):
+        iv = float(ivals[i])
+        if np.isnan(iv) or ccode[i] < 0 or ccode[i] >= len(levels):
+            continue
+        r_ = pos[iv]
+        if np.isnan(M[r_, ccode[i]]):
+            M[r_, ccode[i]] = vvals[i]
+    out, cats, doms = {}, [], {}
+    if icol_cat:
+        out[iname] = uniq.astype(np.int32)
+        cats.append(iname)
+        doms[iname] = f.col(iname).domain
+    else:
+        out[iname] = uniq
+    for j, lev in enumerate(levels):
+        out[str(lev)] = M[:, j]
+    return Frame.from_numpy(out, categorical=cats, domains=doms)

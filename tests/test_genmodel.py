@@ -330,3 +330,27 @@ def test_pojo_glm(tmp_path):
     for i in range(0, 300, 29):
         assert abs(mod.score0({k: raw[k][i] for k in raw})["predict"]
                    - want[i]) < 1e-4
+
+
+def test_pojo_deeplearning_and_kmeans(tmp_path):
+    from h2o3_tpu.models.deeplearning import DeepLearningEstimator
+    from h2o3_tpu.models.kmeans import KMeansEstimator
+    r = np.random.RandomState(9)
+    fr = h2o3_tpu.Frame.from_numpy({
+        "a": r.randn(400), "b": r.randn(400),
+        "y": np.where(r.randn(400) > 0, "u", "v")}, categorical=["y"])
+    dl = DeepLearningEstimator(hidden=[8, 8], epochs=3.0, seed=2).train(
+        fr, y="y")
+    mod = _load_pojo(dl.download_pojo(str(tmp_path / "dl.py")))
+    raw = _raw_cols(fr, mod.NAMES)
+    want = dl._score_raw(fr)["p1"]
+    for i in range(0, 400, 57):
+        assert abs(mod.score0({k: raw[k][i] for k in raw})["p1"]
+                   - want[i]) < 1e-4
+    km = KMeansEstimator(k=3, seed=2).train(fr, x=["a", "b"])
+    kmod = _load_pojo(km.download_pojo(str(tmp_path / "km.py")))
+    kraw = _raw_cols(fr, kmod.NAMES)
+    kwant = km._score_raw(fr)["predict"]
+    hits = sum(kmod.score0({k: kraw[k][i] for k in kraw})["predict"]
+               == kwant[i] for i in range(0, 400, 23))
+    assert hits >= 16           # allow boundary-tie flips out of 18

@@ -1,0 +1,140 @@
+"""Extended Rapids prims — matrix, advmath, repeaters, filters, reshape
+(the remaining water/rapids/ast/prims families; wire names match the
+reference's AST str() names)."""
+
+import numpy as np
+
+import h2o3_tpu
+from h2o3_tpu.frame.frame import Frame
+from h2o3_tpu.rapids import rapids
+
+
+def _fr(key, cols, **kw):
+    return Frame.from_numpy(cols, key=key, **kw)
+
+
+def test_transpose_and_mmult():
+    _fr("mA", {"a": np.array([1.0, 2.0]), "b": np.array([3.0, 4.0])})
+    _fr("mB", {"a": np.array([1.0, 0.0]), "b": np.array([0.0, 1.0])})
+    t = rapids("(t mA)")
+    assert t.nrows == 2 and t.ncols == 2
+    np.testing.assert_allclose(t.col("C1").to_numpy(), [1.0, 3.0])
+    m = rapids("(x mA mB)")
+    np.testing.assert_allclose(m.col("C1").to_numpy(), [1.0, 2.0])
+    np.testing.assert_allclose(m.col("C2").to_numpy(), [3.0, 4.0])
+
+
+def test_hist_and_cut():
+    _fr("hv", {"v": np.linspace(0.0, 10.0, 101)})
+    h = rapids("(hist hv 5)")
+    counts = h.col("counts").to_numpy()
+    assert np.nansum(counts) == 101
+    assert len(h.col("breaks").to_numpy()) == 6
+    c = rapids("(cut hv [0 2.5 5 10] [] 1 1 3)")
+    col = c.col("v")
+    assert col.is_categorical and len(col.domain) == 3
+    codes = np.asarray(col.data)[: c.nrows]
+    assert codes[10] == 0 and codes[40] == 1 and codes[90] == 2
+
+
+def test_fillna_forward():
+    _fr("fn", {"v": np.array([1.0, np.nan, np.nan, 4.0, np.nan])})
+    out = rapids('(h2o.fillna fn "forward" 0 1)')
+    got = out.col("v").to_numpy()
+    np.testing.assert_allclose(got[:2], [1.0, 1.0])
+    assert np.isnan(got[2])          # maxlen=1 caps the fill run
+    np.testing.assert_allclose(got[3:], [4.0, 4.0])
+
+
+def test_kfold_columns():
+    _fr("kf", {"v": np.arange(100, dtype=np.float64)})
+    f = rapids("(kfold_column kf 5 42)").col("fold").to_numpy()
+    assert set(np.unique(f)) <= set(range(5))
+    m = rapids("(modulo_kfold_column kf 4)").col("fold").to_numpy()
+    np.testing.assert_allclose(m, np.arange(100) % 4)
+    _fr("sk", {"y": np.array(["a"] * 60 + ["b"] * 40, object)},
+        categorical=["y"])
+    s = rapids("(stratified_kfold_column sk 5 42)").col("fold").to_numpy()
+    # each fold must carry ~the class ratio (12 a's, 8 b's)
+    ya = s[:60]
+    for k in range(5):
+        assert 10 <= (ya == k).sum() <= 14
+
+
+def test_stratified_split():
+    _fr("ss", {"y": np.array(["a"] * 80 + ["b"] * 20, object)},
+        categorical=["y"])
+    out = rapids("(h2o.random_stratified_split ss 0.25 7)")
+    col = out.col("test_train_split")
+    assert col.domain == ["train", "test"]
+    codes = np.asarray(col.data)[: out.nrows]
+    assert (codes[:80] == 1).sum() == 20     # 25% of each class
+    assert (codes[80:] == 1).sum() == 5
+
+
+def test_repeaters():
+    s = rapids("(seq_len 5)").col("C1").to_numpy()
+    np.testing.assert_allclose(s, [1, 2, 3, 4, 5])
+    q = rapids("(seq 0 1 0.25)").col("C1").to_numpy()
+    np.testing.assert_allclose(q, [0, 0.25, 0.5, 0.75, 1.0])
+    _fr("rp", {"v": np.array([7.0, 8.0])})
+    r = rapids("(rep_len rp 5)").col("C1").to_numpy()
+    np.testing.assert_allclose(r, [7, 8, 7, 8, 7])
+
+
+def test_distance():
+    _fr("dA", {"x": np.array([0.0, 3.0]), "y": np.array([0.0, 4.0])})
+    _fr("dB", {"x": np.array([0.0]), "y": np.array([0.0])})
+    d = rapids('(distance dA dB "l2")').col("C1").to_numpy()
+    np.testing.assert_allclose(d, [0.0, 5.0])
+
+
+def test_dropdup_and_grep():
+    _fr("dd", {"a": np.array([1.0, 1.0, 2.0, 2.0, 3.0]),
+               "b": np.array([9.0, 9.0, 8.0, 7.0, 6.0])})
+    out = rapids('(dropdup dd ["a"] "first")')
+    np.testing.assert_allclose(out.col("b").to_numpy(), [9.0, 8.0, 6.0])
+    _fr("gg", {"s": np.array(["apple", "banana", "cherry"], object)},
+        categorical=["s"])
+    hits = rapids('(grep gg "an" 0 0 0)').col("C1").to_numpy()
+    np.testing.assert_allclose(hits, [1.0])
+    logical = rapids('(grep gg "an" 0 1 1)').col("C1").to_numpy()
+    np.testing.assert_allclose(logical, [1.0, 0.0, 1.0])
+
+
+def test_strip():
+    _fr("st", {"s": np.array(["  hi", "yo  ", "  both  "], object)},
+        categorical=["s"])
+    l = rapids("(lstrip st)")
+    dom = l.col("s").domain
+    codes = np.asarray(l.col("s").data)[: l.nrows]
+    assert [dom[c] for c in codes] == ["hi", "yo  ", "both  "]
+
+
+def test_melt_pivot_roundtrip():
+    _fr("wide", {"id": np.array([1.0, 2.0]),
+                 "p": np.array([10.0, 20.0]),
+                 "q": np.array([30.0, 40.0])})
+    long = rapids('(melt wide ["id"] ["p" "q"] "variable" "value" 0)')
+    assert long.nrows == 4
+    vdom = long.col("variable").domain
+    assert vdom == ["p", "q"]
+    back = rapids('(pivot py_melt_tmp "id" "variable" "value")'
+                  .replace("py_melt_tmp", long.key))
+    np.testing.assert_allclose(back.col("p").to_numpy(), [10.0, 20.0])
+    np.testing.assert_allclose(back.col("q").to_numpy(), [30.0, 40.0])
+
+
+def test_seq_negative_and_fillna_strings_and_dropdup_na():
+    s = rapids("(seq 5 1 -1)").col("C1").to_numpy()
+    np.testing.assert_allclose(s, [5, 4, 3, 2, 1])
+    _fr("fns", {"v": np.array([np.nan, 2.0, np.nan]),
+                "s": np.array(["a", None, "c"], object)},
+        strings=["s"])
+    out = rapids('(h2o.fillna fns "backward" 0 5)')
+    np.testing.assert_allclose(out.col("v").to_numpy(), [2.0, 2.0, np.nan])
+    assert list(out.col("s").to_numpy()) == ["a", None, "c"]
+    _fr("ddn", {"a": np.array([np.nan, np.nan, 1.0]),
+                "b": np.array([1.0, 2.0, 3.0])})
+    out = rapids('(dropdup ddn ["a"] "first")')
+    np.testing.assert_allclose(out.col("b").to_numpy(), [1.0, 3.0])
