@@ -46,7 +46,9 @@ def init(backend: Optional[str] = None,
     """
     global _STARTED
     if (_STARTED and backend is None and coordinator_address is None
-            and data_axis is None and model_axis is None):
+            and data_axis is None and model_axis is None
+            and num_processes is None and process_id is None
+            and not kwargs):
         # cloud already formed and no explicit backend/mesh re-shape
         # requested: attach, don't reform (h2o.init attaches to a
         # running cluster; silently re-detecting devices here could

@@ -129,7 +129,15 @@ def _irls_iter(X1, coef, y, w, l1, l2, family: str, link: str,
     w_irls = w * d * d / jnp.maximum(var, 1e-10)
     dev = jnp.sum(w * fam.deviance(y, mu))
 
-    xtx, xtz, _ = gram(X1, w_irls, z, mesh=get_mesh())
+    mesh = get_mesh()
+    from h2o3_tpu.parallel.mesh import MODEL_AXIS
+    if mesh.shape.get(MODEL_AXIS, 1) > 1:
+        # wide one-hot designs on a (data, model) mesh: column-sharded
+        # Gram via the ppermute ring (SURVEY §2.4 item 6 TP-like axis)
+        from h2o3_tpu.ops.gram import gram_model_sharded
+        xtx, xtz, _ = gram_model_sharded(X1, w_irls, z, mesh=mesh)
+    else:
+        xtx, xtz, _ = gram(X1, w_irls, z, mesh=mesh)
     nobs = jnp.maximum(jnp.sum(w), 1.0)
     A = xtx / nobs
     q = xtz / nobs

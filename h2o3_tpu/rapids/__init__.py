@@ -1428,70 +1428,70 @@ def _cut(env, fr, breaks, labels=None, include_lowest=("num", 0),
 @prim("h2o.fillna", "fillna")
 def _fillna(env, fr, method=("str", "forward"), axis=("num", 0),
             maxlen=("num", 1)):
-    """mungers/AstFillNA: directional NA fill with a run cap."""
+    """mungers/AstFillNA: directional NA fill with a run cap.
+
+    Vectorized: last-valid-index propagation via maximum.accumulate +
+    a run-length cap; column order is preserved; strings pass through.
+    """
     f = _as_frame(env.ev(fr))
     meth = str(env.ev(method)).lower()
     ax = int(env.ev(axis))
     cap = int(env.ev(maxlen))
-    out, cats, doms = {}, [], {}
-    strs = {}
+    forward = meth == "forward"
+
+    def capped_fill(M):
+        """Fill along axis 1 of a [n, m] float matrix."""
+        if not forward:
+            M = M[:, ::-1]
+        valid = ~np.isnan(M)
+        m = M.shape[1]
+        idx = np.arange(m)[None, :]
+        last = np.maximum.accumulate(np.where(valid, idx, -1), axis=1)
+        rows = np.arange(M.shape[0])[:, None]
+        src = M[rows, np.maximum(last, 0)]
+        fill = ~valid & (last >= 0) & (idx - last <= cap)
+        out = np.where(fill, src, M)
+        return out[:, ::-1] if not forward else out
+
+    out, cats, doms, strs = {}, [], {}, []
     if ax == 0:     # along rows, per column
         for n in f.names:
             c = f.col(n)
             if c.type == "string":
-                strs[n] = c.to_numpy()      # strings pass through
+                out[n] = c.to_numpy()
+                strs.append(n)
                 continue
             v = (_cat_codes(f, n).astype(np.float64) if c.is_categorical
-                 else _col_np(f, n).copy())
+                 else _col_np(f, n))
             if c.is_categorical:
-                v[v < 0] = np.nan
-            rng = range(len(v)) if meth == "forward" else \
-                range(len(v) - 1, -1, -1)
-            last, run = np.nan, 0
-            for i in rng:
-                if np.isnan(v[i]):
-                    if not np.isnan(last) and run < cap:
-                        v[i] = last
-                        run += 1
-                else:
-                    last, run = v[i], 0
+                v = np.where(v < 0, np.nan, v)
+            v = capped_fill(v[None, :])[0]
             if c.is_categorical:
-                codes = np.where(np.isnan(v), -1, v).astype(np.int32)
-                out[n] = codes
+                out[n] = np.where(np.isnan(v), -1, v).astype(np.int32)
                 cats.append(n)
                 doms[n] = c.domain
             else:
                 out[n] = v
-        out.update(strs)
     else:           # along columns, per row (numeric columns only)
-        num_names = [n for n in f.names if f.col(n).type != "string"
-                     and not f.col(n).is_categorical]
-        strs = {n: f.col(n).to_numpy() for n in f.names
-                if f.col(n).type == "string"}
-        M = np.stack([_col_np(f, n) for n in num_names], axis=1)
-        cols_rng = range(M.shape[1]) if meth == "forward" else \
-            range(M.shape[1] - 1, -1, -1)
-        for r_ in range(M.shape[0]):
-            last, run = np.nan, 0
-            for j in cols_rng:
-                if np.isnan(M[r_, j]):
-                    if not np.isnan(last) and run < cap:
-                        M[r_, j] = last
-                        run += 1
-                else:
-                    last, run = M[r_, j], 0
-        for j, n in enumerate(num_names):
-            out[n] = M[:, j]
-        # categoricals and strings cross rows untouched in axis=1 mode
-        for n in f.names:
+        num_names = [n for n in f.names if not f.col(n).is_categorical
+                     and f.col(n).type != "string"]
+        M = (np.stack([_col_np(f, n) for n in num_names], axis=1)
+             if num_names else None)
+        if M is not None:
+            M = capped_fill(M)
+        for n in f.names:          # original order preserved
             c = f.col(n)
-            if c.is_categorical:
+            if c.type == "string":
+                out[n] = c.to_numpy()
+                strs.append(n)
+            elif c.is_categorical:
                 out[n] = _cat_codes(f, n)
                 cats.append(n)
                 doms[n] = c.domain
-        out.update(strs)
+            else:
+                out[n] = M[:, num_names.index(n)]
     return Frame.from_numpy(out, categorical=cats, domains=doms,
-                            strings=list(strs))
+                            strings=strs)
 
 
 @prim("kfold_column")
@@ -1606,10 +1606,21 @@ def _dropdup(env, fr, cols_sel, keep=("str", "first")):
     f = _as_frame(env.ev(fr))
     names = _resolve_cols(f, cols_sel)
     kp = str(env.ev(keep)).lower()
-    keyarr = np.stack(
-        [(_cat_codes(f, n).astype(np.float64)
-          if f.col(n).is_categorical else _col_np(f, n)) for n in names],
-        axis=1)
+
+    def keycol(n):
+        c = f.col(n)
+        if c.is_categorical:
+            return _cat_codes(f, n).astype(np.float64)
+        if c.type == "string":
+            # intern strings to codes so keys stay numeric (None -> nan)
+            vals = c.to_numpy()
+            lut = {}
+            return np.array(
+                [np.nan if v is None else lut.setdefault(v, len(lut))
+                 for v in vals], np.float64)
+        return _col_np(f, n)
+
+    keyarr = np.stack([keycol(n) for n in names], axis=1)
     seen = {}
     order = range(f.nrows) if kp == "first" else range(f.nrows - 1, -1, -1)
     nan_mask = np.isnan(keyarr)

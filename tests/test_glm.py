@@ -189,3 +189,33 @@ def test_glm_p_values_binomial():
     tbl = {row["name"]: row for row in m.output["coefficients_table"]}
     assert tbl["x0"]["p_value"] < 1e-8
     assert tbl["noise"]["p_value"] > 0.01
+
+
+def test_glm_on_model_axis_mesh_matches_data_parallel():
+    """GLM IRLS over a (4 data x 2 model) mesh (ring Gram) must agree
+    with the (8, 1) data-parallel run — SURVEY §2.4 item 6."""
+    import jax
+    from h2o3_tpu.models.glm import GLMEstimator
+    from h2o3_tpu.parallel import mesh as mesh_mod
+    r = np.random.RandomState(11)
+    fr = h2o3_tpu.Frame.from_numpy({
+        **{f"x{i}": r.randn(600) for i in range(5)},
+        "g": r.choice(["a", "b", "c", "d"], 600),
+        "y": r.randn(600)})
+    kw = dict(family="gaussian", lambda_=0.0, standardize=True)
+    base = GLMEstimator(**kw).train(fr, y="y")
+    old = mesh_mod.get_mesh()
+    try:
+        m2 = mesh_mod.make_mesh(jax.devices("cpu")[:8], 4, 2)
+        mesh_mod.set_global_mesh(m2)
+        r2 = np.random.RandomState(11)
+        fr2 = h2o3_tpu.Frame.from_numpy({
+            **{f"x{i}": r2.randn(600) for i in range(5)},
+            "g": r2.choice(["a", "b", "c", "d"], 600),
+            "y": r2.randn(600)})
+        wide = GLMEstimator(**kw).train(fr2, y="y")
+    finally:
+        mesh_mod.set_global_mesh(old)
+    for k, v in base.coefficients.items():
+        assert abs(wide.coefficients[k] - v) < 1e-3, (k, v,
+                                                      wide.coefficients[k])

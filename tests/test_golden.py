@@ -1,0 +1,129 @@
+"""Golden numeric agreement vs independent reference implementations.
+
+The testdir_golden tier of the reference's test pyramid (SURVEY §4:
+"numeric agreement vs R reference implementations") — here sklearn and
+scipy play the R role: each algorithm must land within a quality band of
+an independent implementation on the same data.
+"""
+
+import numpy as np
+import pytest
+
+import h2o3_tpu
+from h2o3_tpu.frame.frame import Frame
+
+sklearn = pytest.importorskip("sklearn")
+
+
+def _make(seed=7, n=2000, f=8):
+    r = np.random.RandomState(seed)
+    X = r.randn(n, f)
+    logits = X[:, 0] * 1.2 - X[:, 1] + 0.5 * X[:, 2] * X[:, 3]
+    y = (r.rand(n) < 1 / (1 + np.exp(-logits))).astype(int)
+    return X, y
+
+
+def _frame(X, y=None, ycat=True):
+    cols = {f"x{i}": X[:, i] for i in range(X.shape[1])}
+    cats = []
+    if y is not None:
+        if ycat:
+            cols["y"] = np.array(["n", "p"], object)[y]
+            cats = ["y"]
+        else:
+            cols["y"] = y.astype(np.float64)
+    return Frame.from_numpy(cols, categorical=cats)
+
+
+def test_gbm_auc_tracks_sklearn():
+    from sklearn.ensemble import GradientBoostingClassifier
+    from sklearn.metrics import roc_auc_score
+    X, y = _make()
+    fr = _frame(X, y)
+    from h2o3_tpu.models.gbm import GBMEstimator
+    m = GBMEstimator(ntrees=40, max_depth=4, learn_rate=0.1, seed=1).train(
+        fr, y="y")
+    ours = m.training_metrics["AUC"]
+    sk = GradientBoostingClassifier(n_estimators=40, max_depth=4,
+                                    learning_rate=0.1, random_state=1)
+    sk.fit(X, y)
+    theirs = roc_auc_score(y, sk.predict_proba(X)[:, 1])
+    assert ours > 0.8
+    assert abs(ours - theirs) < 0.06, (ours, theirs)
+
+
+def test_drf_auc_tracks_sklearn_forest():
+    from sklearn.ensemble import RandomForestClassifier
+    from sklearn.metrics import roc_auc_score
+    X, y = _make(seed=5)
+    fr = _frame(X, y)
+    from h2o3_tpu.models.drf import DRFEstimator
+    m = DRFEstimator(ntrees=40, max_depth=10, seed=1).train(fr, y="y")
+    p1 = m.predict(fr).col("p1").to_numpy()
+    ours = roc_auc_score(y, p1)
+    sk = RandomForestClassifier(n_estimators=40, max_depth=10,
+                                random_state=1, max_features="sqrt")
+    sk.fit(X, y)
+    theirs = roc_auc_score(y, sk.predict_proba(X)[:, 1])
+    # in-sample forest AUCs are near-1 for both; ours must keep pace
+    assert ours > theirs - 0.05, (ours, theirs)
+
+
+def test_kmeans_inertia_tracks_sklearn():
+    from sklearn.cluster import KMeans as SKKMeans
+    r = np.random.RandomState(3)
+    centers = r.randn(4, 5) * 4
+    X = np.concatenate([centers[i] + r.randn(250, 5)
+                        for i in range(4)])
+    fr = _frame(X)
+    from h2o3_tpu.models.kmeans import KMeansEstimator
+    m = KMeansEstimator(k=4, seed=1, standardize=False).train(
+        fr, x=list(fr.names))
+    ours = m.training_metrics["tot_withinss"]
+    sk = SKKMeans(n_clusters=4, n_init=5, random_state=1).fit(X)
+    assert ours < sk.inertia_ * 1.05, (ours, sk.inertia_)
+
+
+def test_pca_variance_matches_sklearn():
+    from sklearn.decomposition import PCA as SKPCA
+    r = np.random.RandomState(9)
+    X = r.randn(500, 6) @ np.diag([3.0, 2.0, 1.5, 1.0, 0.5, 0.1])
+    fr = _frame(X)
+    from h2o3_tpu.models.pca import PCAEstimator
+    m = PCAEstimator(k=3, transform="demean").train(fr, x=list(fr.names))
+    sk = SKPCA(n_components=3).fit(X)
+    ours = np.abs(np.asarray(m.eigvecs))[:, :3]
+    theirs = np.abs(sk.components_.T)
+    np.testing.assert_allclose(ours, theirs, atol=5e-3)
+
+
+def test_isotonic_matches_sklearn():
+    from sklearn.isotonic import IsotonicRegression as SKIso
+    r = np.random.RandomState(2)
+    x = np.sort(r.rand(400) * 10)
+    y = np.log1p(x) + 0.3 * r.randn(400)
+    fr = Frame.from_numpy({"x": x, "y": y})
+    from h2o3_tpu.models.isotonic import IsotonicRegressionEstimator
+    m = IsotonicRegressionEstimator().train(fr, x=["x"], y="y")
+    ours = m.predict(fr).col("predict").to_numpy()
+    theirs = SKIso(out_of_bounds="clip").fit(x, y).predict(x)
+    np.testing.assert_allclose(ours, theirs, atol=1e-5)
+
+
+def test_glrm_reconstruction_beats_truncated_svd():
+    from sklearn.decomposition import TruncatedSVD
+    r = np.random.RandomState(4)
+    W = r.randn(300, 3)
+    H = r.randn(3, 8)
+    X = W @ H + 0.05 * r.randn(300, 8)
+    fr = _frame(X)
+    from h2o3_tpu.models.glrm import GLRMEstimator
+    m = GLRMEstimator(k=3, transform="none", max_iterations=80,
+                      seed=1).train(fr, x=list(fr.names))
+    sk = TruncatedSVD(n_components=3).fit(X)
+    sk_err = ((X - sk.inverse_transform(sk.transform(X))) ** 2).sum()
+    ours = float(m.output.get("objective") or m.output.get("final_obj")
+                 or np.nan)
+    # GLRM with no regularization must get within 2x of the optimal
+    # rank-3 reconstruction (SVD is the global optimum)
+    assert np.isfinite(ours) and ours < 2.0 * sk_err + 1e-6, (ours, sk_err)

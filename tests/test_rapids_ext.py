@@ -138,3 +138,19 @@ def test_seq_negative_and_fillna_strings_and_dropdup_na():
                 "b": np.array([1.0, 2.0, 3.0])})
     out = rapids('(dropdup ddn ["a"] "first")')
     np.testing.assert_allclose(out.col("b").to_numpy(), [1.0, 3.0])
+
+
+def test_fillna_order_and_axis1_guard_and_dropdup_strings():
+    _fr("fo", {"s": np.array(["a", "b", "c"], object),
+               "v": np.array([1.0, np.nan, 3.0])}, strings=["s"])
+    out = rapids('(h2o.fillna fo "forward" 0 5)')
+    assert out.names == ["s", "v"]       # column order preserved
+    out = rapids('(h2o.fillna fo "forward" 1 5)')
+    assert out.names == ["s", "v"]
+    _fr("fcat", {"g": np.array(["x", "y", "x"], object)}, categorical=["g"])
+    out = rapids('(h2o.fillna fcat "forward" 1 2)')  # zero numeric cols
+    assert out.names == ["g"]
+    _fr("dds", {"s": np.array(["k", "k", "m", None, None], object),
+                "v": np.arange(5, dtype=np.float64)}, strings=["s"])
+    out = rapids('(dropdup dds ["s"] "first")')
+    np.testing.assert_allclose(out.col("v").to_numpy(), [0.0, 2.0, 3.0])
