@@ -1,0 +1,171 @@
+"""Synthesize smalldata files the curated pyunits need but that don't
+exist anywhere in this environment.
+
+These are schema-compatible stand-ins (same column names/types/rough
+distributions as the well-known public datasets), generated with fixed
+seeds — NOT copies. Tests that assert exact golden values against the
+original data are excluded from the curated list instead.
+"""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+
+def _write_csv(path: str, header: list, cols: list) -> None:
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    if os.path.exists(path):
+        return
+    n = len(cols[0])
+    with open(path, "w") as f:
+        f.write(",".join(header) + "\n")
+        for i in range(n):
+            f.write(",".join("" if v is None else str(c[i])
+                             for c, v in ((c, c[i]) for c in cols)) + "\n")
+
+
+def gen_cars(sd: str) -> None:
+    """cars_20mpg.csv: mpg-classification set (schema of the classic
+    'cars' data: name,economy,cylinders,displacement,power,weight,
+    acceleration,year,economy_20mpg)."""
+    r = np.random.RandomState(42)
+    n = 406
+    cyl = r.choice([3, 4, 5, 6, 8], n, p=[0.01, 0.5, 0.01, 0.21, 0.27])
+    disp = (cyl * 40 + r.randn(n) * 25).round(1)
+    power = (cyl * 20 + r.randn(n) * 15).round(0)
+    weight = (cyl * 500 + r.randn(n) * 300).round(0)
+    accel = (25 - cyl + r.randn(n) * 2).round(1)
+    year = r.randint(70, 83, n)
+    econ = (50 - 3.5 * cyl + (year - 70) * 0.5 + r.randn(n) * 3).round(1)
+    econ20 = (econ >= 20).astype(int)
+    name = [f"car_{i}" for i in range(n)]
+    _write_csv(os.path.join(sd, "junit/cars_20mpg.csv"),
+               ["name", "economy", "cylinders", "displacement", "power",
+                "weight", "acceleration", "year", "economy_20mpg"],
+               [name, econ, cyl, disp, power, weight, accel, year, econ20])
+
+
+def gen_benign(sd: str) -> None:
+    """logreg/benign.csv: 14 numeric cols, binary FNDX response."""
+    r = np.random.RandomState(7)
+    n = 189
+    names = ["STR", "OBS", "AGMT", "FNDX", "HIGD", "DEG", "CHK",
+             "AGP1", "AGMN", "NLV", "LIV", "WT", "AGLP", "MST"]
+    data = [r.randint(1, 5, n), np.arange(1, n + 1), r.randint(30, 65, n)]
+    fndx = r.binomial(1, 0.3, n)
+    data.append(fndx)
+    for _ in range(10):
+        data.append((r.randn(n) * 10 + 30).round(0).astype(int))
+    _write_csv(os.path.join(sd, "logreg/benign.csv"), names, data)
+
+
+def gen_insurance(sd: str) -> None:
+    """glm_test/insurance.csv: District,Group,Age,Holders,Claims."""
+    r = np.random.RandomState(11)
+    dist, grp, age = [], [], []
+    groups = ["<1l", "1-1.5l", "1.5-2l", ">2l"]
+    ages = ["<25", "25-29", "30-35", ">35"]
+    for d in range(1, 5):
+        for g in groups:
+            for a in ages:
+                dist.append(d)
+                grp.append(g)
+                age.append(a)
+    n = len(dist)
+    holders = r.randint(10, 500, n)
+    lam = holders * 0.12
+    claims = r.poisson(lam)
+    _write_csv(os.path.join(sd, "glm_test/insurance.csv"),
+               ["District", "Group", "Age", "Holders", "Claims"],
+               [dist, grp, age, holders, claims])
+
+
+def gen_higgs_sample(sd: str) -> None:
+    """testng/higgs_train_5k.csv / higgs_test_5k.csv: response + 28 num."""
+    for fname, seed, n in (("higgs_train_5k.csv", 3, 5000),
+                           ("higgs_test_5k.csv", 4, 5000)):
+        r = np.random.RandomState(seed)
+        y = r.binomial(1, 0.53, n)
+        feats = [(r.randn(n) + 0.2 * y).round(6) for _ in range(28)]
+        _write_csv(os.path.join(sd, "testng", fname),
+                   ["response"] + [f"x{i}" for i in range(1, 29)],
+                   [y] + feats)
+
+
+def gen_airlines(sd: str) -> None:
+    """airlines/allyears2k_headers.zip stand-in as csv (common columns)."""
+    import zipfile
+    r = np.random.RandomState(5)
+    n = 2000
+    year = r.randint(1987, 2009, n)
+    month = r.randint(1, 13, n)
+    dom = r.randint(1, 29, n)
+    dow = r.randint(1, 8, n)
+    crsdep = r.randint(0, 2400, n)
+    deptime = crsdep + r.randint(-10, 60, n)
+    origin = r.choice(["SFO", "JFK", "ORD", "ATL", "DEN"], n)
+    dest = r.choice(["LAX", "BOS", "SEA", "MIA", "PHX"], n)
+    dist = r.randint(100, 2500, n)
+    carrier = r.choice(["UA", "AA", "DL", "WN"], n)
+    depdelay = np.maximum(deptime - crsdep, 0)
+    isdelayed = np.where(depdelay > 15, "YES", "NO")
+    path = os.path.join(sd, "airlines/allyears2k_headers.zip")
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    if not os.path.exists(path):
+        import io
+        buf = io.StringIO()
+        hdr = ["Year", "Month", "DayofMonth", "DayOfWeek", "DepTime",
+               "CRSDepTime", "UniqueCarrier", "Origin", "Dest",
+               "Distance", "DepDelay", "IsDepDelayed"]
+        buf.write(",".join(hdr) + "\n")
+        for i in range(n):
+            buf.write(f"{year[i]},{month[i]},{dom[i]},{dow[i]},"
+                      f"{deptime[i]},{crsdep[i]},{carrier[i]},{origin[i]},"
+                      f"{dest[i]},{dist[i]},{depdelay[i]},{isdelayed[i]}\n")
+        with zipfile.ZipFile(path, "w") as z:
+            z.writestr("allyears2k_headers.csv", buf.getvalue())
+
+
+def gen_prostate_variants(sd: str) -> None:
+    """logreg/prostate.csv + train/test splits, derived from the real
+    prostate data already linked at smalldata/prostate/prostate.csv
+    (the reference's logreg variants drop ID and pre-split the rows)."""
+    src = os.path.join(sd, "prostate/prostate.csv")
+    if not os.path.exists(src):
+        return
+    with open(src) as f:
+        header = f.readline().strip().split(",")
+        rows = [ln.strip().split(",") for ln in f if ln.strip()]
+    os.makedirs(os.path.join(sd, "logreg"), exist_ok=True)
+    full = os.path.join(sd, "logreg/prostate.csv")
+    if not os.path.exists(full):
+        with open(full, "w") as f:
+            f.write(",".join(header) + "\n")
+            f.writelines(",".join(r) + "\n" for r in rows)
+    # train/test: no ID column, CAPSULE first, deterministic 70/30 split
+    idx = header.index("CAPSULE")
+    keep = [idx] + [i for i in range(len(header))
+                    if header[i] not in ("ID", "CAPSULE")]
+    r = np.random.RandomState(17)
+    mask = r.rand(len(rows)) < 0.7
+    for name, sel in (("prostate_train.csv", mask),
+                      ("prostate_test.csv", ~mask)):
+        path = os.path.join(sd, "logreg", name)
+        if os.path.exists(path):
+            continue
+        with open(path, "w") as f:
+            f.write(",".join(header[i] for i in keep) + "\n")
+            for j, row in enumerate(rows):
+                if sel[j]:
+                    f.write(",".join(row[i] for i in keep) + "\n")
+
+
+def generate_all(sd: str) -> None:
+    gen_cars(sd)
+    gen_benign(sd)
+    gen_insurance(sd)
+    gen_higgs_sample(sd)
+    gen_airlines(sd)
+    gen_prostate_variants(sd)

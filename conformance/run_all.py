@@ -1,0 +1,187 @@
+"""Conformance driver: run the curated reference-pyunit subset against
+our server and write CONFORMANCE.md.
+
+Usage:
+    python conformance/run_all.py            # full curated list
+    python conformance/run_all.py gbm        # only entries matching substr
+
+Each pyunit runs unmodified in its own subprocess connected to one shared
+server (the reference's scripts/run.py topology: one cloud, many tests).
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import subprocess
+import sys
+import tempfile
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+TESTS = "/root/reference/h2o-py/tests"
+ALGOS = os.path.join(TESTS, "testdir_algos")
+MISC = os.path.join(TESTS, "testdir_misc")
+MUNGING = os.path.join(TESTS, "testdir_munging")
+
+PER_TEST_TIMEOUT = 420
+
+# Curated subset (VERDICT round-1 item 1: ≥40 from
+# testdir_algos/{gbm,glm,deeplearning,kmeans,automl}).  Chosen to need
+# only datasets available in this offline environment (prostate, iris,
+# synthesized cars/benign/insurance/higgs — conformance/gen_data.py).
+PYUNITS = [
+    # ---- gbm
+    f"{ALGOS}/gbm/pyunit_prostate_gbm.py",
+    f"{ALGOS}/gbm/pyunit_iris_gbm.py",
+    f"{ALGOS}/gbm/pyunit_bernoulli_gbm.py",
+    f"{ALGOS}/gbm/pyunit_cv_cars_gbm.py",
+    f"{ALGOS}/gbm/pyunit_weights_gbm.py",
+    f"{ALGOS}/gbm/pyunit_weights_var_impGBM.py",
+    f"{ALGOS}/gbm/pyunit_offset_gaussian_gbm.py",
+    f"{ALGOS}/gbm/pyunit_offset_poissonGBM.py",
+    f"{ALGOS}/gbm/pyunit_offset_gamma_gbm.py",
+    f"{ALGOS}/gbm/pyunit_offset_tweedie_gbm.py",
+    f"{ALGOS}/gbm/pyunit_mean_residual_deviance_gbm.py",
+    f"{ALGOS}/gbm/pyunit_gbm_train_api.py",
+    f"{ALGOS}/gbm/pyunit_gbm_grid.py",
+    f"{ALGOS}/gbm/pyunit_grid_carsGBM.py",
+    f"{ALGOS}/gbm/pyunit_constant_response_gbm.py",
+    f"{ALGOS}/gbm/pyunit_staged_predict_gbm.py",
+    # ---- glm
+    f"{ALGOS}/glm/pyunit_benign_glm.py",
+    f"{ALGOS}/glm/pyunit_prostate_glm.py",
+    f"{ALGOS}/glm/pyunit_cv_cars_glm.py",
+    f"{ALGOS}/glm/pyunit_solvers_glm.py",
+    f"{ALGOS}/glm/pyunit_mean_residual_deviance_glm.py",
+    f"{ALGOS}/glm/pyunit_benign_glm_grid.py",
+    f"{ALGOS}/glm/pyunit_glm_seed.py",
+    f"{ALGOS}/glm/pyunit_coef_and_coef_norm.py",
+    f"{ALGOS}/glm/pyunit_link_incompatible_error_glm.py",
+    # ---- deeplearning
+    f"{ALGOS}/deeplearning/pyunit_iris_basic_deeplearning.py",
+    f"{ALGOS}/deeplearning/pyunit_iris_no_hidden.py",
+    f"{ALGOS}/deeplearning/pyunit_mean_residual_deviance_deeplearning.py",
+    f"{ALGOS}/deeplearning/pyunit_cv_cars_deeplearning_medium.py",
+    f"{ALGOS}/deeplearning/pyunit_weights_and_biases_deeplearning.py",
+    # ---- kmeans
+    f"{ALGOS}/kmeans/pyunit_iris_h2o_vs_sciKmeans.py",
+    f"{ALGOS}/kmeans/pyunit_benignKmeans.py",
+    f"{ALGOS}/kmeans/pyunit_get_modelKmeans.py",
+    f"{ALGOS}/kmeans/pyunit_kmeans_cv.py",
+    f"{ALGOS}/kmeans/pyunit_kmeans_grid_iris.py",
+    # ---- drf
+    f"{ALGOS}/rf/pyunit_iris_nfoldsRF.py",
+    f"{ALGOS}/rf/pyunit_no_oob_prostateRF.py",
+    f"{ALGOS}/rf/pyunit_get_modelRF.py",
+    f"{ALGOS}/rf/pyunit_cv_carsRF.py",
+    f"{ALGOS}/rf/pyunit_constant_response_rf.py",
+    # ---- naive bayes
+    f"{ALGOS}/naivebayes/pyunit_irisNB.py",
+    f"{ALGOS}/naivebayes/pyunit_irisNB_cv.py",
+    # ---- automl
+    f"{ALGOS}/automl/pyunit_automl_train.py",
+    # ---- api/munging
+    f"{MISC}/pyunit_assign.py",
+    f"{MISC}/pyunit_apply.py",
+    f"{MISC}/pyunit_as_data_frame.py",
+    f"{MUNGING}/pyunit_quantile.py",
+]
+
+
+def start_server():
+    proc = subprocess.Popen(
+        [sys.executable, os.path.join(REPO, "conformance", "server_main.py")],
+        stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, text=True,
+        cwd=REPO)
+    import selectors
+    sel = selectors.DefaultSelector()
+    sel.register(proc.stdout, selectors.EVENT_READ)
+    port = None
+    t0 = time.time()
+    while time.time() - t0 < 120:
+        if proc.poll() is not None:
+            break                       # child died — fail fast
+        if not sel.select(timeout=1.0):
+            continue                    # nothing to read yet
+        line = proc.stdout.readline()
+        m = re.match(r"PORT=(\d+)", line or "")
+        if m:
+            port = int(m.group(1))
+            break
+    if port is None:
+        proc.kill()
+        raise RuntimeError("server failed to start")
+    return proc, port
+
+
+def main():
+    filt = sys.argv[1] if len(sys.argv) > 1 else ""
+    units = [u for u in PYUNITS if filt in u]
+    workdir = tempfile.mkdtemp(prefix="h2o3tpu_conf_")
+    sys.path.insert(0, REPO)
+    from conformance.harness import build_smalldata
+    build_smalldata(workdir)
+
+    proc, port = start_server()
+    url = f"http://127.0.0.1:{port}"
+    results = []
+    try:
+        for u in units:
+            name = "/".join(u.split("/")[-2:])
+            t0 = time.time()
+            try:
+                r = subprocess.run(
+                    [sys.executable,
+                     os.path.join(REPO, "conformance", "run_one.py"),
+                     url, u, workdir],
+                    capture_output=True, text=True,
+                    timeout=PER_TEST_TIMEOUT, cwd=REPO)
+                ok = r.returncode == 0 and "PYUNIT-PASS" in r.stdout
+                tail = (r.stderr or r.stdout).strip().splitlines()[-8:]
+            except subprocess.TimeoutExpired:
+                ok, tail = False, ["TIMEOUT"]
+            dt = time.time() - t0
+            results.append((name, ok, dt, tail))
+            print(f"{'PASS' if ok else 'FAIL'}  {name}  ({dt:.1f}s)",
+                  flush=True)
+            if not ok:
+                for ln in tail:
+                    print("      " + ln)
+    finally:
+        proc.kill()
+
+    npass = sum(1 for _, ok, _, _ in results if ok)
+    print(f"\n{npass}/{len(results)} passed")
+    write_report(results)
+
+
+def write_report(results):
+    npass = sum(1 for _, ok, _, _ in results if ok)
+    lines = [
+        "# CONFORMANCE — reference h2o-py pyunits vs h2o3-tpu",
+        "",
+        "The UNMODIFIED reference client (`/root/reference/h2o-py`, via the",
+        "tiny `future` shim in `conformance/shims/`) runs curated reference",
+        "pyunits against this server (`python conformance/run_all.py`).",
+        "Datasets: real in-tree files (prostate, iris) symlinked at runtime;",
+        "schema-compatible synthetic stand-ins elsewhere",
+        "(`conformance/gen_data.py`). Tests needing data that does not",
+        "exist in this offline image are excluded.",
+        "",
+        f"**Result: {npass}/{len(results)} passing** "
+        f"({time.strftime('%Y-%m-%d')})",
+        "",
+        "| pyunit | status | time |",
+        "|---|---|---|",
+    ]
+    for name, ok, dt, tail in results:
+        status = "pass" if ok else "FAIL — `" + \
+            (tail[-1][:80].replace("|", "/") if tail else "?") + "`"
+        lines.append(f"| {name} | {status} | {dt:.1f}s |")
+    with open(os.path.join(REPO, "CONFORMANCE.md"), "w") as f:
+        f.write("\n".join(lines) + "\n")
+
+
+if __name__ == "__main__":
+    main()

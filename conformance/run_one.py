@@ -1,0 +1,34 @@
+"""Run ONE reference pyunit against an already-running h2o3-tpu server.
+
+Usage: python conformance/run_one.py <server-url> <pyunit-path> <workdir>
+
+The pyunit is executed unmodified with run_name="__main__";
+pyunit_utils.standalone_test sees the pre-opened connection and skips
+h2o.init (h2o-py/tests/pyunit_utils/utilsPY.py:689).
+"""
+
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+REF_PY = "/root/reference/h2o-py"
+
+sys.path.insert(0, os.path.join(REPO, "conformance", "shims"))
+sys.path.insert(0, REF_PY)
+sys.path.insert(0, os.path.join(REF_PY, "tests"))
+
+url, pyunit, workdir = sys.argv[1], sys.argv[2], sys.argv[3]
+os.chdir(workdir)    # so pyunit_utils.locate finds the smalldata farm
+
+import h2o                                   # noqa: E402
+h2o.connect(url=url, verbose=False, strict_version_check=False)
+
+# Disable per-call progress bars: they spam the captured output
+try:
+    h2o.no_progress()
+except Exception:
+    pass
+
+import runpy                                  # noqa: E402
+runpy.run_path(pyunit, run_name="__main__")
+print("PYUNIT-PASS")

@@ -1,0 +1,9 @@
+"""Minimal py3-only stand-in for the `future` package.
+
+The reference h2o-py client (h2o-py/h2o/utils/compatibility.py:64) imports
+a handful of names from `future`; the real package is a py2/py3 bridge that
+is pure pass-through on python 3. This shim provides exactly those names so
+the unmodified client can run in this environment (no pip installs).
+"""
+
+from . import standard_library   # noqa: F401

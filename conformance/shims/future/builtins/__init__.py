@@ -1,0 +1,4 @@
+"""future.builtins on python 3 == the builtins module."""
+from builtins import *          # noqa: F401,F403
+from builtins import (chr, input, open, next, round, super,  # noqa: F401
+                      range, filter, map, zip)

@@ -1,0 +1,1 @@
+from builtins import range, filter, map, zip   # noqa: F401

@@ -1,0 +1,5 @@
+"""future.standard_library — no-op on python 3."""
+
+
+def install_aliases():
+    pass

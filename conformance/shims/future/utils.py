@@ -1,0 +1,26 @@
+"""future.utils for python 3: constants and dict views are native."""
+
+PY2 = False
+PY3 = True
+
+
+def with_metaclass(meta, *bases):
+    """Create a base class with a metaclass (classic recipe)."""
+    class metaclass(type):
+        def __new__(cls, name, this_bases, d):
+            if this_bases is None:
+                return type.__new__(cls, name, (), d)
+            return meta(name, bases, d)
+    return metaclass("temporary_class", None, {})
+
+
+def viewitems(d, **kw):
+    return d.items(**kw)
+
+
+def viewkeys(d, **kw):
+    return d.keys(**kw)
+
+
+def viewvalues(d, **kw):
+    return d.values(**kw)

@@ -1,0 +1,21 @@
+"""`imp` stdlib module shim for python >= 3.12 (removed upstream).
+
+The reference h2o-py test utils only use imp.load_source
+(h2o-py/tests/pyunit_utils/utilsPY.py), reimplemented here on importlib.
+"""
+
+import importlib.util
+import sys
+
+
+def load_source(name, path):
+    spec = importlib.util.spec_from_file_location(name, path)
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules[name] = mod
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def new_module(name):
+    import types
+    return types.ModuleType(name)

@@ -1,0 +1,1 @@
+"""Minimal py3-only stand-in for the `past` package (see future/)."""

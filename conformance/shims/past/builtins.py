@@ -1,0 +1,13 @@
+"""past.builtins on python 3: py2 names mapped to py3 equivalents."""
+
+basestring = str
+unicode = str
+long = int
+
+
+def xrange(*a):
+    return range(*a)
+
+
+def cmp(a, b):
+    return (a > b) - (a < b)

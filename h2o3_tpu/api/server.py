@@ -46,6 +46,11 @@ def route(method: str, pattern: str):
     return deco
 
 
+def _register_metadata_routes():
+    from h2o3_tpu.api import metadata
+    metadata.register(route)
+
+
 def _coerce(v: str) -> Any:
     """Form-value → python (the Schema fillFromParms coercion)."""
     if not isinstance(v, str):
@@ -67,27 +72,102 @@ def _coerce(v: str) -> Any:
         return s
 
 
-def _frame_json(fr: Frame, rows: int = 10) -> dict:
-    """Frame preview schema (water/api/schemas3/FrameV3)."""
-    cols = []
-    for n in fr.names:
-        c = fr.col(n)
-        preview = c.to_numpy()[:rows]
-        if c.is_categorical and c.domain:
-            dom = np.array(c.domain + [None], dtype=object)
-            codes = np.asarray(c.data)[: min(rows, fr.nrows)].astype(np.int64)
-            na = np.asarray(c.na_mask)[: min(rows, fr.nrows)]
-            preview = dom[np.where(na, len(c.domain), codes)]
-        cols.append({
-            "label": n, "type": c.type,
-            "domain": c.domain,
-            "data": [None if (isinstance(x, float) and np.isnan(x)) else
-                     (x.item() if isinstance(x, np.generic) else x)
-                     for x in preview],
-        })
-    return {"frame_id": {"name": fr.key}, "rows": fr.nrows,
-            "num_columns": fr.ncols, "column_names": fr.names,
-            "columns": cols}
+def _unquote(s):
+    """Strip the client-side quoted() wrapper (h2o-py sends frame ids and
+    type names wrapped in literal double quotes)."""
+    if isinstance(s, str) and len(s) >= 2 and s[0] == s[-1] and s[0] in "\"'":
+        return s[1:-1]
+    return s
+
+
+_WIRE_TYPES = {"numeric": "real", "categorical": "enum",
+               "time": "time", "string": "string"}
+
+
+def _col_json(fr: Frame, name: str, row_offset: int, rows: int,
+              summ: Optional[dict] = None) -> dict:
+    """ColV3 wire shape (water/api/schemas3/FrameV3.java ColV3).
+
+    The real h2o-py pops __meta / domain_cardinality / string_data
+    unconditionally (h2o-py/h2o/expr.py:381-385), so those keys are
+    mandatory."""
+    c = fr.col(name)
+    lo, hi = row_offset, min(row_offset + rows, fr.nrows)
+    wire_type = _WIRE_TYPES.get(c.type, c.type)
+    data, string_data, domain = None, None, None
+    if c.type == "string":
+        vals = c.to_numpy()[lo:hi]
+        string_data = [None if v is None else str(v) for v in vals]
+        data = []
+    elif c.is_categorical:
+        domain = list(c.domain or [])
+        codes = np.asarray(c.data)[lo:hi].astype(np.int64)
+        na = np.asarray(c.na_mask)[lo:hi]
+        data = [None if m else int(v) for v, m in zip(codes, na)]
+    else:
+        vals = np.asarray(c.to_numpy()[lo:hi], np.float64)
+        if wire_type == "real" and vals.size and \
+                np.all(np.isnan(vals) | (vals == np.round(vals))) and \
+                np.nanmax(np.abs(vals), initial=0) < 2**53:
+            wire_type = "int"
+        data = [None if np.isnan(v) else
+                (int(v) if wire_type in ("int", "time") else float(v))
+                for v in vals]
+    try:
+        s = (summ if summ is not None else fr.summary()).get(name, {})
+    except Exception:
+        s = {}
+    mean = s.get("mean")
+    sigma = s.get("sigma")
+    mins = [s.get("min")] if s.get("min") is not None else []
+    maxs = [s.get("max")] if s.get("max") is not None else []
+    return {
+        "__meta": {"schema_version": 3, "schema_name": "ColV3",
+                   "schema_type": "Vec"},
+        "label": name, "type": wire_type,
+        "missing_count": int(s.get("na_count", 0) or 0),
+        "zero_count": int(s.get("zeros", 0) or 0),
+        "positive_infinity_count": 0, "negative_infinity_count": 0,
+        "mins": [None if (isinstance(v, float) and np.isnan(v)) else v
+                 for v in mins],
+        "maxs": [None if (isinstance(v, float) and np.isnan(v)) else v
+                 for v in maxs],
+        "mean": None if mean is None or (isinstance(mean, float)
+                                         and np.isnan(mean)) else mean,
+        "sigma": None if sigma is None or (isinstance(sigma, float)
+                                           and np.isnan(sigma)) else sigma,
+        "persist_type": "HBM", "precision": -1,
+        "domain": domain,
+        "domain_cardinality": len(domain) if domain else 0,
+        "data": data, "string_data": string_data,
+        "histogram_bins": None, "histogram_base": 0,
+        "histogram_stride": 0, "percentiles": None,
+    }
+
+
+def _frame_json(fr: Frame, rows: int = 10, row_offset: int = 0) -> dict:
+    """FrameV3 wire shape (water/api/schemas3/FrameV3.java)."""
+    rows = min(rows, fr.nrows)
+    try:
+        summ = fr.summary()
+    except Exception:
+        summ = {}
+    cols = [_col_json(fr, n, row_offset, rows, summ) for n in fr.names]
+    return {"__meta": {"schema_version": 3, "schema_name": "FrameV3",
+                       "schema_type": "Frame"},
+            "frame_id": {"name": fr.key, "type": "Key<Frame>",
+                         "URL": f"/3/Frames/{fr.key}"},
+            "byte_size": 0, "is_text": False,
+            "row_offset": row_offset, "row_count": rows,
+            "column_offset": 0, "column_count": fr.ncols,
+            "full_column_count": fr.ncols, "total_column_count": fr.ncols,
+            "checksum": 0,
+            "rows": fr.nrows, "num_columns": fr.ncols,
+            "default_percentiles": [0.001, 0.01, 0.1, 0.25, 0.333, 0.5,
+                                    0.667, 0.75, 0.9, 0.99, 0.999],
+            "column_names": fr.names,
+            "columns": cols, "compatible_models": [],
+            "chunk_summary": None, "distribution_summary": None}
 
 
 # ------------------------------------------------------------- handlers
@@ -95,18 +175,92 @@ def _frame_json(fr: Frame, rows: int = 10) -> dict:
 
 @route("GET", "/3/Cloud")
 def _cloud(params, body):
+    """Cluster status (water/api/CloudHandler, schemas3/CloudV3.java)."""
+    import os
     info = cloud_mod.cluster_info()
-    return {"version": info["version"], "cloud_name": info["cloud_name"],
+    now = int(__import__("time").time() * 1000)
+    nodes = []
+    for i, d in enumerate(info["devices"]):
+        nodes.append({
+            "h2o": d, "ip_port": f"127.0.0.1:{54321 + i}", "healthy": True,
+            "last_ping": now, "pid": os.getpid(), "num_cpus": os.cpu_count(),
+            "cpus_allowed": os.cpu_count(), "nthreads": os.cpu_count(),
+            "sys_load": 0.0, "my_cpu_pct": 0, "sys_cpu_pct": 0,
+            "mem_value_size": 0, "pojo_mem": 0, "free_mem": 0,
+            "max_mem": 0, "swap_mem": 0, "num_keys": len(list(DKV.keys())),
+            "free_disk": 0, "max_disk": 0, "rpcs_active": 0,
+            "fjthrds": [], "fjqueue": [], "tcps_active": 0,
+            "open_fds": -1, "gflops": 0.0, "mem_bw": 0.0,
+        })
+    return {"__meta": {"schema_version": 3, "schema_name": "CloudV3",
+                       "schema_type": "Iced"},
+            "version": info["version"], "branch_name": "tpu-native",
+            "last_commit_hash": "", "describe": "h2o3-tpu",
+            "compiled_by": "h2o3-tpu", "compiled_on": "",
+            "build_number": "0", "build_age": "0 days",
+            "build_too_old": False, "node_idx": 0,
+            "cloud_name": info["cloud_name"],
             "cloud_size": info["cloud_size"],
-            "cloud_healthy": info["cloud_healthy"],
-            "consensus": True, "locked": True,
-            "nodes": [{"h2o": d, "healthy": True}
-                      for d in info["devices"]]}
+            "cloud_uptime_millis": info["cloud_uptime_ms"],
+            "cloud_internal_timezone": "UTC",
+            "datafile_parser_timezone": "UTC",
+            "cloud_healthy": info["cloud_healthy"], "bad_nodes": 0,
+            "consensus": True, "locked": True, "is_client": False,
+            "nodes": nodes, "internal_security_enabled": False,
+            "web_ip": "127.0.0.1"}
 
 
 @route("GET", "/3/Ping")
 def _ping(params, body):
     return {"status": "running"}
+
+
+_SESSIONS: set = set()
+
+
+@route("POST", "/4/sessions")
+def _new_session(params, body):
+    """Issue a Rapids session id (water/api/InitIDHandler)."""
+    import uuid
+    sid = "_sid_" + uuid.uuid4().hex[:12]
+    _SESSIONS.add(sid)
+    return {"__meta": {"schema_version": 4, "schema_name": "SessionIdV4",
+                       "schema_type": "Iced"},
+            "session_key": sid}
+
+
+@route("POST", "/3/InitID")
+def _init_id(params, body):
+    import uuid
+    sid = "_sid_" + uuid.uuid4().hex[:12]
+    _SESSIONS.add(sid)
+    return {"__meta": {"schema_version": 3, "schema_name": "InitIDV3",
+                       "schema_type": "Iced"},
+            "session_key": sid}
+
+
+@route("DELETE", r"/4/sessions/(?P<sid>[^/]+)")
+def _end_session(params, body, sid=None):
+    _SESSIONS.discard(sid)
+    return {"session_key": sid}
+
+
+@route("GET", "/3/Capabilities")
+def _capabilities(params, body):
+    caps = [{"name": n} for n in
+            ("AutoML", "Algos", "TargetEncoder", "TPU")]
+    return {"capabilities": caps}
+
+
+@route("GET", "/3/Capabilities/Core")
+def _capabilities_core(params, body):
+    return {"capabilities": [{"name": "TPU"}, {"name": "Algos"}]}
+
+
+@route("GET", "/3/Capabilities/API")
+def _capabilities_api(params, body):
+    return {"capabilities": [{"name": "AutoML"},
+                             {"name": "TargetEncoder"}]}
 
 
 @route("GET", "/3/Cleaner")
@@ -124,42 +278,132 @@ def _about(params, body):
                         {"name": "Backend", "value": info["platform"]}]}
 
 
+def _wire_list(src) -> List[str]:
+    """Decode h2o-py's stringify_list wire format: '[a,b]' where items
+    may or may not be individually double-quoted (shared_utils.py:171 —
+    bare for paths, quoted() for frame ids)."""
+    if isinstance(src, list):
+        items = src
+    else:
+        s = str(src).strip()
+        if s.startswith("[") and s.endswith("]"):
+            s = s[1:-1]
+        items = s.split(",") if s else []
+    out = []
+    for it in items:
+        if isinstance(it, dict):
+            it = it.get("name")
+        out.append(_unquote(str(it).strip()))
+    return out
+
+
+def _src_list(params) -> List[str]:
+    """source_frames / paths param → clean list of path strings."""
+    src = params.get("source_frames") or params.get("paths") or \
+        params.get("path")
+    return _wire_list(src)
+
+
 @route("POST", "/3/ImportFiles")
 def _import_files(params, body):
-    path = params.get("path")
+    path = _unquote(params.get("path"))
+    import os
+    if not os.path.exists(path) and not any(c in path for c in "*?["):
+        return {"files": [], "destination_frames": [], "fails": [path],
+                "dels": []}
     return {"files": [path], "destination_frames": [path], "fails": [],
             "dels": []}
+
+
+@route("POST", "/3/ImportFilesMulti")
+def _import_files_multi(params, body):
+    """Multi-path import (water/api/ImportFilesHandler) — the real
+    h2o-py always goes through this (h2o-py/h2o/h2o.py:336)."""
+    import os
+    paths = _src_list(params)
+    files, fails = [], []
+    for p in paths:
+        if os.path.exists(p) or any(c in p for c in "*?["):
+            files.append(p)
+        else:
+            fails.append(p)
+    return {"files": files, "destination_frames": files, "fails": fails,
+            "dels": []}
+
+
+# ParseSetupV3 column-type enum names (water/parser/ParseSetup)
+_SETUP_TYPES = {"numeric": "Numeric", "categorical": "Enum",
+                "string": "String", "time": "Time"}
+_SETUP_TYPES_BACK = {"numeric": "numeric", "enum": "categorical",
+                     "factor": "categorical", "categorical": "categorical",
+                     "string": "string", "time": "time", "int": "numeric",
+                     "real": "numeric", "float": "numeric",
+                     "uuid": "string"}
 
 
 @route("POST", "/3/ParseSetup")
 def _parse_setup(params, body):
     from h2o3_tpu.io.parser import parse_setup
-    src = params.get("source_frames")
-    if isinstance(src, list):
-        src = src[0]
-    src = str(src).strip('[]"')
-    setup = parse_setup(src)
-    return {"source_frames": [{"name": src}],
-            "destination_frame": src.split("/")[-1] + ".hex",
+    srcs = _src_list(params)
+    setup = parse_setup(srcs[0])
+    dest = srcs[0].split("/")[-1]
+    for ext in (".zip", ".gz", ".csv", ".parquet", ".pq", ".xlsx",
+                ".arff", ".svm", ".svmlight"):
+        if dest.endswith(ext):
+            dest = dest[: -len(ext)]
+    return {"__meta": {"schema_version": 3, "schema_name": "ParseSetupV3",
+                       "schema_type": "ParseSetup"},
+            "source_frames": [{"name": s} for s in srcs],
+            "destination_frame": dest + ".hex",
+            "parse_type": "CSV",
             "column_names": setup["columns"],
-            "column_types": [setup["types"][c] for c in setup["columns"]],
+            "column_types": [_SETUP_TYPES.get(setup["types"][c], "Numeric")
+                             for c in setup["columns"]],
+            "na_strings": None,
+            "warnings": [],
             "separator": ord(setup["separator"]),
+            "single_quotes": False,
             "check_header": 1 if setup["header"] else 0,
-            "number_columns": len(setup["columns"])}
+            "number_columns": len(setup["columns"]),
+            "chunk_size": 1 << 22,
+            "total_filtered_column_count": len(setup["columns"])}
 
 
 @route("POST", "/3/Parse")
 def _parse(params, body):
     from h2o3_tpu.io.parser import import_file
-    src = params.get("source_frames")
-    if isinstance(src, list):
-        src = src[0]
-    src = str(src).strip('[]"')
-    dest = params.get("destination_frame") or None
-    job = Job(f"parse {src}", dest=dest)
+    srcs = _src_list(params)
+    dest = _unquote(params.get("destination_frame")) or None
+    names = _wire_list(params["column_names"]) \
+        if params.get("column_names") else None
+    types = _wire_list(params["column_types"]) \
+        if params.get("column_types") else None
+    col_types = None
+    if types and names:
+        # type names arrive in either ParseSetup casing ("Enum") or the
+        # client's lowercase coltype vocabulary ("enum"); unknowns are
+        # left to the parser's own guess rather than forced numeric
+        col_types = {}
+        for n, t in zip(names, types):
+            mapped = _SETUP_TYPES_BACK.get(str(t).lower())
+            if mapped:
+                col_types[n] = mapped
+    job = Job(f"parse {srcs[0]}", dest=dest)
 
     def _run(j):
-        fr = import_file(src, destination_frame=dest)
+        if len(srcs) == 1:
+            fr = import_file(srcs[0], destination_frame=dest,
+                             col_types=col_types)
+        else:
+            import pandas as pd
+            parts = []
+            for s in srcs:
+                part = import_file(s, col_types=col_types)
+                parts.append(part.to_pandas())
+                DKV.remove(part.key)     # intermediate per-file frames
+            fr = Frame.from_pandas(pd.concat(parts, ignore_index=True),
+                                   key=dest)
+            DKV.put(fr.key, fr)
         j.update(1.0, "parsed")
         return fr
 
@@ -194,13 +438,21 @@ def _frame_summary(params, body, fid=None):
     return {"frames": [j]}
 
 
+@route("GET", r"/3/Frames/(?P<fid>[^/]+)/light")
+def _frame_light(params, body, fid=None):
+    return _frame_one(params, body, fid=fid)
+
+
 @route("GET", r"/3/Frames/(?P<fid>[^/]+)")
 def _frame_one(params, body, fid=None):
     fr = DKV.get(fid)
     if not isinstance(fr, Frame):
         raise KeyError(f"frame {fid} not found")
-    rows = int(params.get("row_count") or 10)
-    return {"frames": [_frame_json(fr, rows=rows)]}
+    rows = int(float(params.get("row_count") or 10))
+    if rows < 0:
+        rows = fr.nrows
+    offset = int(float(params.get("row_offset") or 0))
+    return {"frames": [_frame_json(fr, rows=rows, row_offset=offset)]}
 
 
 @route("DELETE", r"/3/Frames/(?P<fid>[^/]+)")
@@ -209,10 +461,36 @@ def _frame_del(params, body, fid=None):
     return {}
 
 
+@route("DELETE", "/3/DKV")
+def _dkv_del_all(params, body):
+    """h2o.remove_all(): clear every key except retained models/frames;
+    a retained MODEL also keeps its training/validation frames
+    (water/api/RemoveAllHandler → DKVManager.retain model→frame)."""
+    retained = set(_wire_list(params.get("retained_keys") or []))
+    from h2o3_tpu.models.model import Model as _Model
+    for k in list(retained):
+        v = DKV.get_raw(k)
+        if isinstance(v, _Model):
+            for fk in (v.output.get("training_frame"),
+                       v.output.get("validation_frame")):
+                if fk:
+                    retained.add(str(fk))
+    for k in list(DKV.keys()):
+        if k not in retained:
+            DKV.remove(k)
+    return {}
+
+
 @route("DELETE", r"/3/DKV/(?P<key>[^/]+)")
 def _dkv_del(params, body, key=None):
     DKV.remove(key)
     return {}
+
+
+@route("POST", "/3/LogAndEcho")
+def _log_and_echo(params, body):
+    log.info("client: %s", params.get("message") or "")
+    return {"message": params.get("message") or ""}
 
 
 @route("GET", "/3/ModelBuilders")
@@ -250,7 +528,12 @@ def _train(params, body, algo=None):
     # the one ModelBuilder.train lifecycle (CV dispatch, run_time, logs)
     job = builder.train(fr, y=y, validation_frame=vf, background=True,
                         dest_key=model_id)
-    return {"job": job.to_dict()}
+    # ModelBuilderSchema shape: job + validation messages
+    # (h2o-py/h2o/estimators/estimator_base.py:190 reads "messages")
+    return {"__meta": {"schema_version": 3,
+                       "schema_name": "ModelBuilderSchema",
+                       "schema_type": "ModelBuilder"},
+            "job": job.to_dict(), "messages": [], "error_count": 0}
 
 
 @route("GET", r"/3/Jobs/(?P<key>[^/]+)")
@@ -291,10 +574,11 @@ def _models(params, body):
 
 @route("GET", r"/3/Models/(?P<mid>[^/]+)")
 def _model_one(params, body, mid=None):
+    from h2o3_tpu.api.model_schema import model_to_v3
     m = DKV.get(mid)
     if not isinstance(m, Model):
         raise KeyError(f"model {mid} not found")
-    return {"models": [m.to_dict()]}
+    return {"models": [model_to_v3(m)]}
 
 
 @route("DELETE", r"/3/Models/(?P<mid>[^/]+)")
@@ -332,6 +616,32 @@ def _predict(params, body, mid=None, fid=None):
             "model_metrics": [{}]}
 
 
+@route("POST", r"/4/Predictions/models/(?P<mid>[^/]+)/frames/(?P<fid>[^/]+)")
+def _predict_async(params, body, mid=None, fid=None):
+    """Async bulk scoring (water/api/ModelMetricsHandler.predictAsync —
+    returns a bare JobV3; the real h2o-py polls it then fetches
+    job.dest as the predictions frame)."""
+    m = DKV.get(mid)
+    fr = DKV.get(fid)
+    if not isinstance(m, Model):
+        raise KeyError(f"model {mid} not found")
+    if not isinstance(fr, Frame):
+        raise KeyError(f"frame {fid} not found")
+    dest = f"prediction_{mid}_on_{fid}"
+    job = Job(f"predict {mid}", dest=dest)
+
+    def _run(j):
+        preds = m.predict(fr)
+        DKV.remove(preds.key)
+        preds.key = dest
+        DKV.put(dest, preds)
+        j.update(1.0, "scored")
+        return preds
+
+    job.start(_run, background=True)
+    return job.to_dict()
+
+
 @route("GET", r"/3/Models/(?P<mid>[^/]+)/mojo")
 def _model_mojo(params, body, mid=None):
     """Stream the MOJO zip (h2o-py download_mojo GET endpoint)."""
@@ -367,9 +677,9 @@ def _model_metrics(params, body, mid=None, fid=None):
         raise KeyError(f"model {mid} not found")
     if not isinstance(fr, Frame):
         raise KeyError(f"frame {fid} not found")
+    from h2o3_tpu.api.model_schema import metrics_v3
     mm_ = m.model_performance(fr)
-    d = mm_.to_dict() if hasattr(mm_, "to_dict") else dict(mm_ or {})
-    return {"model_metrics": [d]}
+    return {"model_metrics": [metrics_v3(mm_, m, frame_key=fid)]}
 
 
 @route("POST", "/3/PartialDependence")
@@ -392,17 +702,27 @@ def _pdp(params, body):
 
 @route("POST", "/99/Rapids")
 def _rapids_ep(params, body):
+    """Rapids eval (water/api/RapidsHandler). The real h2o-py reads
+    key/num_rows/num_cols for frames, scalar, string, map_keys/frames
+    (h2o-py/h2o/expr.py:116-128); errors must be H2OErrorV3."""
     from h2o3_tpu.rapids import rapids
     expr = params.get("ast") or ""
-    try:
-        val = rapids(expr)
-    except Exception as e:
-        return {"error": str(e)}
+    val = rapids(expr)
     if isinstance(val, Frame):
-        return {"key": {"name": val.key},
+        return {"__meta": {"schema_version": 3,
+                           "schema_name": "RapidsFrameV3",
+                           "schema_type": "RapidsFrame"},
+                "key": {"name": val.key},
+                "num_rows": val.nrows, "num_cols": val.ncols,
                 "frame": _frame_json(val, rows=5)}
-    if isinstance(val, (int, float)):
+    if isinstance(val, (bool, np.bool_)):
+        return {"scalar": bool(val)}
+    if isinstance(val, (int, float, np.generic)):
         return {"scalar": float(val)}
+    if val is None:
+        return {"scalar": None}
+    if isinstance(val, (list, np.ndarray)):
+        return {"scalar": [float(x) for x in np.asarray(val).ravel()]}
     return {"string": str(val)}
 
 
@@ -571,6 +891,32 @@ class _Handler(BaseHTTPRequestHandler):
             k: v[0] for k, v in urllib.parse.parse_qs(parsed.query).items()}
         length = int(self.headers.get("Content-Length") or 0)
         raw = self.rfile.read(length) if length else b""
+        if path.startswith("/3/PostFile"):
+            # raw file-body upload (h2o-py sends the file bytes as the
+            # request body, h2o-py/h2o/backend/connection.py:473)
+            import tempfile
+            # the client sends no filename: sniff the container format so
+            # the extension-dispatching parser picks the right reader
+            if raw[:4] == b"PK\x03\x04":
+                suffix = ".zip"
+            elif raw[:2] == b"\x1f\x8b":
+                suffix = ".csv.gz"
+            elif raw[:4] == b"PAR1":
+                suffix = ".parquet"
+            else:
+                suffix = ".csv"
+            fd, tmp = tempfile.mkstemp(prefix="h2o3tpu_upload_",
+                                       suffix=suffix)
+            with open(fd, "wb") as f:
+                f.write(raw)
+            payload = json.dumps({"destination_frame": tmp,
+                                  "total_bytes": len(raw)}).encode()
+            self.send_response(200)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(payload)))
+            self.end_headers()
+            self.wfile.write(payload)
+            return
         body = raw.decode("utf-8", "replace")
         ctype = self.headers.get("Content-Type", "")
         if "json" in ctype and body:
@@ -592,15 +938,11 @@ class _Handler(BaseHTTPRequestHandler):
                     out = fn(params, body, **match.groupdict())
                     code = 200
                 except KeyError as e:
-                    out = {"__meta": {"schema_type": "H2OError"},
-                           "error_url": path, "msg": str(e),
-                           "exception_msg": str(e)}
+                    out = _error_json(path, e, 404)
                     code = 404
                 except Exception as e:   # noqa: BLE001 - request boundary
                     log.exception("handler error on %s %s", method, path)
-                    out = {"__meta": {"schema_type": "H2OError"},
-                           "error_url": path, "msg": str(e),
-                           "exception_msg": str(e)}
+                    out = _error_json(path, e, 500)
                     code = 500
                 if isinstance(out, dict) and "__bytes__" in out:
                     payload = out["__bytes__"]
@@ -609,7 +951,8 @@ class _Handler(BaseHTTPRequestHandler):
                     payload = out["__html__"].encode()
                     ctype = "text/html; charset=utf-8"
                 else:
-                    payload = json.dumps(out, default=_json_default).encode()
+                    payload = json.dumps(_json_sanitize(out),
+                                         default=_json_default).encode()
                     ctype = "application/json"
                 self.send_response(code)
                 self.send_header("Content-Type", ctype)
@@ -634,6 +977,21 @@ class _Handler(BaseHTTPRequestHandler):
         self._dispatch("DELETE")
 
 
+def _error_json(path: str, e: Exception, status: int) -> dict:
+    """H2OErrorV3 wire shape (water/api/schemas3/H2OErrorV3.java) — the
+    real h2o-py turns this into an H2OResponseError with .msg etc."""
+    import time
+    import traceback
+    return {"__meta": {"schema_version": 3, "schema_name": "H2OErrorV3",
+                       "schema_type": "H2OError"},
+            "timestamp": int(time.time() * 1000),
+            "error_url": path, "msg": str(e),
+            "dev_msg": str(e), "http_status": status, "values": {},
+            "exception_type": type(e).__name__,
+            "exception_msg": str(e),
+            "stacktrace": traceback.format_exc().splitlines()[-10:]}
+
+
 def _json_default(o):
     if isinstance(o, np.generic):
         return o.item()
@@ -642,6 +1000,20 @@ def _json_default(o):
     if isinstance(o, float) and np.isnan(o):
         return None
     return str(o)
+
+
+def _json_sanitize(o):
+    """Strict-JSON cleanup: the real h2o-py parses responses with a
+    strict decoder, so NaN/Infinity literals are wire errors."""
+    if isinstance(o, dict):
+        return {k: _json_sanitize(v) for k, v in o.items()}
+    if isinstance(o, (list, tuple)):
+        return [_json_sanitize(v) for v in o]
+    if isinstance(o, np.generic):
+        o = o.item()
+    if isinstance(o, float) and (np.isnan(o) or np.isinf(o)):
+        return None
+    return o
 
 
 _SERVER: Optional[ThreadingHTTPServer] = None
@@ -669,3 +1041,8 @@ def stop_server():
     if _SERVER is not None:
         _SERVER.shutdown()
         _SERVER = None
+
+
+# schema-metadata endpoints live in api/metadata.py; register them into the
+# same ROUTES table at import time
+_register_metadata_routes()

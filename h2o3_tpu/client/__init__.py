@@ -264,10 +264,14 @@ class H2OModel:
 
     @property
     def params(self) -> dict:
-        return self._info()["models"][0]["params"]
+        """actual param values from the ModelSchemaV3 parameters list."""
+        plist = self._info()["models"][0].get("parameters") or []
+        return {p["name"]: p.get("actual_value") for p in plist}
 
     def metrics(self, kind: str = "training_metrics") -> dict:
-        return self._info()["models"][0][kind] or {}
+        # metrics live under output (ModelOutputSchemaV3), like the
+        # reference wire shape
+        return self._info()["models"][0]["output"].get(kind) or {}
 
     def auc(self) -> float:
         return self.metrics()["AUC"]

@@ -102,15 +102,20 @@ class Cleaner:
         return stats["bytes_in_use"] / stats["bytes_limit"]
 
     def _lru_frames(self):
-        """(atime, key, frame) for every in-memory DKV frame, coldest
-        first."""
+        """(atime, key) for every in-memory DKV frame, coldest first.
+
+        Deliberately does NOT keep a reference to the Frame: holding one
+        across the spill loop would pin every frame's device buffers for
+        the whole scan, so pressure() could never drop mid-loop and one
+        step would spill the entire DKV (hot frames included)."""
         from h2o3_tpu.core.kv import DKV
         from h2o3_tpu.frame.frame import Frame
         out = []
         for key in list(DKV.keys()):
             v = DKV.get_raw(key)
             if isinstance(v, Frame):
-                out.append((DKV.atime(key), key, v))
+                out.append((DKV.atime(key), key))
+            del v
         out.sort(key=lambda t: t[0])
         return out
 
@@ -150,7 +155,7 @@ class Cleaner:
         """Spill the n least-recently-used frames; returns spilled keys."""
         exclude = exclude or set()
         done: List[str] = []
-        for _, key, _fr in self._lru_frames():
+        for _, key in self._lru_frames():
             if key in exclude:
                 continue
             if self.spill(key) is not None:
@@ -166,7 +171,7 @@ class Cleaner:
         spilled: List[str] = []
         if self.pressure() <= self.threshold:
             return spilled
-        for _, key, _fr in self._lru_frames():
+        for _, key in self._lru_frames():
             if self.spill(key) is not None:
                 spilled.append(key)
             if self.pressure() <= self.threshold:

@@ -114,15 +114,31 @@ class Job:
         return end - self.start_time if self.start_time else 0.0
 
     def to_dict(self) -> dict:
-        """JSON shape for GET /3/Jobs/{key} (water/api/JobsHandler.java)."""
+        """JobV3 wire shape (water/api/schemas3/JobV3.java) — the real
+        h2o-py H2OJob reads key.name, dest.name, status, progress,
+        auto_recoverable, warnings (h2o-py/h2o/job.py:36-56)."""
+        dest_type = "Key<Keyed>"
+        if self.dest:
+            from h2o3_tpu.models.model import Model
+            if isinstance(DKV.get_raw(self.dest), Model):
+                dest_type = "Key<Model>"
         return {
-            "key": self.key,
+            "__meta": {"schema_version": 3, "schema_name": "JobV3",
+                       "schema_type": "Job"},
+            "key": {"name": self.key, "type": "Key<Job>",
+                    "URL": f"/3/Jobs/{self.key}"},
             "description": self.description,
             "status": self.status,
             "progress": self.progress,
             "progress_msg": self._msg,
-            "dest": self.dest,
+            "start_time": int(self.start_time * 1000),
+            "msec": int(self.run_time * 1000),
+            "dest": {"name": self.dest or "", "type": dest_type},
             "exception": self.exception,
+            "stacktrace": self.exception,
+            "warnings": [],
+            "auto_recoverable": False,
+            "ready_for_view": True,
             "run_time_ms": int(self.run_time * 1000),
         }
 

@@ -294,12 +294,15 @@ def load_frame(uri: str, key: Optional[str] = None):
         t = header["types"][name]
         if t == "string":
             s = npz[f"c{i}"].astype(object)
-            s[npz[f"m{i}"]] = None
+            # frames saved before masks existed have no m{i}: all-valid
+            if f"m{i}" in npz.files:
+                s[npz[f"m{i}"]] = None
             cols[name] = s
             strs.append(name)
         elif t == "categorical":
             codes = npz[f"c{i}"].astype(np.int32)
-            codes = np.where(npz[f"m{i}"], -1, codes)
+            if f"m{i}" in npz.files:
+                codes = np.where(npz[f"m{i}"], -1, codes)
             cols[name] = codes
             domains[name] = header["domains"][name]
             cats.append(name)

@@ -88,6 +88,8 @@ def train_with_cv(builder, frame: Frame, x: Sequence[str], y: str,
     else:
         holdout = np.zeros((n,), np.float32)
 
+    keep_preds = bool(p.get("keep_cross_validation_predictions"))
+    cv_pred_keys = []
     for f in range(nfolds):
         mask_tr = folds != f
         tr = subset_frame(frame, mask_tr)
@@ -104,6 +106,19 @@ def train_with_cv(builder, frame: Frame, x: Sequence[str], y: str,
                 holdout[idx, k] = preds[f"p{k}"]
         else:
             holdout[idx] = preds["predict"]
+        if keep_preds:
+            # per-fold holdout prediction frame: full nrows, zeros off-fold
+            # (reference keep_cross_validation_predictions contract)
+            cols = {}
+            for name, arr in preds.items():
+                a = np.asarray(arr, np.float64)
+                if a.dtype.kind not in "fiu":
+                    continue
+                fullcol = np.zeros(n, np.float64)
+                fullcol[idx] = a[: len(idx)]
+                cols[name] = fullcol
+            pf = Frame.from_numpy(cols)
+            cv_pred_keys.append(pf.key)
 
     # final model on all data (ModelBuilder.java "main model")
     final = builder.__class__(**sub_params)._fit(frame, list(x), y, job)
@@ -131,7 +146,20 @@ def train_with_cv(builder, frame: Frame, x: Sequence[str], y: str,
         yv = np.nan_to_num(yraw).astype(np.float32)
         final.cross_validation_metrics = mm.regression_metrics(holdout, yv, wv)
     final.output["cv_holdout_predictions"] = None
+    final.output["cv_predictions_keys"] = cv_pred_keys or None
     final.output["nfolds"] = nfolds
+    # expose CV models to clients like the reference does: keys named
+    # {main}_cv_{i}, listed under output.cross_validation_models
+    # (hex/ModelBuilder.java:819 cv-model naming)
+    from h2o3_tpu.core.kv import DKV
+    cv_keys = []
+    for i, m in enumerate(cv_models):
+        new_key = f"{final.key}_cv_{i + 1}"
+        DKV.remove(m.key)
+        m.key = new_key
+        DKV.put(new_key, m)
+        cv_keys.append(new_key)
+    final.output["cv_model_keys"] = cv_keys
     final._cv_holdout = holdout
     final._cv_models = cv_models
     final._cv_folds = folds

@@ -51,6 +51,10 @@ class Family:
         defaults = {"gaussian": "identity", "binomial": "logit",
                     "poisson": "log", "gamma": "log", "tweedie": "tweedie",
                     "multinomial": "multinomial"}
+        # "family_default" is the wire spelling of "use the default link"
+        # (hex/glm/GLMModel.GLMParameters.Link.family_default)
+        if link in ("family_default", "auto", ""):
+            link = None
         self.link = link or defaults[name]
 
     # mu = linkinv(eta)
@@ -315,6 +319,9 @@ class GLMEstimator(ModelBuilder):
         # h2o-py spells it "Lambda" or "lambda_"
         if "Lambda" in params:
             params["lambda_"] = params.pop("Lambda")
+        # h2o-py's name for the tweedie power (GLMModel.GLMParameters)
+        if "tweedie_variance_power" in params:
+            params["tweedie_power"] = params.pop("tweedie_variance_power")
         unknown = set(params) - set(merged)
         if unknown:
             raise ValueError(f"unknown GLM params: {sorted(unknown)}")

@@ -114,7 +114,7 @@ def binomial_metrics(p, y, w=None, mesh=None) -> ModelMetrics:
     fn = pos_h[:idx].sum(); tn = neg_h[:idx].sum()
     err0 = fp / max(fp + tn, 1e-12)
     err1 = fn / max(fn + tp, 1e-12)
-    return ModelMetrics(
+    mm = ModelMetrics(
         "Binomial", int(tot), sse / max(tot, 1e-12),
         logloss=ll / max(tot, 1e-12),
         AUC=roc["auc"], pr_auc=roc["pr_auc"], Gini=roc["gini"],
@@ -122,6 +122,10 @@ def binomial_metrics(p, y, w=None, mesh=None) -> ModelMetrics:
         mean_per_class_error=float((err0 + err1) / 2),
         confusion_matrix=[[float(tn), float(fp)], [float(fn), float(tp)]],
         positive_fraction=pos / max(tot, 1e-12))
+    # keep the 400-bin score histogram for the REST thresholds table
+    # (hex/AUC2 serves per-threshold rows to the client)
+    mm.hist = (pos_h, neg_h)
+    return mm
 
 
 @partial(jax.jit, static_argnames=("mesh",))

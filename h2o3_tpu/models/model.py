@@ -219,7 +219,15 @@ class ModelBuilder:
         training frame and attached to training_metrics as 'custom'."""
         x = self.resolve_x(training_frame, x, y)
         nfolds = int(self.params.get("nfolds") or 0)
-        job = Job(f"{self.algo} train", work=1.0)
+        # an explicit fold column triggers CV regardless of nfolds
+        # (hex/ModelBuilder.java computeCrossValidation entry conditions)
+        if self.params.get("fold_column") and nfolds < 2:
+            nfolds = 2      # actual count comes from the fold column
+        # the model key must exist BEFORE training starts: the real h2o-py
+        # captures job.dest at submission time (h2o-py/h2o/job.py:48)
+        if not dest_key:
+            dest_key = make_key(f"model_{self.algo}")
+        job = Job(f"{self.algo} train", work=1.0, dest=dest_key)
         self._job = job
 
         def _run(j: Job) -> Model:
@@ -243,7 +251,8 @@ class ModelBuilder:
                     model.training_metrics.extra["custom"] = val
                 model.output["custom_metric"] = val
             model.output["run_time"] = time.time() - t0
-            if dest_key:   # REST model_id: rename into the requested key
+            if dest_key and model.key != dest_key:
+                # rename into the pre-announced job dest key
                 DKV.remove(model.key)
                 model.key = dest_key
                 DKV.put(dest_key, model)

@@ -336,12 +336,17 @@ def leaf_assignment_frame(model, frame):
     bm = rebin_for_scoring(model.bm, frame)
     ids = np.asarray(leaf_assignments(model.forest, bm.bins,
                                       model.bm.nbins_total))[: frame.nrows]
+    category = model.output.get("category")
     K = (model.output.get("nclasses", 1)
-         if model.output.get("category") == "Multinomial" else 1)
+         if category == "Multinomial" else 1)
+    # classification columns carry a .C{k} suffix even for binomial
+    # (SharedTreeModel.java:326 — suffix dropped only when the per-iter
+    # tree-key array has a single entry, i.e. regression)
+    suffixed = category in ("Binomial", "Multinomial")
     cols = {}
     for j in range(ids.shape[1]):
-        name = (f"T{j + 1}" if K <= 1
-                else f"T{j // K + 1}.C{j % K + 1}")
+        name = (f"T{j // K + 1}.C{j % K + 1}" if suffixed
+                else f"T{j + 1}")
         cols[name] = ids[:, j].astype(np.float64)
     return Frame.from_numpy(cols)
 

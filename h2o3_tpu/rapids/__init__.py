@@ -575,6 +575,17 @@ def _rect_assign(env, dst, src, col_sel, row_sel):
                 vc = val.col(val.names[j])
                 v = (_cat_codes(val, val.names[j]).astype(np.float64)
                      if vc.is_categorical else vc.to_numpy())
+                full = len(rows) == f.nrows
+                if full and vc.is_categorical and dom is None:
+                    # whole-column replace with a factor: the column
+                    # BECOMES categorical (fr["y"] = fr["y"].asfactor())
+                    dom = list(vc.domain or [])
+                    arr = np.full(f.nrows, np.nan)
+                elif full and not vc.is_categorical and dom is not None \
+                        and c.type != "string":
+                    # whole-column replace with numeric: drops the factor
+                    dom = None
+                    arr = np.full(f.nrows, np.nan)
                 if vc.is_categorical and dom is not None:
                     # remap source codes into the destination domain
                     lut = {lvl: k for k, lvl in enumerate(dom)}
@@ -1500,7 +1511,10 @@ def _kfold_column(env, fr, nfolds, seed=("num", -1)):
     f = _as_frame(env.ev(fr))
     k = int(env.ev(nfolds))
     s = int(env.ev(seed))
-    r = np.random.RandomState(s if s >= 0 else 0xF01D)
+    # seed==-1 means "draw a fresh random seed" in the reference, not a
+    # fixed constant (AstKFold)
+    r = np.random.RandomState(
+        s if s >= 0 else np.random.SeedSequence().entropy % (2**32))
     return Frame.from_numpy(
         {"fold": r.randint(0, k, f.nrows).astype(np.float64)})
 
@@ -1520,7 +1534,8 @@ def _strat_kfold(env, fr, nfolds, seed=("num", -1)):
     f = _as_frame(env.ev(fr))
     k = int(env.ev(nfolds))
     s = int(env.ev(seed))
-    r = np.random.RandomState(s if s >= 0 else 0x5F01D)
+    r = np.random.RandomState(
+        s if s >= 0 else np.random.SeedSequence().entropy % (2**32))
     y = _cat_codes(f, f.names[0]) if f.col(f.names[0]).is_categorical \
         else _col_np(f, f.names[0])
     fold = np.zeros(f.nrows, np.float64)

@@ -67,11 +67,11 @@ def test_client_x_subsets_predictors(conn, csv_path):
                                                       seed=2)
     m = est.train(x=["x0"], y="target", training_frame=fr)
     info = m._info()["models"][0]
-    assert info["output"]["names"] == ["x0"]
+    assert info["output"]["names"] == ["x0", "target"]
     # h2o-py positional order train(x, y, training_frame) works too
     m2 = h2o.estimators.H2OGradientBoostingEstimator(ntrees=3, seed=2).train(
         ["x0", "x1"], "target", fr)
-    assert set(m2._info()["models"][0]["output"]["names"]) == {"x0", "x1"}
+    assert set(m2._info()["models"][0]["output"]["names"]) == {"x0", "x1", "target"}
     with pytest.raises(ValueError, match="training_frame"):
         est.train(y="target")
 

@@ -332,6 +332,23 @@ def test_pojo_glm(tmp_path):
                    - want[i]) < 1e-4
 
 
+def test_pojo_glm_tweedie(tmp_path):
+    from h2o3_tpu.models.glm import GLMEstimator
+    r = np.random.RandomState(7)
+    fr = h2o3_tpu.Frame.from_numpy({
+        "a": r.randn(300), "b": r.randn(300),
+        "y": np.exp(r.randn(300) * 0.3) * (r.rand(300) > 0.2)})
+    m = GLMEstimator(family="tweedie", tweedie_variance_power=1.5,
+                     lambda_=0.0).train(fr, y="y")
+    mod = _load_pojo(m.download_pojo(str(tmp_path / "glm_tw.py")))
+    raw = _raw_cols(fr, mod.NAMES)
+    want = m._score_raw(fr)["predict"]
+    for i in range(0, 300, 29):
+        # tweedie link is exp(eta) — the POJO must not fall back to eta
+        assert abs(mod.score0({k: raw[k][i] for k in raw})["predict"]
+                   - want[i]) < 1e-4 * max(1.0, abs(want[i]))
+
+
 def test_pojo_deeplearning_and_kmeans(tmp_path):
     from h2o3_tpu.models.deeplearning import DeepLearningEstimator
     from h2o3_tpu.models.kmeans import KMeansEstimator

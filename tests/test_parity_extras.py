@@ -85,7 +85,7 @@ def test_xgboost_over_rest(classif_frame):
                  "response_column": "y", "ntrees": 4, "eta": 0.3,
                  "max_depth": 3, "booster": "gbtree"}, "", algo="xgboost")
     from h2o3_tpu.core.kv import DKV
-    job = DKV.get(out["job"]["key"]).join()
+    job = DKV.get(out["job"]["key"]["name"]).join()
     assert job.status == "DONE", job.exception
     m = job.result
     assert m.params["learn_rate"] == 0.3 and m.params["ntrees"] == 4

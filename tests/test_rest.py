@@ -107,13 +107,13 @@ def test_train_and_predict_over_rest(port):
                  training_frame="rest_train", response_column="y",
                  ntrees=5, max_depth=3, seed=1, model_id="rest_gbm_model")
     assert st == 200, j
-    job = _wait_job(port, j["job"]["key"])
+    job = _wait_job(port, j["job"]["key"]["name"])
     assert job["status"] == "DONE", job
     st, j = _req(port, "GET", "/3/Models/rest_gbm_model")
     assert st == 200
     md = j["models"][0]
     assert md["algo"] == "gbm"
-    assert md["training_metrics"]["AUC"] > 0.7
+    assert md["output"]["training_metrics"]["AUC"] > 0.7
     st, j = _req(port, "POST",
                  "/3/Predictions/models/rest_gbm_model/frames/rest_train")
     assert st == 200
@@ -155,7 +155,7 @@ def test_parse_endpoint(port, tmp_path):
                  source_frames=json.dumps([str(csv)]),
                  destination_frame="mini_hex")
     assert st == 200
-    _wait_job(port, j["job"]["key"])
+    _wait_job(port, j["job"]["key"]["name"])
     st, j = _req(port, "GET", "/3/Frames/mini_hex")
     assert st == 200
     assert j["frames"][0]["rows"] == 3
