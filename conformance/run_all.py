@@ -128,6 +128,10 @@ def main():
     results = []
     try:
         for u in units:
+            if proc.poll() is not None:      # backend died — restart it
+                print("  [server died; restarting]", flush=True)
+                proc, port = start_server()
+                url = f"http://127.0.0.1:{port}"
             name = "/".join(u.split("/")[-2:])
             t0 = time.time()
             try:

@@ -196,6 +196,11 @@ def metrics_v3(mm, model: Model, frame_key: str = "",
         out.update({
             "logloss": _clean(d.get("logloss")),
             "mean_per_class_error": _clean(d.get("mean_per_class_error")),
+            # multinomial AUC/AUCPR exist as fields the client probes
+            # unconditionally (metrics_base.py:126); None = "not computed"
+            "AUC": _clean(d.get("AUC")), "pr_auc": _clean(d.get("pr_auc")),
+            "multinomial_auc_table": None,
+            "multinomial_aucpr_table": None,
             "cm": {"__meta": {"schema_version": 3,
                               "schema_name": "ConfusionMatrixV3",
                               "schema_type": "ConfusionMatrix"},
@@ -220,10 +225,24 @@ def metrics_v3(mm, model: Model, frame_key: str = "",
                     d.get("residual_degrees_of_freedom"),
             })
     elif kind == "Clustering":
+        cs = d.get("centroid_stats")
+        cs_table = None
+        if isinstance(cs, dict) and cs.get("size") is not None:
+            sizes = cs["size"]
+            wss = cs.get("within_cluster_sum_of_squares",
+                         [None] * len(sizes))
+            rows = [[i + 1, float(sizes[i]),
+                     _clean(wss[i]) if i < len(wss) else None]
+                    for i in range(len(sizes))]
+            cs_table = twodim(
+                "Centroid Statistics",
+                ["centroid", "size", "within_cluster_sum_of_squares"],
+                ["int32", "float64", "float64"], rows)
         out.update({
             "tot_withinss": _clean(d.get("tot_withinss")),
             "totss": _clean(d.get("totss")),
             "betweenss": _clean(d.get("betweenss")),
+            "centroid_stats": cs_table,
         })
     if model.algo in ("glm", "gam") and kind == "Binomial":
         out.update({

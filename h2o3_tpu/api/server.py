@@ -438,6 +438,24 @@ def _frame_summary(params, body, fid=None):
     return {"frames": [j]}
 
 
+@route("GET", "/3/DownloadDataset")
+def _download_dataset(params, body):
+    """Frame → CSV stream (water/api/DownloadDataHandler) — h2o-py's
+    as_data_frame()/frame download path."""
+    fid = _unquote(params.get("frame_id"))
+    fr = DKV.get(fid)
+    if not isinstance(fr, Frame):
+        raise KeyError(f"frame {fid} not found")
+    import io
+    buf = io.StringIO()
+    fr.to_pandas().to_csv(buf, index=False)
+    data = buf.getvalue().encode()
+    return {"__bytes__": data, "__ctype__": "text/csv",
+            "__headers__": {
+                "Content-Disposition":
+                    f'attachment; filename="{fid}.csv"'}}
+
+
 @route("GET", r"/3/Frames/(?P<fid>[^/]+)/light")
 def _frame_light(params, body, fid=None):
     return _frame_one(params, body, fid=fid)
@@ -726,6 +744,126 @@ def _rapids_ep(params, body):
     return {"string": str(val)}
 
 
+@route("POST", r"/99/Grid/(?P<algo>[^/]+)")
+def _grid_build(params, body, algo=None):
+    """Grid search build (water/api/GridSearchHandler +
+    hex/grid/GridSearch.java:70). The real h2o-py posts
+    hyper_parameters as a stringified map and polls the returned job
+    (h2o-py/h2o/grid/grid_search.py:414)."""
+    from h2o3_tpu.ml.grid import GridSearch
+    cls = get_builder(algo)
+    p = {k: _coerce(v) for k, v in params.items()}
+    hyper = p.pop("hyper_parameters", None) or {}
+    if isinstance(hyper, str):
+        hyper = json.loads(hyper.replace("'", '"'))
+    criteria = p.pop("search_criteria", None)
+    if isinstance(criteria, str):
+        criteria = json.loads(criteria.replace("'", '"'))
+    frame_key = str(p.pop("training_frame", None))
+    y = p.pop("response_column", None)
+    valid_key = p.pop("validation_frame", None)
+    grid_id = p.pop("grid_id", None)
+    ignored = p.pop("ignored_columns", None)
+    if isinstance(ignored, str):
+        ignored = _wire_list(ignored)
+    fr = DKV.get(frame_key)
+    if not isinstance(fr, Frame):
+        raise KeyError(f"training_frame {frame_key} not found")
+    vf = DKV.get(str(valid_key)) if valid_key else None
+    known = cls.accepted_params()
+    fixed = {k: v for k, v in p.items() if k in known and k not in hyper}
+    if ignored:
+        fixed["ignored_columns"] = [_unquote(c) for c in ignored]
+    gs = GridSearch(cls, hyper, search_criteria=criteria,
+                    grid_id=grid_id, **fixed)
+    job = Job(f"grid {algo}", dest=gs.grid_id)
+
+    def _run(j):
+        grid = gs.train(fr, y=y, validation_frame=vf)
+        j.update(1.0, "grid done")
+        return grid
+
+    job.start(_run, background=True)
+    return {"__meta": {"schema_version": 99,
+                       "schema_name": "GridSearchSchema",
+                       "schema_type": "GridSearch"},
+            "job": job.to_dict(), "messages": [], "error_count": 0}
+
+
+def _grid_json(grid, sort_by=None, decreasing=None):
+    from h2o3_tpu.api.model_schema import twodim
+    metric = sort_by or grid.sort_metric
+    try:
+        models = grid.sorted_models(metric)
+    except Exception:
+        models = list(grid.models)
+    if decreasing is not None and str(decreasing).lower() == "true":
+        models = models[::-1]
+    hyper_names = sorted({k for m in models
+                          for k in (m.output.get("grid_params") or {})})
+    rows = []
+    for m in models:
+        gp = m.output.get("grid_params") or {}
+        mm_ = m.default_metrics
+        val = None
+        if mm_ is not None:
+            try:
+                val = float(mm_[metric.upper()
+                                if metric.lower() == "auc" else metric])
+            except Exception:
+                try:
+                    val = float(mm_["MSE"])
+                except Exception:
+                    val = None
+        rows.append([str(gp.get(h)) for h in hyper_names] +
+                    [m.key, val])
+    summary = twodim(
+        "Hyper-Parameter Search Summary",
+        hyper_names + ["model_ids", metric],
+        ["string"] * len(hyper_names) + ["string", "float64"], rows)
+    return {
+        "__meta": {"schema_version": 99, "schema_name": "GridSchemaV99",
+                   "schema_type": "Grid"},
+        "grid_id": {"name": grid.grid_id, "type": "Key<Grid>"},
+        "model_ids": [{"name": m.key, "type": "Key<Model>"}
+                      for m in models],
+        "hyper_names": hyper_names,
+        "failure_details": [f["error"] for f in grid.failures],
+        "failure_stack_traces": [f.get("stacktrace", f["error"])
+                                 for f in grid.failures],
+        "failed_params": [f["params"] for f in grid.failures],
+        "warning_details": [],
+        "export_checkpoints_dir": None,
+        "summary_table": summary,
+    }
+
+
+@route("GET", r"/99/Grids/(?P<gid>[^/]+)")
+def _grid_get(params, body, gid=None):
+    from h2o3_tpu.ml.grid import Grid
+    g = DKV.get(gid)
+    if not isinstance(g, Grid):
+        raise KeyError(f"grid {gid} not found")
+    return _grid_json(g, sort_by=params.get("sort_by"),
+                      decreasing=params.get("decreasing"))
+
+
+@route("GET", "/99/Grids")
+def _grids_list(params, body):
+    from h2o3_tpu.ml.grid import Grid
+    out = []
+    for k in list(DKV.keys()):
+        g = DKV.get_raw(k)
+        if isinstance(g, Grid):
+            out.append({"name": g.grid_id, "type": "Key<Grid>"})
+    return {"grids": out}
+
+
+@route("GET", r"/99/Models/(?P<mid>[^/]+)")
+def _model_one_v99(params, body, mid=None):
+    return _model_one(params, body, mid=mid)
+
+
 @route("POST", "/99/AutoMLBuilder")
 def _automl(params, body):
     from h2o3_tpu.automl import H2OAutoML
@@ -944,9 +1082,11 @@ class _Handler(BaseHTTPRequestHandler):
                     log.exception("handler error on %s %s", method, path)
                     out = _error_json(path, e, 500)
                     code = 500
+                extra_headers = {}
                 if isinstance(out, dict) and "__bytes__" in out:
                     payload = out["__bytes__"]
                     ctype = out.get("__ctype__", "application/octet-stream")
+                    extra_headers = out.get("__headers__") or {}
                 elif isinstance(out, dict) and "__html__" in out:
                     payload = out["__html__"].encode()
                     ctype = "text/html; charset=utf-8"
@@ -957,6 +1097,8 @@ class _Handler(BaseHTTPRequestHandler):
                 self.send_response(code)
                 self.send_header("Content-Type", ctype)
                 self.send_header("Content-Length", str(len(payload)))
+                for hk, hv in extra_headers.items():
+                    self.send_header(hk, hv)
                 self.end_headers()
                 self.wfile.write(payload)
                 return

@@ -65,9 +65,11 @@ class Job:
                 self.status = CANCELLED
                 _tl("job", f"cancelled {self.description}", key=self.key)
             except Exception as e:  # noqa: BLE001 - job boundary
-                self.status = FAILED
+                # exception BEFORE status: pollers react to FAILED by
+                # reading .exception, which must already be set
                 self.exception = "".join(
                     traceback.format_exception(type(e), e, e.__traceback__))
+                self.status = FAILED
                 _tl("job", f"failed {self.description}", key=self.key,
                     error=str(e)[:200])
                 log.error("job %s failed: %s", self.key, e)

@@ -62,7 +62,9 @@ def parse_setup(path: str, nrows_sample: int = 1000) -> dict:
     sample = pd.read_csv(path, nrows=nrows_sample)
     types = {}
     for c in sample.columns:
-        if sample[c].dtype == object:
+        # pandas >= 3.0 infers text columns as 'str' dtype, not object
+        if sample[c].dtype == object or \
+                pd.api.types.is_string_dtype(sample[c].dtype):
             types[c] = "categorical"
         else:
             types[c] = "numeric"

@@ -1,0 +1,235 @@
+"""Streaming CSV → device ingest — the FileVec / chunked-parse path.
+
+Reference: lazy byte Vecs over external files (water/fvec/FileVec.java:1)
+feeding MultiFileParseTask chunk-at-a-time (water/parser/
+ParseDataset.java:253), with cloud-wide categorical interning
+(ParseDataset.java:356-440).
+
+TPU shape of the same idea: the host reads fixed-size byte windows cut at
+line boundaries, the native threaded tokenizer
+(h2o3_tpu/native/csv_parser.cpp) parses each window, categorical levels
+are interned incrementally against a global running domain, and every
+parsed window's columns are `jax.device_put` immediately — JAX transfers
+are async, so the host parses window i+1 while window i streams over
+PCIe/tunnel to HBM. Peak host memory is one window, not the file.
+
+This is what makes north-star-scale ingest (Airlines-116M on one chip)
+possible: the 10+GB CSV never exists in host RAM at once.
+"""
+
+from __future__ import annotations
+
+import gzip
+import os
+from typing import Dict, IO, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from h2o3_tpu.frame.column import Column, T_CAT, T_NUM
+from h2o3_tpu.frame.frame import Frame
+from h2o3_tpu.parallel import mesh as mesh_mod
+from h2o3_tpu.utils.log import get_logger
+
+log = get_logger("h2o3_tpu.stream")
+
+DEFAULT_CHUNK_BYTES = 256 << 20          # one parse window
+
+
+def _open(path: str) -> IO[bytes]:
+    if path.endswith(".gz"):
+        return gzip.open(path, "rb")
+    return open(path, "rb")
+
+
+def _iter_line_chunks(paths: List[str], chunk_bytes: int):
+    """Yield (window, first_of_file) byte windows cut on newline
+    boundaries; each file's first window starts at its header line."""
+    for path in paths:
+        rem = b""
+        first_of_file = True
+        with _open(path) as f:
+            while True:
+                buf = f.read(chunk_bytes)
+                if not buf:
+                    break
+                buf = rem + buf
+                cut = buf.rfind(b"\n")
+                if cut < 0:
+                    rem = buf
+                    continue
+                rem = buf[cut + 1:]
+                yield buf[: cut + 1], first_of_file
+                first_of_file = False
+        if rem:
+            yield (rem if rem.endswith(b"\n") else rem + b"\n"), \
+                first_of_file
+
+
+class _ColAcc:
+    """Per-column accumulator: device chunk list + global domain."""
+
+    def __init__(self, name: str):
+        self.name = name
+        self.parts: List[jax.Array] = []     # device arrays (async put)
+        self.na_parts: List[jax.Array] = []
+        self.levels: Dict[str, int] = {}     # global categorical domain
+        self.order: List[str] = []
+        self.is_cat = False
+
+    def add_numeric(self, arr: np.ndarray):
+        if self.is_cat:
+            # numeric window inside a categorical column: values become
+            # their string levels (the reference re-types the column)
+            self.add_categorical(
+                np.where(np.isnan(arr), -1, 0).astype(np.int32),
+                [], raw_numeric=arr)
+            return
+        na = ~np.isfinite(arr)
+        clean = np.where(na, 0.0, arr)
+        # per-chunk integrality/range tracking for dtype narrowing at
+        # finish (the NewChunk.compress codec-selection role)
+        if not hasattr(self, "_all_int"):
+            self._all_int, self._lo, self._hi = True, np.inf, -np.inf
+        if self._all_int and np.all(clean == np.round(clean)) and \
+                np.all(np.abs(clean) < 2**31):
+            if clean.size:
+                self._lo = min(self._lo, float(clean.min()))
+                self._hi = max(self._hi, float(clean.max()))
+        else:
+            self._all_int = False
+        vals = clean.astype(np.float32)
+        self.parts.append(jax.device_put(vals))
+        self.na_parts.append(jax.device_put(na))
+
+    def add_categorical(self, codes: np.ndarray, domain: List[str],
+                        raw_numeric: Optional[np.ndarray] = None):
+        if not self.is_cat and self.parts:
+            # column promoted to categorical mid-stream: earlier numeric
+            # windows are fetched back and re-expressed as levels (rare
+            # type-drift path; one host round trip per prior window —
+            # the reference re-parses the column in the same situation)
+            old_parts, old_nas = self.parts, self.na_parts
+            self.parts, self.na_parts = [], []
+            self.is_cat = True
+            for part, na in zip(old_parts, old_nas):
+                vals = np.asarray(jax.device_get(part), np.float64)
+                vals[np.asarray(jax.device_get(na))] = np.nan
+                self.add_categorical(np.zeros(0, np.int32), [],
+                                     raw_numeric=vals)
+        self.is_cat = True
+        if raw_numeric is not None:
+            strs = np.array([None if np.isnan(v) else
+                             (f"{v:g}") for v in raw_numeric], object)
+            codes = np.empty(len(strs), np.int32)
+            for i, s in enumerate(strs):
+                if s is None:
+                    codes[i] = -1
+                else:
+                    k = self.levels.get(s)
+                    if k is None:
+                        k = self.levels[s] = len(self.order)
+                        self.order.append(s)
+                    codes[i] = k
+            remapped = codes
+        else:
+            lut = np.empty(max(len(domain), 1), np.int32)
+            for j, lvl in enumerate(domain):
+                k = self.levels.get(lvl)
+                if k is None:
+                    k = self.levels[lvl] = len(self.order)
+                    self.order.append(lvl)
+                lut[j] = k
+            remapped = np.where(codes >= 0, lut[np.maximum(codes, 0)], -1)
+        na = remapped < 0
+        self.parts.append(jax.device_put(
+            np.where(na, 0, remapped).astype(np.int32)))
+        self.na_parts.append(jax.device_put(na))
+
+    def finish(self, n: int, npad: int, shard) -> Column:
+        data = jnp.concatenate(self.parts) if len(self.parts) > 1 \
+            else self.parts[0]
+        na = jnp.concatenate(self.na_parts) if len(self.na_parts) > 1 \
+            else self.na_parts[0]
+        pad = npad - n
+        if pad:
+            data = jnp.concatenate(
+                [data, jnp.zeros((pad,), data.dtype)])
+            na = jnp.concatenate([na, jnp.ones((pad,), bool)])
+        if not self.is_cat and getattr(self, "_all_int", False):
+            # integral column: narrow on device (int8/int16/int32) — the
+            # dtype-codec role of NewChunk.compress
+            lo, hi = self._lo, self._hi
+            if -128 <= lo and hi <= 127:
+                data = data.astype(jnp.int8)
+            elif -32768 <= lo and hi <= 32767:
+                data = data.astype(jnp.int16)
+            else:
+                data = data.astype(jnp.int32)
+        data = jax.device_put(data, shard)
+        na = jax.device_put(na, shard)
+        if self.is_cat:
+            return Column(name=self.name, type=T_CAT, data=data,
+                          na_mask=na, nrows=n, domain=list(self.order))
+        return Column(name=self.name, type=T_NUM, data=data,
+                      na_mask=na, nrows=n)
+
+
+def stream_import_csv(path, destination_frame: Optional[str] = None,
+                      chunk_bytes: int = DEFAULT_CHUNK_BYTES,
+                      col_types: Optional[Dict[str, str]] = None) -> Frame:
+    """Chunked native parse with overlapped async H2D transfer."""
+    from h2o3_tpu.native import parse_csv_bytes
+    paths = [path] if isinstance(path, str) else list(path)
+    accs: Dict[str, _ColAcc] = {}
+    names: List[str] = []
+    header_line = None
+    total = 0
+    first = True
+    for window, first_of_file in _iter_line_chunks(paths, chunk_bytes):
+        if first_of_file and not first and header_line and \
+                window.startswith(header_line):
+            # repeated header in files 2..N — drop it (the reference
+            # parser likewise skips per-file headers)
+            window = window[len(header_line):]
+            if not window:
+                continue
+        res = parse_csv_bytes(window, header=first, decode=False)
+        if res is None:
+            raise RuntimeError("native csv parser unavailable")
+        cols, domains = res
+        if first:
+            names = list(cols.keys())
+            accs = {nm: _ColAcc(nm) for nm in names}
+            nl = window.find(b"\n")
+            header_line = window[: nl + 1] if nl >= 0 else None
+            first = False
+        else:
+            # headerless windows come back as C1..Cn positionally
+            cols = {names[j]: arr
+                    for j, arr in enumerate(cols.values())}
+            domains = {names[int(k[1:]) - 1] if k.startswith("C") else k: v
+                       for k, v in domains.items()}
+        nrows_w = len(next(iter(cols.values()))) if cols else 0
+        total += nrows_w
+        for nm in names:
+            arr = cols[nm]
+            forced = (col_types or {}).get(nm)
+            if nm in domains or forced == "categorical":
+                if nm in domains:
+                    accs[nm].add_categorical(arr.astype(np.int32),
+                                             domains[nm])
+                else:
+                    accs[nm].add_categorical(
+                        np.zeros(0, np.int32), [],
+                        raw_numeric=arr.astype(np.float64))
+            else:
+                accs[nm].add_numeric(np.asarray(arr, np.float64))
+    npad = mesh_mod.padded_rows(total)
+    shard = mesh_mod.row_sharding()
+    columns = [accs[nm].finish(total, npad, shard) for nm in names]
+    fr = Frame(columns, total, key=destination_frame)
+    log.info("stream-parsed %s -> %s (%d x %d)", paths[0], fr.key,
+             fr.nrows, fr.ncols)
+    return fr

@@ -45,11 +45,15 @@ class Family:
     """linkinv/variance/deviance on mu; link derivative for IRLS."""
 
     def __init__(self, name: str, tweedie_power: float = 1.5,
-                 link: Optional[str] = None):
+                 link: Optional[str] = None, theta: float = 1e-5):
         self.name = name
         self.p = tweedie_power
+        self.theta = theta       # negativebinomial inverse dispersion
+        # (may be a traced scalar inside jit — no host float() here)
         defaults = {"gaussian": "identity", "binomial": "logit",
+                    "quasibinomial": "logit", "fractionalbinomial": "logit",
                     "poisson": "log", "gamma": "log", "tweedie": "tweedie",
+                    "negativebinomial": "log",
                     "multinomial": "multinomial"}
         # "family_default" is the wire spelling of "use the default link"
         # (hex/glm/GLMModel.GLMParameters.Link.family_default)
@@ -86,7 +90,7 @@ class Family:
     def variance(self, mu):
         if self.name == "gaussian":
             return jnp.ones_like(mu)
-        if self.name == "binomial":
+        if self.name in ("binomial", "quasibinomial", "fractionalbinomial"):
             return mu * (1.0 - mu)
         if self.name == "poisson":
             return jnp.maximum(mu, 1e-10)
@@ -94,6 +98,11 @@ class Family:
             return jnp.maximum(mu * mu, 1e-10)
         if self.name == "tweedie":
             return jnp.maximum(mu, 1e-10) ** self.p
+        if self.name == "negativebinomial":
+            # var = mu + theta*mu^2 (hex/glm/GLMModel Family
+            # negativebinomial; theta = inverse dispersion)
+            th = jnp.maximum(self.theta, 1e-10)
+            return jnp.maximum(mu * (1.0 + th * mu), 1e-10)
         raise ValueError(self.name)
 
     def deviance(self, y, mu):
@@ -114,22 +123,33 @@ class Family:
             return 2.0 * (jnp.maximum(y, 0.0) ** (2 - p) / ((1 - p) * (2 - p))
                           - y * mu ** (1 - p) / (1 - p)
                           + mu ** (2 - p) / (2 - p))
+        if self.name in ("quasibinomial", "fractionalbinomial"):
+            # binomial log-likelihood deviance with real-valued y
+            mu = jnp.clip(mu, 1e-7, 1 - 1e-7)
+            return -2.0 * (y * jnp.log(mu) + (1 - y) * jnp.log1p(-mu))
+        if self.name == "negativebinomial":
+            th = jnp.maximum(self.theta, 1e-10)
+            ylogy = jnp.where(
+                y > 0, y * jnp.log(jnp.maximum(y, 1e-10) / mu), 0.0)
+            return 2.0 * (ylogy - (y + 1.0 / th) * jnp.log(
+                (1.0 + th * y) / (1.0 + th * mu)))
         raise ValueError(self.name)
 
 
 @partial(jax.jit, static_argnames=("family", "link", "use_l1"))
-def _irls_iter(X1, coef, y, w, l1, l2, family: str, link: str,
-               tweedie_power, *, use_l1: bool):
+def _irls_iter(X1, coef, y, w, off, l1, l2, family: str, link: str,
+               tweedie_power, theta=1e-5, *, use_l1: bool):
     """One full IRLS iteration on device: re-weight → Gram (psum over the
     mesh) → penalized solve. λ enters as traced scalars so the lambda
     path reuses one compiled program (GLM.java fitIRLSM per-lambda loop).
     """
-    fam = Family(family, tweedie_power, link)
-    eta = X1 @ coef
+    fam = Family(family, tweedie_power, link, theta=theta)
+    eta = X1 @ coef + off
     mu = fam.linkinv(eta)
     d = fam.dmu_deta(eta, mu)
     var = fam.variance(mu)
-    z = eta + (y - mu) / jnp.where(jnp.abs(d) < 1e-10, 1e-10, d)
+    # working response net of the fixed offset (GLMTask with offset)
+    z = eta - off + (y - mu) / jnp.where(jnp.abs(d) < 1e-10, 1e-10, d)
     w_irls = w * d * d / jnp.maximum(var, 1e-10)
     dev = jnp.sum(w * fam.deviance(y, mu))
 
@@ -157,8 +177,9 @@ def _irls_iter(X1, coef, y, w, l1, l2, family: str, link: str,
 
 
 @partial(jax.jit, static_argnames=("family", "link", "use_l1"))
-def _irls_solve(X1, coef, y, w, l1, l2, beta_eps, max_iter, family: str,
-                link: str, tweedie_power, *, use_l1: bool):
+def _irls_solve(X1, coef, y, w, off, l1, l2, beta_eps, max_iter,
+                family: str, link: str, tweedie_power, theta=1e-5, *,
+                use_l1: bool):
     """The whole IRLS loop as one compiled ``while_loop`` — per-iteration
     host syncs (one device round trip each) previously dominated GLM
     wall time on a remote-attached chip."""
@@ -169,8 +190,9 @@ def _irls_solve(X1, coef, y, w, l1, l2, beta_eps, max_iter, family: str,
 
     def body(state):
         coef, _, it = state
-        new_coef, delta, _ = _irls_iter(X1, coef, y, w, l1, l2, family,
-                                        link, tweedie_power, use_l1=use_l1)
+        new_coef, delta, _ = _irls_iter(X1, coef, y, w, off, l1, l2,
+                                        family, link, tweedie_power,
+                                        theta, use_l1=use_l1)
         return new_coef, delta, it + 1
 
     coef, _, _ = jax.lax.while_loop(
@@ -178,17 +200,47 @@ def _irls_solve(X1, coef, y, w, l1, l2, beta_eps, max_iter, family: str,
     return coef
 
 
+@partial(jax.jit, static_argnames=("family", "link", "sweeps"))
+def _irls_iter_cod(X1, coef, y, w, off, l1, l2, lo, hi, family: str,
+                   link: str, tweedie_power, theta=1e-5, *,
+                   sweeps: int = 50):
+    """One IRLS iteration solved by (optionally box-constrained) cyclic
+    coordinate descent — GLM.java:1495 fitCOD and the beta_constraints /
+    non_negative projected path."""
+    from h2o3_tpu.ops.optimize import coordinate_descent_quadratic
+    fam = Family(family, tweedie_power, link, theta=theta)
+    eta = X1 @ coef + off
+    mu = fam.linkinv(eta)
+    d = fam.dmu_deta(eta, mu)
+    var = fam.variance(mu)
+    z = eta - off + (y - mu) / jnp.where(jnp.abs(d) < 1e-10, 1e-10, d)
+    w_irls = w * d * d / jnp.maximum(var, 1e-10)
+    mesh = get_mesh()
+    xtx, xtz, _ = gram(X1, w_irls, z, mesh=mesh)
+    nobs = jnp.maximum(jnp.sum(w), 1.0)
+    A = xtx / nobs
+    q = xtz / nobs
+    Pp1 = X1.shape[1]
+    penalize = jnp.concatenate([jnp.ones(Pp1 - 1),
+                                jnp.zeros(1)]).astype(A.dtype)
+    new_coef = coordinate_descent_quadratic(A, q, l1, l2, penalize,
+                                            lower=lo, upper=hi,
+                                            sweeps=sweeps)
+    delta = jnp.max(jnp.abs(new_coef - coef))
+    return new_coef, delta
+
+
 @partial(jax.jit, static_argnames=("family", "link"))
-def _glm_value_grad(coef, X1, y, w, l2, family: str, link: str,
-                    tweedie_power):
+def _glm_value_grad(coef, X1, y, w, off, l2, family: str, link: str,
+                    tweedie_power, theta=1e-5):
     """Penalized deviance objective + gradient (GLMGradientTask role)."""
-    fam = Family(family, tweedie_power, link)
+    fam = Family(family, tweedie_power, link, theta=theta)
     Pp1 = X1.shape[1]
     penalize = jnp.concatenate([jnp.ones(Pp1 - 1), jnp.zeros(1)]).astype(jnp.float32)
     nobs = jnp.maximum(jnp.sum(w), 1.0)
 
     def obj(c):
-        mu = fam.linkinv(X1 @ c.astype(jnp.float32))
+        mu = fam.linkinv(X1 @ c.astype(jnp.float32) + off)
         dev = jnp.sum(w * fam.deviance(y, mu)) / (2.0 * nobs)
         return dev + 0.5 * l2 * jnp.sum(penalize * c * c)
 
@@ -211,6 +263,110 @@ def _multinomial_value_grad(flat, X1, y_int, w, l2, K: int):
     return jax.value_and_grad(obj)(flat)
 
 
+@partial(jax.jit, static_argnames=("K",))
+def _ordinal_value_grad(flat, X1, y_int, w, l2, K: int):
+    """Proportional-odds (cumulative logit) NLL + gradient
+    (hex/glm Family.ordinal — GLM.java ordinal path).
+
+    Params: [beta (P, no intercept term used), raw thresholds (K-1)]
+    with thresholds alpha_k = a0 + cumsum(exp(d_k)) to keep them ordered.
+    P(y<=k) = sigmoid(alpha_k - eta).
+    """
+    P = X1.shape[1] - 1            # design carries a ones column; unused
+    Xb = X1[:, :P]
+
+    def obj(fl):
+        beta = fl[:P].astype(jnp.float32)
+        a0 = fl[P]
+        deltas = fl[P + 1:]
+        alphas = jnp.concatenate(
+            [a0[None], a0 + jnp.cumsum(jnp.exp(deltas))]).astype(jnp.float32)
+        eta = Xb @ beta
+        # cumulative probs for k = 0..K-2, bracketed by 0 and 1
+        cum = jax.nn.sigmoid(alphas[None, :] - eta[:, None])
+        cum = jnp.concatenate([jnp.zeros((eta.shape[0], 1)), cum,
+                               jnp.ones((eta.shape[0], 1))], axis=1)
+        pk = jnp.take_along_axis(cum, y_int[:, None] + 1, axis=1)[:, 0] - \
+            jnp.take_along_axis(cum, y_int[:, None], axis=1)[:, 0]
+        nll = -jnp.sum(w * jnp.log(jnp.clip(pk, 1e-9, 1.0))) \
+            / jnp.maximum(jnp.sum(w), 1.0)
+        return nll + 0.5 * l2 * jnp.sum(beta * beta)
+
+    return jax.value_and_grad(obj)(flat)
+
+
+def expand_interactions(frame: Frame, inter_cols: Sequence[str]) -> Frame:
+    """Augment a frame with pairwise interaction columns among
+    ``inter_cols`` (hex/DataInfo.java:16 interactions /
+    InteractionWrappedVec semantics):
+
+      num x num   → product column  a_b
+      enum x enum → combined factor a_b with observed level pairs
+      enum x num  → per-level masked numerics a.<level>_b
+
+    Original Column objects are shared (no device copies)."""
+    import itertools
+    from h2o3_tpu.frame.column import Column, T_CAT, T_NUM
+    from h2o3_tpu.parallel import mesh as mesh_mod
+    cols = [frame.col(n) for n in frame.names]
+    n = frame.nrows
+    npad = cols[0].data.shape[0] if cols and cols[0].data is not None \
+        else mesh_mod.padded_rows(n)
+    shard = mesh_mod.row_sharding()
+    new_cols = list(cols)
+    for a, b in itertools.combinations(inter_cols, 2):
+        ca, cb = frame.col(a), frame.col(b)
+        if not ca.is_categorical and not cb.is_categorical:
+            va, vb = ca.numeric_view(), cb.numeric_view()
+            prod = va * vb
+            na = jnp.isnan(prod)
+            new_cols.append(Column(
+                name=f"{a}_{b}", type=T_NUM,
+                data=jax.device_put(jnp.where(na, 0.0, prod), shard),
+                na_mask=jax.device_put(na, shard), nrows=n))
+        elif ca.is_categorical and cb.is_categorical:
+            ka = np.asarray(ca.data)[:n]
+            kb = np.asarray(cb.data)[:n]
+            na = (np.asarray(ca.na_mask)[:n] | np.asarray(cb.na_mask)[:n])
+            combo = ka.astype(np.int64) * len(cb.domain or []) + kb
+            combo[na] = -1
+            seen = np.unique(combo[combo >= 0])
+            lut = {int(c): i for i, c in enumerate(seen)}
+            codes = np.array([lut.get(int(c), -1) for c in combo],
+                             np.int32)
+            dom = [f"{ca.domain[c // len(cb.domain)]}_"
+                   f"{cb.domain[c % len(cb.domain)]}" for c in seen]
+            codes_p = np.pad(np.where(codes < 0, 0, codes),
+                             (0, npad - n))
+            na_p = np.pad(codes < 0, (0, npad - n),
+                          constant_values=True)
+            new_cols.append(Column(
+                name=f"{a}_{b}", type=T_CAT,
+                data=jax.device_put(jnp.asarray(codes_p), shard),
+                na_mask=jax.device_put(jnp.asarray(na_p), shard),
+                nrows=n, domain=dom))
+        else:
+            cat, num = (ca, cb) if ca.is_categorical else (cb, ca)
+            cname, nname = (a, b) if ca.is_categorical else (b, a)
+            vnum = num.numeric_view()
+            codes = jnp.asarray(np.pad(
+                np.asarray(cat.data)[:n], (0, npad - n)))
+            cna = jnp.asarray(np.pad(
+                np.asarray(cat.na_mask)[:n], (0, npad - n),
+                constant_values=True))
+            for li, lvl in enumerate(cat.domain or []):
+                v = jnp.where((codes == li) & ~cna, vnum, 0.0)
+                na = jnp.isnan(v)
+                new_cols.append(Column(
+                    name=f"{cname}.{lvl}_{nname}", type=T_NUM,
+                    data=jax.device_put(jnp.where(na, 0.0, v), shard),
+                    na_mask=jax.device_put(na, shard), nrows=n))
+    out = Frame(new_cols, n)
+    from h2o3_tpu.core.kv import DKV
+    DKV.remove(out.key)      # transient view, keep it out of the store
+    return out
+
+
 class GLMModel(Model):
     algo = "glm"
 
@@ -225,6 +381,9 @@ class GLMModel(Model):
         self.features = features
 
     def _design(self, frame: Frame) -> jax.Array:
+        inter = self.params.get("interactions")
+        if inter:
+            frame = expand_interactions(frame, inter)
         di = build_datainfo(frame, self.features,
                             standardize=self.params.get("standardize", True),
                             use_all_factor_levels=self.params.get(
@@ -233,15 +392,38 @@ class GLMModel(Model):
         ones = jnp.ones((di.X.shape[0], 1), jnp.float32)
         return jnp.concatenate([di.X, ones], axis=1)
 
+    def _frame_offset(self, frame: Frame):
+        oc = self.params.get("offset_column")
+        if not oc or oc not in frame:
+            return None
+        ov = frame.col(oc).numeric_view()
+        return jnp.where(jnp.isnan(ov), 0.0, ov).astype(jnp.float32)
+
     def _eta(self, frame: Frame):
         X1 = self._design(frame)
+        off = self._frame_offset(frame)
         if self.coef_multinomial is not None:
             return X1 @ jnp.asarray(self.coef_multinomial, jnp.float32)
-        return X1 @ jnp.asarray(self.coef, jnp.float32)
+        eta = X1 @ jnp.asarray(self.coef, jnp.float32)
+        return eta if off is None else eta + off
 
     def _score_raw(self, frame: Frame) -> Dict[str, np.ndarray]:
         n = frame.nrows
         cat = self.output["category"]
+        if self.output.get("family") == "ordinal":
+            X1 = self._design(frame)
+            P = X1.shape[1] - 1
+            eta = np.asarray(X1[:, :P] @ jnp.asarray(
+                self.coef[:P], jnp.float32))[:n]
+            alphas = np.asarray(self.output["ordinal_alphas"])
+            cum = 1 / (1 + np.exp(-(alphas[None, :] - eta[:, None])))
+            cum = np.concatenate([np.zeros((n, 1)), cum,
+                                  np.ones((n, 1))], axis=1)
+            probs = np.diff(cum, axis=1)
+            out = {"predict": probs.argmax(axis=1).astype(np.int32)}
+            for k in range(probs.shape[1]):
+                out[f"p{k}"] = probs[:, k]
+            return out
         eta = self._eta(frame)
         if cat == ModelCategory.MULTINOMIAL:
             p = np.asarray(jax.nn.softmax(eta, axis=1))[:n]
@@ -308,10 +490,15 @@ class GLMEstimator(ModelBuilder):
         lambda_min_ratio=1e-4, standardize=True,
         use_all_factor_levels=False, max_iterations=50,
         beta_epsilon=1e-4, objective_epsilon=1e-6,
-        tweedie_power=1.5, seed=-1, nfolds=0, fold_assignment="auto",
-        weights_column=None, fold_column=None, ignored_columns=None,
+        tweedie_power=1.5, theta=1e-5, seed=-1, nfolds=0,
+        fold_assignment="auto",
+        weights_column=None, fold_column=None, offset_column=None,
+        ignored_columns=None,
         missing_values_handling="mean_imputation",
         compute_p_values=False, intercept=True,
+        beta_constraints=None, non_negative=False, interactions=None,
+        keep_cross_validation_models=True,
+        keep_cross_validation_predictions=False,
     )
 
     def __init__(self, **params):
@@ -331,23 +518,96 @@ class GLMEstimator(ModelBuilder):
     # ---- solvers -----------------------------------------------------
     def _fit_irlsm(self, X1, yv, w, fam: Family, l1: float, l2: float,
                    coef0: np.ndarray, nobs: float, max_iter: int,
-                   beta_eps: float) -> np.ndarray:
+                   beta_eps: float, off=None) -> np.ndarray:
+        if off is None:
+            off = jnp.zeros((X1.shape[0],), jnp.float32)
         coef = jnp.asarray(coef0, jnp.float32)
-        coef = _irls_solve(X1, coef, yv, w, jnp.float32(l1),
+        coef = _irls_solve(X1, coef, yv, w, off, jnp.float32(l1),
                            jnp.float32(l2), jnp.float32(beta_eps),
                            jnp.int32(max_iter),
                            fam.name, fam.link, jnp.float32(fam.p),
-                           use_l1=l1 > 0)
+                           jnp.float32(fam.theta), use_l1=l1 > 0)
         return np.asarray(coef)
 
+    def _fit_cod(self, X1, yv, w, fam: Family, l1: float, l2: float,
+                 coef0: np.ndarray, max_iter: int, beta_eps: float,
+                 bounds, off=None) -> np.ndarray:
+        """IRLS outer loop with a COD (box-constrained) inner solve."""
+        Pp1 = X1.shape[1]
+        if bounds is None:
+            lo = jnp.full((Pp1,), -jnp.inf, jnp.float32)
+            hi = jnp.full((Pp1,), jnp.inf, jnp.float32)
+        else:
+            lo = jnp.asarray(bounds[0], jnp.float32)
+            hi = jnp.asarray(bounds[1], jnp.float32)
+        if off is None:
+            off = jnp.zeros((X1.shape[0],), jnp.float32)
+        coef = jnp.asarray(coef0, jnp.float32)
+        for _ in range(max_iter):
+            coef, delta = _irls_iter_cod(
+                X1, coef, yv, w, off, jnp.float32(l1), jnp.float32(l2),
+                lo, hi, fam.name, fam.link, jnp.float32(fam.p),
+                jnp.float32(fam.theta))
+            if float(delta) < beta_eps:
+                break
+        return np.asarray(coef)
+
+    def _bounds_of(self, p, coef_names) -> Optional[tuple]:
+        """lower/upper coefficient bounds from beta_constraints /
+        non_negative (hex/glm/GLM.java BetaConstraints; the client ships
+        a frame with names/lower_bounds/upper_bounds columns)."""
+        Pp1 = len(coef_names) + 1
+        lo = np.full(Pp1, -np.inf)
+        hi = np.full(Pp1, np.inf)
+        if p.get("non_negative"):
+            lo[:-1] = 0.0
+        bc = p.get("beta_constraints")
+        if bc is not None:
+            from h2o3_tpu.core.kv import DKV
+            if isinstance(bc, str):
+                bc = DKV.get(bc)
+            rows: Dict[str, tuple] = {}
+            if isinstance(bc, Frame):
+                nm_col = bc.col("names")
+                if nm_col.is_categorical and nm_col.domain:
+                    codes = np.asarray(nm_col.data)[: bc.nrows]
+                    labels = [nm_col.domain[int(c)] if c >= 0 else None
+                              for c in codes]
+                else:
+                    labels = [str(v) for v in nm_col.to_numpy()]
+                lob = (bc.col("lower_bounds").to_numpy()
+                       if "lower_bounds" in bc else [None] * bc.nrows)
+                upb = (bc.col("upper_bounds").to_numpy()
+                       if "upper_bounds" in bc else [None] * bc.nrows)
+                for i, nm in enumerate(labels):
+                    rows[str(nm)] = (lob[i], upb[i])
+            elif isinstance(bc, dict):
+                rows = {k: tuple(v) for k, v in bc.items()}
+            for j, nm in enumerate(coef_names):
+                if nm in rows:
+                    l_, u_ = rows[nm]
+                    if l_ is not None and not (isinstance(l_, float)
+                                               and np.isnan(l_)):
+                        lo[j] = float(l_)
+                    if u_ is not None and not (isinstance(u_, float)
+                                               and np.isnan(u_)):
+                        hi[j] = float(u_)
+        if not (np.isfinite(lo).any() or np.isfinite(hi).any()):
+            return None
+        return lo, hi
+
     def _fit_lbfgs(self, X1, yv, w, fam: Family, l2: float,
-                   coef0: np.ndarray, nobs: float, max_iter: int) -> np.ndarray:
+                   coef0: np.ndarray, nobs: float, max_iter: int,
+                   off=None) -> np.ndarray:
+        if off is None:
+            off = jnp.zeros((X1.shape[0],), jnp.float32)
         l2d = jnp.float32(l2)
         pw = jnp.float32(fam.p)
+        th = jnp.float32(fam.theta)
 
         def vgrad(c):
             return _glm_value_grad(jnp.asarray(c, jnp.float32), X1, yv, w,
-                                   l2d, fam.name, fam.link, pw)
+                                   off, l2d, fam.name, fam.link, pw, th)
 
         coef, _, _ = lbfgs(vgrad, coef0, max_iter=max_iter)
         return np.asarray(coef)
@@ -366,7 +626,7 @@ class GLMEstimator(ModelBuilder):
 
     # ---- training ----------------------------------------------------
     def _resolve_family(self, category: str) -> str:
-        f = self.params["family"]
+        f = str(self.params["family"]).lower()
         if f != "auto":
             return f
         return {"Binomial": "binomial", "Multinomial": "multinomial",
@@ -378,10 +638,21 @@ class GLMEstimator(ModelBuilder):
         mesh = get_mesh()
         category = infer_category(frame, y)
         fam_name = self._resolve_family(category)
-        fam = Family(fam_name, float(p["tweedie_power"]), p["link"]) \
-            if fam_name != "multinomial" else None
+        fam = Family(fam_name, float(p["tweedie_power"]), p["link"],
+                     theta=float(p.get("theta") or 1e-5)) \
+            if fam_name not in ("multinomial", "ordinal") else None
 
-        di = build_datainfo(frame, x, standardize=bool(p["standardize"]),
+        di_frame = frame
+        if p.get("interactions"):
+            inter = p["interactions"]
+            if isinstance(inter, str):
+                inter = [c.strip().strip('"') for c in
+                         inter.strip("[]").split(",")]
+                p["interactions"] = inter
+            di_frame = expand_interactions(frame, inter)
+            x = list(x) + [c for c in di_frame.names
+                           if c not in frame.names]
+        di = build_datainfo(di_frame, x, standardize=bool(p["standardize"]),
                             use_all_factor_levels=bool(p["use_all_factor_levels"]),
                             missing_values_handling=p["missing_values_handling"])
         ones = jnp.ones((di.X.shape[0], 1), jnp.float32)
@@ -393,10 +664,63 @@ class GLMEstimator(ModelBuilder):
             wc = frame.col(p["weights_column"]).numeric_view()
             w = w * jnp.where(jnp.isnan(wc), 0.0, wc)
 
+        # offset_column: fixed per-row addition to eta (GLM.java offset)
+        off = None
+        if p.get("offset_column") and p["offset_column"] in frame:
+            ov = frame.col(p["offset_column"]).numeric_view()
+            off = jnp.where(jnp.isnan(ov), 0.0, ov).astype(jnp.float32)
+        off_or0 = off if off is not None else \
+            jnp.zeros((X1.shape[0],), jnp.float32)
+
         rc = frame.col(y)
         output = {"category": category, "response": y, "names": list(x),
                   "coef_names": di.coef_names, "domain": rc.domain,
                   "nclasses": rc.cardinality if rc.is_categorical else 1}
+
+        if fam_name == "ordinal":
+            if not rc.is_categorical:
+                raise ValueError("ordinal family requires a categorical "
+                                 "response (ordered levels)")
+            K = rc.cardinality
+            yv = np.asarray(rc.data)[: frame.nrows].astype(np.int32)
+            resp_na = np.asarray(rc.na_mask)[: frame.nrows]
+            yv = np.pad(yv, (0, X1.shape[0] - frame.nrows))
+            w = w * jnp.asarray(np.pad((~resp_na).astype(np.float32),
+                                       (0, X1.shape[0] - frame.nrows)))
+            y_dev = jax.device_put(yv, row_sharding(mesh))
+            l2 = _l2_of(p)
+            P = X1.shape[1] - 1
+            l2d = jnp.float32(l2)
+
+            def vgrad(c):
+                return _ordinal_value_grad(jnp.asarray(c, jnp.float32),
+                                           X1, y_dev, w, l2d, K)
+
+            x0 = np.zeros(P + K - 1)
+            x0[P + 1:] = np.log(0.5)       # small increasing gaps
+            sol, _, _ = lbfgs(vgrad, x0,
+                              max_iter=int(p["max_iterations"]) * 4)
+            beta = np.asarray(sol[:P])
+            a0 = float(sol[P])
+            alphas = np.concatenate(
+                [[a0], a0 + np.cumsum(np.exp(np.asarray(sol[P + 1:])))])
+            output["category"] = "Ordinal"
+            output["family"] = "ordinal"
+            output["ordinal_alphas"] = alphas.tolist()
+            coef_full = np.concatenate([beta, [0.0]])
+            model = GLMModel(p, output, coef_full, Family("binomial"),
+                             stats_of(di), list(x))
+            probs_np = model._score_raw(frame)
+            probs = jnp.asarray(np.stack(
+                [np.pad(probs_np[f"p{k}"],
+                        (0, X1.shape[0] - frame.nrows))
+                 for k in range(K)], axis=1))
+            model.training_metrics = mm.multinomial_metrics(
+                probs, y_dev, w, domain=rc.domain)
+            model.training_metrics.kind = "Ordinal"
+            job.update(1.0)
+            _finish(model, frame, validation_frame)
+            return model
 
         if category == ModelCategory.MULTINOMIAL:
             if p.get("compute_p_values"):
@@ -447,21 +771,32 @@ class GLMEstimator(ModelBuilder):
             raise ValueError("compute_p_values requires no regularization "
                              "(lambda = 0)")
         solver = str(p["solver"]).lower()
+        bounds = self._bounds_of(p, di.coef_names)
         if solver == "auto":
-            solver = "irlsm" if alpha > 0 or len(lambdas) > 1 else "irlsm"
+            solver = "coordinate_descent" if bounds is not None else "irlsm"
+        elif bounds is not None:
+            # constrained solves go through the projected COD path
+            solver = "coordinate_descent"
 
         coef = np.zeros(X1.shape[1])
         best = None
         for li, lam in enumerate(lambdas):
             l1 = lam * alpha
             l2 = lam * (1.0 - alpha)
-            if solver in ("l_bfgs", "lbfgs") and l1 == 0:
+            if solver in ("coordinate_descent", "coordinate_descent_naive"):
+                coef = self._fit_cod(X1, y_dev, w, fam, l1, l2, coef,
+                                     int(p["max_iterations"]),
+                                     float(p["beta_epsilon"]), bounds,
+                                     off=off_or0)
+            elif solver in ("l_bfgs", "lbfgs") and l1 == 0:
                 coef = self._fit_lbfgs(X1, y_dev, w, fam, l2, coef, nobs,
-                                       int(p["max_iterations"]))
+                                       int(p["max_iterations"]),
+                                       off=off_or0)
             else:
                 coef = self._fit_irlsm(X1, y_dev, w, fam, l1, l2, coef,
                                        nobs, int(p["max_iterations"]),
-                                       float(p["beta_epsilon"]))
+                                       float(p["beta_epsilon"]),
+                                       off=off_or0)
             job.update(1.0 / len(lambdas), f"lambda {li + 1}/{len(lambdas)}")
             best = coef
         coef = best
@@ -473,10 +808,10 @@ class GLMEstimator(ModelBuilder):
             # (GLM.java compute_p_values; lambda==0 validated up front)
             output["coefficients_table"] = _p_values_table(
                 X1, y_dev, w, jnp.asarray(coef, jnp.float32), fam,
-                di.coef_names + ["Intercept"], nobs)
+                di.coef_names + ["Intercept"], nobs, off=off_or0)
 
         model = GLMModel(p, output, coef, fam, stats_of(di), list(x))
-        mu = fam.linkinv(X1 @ jnp.asarray(coef, jnp.float32))
+        mu = fam.linkinv(X1 @ jnp.asarray(coef, jnp.float32) + off_or0)
         if category == ModelCategory.BINOMIAL:
             model.training_metrics = mm.binomial_metrics(mu, y_dev, w)
             model.output["default_threshold"] = \
@@ -512,7 +847,8 @@ def _lambda_path(p, X1, y, w, nobs, alpha, mesh) -> List[float]:
     return list(np.exp(np.linspace(np.log(lam_max), np.log(lam_min), n)))
 
 
-def _p_values_table(X1, y, w, coef, fam: Family, names, nobs: float):
+def _p_values_table(X1, y, w, coef, fam: Family, names, nobs: float,
+                    off=None):
     """Wald inference rows (name, coefficient, std_error, z_value,
     p_value) — hex/glm GLMModel coefficients table with p-values.
 
@@ -521,7 +857,7 @@ def _p_values_table(X1, y, w, coef, fam: Family, names, nobs: float):
     moment-estimated dispersion, other families the normal (z) with
     dispersion 1 (binomial/poisson) or the Pearson estimate (gamma/
     tweedie), matching the reference's computePValues path."""
-    eta = X1 @ coef
+    eta = X1 @ coef if off is None else X1 @ coef + off
     mu = fam.linkinv(eta)
     name = fam.name
     # general GLM Fisher weight: (dmu/deta)^2 / Var(mu) — exact for every

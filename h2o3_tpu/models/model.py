@@ -199,7 +199,9 @@ class ModelBuilder:
                   y: Optional[str]) -> List[str]:
         ignored = set(self.params.get("ignored_columns") or [])
         drop = ignored | ({y} if y else set())
-        drop |= {self.params.get("weights_column"), self.params.get("fold_column")}
+        drop |= {self.params.get("weights_column"),
+                 self.params.get("fold_column"),
+                 self.params.get("offset_column")}
         if x is None:
             x = [n for n in frame.names if n not in drop]
         else:

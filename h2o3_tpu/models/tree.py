@@ -278,7 +278,8 @@ def grow_tree(bins, nb, w, g, h, col_mask, *, params: TreeParams, mesh,
     leaf_stats = segment_sum(nid, stats, n_nodes=nleaf, mesh=mesh,
                              block_rows=params.block_rows)
     G, H = leaf_stats[:, 1], leaf_stats[:, 2]
-    leaf = jnp.where(leaf_stats[:, 0] > 0, -G / (H + params.reg_lambda), 0.0)
+    leaf = jnp.where(leaf_stats[:, 0] > 0,
+                     -G / (H + params.reg_lambda + 1e-10), 0.0)
     if constraints is not None:
         leaf = jnp.clip(leaf, lo, hi)   # leaves honor propagated bounds
     tree = Tree(feats, threshs, na_lefts, is_splits, leaf, leaf_stats[:, 0])

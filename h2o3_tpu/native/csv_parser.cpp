@@ -48,7 +48,7 @@ static inline bool is_na_token(const char* p, long n) {
   return false;
 }
 
-static inline bool parse_double(const char* p, long n, double* out) {
+static bool parse_double_slow(const char* p, long n, double* out) {
   // strtod needs NUL-termination; fields are short, copy to stack
   char buf[64];
   if (n <= 0 || n >= 63) return false;
@@ -62,23 +62,64 @@ static inline bool parse_double(const char* p, long n, double* out) {
   return true;
 }
 
+static const double kPow10[19] = {
+  1e0, 1e1, 1e2, 1e3, 1e4, 1e5, 1e6, 1e7, 1e8, 1e9, 1e10, 1e11,
+  1e12, 1e13, 1e14, 1e15, 1e16, 1e17, 1e18};
+
+static inline bool parse_double(const char* p, long n, double* out) {
+  // fast path for the overwhelmingly common [+-]ddd[.ddd] form — strtod
+  // plus the stack copy costs ~2x the whole tokenize loop on a 1-core
+  // host; exponents/hex/inf fall back to strtod
+  if (n <= 0) return false;
+  const char* start = p;
+  const char* e = p + n;
+  bool neg = false;
+  if (*p == '-' || *p == '+') { neg = (*p == '-'); p++; }
+  if (p == e) return false;
+  unsigned long long ip = 0;
+  int digits = 0;
+  while (p < e && *p >= '0' && *p <= '9') {
+    ip = ip * 10u + (unsigned)(*p - '0');
+    p++; digits++;
+  }
+  long frac_digits = 0;
+  if (p < e && *p == '.') {
+    p++;
+    while (p < e && *p >= '0' && *p <= '9') {
+      ip = ip * 10u + (unsigned)(*p - '0');
+      p++; digits++; frac_digits++;
+    }
+  }
+  if (p != e || digits == 0 || digits > 17)
+    return parse_double_slow(start, n, out);
+  double v = (double)ip;
+  if (frac_digits) v /= kPow10[frac_digits];
+  *out = neg ? -v : v;
+  return true;
+}
+
 // Advance over one line from `p` (< limit), invoking cb(field_idx, ptr, len)
 // per field. Returns pointer past the line terminator. Handles quoted
 // fields with "" escapes; embedded newlines inside quotes are honored.
+// dispatch table shared by every scan_line call (one core, one sep per
+// parse): building a 256-entry table per LINE dominated short-row files
+struct SpecialTable {
+  bool special[256] = {};
+  explicit SpecialTable(char sep) {
+    special[(unsigned char)sep] = special['\n'] = special['\r'] =
+        special['"'] = true;
+  }
+};
+
 template <typename F>
 static const char* scan_line(const char* p, const char* limit, char sep,
-                             F&& cb) {
+                             const bool* special, F&& cb) {
   int col = 0;
   const char* fstart = p;
   bool quoted = false;
   const char* qstart = nullptr;
   std::string unq;  // only used when a quoted field has "" escapes
   bool has_esc = false;
-
-  // dispatch table: skip runs of ordinary bytes in a tight loop
-  bool special[256] = {};
-  special[(unsigned char)sep] = special['\n'] = special['\r'] =
-      special['"'] = true;
 
   while (p < limit) {
     if (!quoted) {
@@ -182,11 +223,12 @@ void* csv_parse(const char* data, long len, char sep, int header,
   auto* out = new Parsed();
   const char* limit = data + len;
   const char* body = data;
+  SpecialTable st(sep);
 
   // header row
   std::vector<std::string> names;
   if (header) {
-    body = scan_line(data, limit, sep, [&](int, const char* p, long n) {
+    body = scan_line(data, limit, sep, st.special, [&](int, const char* p, long n) {
       names.emplace_back(p, (size_t)n);
     });
   }
@@ -211,7 +253,7 @@ void* csv_parse(const char* data, long len, char sep, int header,
   if (!ncols_guess) {
     // count fields of first line
     size_t c = 0;
-    scan_line(body, limit, sep, [&](int, const char*, long) { c++; });
+    scan_line(body, limit, sep, st.special, [&](int, const char*, long) { c++; });
     ncols_guess = c;
   }
   const size_t NC = ncols_guess;
@@ -226,7 +268,7 @@ void* csv_parse(const char* data, long len, char sep, int header,
       while (p < ch.end) {
         if (*p == '\n') { p++; continue; }                      // blank line
         if (*p == '\r' && p + 1 < ch.end && p[1] == '\n') { p += 2; continue; }
-        p = scan_line(p, limit, sep, [&](int col, const char* fp, long fn) {
+        p = scan_line(p, limit, sep, st.special, [&](int col, const char* fp, long fn) {
           if ((size_t)col >= NC) return;
           if (ch.col_is_str[col] || is_na_token(fp, fn)) return;
           double v;
@@ -264,7 +306,7 @@ void* csv_parse(const char* data, long len, char sep, int header,
         if (*p == '\n') { p++; continue; }                      // blank line
         if (*p == '\r' && p + 1 < ch.end && p[1] == '\n') { p += 2; continue; }
         long before = filled;
-        p = scan_line(p, limit, sep, [&](int col, const char* fp, long fn) {
+        p = scan_line(p, limit, sep, st.special, [&](int col, const char* fp, long fn) {
           if ((size_t)col >= NC) return;
           if (is_str[col]) {
             if (is_na_token(fp, fn)) { ch.local_codes[col].push_back(-1); return; }

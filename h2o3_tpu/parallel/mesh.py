@@ -78,13 +78,27 @@ def replicated(mesh: Optional[Mesh] = None) -> NamedSharding:
 
 
 def padded_rows(n: int, mesh: Optional[Mesh] = None, block: int = 1) -> int:
-    """Rows padded so every data-shard holds an equal, block-aligned count.
+    """Rows padded so every data-shard holds an equal, block-aligned count,
+    then rounded up to a shape BUCKET: at most 16 distinct padded sizes
+    per power of two (≤6.25% padding waste).
 
-    The analogue of H2O chunk alignment (water/fvec/Vec.java ESPC layout):
-    padding rows carry weight 0 so reductions ignore them.
+    The alignment is the analogue of H2O chunk alignment
+    (water/fvec/Vec.java ESPC layout); the bucketing is pure XLA
+    economics — every distinct row count is a fresh compilation, and
+    workflows like k-fold CV produce many near-identical sizes
+    (n·(k-1)/k for k=2..10) that would otherwise each pay the 20-40s
+    trace+compile. Padding rows carry weight 0 so reductions ignore
+    them; all math paths already mask by weight.
     """
     d = data_size(mesh) * max(block, 1)
-    return ((n + d - 1) // d) * d
+    aligned = ((n + d - 1) // d) * d
+    if aligned <= 16 * d:
+        return aligned
+    # round up to the next multiple of 2^(log2(n)-4): 16 buckets/octave
+    q = 1 << (max(aligned.bit_length() - 5, 0))
+    bucket = ((aligned + q - 1) // q) * q
+    # keep mesh/block alignment after bucketing
+    return ((bucket + d - 1) // d) * d
 
 
 def shard_rows(x, mesh: Optional[Mesh] = None, block: int = 1,

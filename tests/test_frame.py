@@ -63,3 +63,34 @@ def test_subset_and_summary(classif_frame):
     assert s["y"]["cardinality"] == 2
     sub = classif_frame[["x0", "y"]]
     assert sub.ncols == 2
+
+
+def test_stream_import_multi_file_headers(tmp_path):
+    """stream_import_csv must skip repeated headers in files 2..N and
+    handle mid-stream numeric→categorical promotion."""
+    import numpy as np
+    from h2o3_tpu.io.stream import stream_import_csv
+    p1 = tmp_path / "a.csv"
+    p2 = tmp_path / "b.csv"
+    p1.write_text("x,g\n1,aa\n2,bb\n")
+    p2.write_text("x,g\n3,aa\n4,cc\n")
+    fr = stream_import_csv([str(p1), str(p2)])
+    assert fr.nrows == 4
+    assert fr.col("g").domain == ["aa", "bb", "cc"]
+    assert np.allclose(np.sort(fr.col("x").to_numpy()), [1, 2, 3, 4])
+
+
+def test_stream_promotion_mid_stream(tmp_path):
+    import numpy as np
+    from h2o3_tpu.io.stream import stream_import_csv
+    p = tmp_path / "c.csv"
+    # first window numeric, later rows strings — tiny chunk forces
+    # multiple windows
+    rows = ["v,x"] + [f"{i},{i}" for i in range(50)] + \
+        [f"lvl{i},{i}" for i in range(50)]
+    p.write_text("\n".join(rows) + "\n")
+    fr = stream_import_csv(str(p), chunk_bytes=64)
+    assert fr.nrows == 100
+    c = fr.col("v")
+    assert c.is_categorical
+    assert "lvl1" in c.domain and "1" in c.domain

@@ -1,0 +1,178 @@
+"""GLM option-surface tests: families, COD solver, constraints,
+interactions (VERDICT r1 item 6; reference hex/glm/GLM.java surface).
+
+Oracles are closed-form / simulation-recovery checks (statsmodels is not
+available in this image; sklearn where it helps).
+"""
+
+import numpy as np
+import pytest
+
+import h2o3_tpu
+from h2o3_tpu.models.glm import GLMEstimator
+
+
+@pytest.fixture(scope="module")
+def rng():
+    return np.random.RandomState(11)
+
+
+def test_negativebinomial_recovers_coefficients(rng):
+    n = 20000
+    x0 = rng.randn(n) * 0.5
+    x1 = rng.randn(n) * 0.5
+    eta = 0.4 + 0.8 * x0 - 0.5 * x1
+    mu = np.exp(eta)
+    theta = 0.5            # var = mu + theta*mu^2
+    # NB via gamma-poisson mixture
+    lam = rng.gamma(shape=1 / theta, scale=mu * theta)
+    y = rng.poisson(lam).astype(float)
+    fr = h2o3_tpu.Frame.from_numpy({"x0": x0, "x1": x1, "y": y})
+    m = GLMEstimator(family="negativebinomial", theta=theta,
+                     lambda_=0.0, standardize=False).train(fr, y="y")
+    c = m.coefficients
+    assert abs(c["x0"] - 0.8) < 0.05
+    assert abs(c["x1"] + 0.5) < 0.05
+    assert abs(c["Intercept"] - 0.4) < 0.05
+
+
+def test_quasibinomial_numeric_response(rng):
+    n = 8000
+    x0 = rng.randn(n)
+    p1 = 1 / (1 + np.exp(-(0.3 + 1.2 * x0)))
+    y = (rng.rand(n) < p1).astype(float)      # numeric 0/1, NOT enum
+    fr = h2o3_tpu.Frame.from_numpy({"x0": x0, "y": y})
+    m = GLMEstimator(family="quasibinomial", lambda_=0.0,
+                     standardize=False).train(fr, y="y")
+    assert abs(m.coefficients["x0"] - 1.2) < 0.15
+
+
+def test_fractionalbinomial_fractional_response(rng):
+    n = 8000
+    x0 = rng.randn(n)
+    mu = 1 / (1 + np.exp(-(0.2 + 0.9 * x0)))
+    y = np.clip(mu + rng.randn(n) * 0.05, 0.0, 1.0)   # fractions in [0,1]
+    fr = h2o3_tpu.Frame.from_numpy({"x0": x0, "y": y})
+    m = GLMEstimator(family="fractionalbinomial", lambda_=0.0,
+                     standardize=False).train(fr, y="y")
+    assert abs(m.coefficients["x0"] - 0.9) < 0.1
+
+
+def test_coordinate_descent_matches_irlsm(rng):
+    n = 5000
+    X = rng.randn(n, 4)
+    y = X @ np.array([1.0, -2.0, 0.5, 0.0]) + rng.randn(n) * 0.3
+    cols = {f"x{i}": X[:, i] for i in range(4)}
+    cols["y"] = y
+    fr = h2o3_tpu.Frame.from_numpy(cols)
+    m_ir = GLMEstimator(family="gaussian", solver="irlsm", lambda_=0.0,
+                        standardize=False).train(fr, y="y")
+    m_cd = GLMEstimator(family="gaussian", solver="coordinate_descent",
+                        lambda_=0.0, standardize=False).train(fr, y="y")
+    for k in m_ir.coefficients:
+        assert abs(m_ir.coefficients[k] - m_cd.coefficients[k]) < 1e-3, k
+
+
+def test_non_negative_constraint(rng):
+    n = 5000
+    X = rng.randn(n, 3)
+    # true beta has a negative component the constraint must clip to 0
+    y = X @ np.array([1.0, -2.0, 0.5]) + rng.randn(n) * 0.3
+    cols = {f"x{i}": X[:, i] for i in range(3)}
+    cols["y"] = y
+    fr = h2o3_tpu.Frame.from_numpy(cols)
+    m = GLMEstimator(family="gaussian", non_negative=True, lambda_=0.0,
+                     standardize=False).train(fr, y="y")
+    c = m.coefficients
+    assert c["x1"] >= -1e-6          # clipped at zero
+    assert abs(c["x0"] - 1.0) < 0.1
+    assert c["x1"] < 0.05
+
+
+def test_beta_constraints_box(rng):
+    n = 5000
+    X = rng.randn(n, 2)
+    y = X @ np.array([2.0, -1.0]) + rng.randn(n) * 0.2
+    fr = h2o3_tpu.Frame.from_numpy({"x0": X[:, 0], "x1": X[:, 1], "y": y})
+    m = GLMEstimator(family="gaussian", lambda_=0.0, standardize=False,
+                     beta_constraints={"x0": (0.0, 0.5)}).train(fr, y="y")
+    c = m.coefficients
+    assert -1e-6 <= c["x0"] <= 0.5 + 1e-6
+    assert abs(c["x1"] + 1.0) < 0.2   # unconstrained coef still fits
+
+
+def test_interactions_num_num(rng):
+    n = 10000
+    a = rng.randn(n)
+    b = rng.randn(n)
+    y = 1.0 + 0.5 * a - 0.25 * b + 2.0 * a * b + rng.randn(n) * 0.1
+    fr = h2o3_tpu.Frame.from_numpy({"a": a, "b": b, "y": y})
+    m = GLMEstimator(family="gaussian", lambda_=0.0, standardize=False,
+                     interactions=["a", "b"]).train(fr, y="y")
+    c = m.coefficients
+    assert abs(c["a_b"] - 2.0) < 0.05
+    assert abs(c["a"] - 0.5) < 0.05
+    # scoring path expands the same interactions
+    pred = m.predict(fr).col("predict").to_numpy()
+    mse = float(np.mean((pred - y) ** 2))
+    assert mse < 0.05
+
+
+def test_interactions_cat_num(rng):
+    n = 10000
+    g = rng.choice(["u", "v"], n)
+    x = rng.randn(n)
+    slope = np.where(g == "u", 1.5, -1.5)
+    y = slope * x + rng.randn(n) * 0.1
+    fr = h2o3_tpu.Frame.from_numpy({"g": g, "x": x, "y": y},
+                                   categorical=["g"])
+    m = GLMEstimator(family="gaussian", lambda_=0.0, standardize=False,
+                     interactions=["g", "x"]).train(fr, y="y")
+    pred = m.predict(fr).col("predict").to_numpy()
+    assert float(np.mean((pred - y) ** 2)) < 0.05
+
+
+def test_ordinal_proportional_odds(rng):
+    n = 12000
+    x = rng.randn(n)
+    eta = 1.4 * x
+    # 3 ordered levels via latent logistic with thresholds -0.8, 0.9
+    u = rng.logistic(size=n)
+    lat = eta + u
+    # level names chosen so lexicographic interning preserves the
+    # ordinal order (the reference likewise uses domain order as the
+    # ordinal order)
+    y = np.where(lat < -0.8, "l0_low", np.where(lat < 0.9, "l1_mid",
+                                                "l2_high"))
+    fr = h2o3_tpu.Frame.from_numpy({"x": x, "y": y}, categorical=["y"])
+    m = GLMEstimator(family="ordinal", lambda_=0.0,
+                     standardize=False).train(fr, y="y")
+    pred = m.predict(fr)
+    assert {"p0", "p1", "p2"} <= set(pred.names)
+    probs = np.stack([pred.col(f"p{k}").to_numpy() for k in range(3)], 1)
+    assert np.allclose(probs.sum(1), 1.0, atol=1e-5)
+    acc = float((pred.col("predict").to_numpy()
+                 == np.asarray(fr.col("y").data)[:n]).mean())
+    assert acc > 0.5            # near the Bayes rate for this noise level
+    # parameter recovery is the sharper check
+    assert abs(float(m.coef[0]) - 1.4) < 0.1
+    alphas = m.output["ordinal_alphas"]
+    assert abs(alphas[0] + 0.8) < 0.1 and abs(alphas[1] - 0.9) < 0.1
+
+
+def test_glm_offset_column(rng):
+    n = 8000
+    x0 = rng.randn(n)
+    off = rng.randn(n) * 0.5
+    y = 2.0 + 1.5 * x0 + off + rng.randn(n) * 0.2
+    fr = h2o3_tpu.Frame.from_numpy({"x0": x0, "off": off, "y": y})
+    m = GLMEstimator(family="gaussian", lambda_=0.0, standardize=False,
+                     offset_column="off").train(fr, y="y")
+    c = m.coefficients
+    # with the offset absorbed, the slope/intercept are recovered and
+    # "off" is NOT a coefficient
+    assert "off" not in c
+    assert abs(c["x0"] - 1.5) < 0.05
+    assert abs(c["Intercept"] - 2.0) < 0.05
+    pred = m.predict(fr).col("predict").to_numpy()
+    assert float(np.mean((pred - y) ** 2)) < 0.1
