@@ -18,6 +18,17 @@ Public API mirrors the h2o-py module surface (h2o-py/h2o/h2o.py):
 ``init``, ``import_file``, ``H2OFrame``-like ``Frame``, estimator classes.
 """
 
+try:
+    # pandas >= 3.0 backs str columns with pyarrow; libarrow segfaults
+    # under this image's threading profile (observed: handler threads in
+    # the REST server dying inside libarrow.so during frame ops). Python
+    # string storage sidesteps the native library entirely — string work
+    # is host-side control plane here, never the hot path.
+    import pandas as _pd
+    _pd.set_option("mode.string_storage", "python")
+except Exception:
+    pass
+
 from h2o3_tpu.version import __version__
 from h2o3_tpu.core.cloud import init, cluster_info, shutdown
 from h2o3_tpu.frame.frame import Frame

@@ -370,6 +370,59 @@ def model_to_v3(model: Model) -> dict:
         "model_summary": None,
         "help": {},
     }
+    # GLM/GAM: coefficients_table with raw + standardized coefficients
+    # (hex/glm GLMModel output; client coef()/coef_norm() read it,
+    # h2o-py/h2o/model/model_base.py:685)
+    if model.algo in ("glm", "gam") and getattr(model, "coef", None) \
+            is not None and out_src.get("coef_names") is not None \
+            and getattr(model, "coef_multinomial", None) is None \
+            and out_src.get("family") != "ordinal":
+        names = list(out_src["coef_names"]) + ["Intercept"]
+        coefs = np.asarray(model.coef, np.float64)
+        mus = np.asarray(out_src.get("coef_means") or
+                         [0.0] * (len(names) - 1), np.float64)
+        sds = np.asarray(out_src.get("coef_sds") or
+                         [1.0] * (len(names) - 1), np.float64)
+        if out_src.get("standardized"):
+            std_c = coefs.copy()
+            raw = coefs.copy()
+            raw[:-1] = std_c[:-1] / sds
+            raw[-1] = std_c[-1] - float(np.sum(std_c[:-1] * mus / sds))
+        else:
+            raw = coefs.copy()
+            std_c = coefs.copy()
+            std_c[:-1] = raw[:-1] * sds
+            std_c[-1] = raw[-1] + float(np.sum(raw[:-1] * mus))
+        rows = [[nm, float(rc_), float(sc_)]
+                for nm, rc_, sc_ in zip(names, raw, std_c)]
+        rows = [rows[-1]] + rows[:-1]     # Intercept first (reference order)
+        output["coefficients_table"] = twodim(
+            "Coefficients",
+            ["names", "coefficients", "standardized_coefficients"],
+            ["string", "float64", "float64"], rows,
+            "glm coefficients")
+
+    # KMeans: centers tables (client centers()/centers_std() read
+    # output.centers.cell_values, h2o-py/h2o/model/models/clustering.py:233)
+    if model.algo == "kmeans" and out_src.get("centers") is not None:
+        cvals = out_src["centers"]
+        rows = [[i + 1] + [float(v) for v in c]
+                for i, c in enumerate(cvals)]
+        width = len(rows[0]) - 1 if rows else 0
+        cand = list(out_src.get("coef_names") or [])
+        if len(cand) != width:
+            cand = list(out_src.get("names") or [])[:width]
+        cols_t = ["centroid"] + cand
+        output["centers"] = twodim(
+            "Cluster means", cols_t,
+            ["int32"] + ["float64"] * (len(cols_t) - 1), rows)
+        if out_src.get("centers_std") is not None:
+            rows_s = [[i + 1] + [float(v) for v in c]
+                      for i, c in enumerate(out_src["centers_std"])]
+            output["centers_std"] = twodim(
+                "Standardized cluster means", cols_t,
+                ["int32"] + ["float64"] * (len(cols_t) - 1), rows_s)
+
     # algo-specific output extras (GLM coefficients, KMeans centers, ...)
     for k, v in out_src.items():
         if k in ("category", "names", "response", "domain", "varimp",

@@ -24,6 +24,8 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import numpy as np
 
+from h2o3_tpu.parallel.mesh import fetch_replicated as _fetch_np
+
 from h2o3_tpu.core import cloud as cloud_mod
 from h2o3_tpu.core.job import Job, list_jobs
 from h2o3_tpu.core.kv import DKV
@@ -101,8 +103,8 @@ def _col_json(fr: Frame, name: str, row_offset: int, rows: int,
         data = []
     elif c.is_categorical:
         domain = list(c.domain or [])
-        codes = np.asarray(c.data)[lo:hi].astype(np.int64)
-        na = np.asarray(c.na_mask)[lo:hi]
+        codes = _fetch_np(c.data)[lo:hi].astype(np.int64)
+        na = _fetch_np(c.na_mask)[lo:hi]
         data = [None if m else int(v) for v, m in zip(codes, na)]
     else:
         vals = np.asarray(c.to_numpy()[lo:hi], np.float64)
@@ -617,6 +619,7 @@ def _predict(params, body, mid=None, fid=None):
     def _flag(name):
         return str(params.get(name, "")).lower() in ("1", "true", "yes")
     for flag, meth in (("leaf_node_assignment", "predict_leaf_node_assignment"),
+                       ("predict_staged_proba", "staged_predict_proba"),
                        ("predict_contributions", "predict_contributions")):
         if _flag(flag):
             fn = getattr(m, meth, None)

@@ -1,14 +1,17 @@
-"""AutoML — budgeted multi-algorithm search + stacked ensembles.
+"""AutoML — step-provider modeling plan + budgeted execution.
 
 Reference: ai/h2o/automl/AutoML.java:49 — planWork (AutoML.java:420)
 allocates a budget across modeling steps from ModelingStepsProviders
-(modeling/{GLM,GBM,DRF,DeepLearning,StackedEnsemble,...}StepsProvider),
-learn (AutoML.java:760) executes defaults then random grids under
-max_models / max_runtime_secs, every model cross-validated, results
-ranked in hex.leaderboard.Leaderboard, StackedEnsemble best-of-family +
-all-models trained last.
+(modeling/{XGBoost,GLM,GBM,DRF,DeepLearning,StackedEnsemble}
+StepsProvider), learn (AutoML.java:760) executes defaults → grids →
+exploitation under max_models / max_runtime_secs with per-model caps,
+every model cross-validated, results ranked in
+hex.leaderboard.Leaderboard, StackedEnsembles last; optional
+TargetEncoding preprocessing (ai/h2o/automl/preprocessing/
+TargetEncoding.java) for tree algos on high-cardinality categoricals.
 
-Same plan here; every candidate trains with nfolds CV on the full mesh.
+The step plan lives in automl/steps.py; budget/per-model-cap
+enforcement in automl/executor.py.
 """
 
 from __future__ import annotations
@@ -16,6 +19,8 @@ from __future__ import annotations
 import time
 from typing import List, Optional, Sequence
 
+from h2o3_tpu.automl.executor import Budget, train_capped
+from h2o3_tpu.automl.steps import Step, modeling_plan
 from h2o3_tpu.frame.frame import Frame
 from h2o3_tpu.ml.ensemble import StackedEnsembleEstimator
 from h2o3_tpu.ml.grid import GridSearch
@@ -24,35 +29,6 @@ from h2o3_tpu.models import get_builder
 from h2o3_tpu.utils.log import get_logger
 
 log = get_logger("h2o3_tpu.automl")
-
-
-def _default_steps(seed: int) -> List[dict]:
-    """The modeling plan (modeling/*StepsProvider defaults, in the
-    reference's execution order: defaults first, then grids)."""
-    return [
-        {"algo": "glm", "name": "GLM_1",
-         "params": {"family": "auto", "lambda_search": True, "nlambdas": 10}},
-        {"algo": "gbm", "name": "GBM_1",
-         "params": {"ntrees": 50, "max_depth": 6, "learn_rate": 0.1,
-                    "sample_rate": 0.8, "col_sample_rate_per_tree": 0.8,
-                    "seed": seed}},
-        {"algo": "gbm", "name": "GBM_2",
-         "params": {"ntrees": 60, "max_depth": 7, "learn_rate": 0.08,
-                    "sample_rate": 0.9, "seed": seed + 1}},
-        {"algo": "gbm", "name": "GBM_3",
-         "params": {"ntrees": 40, "max_depth": 4, "learn_rate": 0.15,
-                    "seed": seed + 2}},
-        {"algo": "drf", "name": "DRF_1",
-         "params": {"ntrees": 50, "max_depth": 12, "seed": seed}},
-        {"algo": "deeplearning", "name": "DeepLearning_1",
-         "params": {"hidden": [64, 64], "epochs": 10, "seed": seed,
-                    "stopping_rounds": 3}},
-        {"grid": True, "algo": "gbm", "name": "GBM_grid_1",
-         "hyper": {"max_depth": [3, 5, 7, 9],
-                   "learn_rate": [0.05, 0.1, 0.2],
-                   "sample_rate": [0.7, 0.9, 1.0]},
-         "params": {"ntrees": 40, "seed": seed}},
-    ]
 
 
 class H2OAutoML:
@@ -72,7 +48,8 @@ class H2OAutoML:
                  stopping_rounds: int = 3, stopping_tolerance: float = 1e-3,
                  keep_cross_validation_predictions: bool = True,
                  verbosity: str = "warn", balance_classes: bool = False,
-                 max_runtime_secs_per_model: float = 0.0):
+                 max_runtime_secs_per_model: float = 0.0,
+                 preprocessing: Optional[Sequence[str]] = None):
         self.max_models = int(max_models)
         self.max_runtime_secs = float(max_runtime_secs)
         self.seed = int(seed) if int(seed) >= 0 else 5723
@@ -86,6 +63,8 @@ class H2OAutoML:
         self.stopping_rounds = int(stopping_rounds)
         self.stopping_tolerance = float(stopping_tolerance)
         self.max_runtime_secs_per_model = float(max_runtime_secs_per_model)
+        self.preprocessing = list(preprocessing or [])
+        self.event_log: List[dict] = []
         if balance_classes:
             log.warning("balance_classes is not implemented; ignoring")
 
@@ -105,64 +84,126 @@ class H2OAutoML:
         return self.leaderboard_obj
 
     def predict(self, frame: Frame) -> Frame:
+        if getattr(self, "_te_model", None) is not None:
+            # models trained on target-encoded columns; encode the
+            # scoring frame the same way (TargetEncoding preprocessing)
+            frame = self._te_model.transform(frame)
         return self.leader.predict(frame)
 
     # -- train ---------------------------------------------------------
+    def _maybe_target_encode(self, frame: Frame, y: str, x):
+        """Optional TargetEncoding preprocessing for tree algos
+        (ai/h2o/automl/preprocessing/TargetEncoding.java): encode
+        categorical predictors with cardinality >= 25 using kfold-safe
+        encodings; returns (encoded_frame, te_model) or (frame, None)."""
+        if "target_encoding" not in self.preprocessing:
+            return frame, None
+        high_card = [n for n in (x or frame.names)
+                     if n != y and frame.col(n).is_categorical
+                     and frame.col(n).cardinality >= 25]
+        if not high_card:
+            return frame, None
+        from h2o3_tpu.models.targetencoder import TargetEncoderEstimator
+        te = TargetEncoderEstimator(
+            data_leakage_handling="loo", noise=0.01,
+            blending=True, seed=self.seed).train(frame, y=y, x=high_card)
+        enc = te.transform(frame, as_training=True)
+        self._log_event("preprocessing", f"target-encoded {high_card}")
+        return enc, te
+
+    def _log_event(self, stage: str, message: str):
+        self.event_log.append({"timestamp": time.time(), "stage": stage,
+                               "message": message})
+        log.info("automl[%s]: %s", stage, message)
+
+    def _lr_annealing_step(self, budget, training_frame, y, x):
+        """Exploitation (GBMStepsProvider lr_annealing): retrain the best
+        GBM so far with more trees and an annealed learn rate."""
+        best_gbm = next((m for m in self.leaderboard_obj.sorted_models()
+                         if m.algo == "gbm"), None)
+        if best_gbm is None:
+            return None
+        params = {k: v for k, v in best_gbm.params.items()
+                  if k in get_builder("gbm").accepted_params()}
+        params.update(ntrees=max(int(params.get("ntrees", 50) * 2), 100),
+                      learn_rate=float(params.get("learn_rate", 0.1)) * 0.5,
+                      stopping_rounds=3, nfolds=self.nfolds)
+        return train_capped(get_builder("gbm")(**params),
+                            training_frame, y, x, budget)
+
     def train(self, y: str, training_frame: Frame,
               x: Optional[Sequence[str]] = None,
               validation_frame: Optional[Frame] = None,
               leaderboard_frame: Optional[Frame] = None):
         t0 = time.time()
-        deadline = (t0 + self.max_runtime_secs
-                    if self.max_runtime_secs else None)
-        steps = _default_steps(self.seed)
-        budget_models = self.max_models or 10 ** 9
+        budget = Budget(self.max_models, self.max_runtime_secs,
+                       self.max_runtime_secs_per_model)
+        plan = modeling_plan(self.seed, include=self.include,
+                             exclude=self.exclude)
+        self._log_event("init", f"plan: {[st.id for st in plan]}")
+        training_frame, te_model = self._maybe_target_encode(
+            training_frame, y, x)
+        self._te_model = te_model
+        if te_model is not None and x is not None:
+            # explicit predictor list: the encoded columns must join it
+            x = list(x) + [c for c in training_frame.names
+                           if c.endswith("_te")]
         trained: List = []
 
-        def out_of_budget():
-            if len(trained) >= budget_models:
-                return True
-            return deadline is not None and time.time() > deadline
-
-        for step in steps:
-            algo = step["algo"]
-            if not self._allowed(algo) or out_of_budget():
-                continue
+        for step in plan:
+            if budget.exhausted():
+                self._log_event("budget", "budget exhausted; stopping plan")
+                break
+            if step.kind == "ensemble":
+                continue        # ensembles run after the loop
             try:
-                if step.get("grid"):
-                    remaining = budget_models - len(trained)
-                    budget_s = (max(0.0, deadline - time.time())
-                                if deadline else 0)
+                if step.kind == "exploitation":
+                    m = self._lr_annealing_step(budget, training_frame, y, x)
+                    if m is not None:
+                        m.output["automl_step"] = step.id
+                        trained.append(m)
+                        self.leaderboard_obj.add(m)
+                        self._log_event("exploitation", f"{step.id} done")
+                    continue
+                cls = get_builder(step.algo)
+                if step.kind == "grid":
+                    remaining = budget.remaining_models()
+                    rem_s = budget.remaining_secs()
                     gs = GridSearch(
-                        get_builder(algo),
-                        step["hyper"],
-                        search_criteria={"strategy": "RandomDiscrete",
-                                         "max_models": min(remaining, 5),
-                                         "max_runtime_secs": budget_s,
-                                         "seed": self.seed},
-                        **{**step["params"], "nfolds": self.nfolds})
+                        cls, step.hyper,
+                        search_criteria={
+                            "strategy": "RandomDiscrete",
+                            "max_models": min(remaining, step.grid_models),
+                            "max_runtime_secs": rem_s or 0,
+                            "seed": self.seed},
+                        **{**step.params, "nfolds": self.nfolds})
                     grid = gs.train(training_frame, y=y, x=x)
                     for m in grid.models:
-                        m.output["automl_step"] = step["name"]
+                        m.output["automl_step"] = step.id
+                    budget.trained += len(grid.models)
                     trained.extend(grid.models)
                     self.leaderboard_obj.add(*grid.models)
                 else:
-                    params = {**step["params"], "nfolds": self.nfolds}
-                    # wire AutoML early stopping into builders that take it
-                    cls = get_builder(algo)
-                    if "stopping_rounds" in cls.DEFAULTS:
+                    params = {**step.params, "nfolds": self.nfolds}
+                    if "stopping_rounds" in getattr(cls, "DEFAULTS", {}):
                         params.setdefault("stopping_rounds",
                                           self.stopping_rounds)
                         params.setdefault("stopping_tolerance",
                                           self.stopping_tolerance)
-                    m = cls(**params).train(training_frame, y=y, x=x)
-                    m.output["automl_step"] = step["name"]
+                    params = {k: v for k, v in params.items()
+                              if k in cls.accepted_params()}
+                    m = train_capped(cls(**params), training_frame, y, x,
+                                     budget)
+                    m.output["automl_step"] = step.id
                     trained.append(m)
                     self.leaderboard_obj.add(m)
-                log.info("automl: %s done (%d models, %.0fs elapsed)",
-                         step["name"], len(trained), time.time() - t0)
+                self._log_event("model",
+                                f"{step.id} done ({budget.trained} models, "
+                                f"{time.time() - t0:.0f}s)")
+            except TimeoutError as e:
+                self._log_event("timeout", f"{step.id}: {e}")
             except Exception as e:
-                log.warning("automl step %s failed: %s", step["name"], e)
+                self._log_event("error", f"{step.id} failed: {e}")
 
         # stacked ensembles last (StackedEnsembleStepsProvider):
         # best-of-family + all-models
@@ -181,7 +222,8 @@ class H2OAutoML:
                     se.output["automl_step"] = "StackedEnsemble_BestOfFamily"
                     self.leaderboard_obj.add(se)
                 except Exception as e:
-                    log.warning("automl best-of-family ensemble failed: %s", e)
+                    self._log_event("error",
+                                    f"best-of-family ensemble failed: {e}")
             if len(with_cv) > max(2, len(best_of_family)):
                 try:
                     se2 = StackedEnsembleEstimator(
@@ -190,9 +232,11 @@ class H2OAutoML:
                     se2.output["automl_step"] = "StackedEnsemble_AllModels"
                     self.leaderboard_obj.add(se2)
                 except Exception as e:
-                    log.warning("automl all-models ensemble failed: %s", e)
+                    self._log_event("error",
+                                    f"all-models ensemble failed: {e}")
 
-        log.info("automl done: %d models in %.0fs; leader=%s",
-                 len(self.leaderboard_obj.models), time.time() - t0,
-                 self.leader.key if self.leader else None)
+        self._log_event("done",
+                        f"{len(self.leaderboard_obj.models)} models in "
+                        f"{time.time() - t0:.0f}s; leader="
+                        f"{self.leader.key if self.leader else None}")
         return self.leader

@@ -1,0 +1,83 @@
+"""AutoML step executor — budget accounting + per-model runtime caps.
+
+Reference: ai/h2o/automl/ModelingStepsExecutor (driven from
+AutoML.java:760 learn) — runs each ModelingStep under the global
+max_models / max_runtime_secs budget, with per-model
+max_runtime_secs_per_model enforced by cancelling the model's Job when
+the cap expires (the reference passes the cap into
+Model.Parameters._max_runtime_secs; here a watchdog cancels the Job,
+which every builder honours at its next progress checkpoint).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import List, Optional
+
+from h2o3_tpu.utils.log import get_logger
+
+log = get_logger("h2o3_tpu.automl")
+
+
+class Budget:
+    """max_models / max_runtime_secs / per-model cap accounting
+    (AutoML.java planWork time allocation)."""
+
+    def __init__(self, max_models: int, max_runtime_secs: float,
+                 per_model_secs: float):
+        self.max_models = max_models or 10 ** 9
+        self.deadline = (time.time() + max_runtime_secs
+                         if max_runtime_secs else None)
+        self.per_model_secs = per_model_secs
+        self.trained = 0
+
+    def exhausted(self) -> bool:
+        if self.trained >= self.max_models:
+            return True
+        return self.deadline is not None and time.time() > self.deadline
+
+    def remaining_models(self) -> int:
+        return max(0, self.max_models - self.trained)
+
+    def remaining_secs(self) -> Optional[float]:
+        if self.deadline is None:
+            return None
+        return max(0.0, self.deadline - time.time())
+
+    def model_cap(self) -> Optional[float]:
+        """Per-model wallclock cap: the explicit cap, bounded by what is
+        left of the global budget."""
+        caps = []
+        if self.per_model_secs:
+            caps.append(self.per_model_secs)
+        rem = self.remaining_secs()
+        if rem is not None:
+            caps.append(rem)
+        return min(caps) if caps else None
+
+
+def train_capped(builder, frame, y, x, budget: Budget):
+    """Train one model under the per-model cap.
+
+    The builder runs as a background Job; a watchdog cancels it when the
+    cap expires (Job.cancel raises JobCancelledException at the next
+    job.update checkpoint — every training loop calls update at least
+    once per scan chunk / IRLS lambda / DL epoch)."""
+    cap = budget.model_cap()
+    job = builder.train(frame, y=y, x=x, background=True)
+    timer = None
+    if cap:
+        timer = threading.Timer(cap, job.cancel)
+        timer.daemon = True
+        timer.start()
+    job.join()
+    if timer:
+        timer.cancel()
+    if job.status == "CANCELLED":
+        raise TimeoutError(
+            f"max_runtime_secs_per_model ({cap:.0f}s) exceeded")
+    if job.status != "DONE":
+        raise RuntimeError(job.exception or f"job {job.status}")
+    budget.trained += 1
+    return job.result

@@ -92,6 +92,14 @@ def _numeric_edges(x: np.ndarray, nbins: int,
         if hi <= lo:
             return np.zeros((0,), dtype=np.float32)
         return np.linspace(lo, hi, nbins + 1)[1:-1].astype(np.float32)
+    if method == "random":
+        # XRT (extremely randomized trees): random split thresholds over
+        # the value range (DRFStepsProvider XRT / DHistogram Random type)
+        lo, hi = float(v.min()), float(v.max())
+        if hi <= lo:
+            return np.zeros((0,), dtype=np.float32)
+        rng = np.random.RandomState(abs(hash((lo, hi))) % (2**31))
+        return np.sort(rng.uniform(lo, hi, nbins - 1)).astype(np.float32)
     if v.size > 200_000:  # sketch on a sample, like the reference's ExactQuantilesToUse cap
         rng = np.random.RandomState(0xC0FFEE)
         v = v[rng.randint(0, v.size, 200_000)]
@@ -181,7 +189,8 @@ def bin_frame(frame: Frame, features: Sequence[str], nbins: int = 64,
         bins = jnp.zeros((frame.nrows_padded, 0), jnp.int32)
     if sharding is not None:
         from h2o3_tpu.parallel.mesh import row_sharding
-        bins = jax.device_put(bins, row_sharding())
+        from h2o3_tpu.parallel.mesh import put_sharded
+        bins = put_sharded(bins, row_sharding())
 
     return BinnedMatrix(bins=bins, nbins=nb_dev, edges=edges_dev,
                         is_cat=is_cat, names=names, nbins_total=B,

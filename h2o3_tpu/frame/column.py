@@ -73,7 +73,8 @@ class Column:
             return self.strings[: self.nrows]
         host = getattr(self, "_host_cache", None)
         if host is None:
-            data, mask = jax.device_get((self.data, self.na_mask))
+            from h2o3_tpu.parallel.mesh import fetch_replicated
+            data, mask = fetch_replicated((self.data, self.na_mask))
             x = data[: self.nrows].astype(np.float64)
             x[mask[: self.nrows]] = np.nan
             host = x
@@ -92,7 +93,8 @@ def prefetch_host(cols: List["Column"]) -> None:
             if c.type != T_STR and getattr(c, "_host_cache", None) is None]
     if not todo:
         return
-    fetched = jax.device_get([(c.data, c.na_mask) for c in todo])
+    from h2o3_tpu.parallel.mesh import fetch_replicated
+    fetched = fetch_replicated([(c.data, c.na_mask) for c in todo])
     for c, (data, mask) in zip(todo, fetched):
         x = data[: c.nrows].astype(np.float64)
         x[mask[: c.nrows]] = np.nan
@@ -149,8 +151,9 @@ def column_from_numpy(name: str, values: np.ndarray, nrows_padded: int,
 
     data = np.pad(data, (0, pad))
     na = np.pad(na, (0, pad), constant_values=True)  # padding rows are NA
+    from h2o3_tpu.parallel.mesh import put_sharded
     return Column(
         name=name, type=ctype,
-        data=jax.device_put(data, sharding),
-        na_mask=jax.device_put(na, sharding),
+        data=put_sharded(data, sharding),
+        na_mask=put_sharded(na, sharding),
         nrows=n, domain=domain)

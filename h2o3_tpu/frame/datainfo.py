@@ -154,3 +154,24 @@ def stats_of(di: DataInfo) -> dict:
     """Training stats needed to rebuild the view on a scoring frame."""
     return {"num_means": di.num_means, "num_sigmas": di.num_sigmas,
             "domains": di.domains}
+
+
+def coef_stats(di: DataInfo):
+    """Per-coefficient (mean, sd) aligned with coef_names — identity
+    (0, 1) for one-hot indicator coefs, the standardization stats for
+    numerics. Lets GLM report both standardized and de-standardized
+    coefficients (hex/glm GLMModel coefficients_table)."""
+    mus, sds = [], []
+    ni = 0
+    for i, cat in enumerate(di.is_cat):
+        if cat:
+            dom = di.domains[i] or []
+            first = 0 if di.use_all_factor_levels else 1
+            k = max(len(dom), 1) - first
+            mus += [0.0] * k
+            sds += [1.0] * k
+        else:
+            mus.append(float(di.num_means[ni]))
+            sds.append(float(di.num_sigmas[ni]))
+            ni += 1
+    return np.asarray(mus), np.asarray(sds)

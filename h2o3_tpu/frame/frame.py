@@ -17,6 +17,8 @@ from typing import Dict, List, Optional, Sequence, Union
 import jax
 import numpy as np
 
+from h2o3_tpu.parallel.mesh import fetch_replicated as _fetch_np
+
 from h2o3_tpu.core.kv import DKV, make_key
 from h2o3_tpu.frame.column import Column, T_CAT, T_NUM, column_from_numpy
 from h2o3_tpu.frame.rollups import rollups
@@ -158,8 +160,8 @@ class Frame:
             v = c.to_numpy()
             if c.is_categorical and c.domain:
                 dom = np.array(c.domain + [None], dtype=object)
-                codes = np.asarray(c.data)[: c.nrows].astype(np.int64)
-                codes[np.asarray(c.na_mask)[: c.nrows]] = len(c.domain)
+                codes = _fetch_np(c.data)[: c.nrows].astype(np.int64)
+                codes[_fetch_np(c.na_mask)[: c.nrows]] = len(c.domain)
                 v = dom[codes]
             data[n] = v
         return pd.DataFrame(data)

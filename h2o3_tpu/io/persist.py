@@ -28,6 +28,8 @@ from typing import Callable, Dict, List, Optional
 
 import numpy as np
 
+from h2o3_tpu.parallel.mesh import fetch_replicated as _fetch_np
+
 from h2o3_tpu.utils.log import get_logger
 
 log = get_logger("h2o3_tpu.persist")
@@ -270,8 +272,8 @@ def save_frame(frame, uri: str) -> str:
             arrays[f"c{i}"] = np.where(mask, "", s).astype("U")
             arrays[f"m{i}"] = mask
         else:
-            arrays[f"c{i}"] = np.asarray(c.data)[: c.nrows]
-            arrays[f"m{i}"] = np.asarray(c.na_mask)[: c.nrows]
+            arrays[f"c{i}"] = _fetch_np(c.data)[: c.nrows]
+            arrays[f"m{i}"] = _fetch_np(c.na_mask)[: c.nrows]
     buf = io.BytesIO()
     np.savez_compressed(buf, __header__=np.frombuffer(
         json.dumps(header).encode(), dtype=np.uint8), **arrays)

@@ -14,6 +14,8 @@ from typing import List, Optional, Sequence
 
 import numpy as np
 
+from h2o3_tpu.parallel.mesh import fetch_replicated as _fetch_np
+
 from h2o3_tpu.frame.frame import Frame
 from h2o3_tpu.models import metrics as mm
 from h2o3_tpu.models.model import ModelCategory, adapt_domain, infer_category
@@ -45,16 +47,16 @@ def subset_frame(frame: Frame, keep: np.ndarray) -> Frame:
         if c.type == "string":
             arrays[name] = c.strings[:frame.nrows][keep]
             continue
-        v = np.asarray(c.data)[: frame.nrows][keep]
+        v = _fetch_np(c.data)[: frame.nrows][keep]
         if c.is_categorical:
             v = v.astype(np.int32)
-            v[np.asarray(c.na_mask)[: frame.nrows][keep]] = -1
+            v[_fetch_np(c.na_mask)[: frame.nrows][keep]] = -1
             domains[name] = c.domain
             cats.append(name)
             arrays[name] = v
         else:
             vv = v.astype(np.float64)
-            vv[np.asarray(c.na_mask)[: frame.nrows][keep]] = np.nan
+            vv[_fetch_np(c.na_mask)[: frame.nrows][keep]] = np.nan
             arrays[name] = vv
     return Frame.from_numpy(arrays, categorical=cats, domains=domains)
 
@@ -70,12 +72,12 @@ def train_with_cv(builder, frame: Frame, x: Sequence[str], y: str,
     category = infer_category(frame, y)
 
     if p.get("fold_column"):
-        folds = np.asarray(frame.col(p["fold_column"]).data)[: frame.nrows].astype(np.int32)
+        folds = _fetch_np(frame.col(p["fold_column"]).data)[: frame.nrows].astype(np.int32)
         nfolds = int(folds.max()) + 1
     else:
         yv = None
         if scheme == "stratified":
-            yv = np.asarray(frame.col(y).data)[: frame.nrows]
+            yv = _fetch_np(frame.col(y).data)[: frame.nrows]
         folds = fold_assignment(frame.nrows, nfolds, scheme, seed, yv)
 
     sub_params = {**p, "nfolds": 0, "fold_column": None}

@@ -14,6 +14,8 @@ from typing import Dict, List, Optional, Sequence
 
 import numpy as np
 
+from h2o3_tpu.parallel.mesh import fetch_replicated as _fetch_np
+
 from h2o3_tpu.frame.frame import Frame
 from h2o3_tpu.models import get_builder
 from h2o3_tpu.models.model import Model, ModelBuilder, ModelCategory
@@ -43,8 +45,8 @@ def _with_response(arrs: Dict[str, np.ndarray], yc, y: str, n: int) -> Frame:
     class-0 labels — the metalearner excludes them like any builder)."""
     arrs = dict(arrs)
     if yc.is_categorical:
-        codes = np.asarray(yc.data)[:n].copy()
-        na = np.asarray(yc.na_mask)[:n]
+        codes = _fetch_np(yc.data)[:n].copy()
+        na = _fetch_np(yc.na_mask)[:n]
         dom = yc.domain
         labels = np.asarray(dom, dtype=object)[np.maximum(codes, 0)]
         labels[na] = None

@@ -27,6 +27,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from h2o3_tpu.parallel.mesh import fetch_replicated as _fetch_np
+
 from h2o3_tpu.frame.datainfo import build_datainfo, stats_of
 from h2o3_tpu.frame.frame import Frame
 from h2o3_tpu.models import metrics as mm
@@ -277,7 +279,7 @@ class CoxPHEstimator(ModelBuilder):
                  if p["start_column"] else np.full(n, -np.inf))
         yc = frame.col(y)
         if yc.is_categorical:
-            ev = np.asarray(yc.data)[:n].astype(np.float64)
+            ev = _fetch_np(yc.data)[:n].astype(np.float64)
         else:
             ev = yc.to_numpy()[:n].astype(np.float64)
         ev = np.nan_to_num(ev)
@@ -285,7 +287,7 @@ class CoxPHEstimator(ModelBuilder):
         strata = np.zeros(n, np.int64)
         for sc in (p["stratify_by"] or []):
             c = frame.col(sc)
-            codes = np.asarray(c.data)[:n].astype(np.int64)
+            codes = _fetch_np(c.data)[:n].astype(np.int64)
             strata = strata * max(c.cardinality, 1) + np.maximum(codes, 0)
 
         rs = _risk_structure(start, stop, ev, strata)

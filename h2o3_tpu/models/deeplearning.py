@@ -24,6 +24,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from h2o3_tpu.parallel.mesh import fetch_replicated as _fetch_np
+
 from h2o3_tpu.frame.datainfo import build_datainfo, stats_of
 from h2o3_tpu.frame.frame import Frame
 from h2o3_tpu.models import metrics as mm
@@ -344,8 +346,8 @@ class DeepLearningEstimator(ModelBuilder):
             cat_mode = "mse"
         else:
             rc = frame.col(y)
-            codes = np.asarray(rc.data)[:n].astype(np.int32)
-            na = np.asarray(rc.na_mask)[:n]
+            codes = _fetch_np(rc.data)[:n].astype(np.int32)
+            na = _fetch_np(rc.na_mask)[:n]
             w = w * jnp.asarray(np.pad((~na).astype(np.float32), (0, N - n)))
             codes[na] = 0
             y_dev = jax.device_put(np.pad(codes, (0, N - n)),

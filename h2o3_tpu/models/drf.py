@@ -26,6 +26,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from h2o3_tpu.parallel.mesh import fetch_replicated as _fetch_np
+
 from h2o3_tpu.frame.binning import BinnedMatrix, bin_frame, rebin_for_scoring
 from h2o3_tpu.frame.frame import Frame
 from h2o3_tpu.models import metrics as mm
@@ -181,6 +183,7 @@ class DRFEstimator(ModelBuilder):
         stopping_tolerance=1e-3, binomial_double_trees=False,
         distribution="auto", calibrate_model=False,
         calibration_frame=None, calibration_method="PlattScaling",
+        histogram_type="auto",
     )
 
     def __init__(self, **params):
@@ -196,13 +199,17 @@ class DRFEstimator(ModelBuilder):
         p = self.params
         mesh = get_mesh()
         category = infer_category(frame, y)
-        bm = bin_frame(frame, x, nbins=p["nbins"], nbins_cats=p["nbins_cats"])
+        ht = str(p.get("histogram_type", "auto")).lower()
+        ht = {"auto": "quantiles", "quantilesglobal": "quantiles",
+              "uniformadaptive": "uniform"}.get(ht, ht)
+        bm = bin_frame(frame, x, nbins=p["nbins"],
+                       nbins_cats=p["nbins_cats"], histogram_type=ht)
         w = frame.valid_weights()
         if p.get("weights_column"):
             wc = frame.col(p["weights_column"]).numeric_view()
             w = w * jnp.where(jnp.isnan(wc), 0.0, wc)
         rc = frame.col(y)
-        resp_na = np.asarray(rc.na_mask)[: frame.nrows]
+        resp_na = _fetch_np(rc.na_mask)[: frame.nrows]
         if resp_na.any():
             w = w * jnp.asarray((~resp_na).astype(np.float32))
 
@@ -234,7 +241,7 @@ class DRFEstimator(ModelBuilder):
             ys = np.pad(yv, (0, N - frame.nrows))[:, None]
             y_int = None
         else:
-            codes = np.asarray(rc.data)[: frame.nrows].astype(np.int32)
+            codes = _fetch_np(rc.data)[: frame.nrows].astype(np.int32)
             codes[resp_na] = 0
             codes = np.pad(codes, (0, N - frame.nrows))
             K = 1 if category == ModelCategory.BINOMIAL else rc.cardinality

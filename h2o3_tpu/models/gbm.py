@@ -24,6 +24,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from h2o3_tpu.parallel.mesh import fetch_replicated as _fetch_np
+
 from h2o3_tpu.frame.binning import BinnedMatrix, bin_frame, rebin_for_scoring
 from h2o3_tpu.frame.frame import Frame
 from h2o3_tpu.models import metrics as mm
@@ -32,7 +34,8 @@ from h2o3_tpu.models.model import (Model, ModelBuilder, ModelCategory,
                                    infer_category)
 from h2o3_tpu.models.tree import (Tree, TreeParams, grow_tree, predict_forest,
                                   predict_tree, stack_trees)
-from h2o3_tpu.parallel.mesh import get_mesh, row_sharding
+from h2o3_tpu.parallel.mesh import (get_mesh, put_sharded,
+                                    row_sharding)
 
 
 def _sample_columns(k1, k2, F: int, rate: float):
@@ -287,18 +290,18 @@ class GBMModel(Model):
         cat = self.output["category"]
         if cat == ModelCategory.BINOMIAL:
             dist = get_distribution("bernoulli")
-            p1 = np.asarray(dist.link_inv(marg))[:n]
+            p1 = _fetch_np(dist.link_inv(marg))[:n]
             t = self.output.get("default_threshold", 0.5)
             return {"predict": (p1 >= t).astype(np.int32),
                     "p0": 1.0 - p1, "p1": p1}
         if cat == ModelCategory.MULTINOMIAL:
-            p = np.asarray(jax.nn.softmax(marg, axis=1))[:n]
+            p = _fetch_np(jax.nn.softmax(marg, axis=1))[:n]
             out = {"predict": p.argmax(axis=1).astype(np.int32)}
             for k in range(p.shape[1]):
                 out[f"p{k}"] = p[:, k]
             return out
         dist = get_distribution(self.dist_name, **self.params)
-        return {"predict": np.asarray(dist.link_inv(marg))[:n]}
+        return {"predict": _fetch_np(dist.link_inv(marg))[:n]}
 
 
     def predict_leaf_node_assignment(self, frame: Frame) -> Frame:
@@ -306,6 +309,57 @@ class GBMModel(Model):
         with type=Node_ID); per-class columns T{t}.C{k} for multinomial."""
         from h2o3_tpu.models.tree import leaf_assignment_frame
         return leaf_assignment_frame(self, frame)
+
+    def staged_predict_proba(self, frame: Frame) -> Frame:
+        """Cumulative per-stage probabilities (h2o-py
+        staged_predict_proba; SharedTreeModel staged scoring): column
+        T{t}.C1 after t trees for binomial (p0, matching the reference's
+        first-class convention), T{t} for regression."""
+        bm = rebin_for_scoring(self.bm, frame)
+        n = frame.nrows
+        cat = self.output["category"]
+        B = bm.nbins_total
+        cols = {}
+        # stage margins accumulate on device; ONE host fetch at the end
+        # (a per-tree fetch costs a full tunnel round trip each)
+        if cat == ModelCategory.MULTINOMIAL:
+            K = self.output.get("nclasses", 2)
+            T = self.forest.feat.shape[0] // K
+            margins = jnp.broadcast_to(
+                jnp.asarray(self.f0)[None, :],
+                (bm.bins.shape[0], K)).astype(jnp.float32)
+            stages = []
+            for t in range(T):
+                for k in range(K):
+                    tr = Tree(*(a[t * K + k] for a in self.forest))
+                    margins = margins.at[:, k].add(
+                        predict_tree(tr, bm.bins, B))
+                stages.append(jax.nn.softmax(margins, axis=1))
+            probs = _fetch_np(jnp.stack(stages))[:, :n]     # [T, n, K]
+            for t in range(T):
+                for k in range(K):
+                    cols[f"T{t + 1}.C{k + 1}"] = probs[t, :, k]
+            return Frame.from_numpy(cols)
+        T = self.forest.feat.shape[0]
+        margin = jnp.full((bm.bins.shape[0],), self.f0, jnp.float32)
+        dist = get_distribution(
+            "bernoulli" if cat == ModelCategory.BINOMIAL else
+            self.dist_name, **self.params)
+        off = self._frame_offset(frame, bm.bins.shape[0])
+        if off is not None:
+            margin = margin + off
+        stages = []
+        for t in range(T):
+            tr = Tree(*(a[t] for a in self.forest))
+            margin = margin + predict_tree(tr, bm.bins, B)
+            stages.append(dist.link_inv(margin))
+        mus = _fetch_np(jnp.stack(stages))[:, :n]           # [T, n]
+        for t in range(T):
+            if cat == ModelCategory.BINOMIAL:
+                cols[f"T{t + 1}.C1"] = 1.0 - mus[t]         # p0 convention
+            else:
+                cols[f"T{t + 1}"] = mus[t]
+        return Frame.from_numpy(cols)
 
     def predict_contributions(self, frame: Frame) -> Frame:
         """TreeSHAP contributions (h2o-py predict_contributions): feature
@@ -379,6 +433,7 @@ class GBMEstimator(ModelBuilder):
         monotone_constraints=None, interaction_constraints=None,
         calibrate_model=False, calibration_frame=None,
         calibration_method="PlattScaling",
+        check_constant_response=True,
     )
 
     def __init__(self, **params):
@@ -438,7 +493,14 @@ class GBMEstimator(ModelBuilder):
         # rows with a missing response are excluded from training and
         # training metrics (reference ModelBuilder drops them)
         rc = frame.col(y)
-        resp_na = np.asarray(rc.na_mask)
+        if p.get("check_constant_response", True) and not rc.is_categorical:
+            yh = rc.to_numpy()
+            vals = yh[~np.isnan(yh)]
+            if vals.size and float(vals.min()) == float(vals.max()):
+                raise ValueError(
+                    "Response cannot be constant - check your response "
+                    "column, or set check_constant_response=False")
+        resp_na = _fetch_np(rc.na_mask)
         if resp_na[: frame.nrows].any():
             w = w * jnp.asarray((~resp_na).astype(np.float32))
 
@@ -543,12 +605,13 @@ class GBMEstimator(ModelBuilder):
         if category == ModelCategory.MULTINOMIAL:
             from h2o3_tpu.models.model import adapt_domain
             K = rc.cardinality
-            yv = np.asarray(rc.data)[: frame.nrows].astype(np.int32)
+            yv = _fetch_np(rc.data)[: frame.nrows].astype(np.int32)
             yv = np.pad(yv, (0, bm.bins.shape[0] - frame.nrows))
-            y_dev = jax.device_put(yv, row_sharding(mesh))
+            y_dev = put_sharded(yv, row_sharding(mesh))
             # weighted class priors over rows that actually train (weights
             # already zero NA-response and padding rows)
-            w_host = np.asarray(w)[: frame.nrows]
+            from h2o3_tpu.parallel.mesh import fetch_replicated
+            w_host = fetch_replicated(w)[: frame.nrows]
             counts = np.bincount(yv[: frame.nrows], weights=w_host,
                                  minlength=K).astype(np.float64)
             pri = np.clip(counts / max(counts.sum(), 1e-12), 1e-10, 1.0)
@@ -561,7 +624,7 @@ class GBMEstimator(ModelBuilder):
                 margins = jnp.broadcast_to(
                     jnp.asarray(f0)[None, :],
                     (bm.bins.shape[0], K)).astype(jnp.float32)
-                margins = jax.device_put(margins, row_sharding(mesh))
+                margins = put_sharded(margins, row_sharding(mesh))
             if vbm is None:
                 val_margins = None
             elif ckpt is not None:   # resume incl. the prior forest's part
@@ -619,15 +682,16 @@ class GBMEstimator(ModelBuilder):
         else:
             if category == ModelCategory.BINOMIAL:
                 dist = get_distribution("bernoulli")
-                yv = np.asarray(rc.data)[: frame.nrows].astype(np.float32)
-                yv[np.asarray(rc.na_mask)[: frame.nrows]] = 0.0
+                yv = _fetch_np(rc.data)[: frame.nrows].astype(np.float32)
+                yv[_fetch_np(rc.na_mask)[: frame.nrows]] = 0.0
             else:
                 dist = get_distribution(dist_name, **p)
                 yv = np.nan_to_num(rc.to_numpy()).astype(np.float32)
             yv = np.pad(yv, (0, bm.bins.shape[0] - frame.nrows))
-            y_dev = jax.device_put(yv, row_sharding(mesh))
-            wn = np.asarray(w)
-            mean_y = float((np.asarray(yv) * wn).sum() / max(wn.sum(), 1e-12))
+            y_dev = put_sharded(yv, row_sharding(mesh))
+            # device-side weighted mean: w may shard across processes
+            mean_y = float(jnp.sum(y_dev * w)) / max(float(jnp.sum(w)),
+                                                     1e-12)
             # offset_column: per-row base margin (GBM.java offset
             # handling; init_f solved WITH the offset in place)
             off = None
@@ -636,17 +700,17 @@ class GBMEstimator(ModelBuilder):
                     frame.col(p["offset_column"]).to_numpy()
                 ).astype(np.float32)
                 onp = np.pad(onp, (0, bm.bins.shape[0] - frame.nrows))
-                off = jax.device_put(jnp.asarray(onp), row_sharding(mesh))
+                off = put_sharded(jnp.asarray(onp), row_sharding(mesh))
             if ckpt is not None:
                 f0 = ckpt.f0
-                margin = jax.device_put(
+                margin = put_sharded(
                     ckpt._margins(bm).astype(jnp.float32), row_sharding(mesh))
                 if off is not None:
                     margin = margin + off
             elif off is None:
                 f0 = np.float32(dist.init_margin(mean_y))
                 margin = jnp.full((bm.bins.shape[0],), f0, jnp.float32)
-                margin = jax.device_put(margin, row_sharding(mesh))
+                margin = put_sharded(margin, row_sharding(mesh))
             else:
                 # Newton solve of the offset-adjusted init
                 # (DistributionFactory init task role)

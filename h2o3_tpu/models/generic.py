@@ -11,6 +11,8 @@ from typing import Dict, Optional, Sequence
 
 import numpy as np
 
+from h2o3_tpu.parallel.mesh import fetch_replicated as _fetch_np
+
 from h2o3_tpu.frame.frame import Frame
 from h2o3_tpu.models import register
 from h2o3_tpu.models.model import Model, ModelBuilder
@@ -22,8 +24,8 @@ def _frame_raw_columns(frame: Frame, names) -> Dict[str, np.ndarray]:
     for n in names:
         c = frame.col(n)
         if c.is_categorical:
-            codes = np.asarray(c.data)[: c.nrows]
-            na = np.asarray(c.na_mask)[: c.nrows]
+            codes = _fetch_np(c.data)[: c.nrows]
+            na = _fetch_np(c.na_mask)[: c.nrows]
             dom = np.asarray(c.domain or [], dtype=object)
             vals = np.empty(c.nrows, dtype=object)
             ok = ~na & (codes >= 0) & (codes < len(dom))

@@ -26,7 +26,10 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from h2o3_tpu.frame.datainfo import DataInfo, build_datainfo, stats_of
+from h2o3_tpu.parallel.mesh import fetch_replicated as _fetch_np
+
+from h2o3_tpu.frame.datainfo import (DataInfo, build_datainfo,
+                                     coef_stats, stats_of)
 from h2o3_tpu.frame.frame import Frame
 from h2o3_tpu.models import metrics as mm
 from h2o3_tpu.models.model import (Model, ModelBuilder, ModelCategory,
@@ -34,7 +37,8 @@ from h2o3_tpu.models.model import (Model, ModelBuilder, ModelCategory,
 from h2o3_tpu.ops.gram import gram
 from h2o3_tpu.ops.optimize import (admm_l1_quadratic,
                                    cholesky_solve_regularized, lbfgs)
-from h2o3_tpu.parallel.mesh import get_mesh, row_sharding
+from h2o3_tpu.parallel.mesh import (get_mesh, put_sharded,
+                                    row_sharding)
 from h2o3_tpu.utils.log import get_logger
 
 log = get_logger("h2o3_tpu.glm")
@@ -325,9 +329,9 @@ def expand_interactions(frame: Frame, inter_cols: Sequence[str]) -> Frame:
                 data=jax.device_put(jnp.where(na, 0.0, prod), shard),
                 na_mask=jax.device_put(na, shard), nrows=n))
         elif ca.is_categorical and cb.is_categorical:
-            ka = np.asarray(ca.data)[:n]
-            kb = np.asarray(cb.data)[:n]
-            na = (np.asarray(ca.na_mask)[:n] | np.asarray(cb.na_mask)[:n])
+            ka = _fetch_np(ca.data)[:n]
+            kb = _fetch_np(cb.data)[:n]
+            na = (_fetch_np(ca.na_mask)[:n] | _fetch_np(cb.na_mask)[:n])
             combo = ka.astype(np.int64) * len(cb.domain or []) + kb
             combo[na] = -1
             seen = np.unique(combo[combo >= 0])
@@ -350,9 +354,9 @@ def expand_interactions(frame: Frame, inter_cols: Sequence[str]) -> Frame:
             cname, nname = (a, b) if ca.is_categorical else (b, a)
             vnum = num.numeric_view()
             codes = jnp.asarray(np.pad(
-                np.asarray(cat.data)[:n], (0, npad - n)))
+                _fetch_np(cat.data)[:n], (0, npad - n)))
             cna = jnp.asarray(np.pad(
-                np.asarray(cat.na_mask)[:n], (0, npad - n),
+                _fetch_np(cat.na_mask)[:n], (0, npad - n),
                 constant_values=True))
             for li, lvl in enumerate(cat.domain or []):
                 v = jnp.where((codes == li) & ~cna, vnum, 0.0)
@@ -413,7 +417,7 @@ class GLMModel(Model):
         if self.output.get("family") == "ordinal":
             X1 = self._design(frame)
             P = X1.shape[1] - 1
-            eta = np.asarray(X1[:, :P] @ jnp.asarray(
+            eta = _fetch_np(X1[:, :P] @ jnp.asarray(
                 self.coef[:P], jnp.float32))[:n]
             alphas = np.asarray(self.output["ordinal_alphas"])
             cum = 1 / (1 + np.exp(-(alphas[None, :] - eta[:, None])))
@@ -426,12 +430,12 @@ class GLMModel(Model):
             return out
         eta = self._eta(frame)
         if cat == ModelCategory.MULTINOMIAL:
-            p = np.asarray(jax.nn.softmax(eta, axis=1))[:n]
+            p = _fetch_np(jax.nn.softmax(eta, axis=1))[:n]
             out = {"predict": p.argmax(axis=1).astype(np.int32)}
             for k in range(p.shape[1]):
                 out[f"p{k}"] = p[:, k]
             return out
-        mu = np.asarray(self.family.linkinv(eta))[:n]
+        mu = _fetch_np(self.family.linkinv(eta))[:n]
         if cat == ModelCategory.BINOMIAL:
             t = self.output.get("default_threshold", 0.5)
             return {"predict": (mu >= t).astype(np.int32),
@@ -570,7 +574,7 @@ class GLMEstimator(ModelBuilder):
             if isinstance(bc, Frame):
                 nm_col = bc.col("names")
                 if nm_col.is_categorical and nm_col.domain:
-                    codes = np.asarray(nm_col.data)[: bc.nrows]
+                    codes = _fetch_np(nm_col.data)[: bc.nrows]
                     labels = [nm_col.domain[int(c)] if c >= 0 else None
                               for c in codes]
                 else:
@@ -673,8 +677,11 @@ class GLMEstimator(ModelBuilder):
             jnp.zeros((X1.shape[0],), jnp.float32)
 
         rc = frame.col(y)
+        cmus, csds = coef_stats(di)
         output = {"category": category, "response": y, "names": list(x),
                   "coef_names": di.coef_names, "domain": rc.domain,
+                  "coef_means": cmus.tolist(), "coef_sds": csds.tolist(),
+                  "standardized": bool(p["standardize"]),
                   "nclasses": rc.cardinality if rc.is_categorical else 1}
 
         if fam_name == "ordinal":
@@ -682,12 +689,12 @@ class GLMEstimator(ModelBuilder):
                 raise ValueError("ordinal family requires a categorical "
                                  "response (ordered levels)")
             K = rc.cardinality
-            yv = np.asarray(rc.data)[: frame.nrows].astype(np.int32)
-            resp_na = np.asarray(rc.na_mask)[: frame.nrows]
+            yv = _fetch_np(rc.data)[: frame.nrows].astype(np.int32)
+            resp_na = _fetch_np(rc.na_mask)[: frame.nrows]
             yv = np.pad(yv, (0, X1.shape[0] - frame.nrows))
             w = w * jnp.asarray(np.pad((~resp_na).astype(np.float32),
                                        (0, X1.shape[0] - frame.nrows)))
-            y_dev = jax.device_put(yv, row_sharding(mesh))
+            y_dev = put_sharded(yv, row_sharding(mesh))
             l2 = _l2_of(p)
             P = X1.shape[1] - 1
             l2d = jnp.float32(l2)
@@ -727,12 +734,12 @@ class GLMEstimator(ModelBuilder):
                 raise ValueError("compute_p_values is not supported for "
                                  "multinomial GLM (reference restriction)")
             K = rc.cardinality
-            yv = np.asarray(rc.data)[: frame.nrows].astype(np.int32)
-            resp_na = np.asarray(rc.na_mask)[: frame.nrows]
+            yv = _fetch_np(rc.data)[: frame.nrows].astype(np.int32)
+            resp_na = _fetch_np(rc.na_mask)[: frame.nrows]
             yv = np.pad(yv, (0, X1.shape[0] - frame.nrows))
             w = w * jnp.asarray(np.pad((~resp_na).astype(np.float32),
                                        (0, X1.shape[0] - frame.nrows)))
-            y_dev = jax.device_put(yv, row_sharding(mesh))
+            y_dev = put_sharded(yv, row_sharding(mesh))
             nobs = float(jnp.sum(w))
             l2 = _l2_of(p)
             B = self._fit_multinomial(X1, y_dev, w, K, l2, nobs,
@@ -761,7 +768,7 @@ class GLMEstimator(ModelBuilder):
             w = w * jnp.asarray(wna)
             yv = np.pad(np.nan_to_num(yn).astype(np.float32),
                         (0, X1.shape[0] - frame.nrows))
-        y_dev = jax.device_put(yv, row_sharding(mesh))
+        y_dev = put_sharded(yv, row_sharding(mesh))
         nobs = float(jnp.sum(w))
 
         alpha = float(p["alpha"] if p["alpha"] is not None else 0.5)
@@ -842,8 +849,13 @@ def _lambda_path(p, X1, y, w, nobs, alpha, mesh) -> List[float]:
     ybar = float(jnp.sum(w * y) / jnp.maximum(jnp.sum(w), 1e-12))
     xty = jnp.abs((X1 * w[:, None]).T @ (y - ybar))[:-1]  # exclude intercept
     lam_max = float(jnp.max(xty)) / (nobs * max(alpha, 1e-3))
-    lam_min = lam_max * float(p["lambda_min_ratio"])
+    lmr = float(p["lambda_min_ratio"])
+    if lmr <= 0:            # wire default -1 = auto (GLMParameters)
+        lmr = 1e-4
+    lam_min = lam_max * lmr
     n = int(p["nlambdas"])
+    if n <= 0:              # wire default -1 = auto → 100-step path
+        n = 100
     return list(np.exp(np.linspace(np.log(lam_max), np.log(lam_min), n)))
 
 

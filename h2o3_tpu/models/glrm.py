@@ -23,6 +23,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from h2o3_tpu.parallel.mesh import fetch_replicated as _fetch_np
+
 from h2o3_tpu.frame.datainfo import build_datainfo, stats_of
 from h2o3_tpu.frame.frame import Frame
 from h2o3_tpu.models.metrics import ModelMetrics
@@ -77,7 +79,7 @@ def _cell_mask(frame: Frame, di) -> jax.Array:
     for i, name in enumerate(di.names):
         c = frame.col(name)
         width = len(di.domains[i] or []) if di.is_cat[i] else 1
-        na = np.asarray(c.na_mask)
+        na = _fetch_np(c.na_mask)
         if na.any():
             mask[na, ptr:ptr + width] = 0.0
         ptr += width

@@ -21,6 +21,8 @@ from typing import Dict, List, Optional, Sequence
 
 import numpy as np
 
+from h2o3_tpu.parallel.mesh import fetch_replicated as _fetch_np
+
 from h2o3_tpu.core.job import Job
 from h2o3_tpu.core.kv import DKV, make_key
 from h2o3_tpu.frame.frame import Frame
@@ -55,16 +57,16 @@ def adapt_domain(test_col, train_domain: List[str]) -> np.ndarray:
     (NA). The adaptTestForTrain domain-mapping pass (hex/Model.java:1850).
     """
     if test_col.domain == train_domain:
-        codes = np.asarray(test_col.data)[: test_col.nrows].copy()
-        codes[np.asarray(test_col.na_mask)[: test_col.nrows]] = -1
+        codes = _fetch_np(test_col.data)[: test_col.nrows].copy()
+        codes[_fetch_np(test_col.na_mask)[: test_col.nrows]] = -1
         return codes
     lut = {lvl: i for i, lvl in enumerate(train_domain)}
     mapping = np.array([lut.get(lvl, -1) for lvl in (test_col.domain or [])],
                        dtype=np.int32)
-    codes = np.asarray(test_col.data)[: test_col.nrows]
+    codes = _fetch_np(test_col.data)[: test_col.nrows]
     out = mapping[codes] if len(mapping) else np.full(test_col.nrows, -1, np.int32)
     out = out.copy()
-    out[np.asarray(test_col.na_mask)[: test_col.nrows]] = -1
+    out[_fetch_np(test_col.na_mask)[: test_col.nrows]] = -1
     return out
 
 

@@ -19,6 +19,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from h2o3_tpu.parallel.mesh import fetch_replicated as _fetch_np
+
 from h2o3_tpu.frame.frame import Frame
 from h2o3_tpu.models import metrics as mm
 from h2o3_tpu.models.model import (Model, ModelBuilder, ModelCategory,
@@ -122,8 +124,8 @@ class NaiveBayesEstimator(ModelBuilder):
         K = rc.cardinality
         n = frame.nrows
         N = frame.nrows_padded
-        codes = np.asarray(rc.data)[:n].astype(np.int32)
-        na = np.asarray(rc.na_mask)[:n]
+        codes = _fetch_np(rc.data)[:n].astype(np.int32)
+        na = _fetch_np(rc.na_mask)[:n]
         codes[na] = 0
         cls = jnp.asarray(np.pad(codes, (0, N - n)))
         w = frame.valid_weights()

@@ -24,6 +24,8 @@ from typing import Dict, List, Optional, Sequence
 import jax.numpy as jnp
 import numpy as np
 
+from h2o3_tpu.parallel.mesh import fetch_replicated as _fetch_np
+
 from h2o3_tpu.frame.frame import Frame
 from h2o3_tpu.models import register
 from h2o3_tpu.models.model import Model, ModelBuilder, adapt_domain
@@ -174,8 +176,8 @@ class TargetEncoderEstimator(ModelBuilder):
         n = frame.nrows
         rc = frame.col(y)
         if rc.is_categorical:
-            yv = np.asarray(rc.data)[:n].astype(np.float64)
-            yna = np.asarray(rc.na_mask)[:n]
+            yv = _fetch_np(rc.data)[:n].astype(np.float64)
+            yna = _fetch_np(rc.na_mask)[:n]
             yv = np.where(yna, np.nan, yv)
             if rc.cardinality > 2:
                 raise ValueError("TargetEncoder supports binomial or "
@@ -201,8 +203,8 @@ class TargetEncoderEstimator(ModelBuilder):
         for col in enc_cols:
             c = frame.col(col)
             dom = c.domain or []
-            codes = np.asarray(c.data)[:n].astype(np.int64)
-            cna = np.asarray(c.na_mask)[:n]
+            codes = _fetch_np(c.data)[:n].astype(np.int64)
+            cna = _fetch_np(c.na_mask)[:n]
             wcol = w * (~cna)
             s, cnt = _level_stats(np.where(cna, 0, codes), yv, wcol,
                                   max(len(dom), 1), folds, nfolds)

@@ -101,6 +101,42 @@ def padded_rows(n: int, mesh: Optional[Mesh] = None, block: int = 1) -> int:
     return ((bucket + d - 1) // d) * d
 
 
+def put_sharded(host_array, sharding):
+    """Place a host array onto a (possibly multi-process) sharding.
+
+    Single process: plain device_put. Multi-process (jax.distributed
+    cloud — the @CloudSize(n) tier): every process holds the SAME full
+    host array (deterministic ingest), so each contributes its
+    addressable shards via make_array_from_callback — the analogue of
+    chunks parsing on their home nodes (water/parser/ParseDataset)."""
+    import numpy as _np
+    if getattr(sharding, "is_fully_addressable", True):
+        return jax.device_put(host_array, sharding)
+    if isinstance(host_array, jax.Array):
+        # already a global device array: reshard (device-to-device),
+        # never pull through the host
+        if host_array.sharding == sharding:
+            return host_array
+        return jax.device_put(host_array, sharding)
+    host_array = _np.asarray(host_array)
+    return jax.make_array_from_callback(
+        host_array.shape, sharding, lambda idx: host_array[idx])
+
+
+def fetch_replicated(x):
+    """Device→host fetch that works on cross-process sharded arrays.
+
+    Single process: device_get. Multi-process: allgather the shards so
+    every host sees the full array (water/MRTask postGlobal view)."""
+    leaves = jax.tree_util.tree_leaves(x)
+    if all(getattr(getattr(v, "sharding", None), "is_fully_addressable",
+                   True) for v in leaves):
+        return jax.device_get(x)
+    from jax.experimental import multihost_utils
+    return jax.device_get(multihost_utils.process_allgather(
+        x, tiled=True))
+
+
 def shard_rows(x, mesh: Optional[Mesh] = None, block: int = 1,
                fill: float = 0.0):
     """Pad axis-0 to a shardable length and place with row_sharding."""

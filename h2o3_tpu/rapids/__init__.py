@@ -25,6 +25,8 @@ from typing import Any, Callable, Dict, List, Optional
 
 import numpy as np
 
+from h2o3_tpu.parallel.mesh import fetch_replicated as _fetch_np
+
 from h2o3_tpu.core.kv import DKV
 from h2o3_tpu.frame.frame import Frame
 from h2o3_tpu.parallel import mesh as mesh_mod
@@ -143,8 +145,8 @@ def _col_np(frame: Frame, name: str) -> np.ndarray:
 
 def _cat_codes(frame: Frame, name: str) -> np.ndarray:
     c = frame.col(name)
-    codes = np.asarray(c.data)[: frame.nrows].astype(np.int32).copy()
-    codes[np.asarray(c.na_mask)[: frame.nrows]] = -1
+    codes = _fetch_np(c.data)[: frame.nrows].astype(np.int32).copy()
+    codes[_fetch_np(c.na_mask)[: frame.nrows]] = -1
     return codes
 
 
@@ -453,6 +455,37 @@ def _rows(env, fr, sel):
 
 @prim("append", "cbind")
 def _append(env, *args):
+    # (append fr value "name"): h2o-py's new-column assignment
+    # fr["new"] = value (h2o-py/h2o/frame.py:2251) — value may be a
+    # scalar (broadcast) or a 1-col frame; the string names the column
+    if len(args) == 3 and isinstance(args[2], tuple) and args[2][0] == "str":
+        base = _as_frame(env.ev(args[0]))
+        val = env.ev(args[1])
+        name = args[2][1]
+        out_arrays, cats, doms = {}, [], {}
+        for n in base.names:
+            c = base.col(n)
+            if c.is_categorical:
+                out_arrays[n] = _cat_codes(base, n)
+                cats.append(n)
+                doms[n] = c.domain
+            else:
+                out_arrays[n] = _col_np(base, n)
+        if isinstance(val, Frame):
+            vc = val.col(val.names[0])
+            if vc.is_categorical:
+                out_arrays[name] = _cat_codes(val, val.names[0])
+                cats.append(name)
+                doms[name] = vc.domain
+            else:
+                out_arrays[name] = _col_np(val, val.names[0])
+        elif isinstance(val, str):
+            out_arrays[name] = np.zeros(base.nrows, np.int32)
+            cats.append(name)
+            doms[name] = [val]
+        else:
+            out_arrays[name] = np.full(base.nrows, float(val), np.float64)
+        return Frame.from_numpy(out_arrays, categorical=cats, domains=doms)
     frames = [_as_frame(env.ev(a)) for a in args
               if not (isinstance(a, tuple) and a[0] == "str")]
     out_arrays, cats, doms = {}, [], {}
@@ -681,10 +714,10 @@ def _as_numeric(env, x):
                 dv = np.array([float(s) for s in dom])
             except ValueError:
                 dv = np.arange(len(dom), dtype=np.float64)
-            codes = np.asarray(c.data)[: f.nrows].astype(np.int64)
+            codes = _fetch_np(c.data)[: f.nrows].astype(np.int64)
             v = dv[codes] if len(dom) else codes.astype(np.float64)
             v = v.copy()
-            v[np.asarray(c.na_mask)[: f.nrows]] = np.nan
+            v[_fetch_np(c.na_mask)[: f.nrows]] = np.nan
             out[n] = v
         else:
             out[n] = _col_np(f, n)
@@ -699,8 +732,8 @@ def _as_character(env, x):
         c = f.col(n)
         if c.is_categorical:
             dom = np.array((c.domain or []) + [None], dtype=object)
-            codes = np.asarray(c.data)[: f.nrows].astype(np.int64)
-            codes = np.where(np.asarray(c.na_mask)[: f.nrows],
+            codes = _fetch_np(c.data)[: f.nrows].astype(np.int64)
+            codes = np.where(_fetch_np(c.na_mask)[: f.nrows],
                              len(dom) - 1, codes)
             out[n] = dom[codes]
         else:
@@ -896,7 +929,7 @@ def _na_omit(env, fr):
     f = _as_frame(env.ev(fr))
     keep = np.ones(f.nrows, bool)
     for n in f.names:
-        keep &= ~np.asarray(f.col(n).na_mask)[: f.nrows]
+        keep &= ~_fetch_np(f.col(n).na_mask)[: f.nrows]
     return _take_rows(f, np.flatnonzero(keep))
 
 
@@ -955,8 +988,8 @@ def _strop(fn):
             c = f.col(n)
             if c.is_categorical:
                 dom = [fn(s, *extra) for s in (c.domain or [])]
-                codes = np.asarray(c.data)[: f.nrows].astype(np.int64)
-                codes = np.where(np.asarray(c.na_mask)[: f.nrows],
+                codes = _fetch_np(c.data)[: f.nrows].astype(np.int64)
+                codes = np.where(_fetch_np(c.na_mask)[: f.nrows],
                                  len(dom), codes)
                 out[n] = np.array(dom + [None], dtype=object)[codes]
                 cats.append(n)
@@ -988,8 +1021,8 @@ def _nchar(env, x):
         if c.is_categorical:
             dom = c.domain or []
             lens = np.array([float(len(s)) for s in dom] + [np.nan])
-            codes = np.asarray(c.data)[: f.nrows].astype(np.int64)
-            codes = np.where(np.asarray(c.na_mask)[: f.nrows], len(dom), codes)
+            codes = _fetch_np(c.data)[: f.nrows].astype(np.int64)
+            codes = np.where(_fetch_np(c.na_mask)[: f.nrows], len(dom), codes)
             out[n] = lens[codes]
         elif c.type == "string":
             out[n] = np.array([float(len(s)) if s is not None else np.nan
@@ -1119,7 +1152,7 @@ def _any_na(env, x):
         if c.type == "string":
             if any(v is None for v in c.to_numpy()):
                 return 1.0
-        elif bool(np.asarray(c.na_mask)[: f.nrows].any()):
+        elif bool(_fetch_np(c.na_mask)[: f.nrows].any()):
             return 1.0
     return 0.0
 

@@ -37,3 +37,37 @@ def test_automl_ensemble_present(classif_frame):
     aml.train(y="y", training_frame=classif_frame)
     steps = {m.output.get("automl_step") for m in aml.leaderboard.models}
     assert "StackedEnsemble_BestOfFamily" in steps, steps
+
+
+def test_automl_step_plan_breadth():
+    """The modeling plan must expose >=15 distinct steps across providers
+    (VERDICT r1 item 5; ai/h2o/automl/modeling/*StepsProvider)."""
+    from h2o3_tpu.automl.steps import modeling_plan
+    plan = modeling_plan(seed=1)
+    ids = [s.id for s in plan]
+    assert len(ids) == len(set(ids))
+    assert len(ids) >= 15, ids
+    kinds = {s.kind for s in plan}
+    assert {"default", "grid", "exploitation", "ensemble"} <= kinds
+    assert any(s.id == "XRT_1" for s in plan)          # XRT variant
+    assert any(s.provider == "XGBoost" for s in plan)
+
+
+def test_automl_per_model_cap_enforced(classif_frame):
+    """max_runtime_secs_per_model must actually cancel slow models
+    (VERDICT r1 weak #5: silently-ignored params are worse than
+    rejections)."""
+    import time as _t
+    from h2o3_tpu.automl.executor import Budget, train_capped
+    from h2o3_tpu.models.gbm import GBMEstimator
+    budget = Budget(max_models=10, max_runtime_secs=0,
+                    per_model_secs=0.02)       # impossibly small cap
+    t0 = _t.time()
+    try:
+        train_capped(GBMEstimator(ntrees=400, max_depth=6, seed=1),
+                     classif_frame, "y", None, budget)
+        raised = False
+    except TimeoutError:
+        raised = True
+    assert raised, "per-model cap did not cancel the job"
+    assert budget.trained == 0

@@ -1,0 +1,92 @@
+"""True multi-process cloud test — the reference's multi-JVM localhost
+tier (multiNodeUtils.sh:22-27; SURVEY §4 tier 2 / @CloudSize(n)).
+
+Launches N separate Python processes that form a jax.distributed cloud
+(1 CPU device each), train GBM + GLM over the cross-process mesh, and
+must match the single-process results.
+"""
+
+import json
+import os
+import socket
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+WORKER = os.path.join(REPO, "tests", "mp_worker.py")
+N_PROC = 2
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+@pytest.fixture(scope="module")
+def mp_result(tmp_path_factory):
+    out = str(tmp_path_factory.mktemp("mp") / "result.json")
+    coord = f"127.0.0.1:{_free_port()}"
+    env = dict(os.environ)
+    env.pop("JAX_PLATFORMS", None)
+    procs = [
+        subprocess.Popen(
+            [sys.executable, WORKER, coord, str(N_PROC), str(i), out],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            text=True)
+        for i in range(N_PROC)
+    ]
+    logs = []
+    for p in procs:
+        try:
+            stdout, _ = p.communicate(timeout=600)
+        except subprocess.TimeoutExpired:
+            p.kill()
+            stdout = "TIMEOUT"
+        logs.append(stdout)
+    for i, p in enumerate(procs):
+        assert p.returncode == 0, \
+            f"worker {i} failed:\n{logs[i][-3000:]}"
+    with open(out) as f:
+        return json.load(f)
+
+
+def _single_process_reference():
+    """Same training in-process (the current pytest cloud)."""
+    import h2o3_tpu
+    from h2o3_tpu.models.gbm import GBMEstimator
+    from h2o3_tpu.models.glm import GLMEstimator
+    r = np.random.RandomState(5)
+    n = 4000
+    a = r.randn(n)
+    b = r.randn(n)
+    g = r.choice(["u", "v", "w"], n)
+    y = 2.0 * a - b + (g == "u") * 1.5 + r.randn(n) * 0.3
+    fr = h2o3_tpu.Frame.from_numpy(
+        {"a": a, "b": b, "g": g, "y": y}, categorical=["g"])
+    gbm = GBMEstimator(ntrees=10, max_depth=4, seed=3).train(fr, y="y")
+    glm = GLMEstimator(family="gaussian", lambda_=0.0).train(fr, y="y")
+    return gbm, glm, fr
+
+
+def test_multiprocess_cloud_forms(mp_result):
+    assert mp_result["process_count"] == N_PROC
+
+
+def test_multiprocess_gbm_matches_single_process(mp_result):
+    gbm, _, fr = _single_process_reference()
+    assert abs(mp_result["gbm_mse"]
+               - float(gbm.training_metrics["MSE"])) < 1e-4
+    pred = gbm.predict(fr).col("predict").to_numpy()[:16]
+    np.testing.assert_allclose(mp_result["gbm_pred_head"], pred, atol=1e-4)
+
+
+def test_multiprocess_glm_matches_single_process(mp_result):
+    _, glm, _ = _single_process_reference()
+    for k, v in glm.coefficients.items():
+        assert abs(mp_result["glm_coefficients"][k] - v) < 1e-3, k
