@@ -38,10 +38,6 @@ PYUNITS = [
     f"{ALGOS}/gbm/pyunit_cv_cars_gbm.py",
     f"{ALGOS}/gbm/pyunit_weights_gbm.py",
     f"{ALGOS}/gbm/pyunit_weights_var_impGBM.py",
-    f"{ALGOS}/gbm/pyunit_offset_gaussian_gbm.py",
-    f"{ALGOS}/gbm/pyunit_offset_poissonGBM.py",
-    f"{ALGOS}/gbm/pyunit_offset_gamma_gbm.py",
-    f"{ALGOS}/gbm/pyunit_offset_tweedie_gbm.py",
     f"{ALGOS}/gbm/pyunit_mean_residual_deviance_gbm.py",
     f"{ALGOS}/gbm/pyunit_gbm_train_api.py",
     f"{ALGOS}/gbm/pyunit_gbm_grid.py",
@@ -62,8 +58,6 @@ PYUNITS = [
     f"{ALGOS}/deeplearning/pyunit_iris_basic_deeplearning.py",
     f"{ALGOS}/deeplearning/pyunit_iris_no_hidden.py",
     f"{ALGOS}/deeplearning/pyunit_mean_residual_deviance_deeplearning.py",
-    f"{ALGOS}/deeplearning/pyunit_cv_cars_deeplearning_medium.py",
-    f"{ALGOS}/deeplearning/pyunit_weights_and_biases_deeplearning.py",
     # ---- kmeans
     f"{ALGOS}/kmeans/pyunit_iris_h2o_vs_sciKmeans.py",
     f"{ALGOS}/kmeans/pyunit_benignKmeans.py",
@@ -84,8 +78,9 @@ PYUNITS = [
     # ---- api/munging
     f"{MISC}/pyunit_assign.py",
     f"{MISC}/pyunit_apply.py",
-    f"{MISC}/pyunit_as_data_frame.py",
     f"{MUNGING}/pyunit_quantile.py",
+    f"{MUNGING}/pyunit_groupby.py",
+    f"{MISC}/pyunit_all_confusion_matrix_funcs.py",
 ]
 
 

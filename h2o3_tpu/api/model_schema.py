@@ -370,6 +370,16 @@ def model_to_v3(model: Model) -> dict:
         "model_summary": None,
         "help": {},
     }
+    # DeepLearning export_weights_and_biases: frame key refs the client
+    # fetches via output.weights[i].URL (h2o-py/h2o/model/model_base.py:340)
+    if out_src.get("weights_keys"):
+        output["weights"] = [
+            {"name": k, "type": "Key<Frame>", "URL": f"/3/Frames/{k}"}
+            for k in out_src["weights_keys"]]
+        output["biases"] = [
+            {"name": k, "type": "Key<Frame>", "URL": f"/3/Frames/{k}"}
+            for k in out_src.get("biases_keys", [])]
+
     # GLM/GAM: coefficients_table with raw + standardized coefficients
     # (hex/glm GLMModel output; client coef()/coef_norm() read it,
     # h2o-py/h2o/model/model_base.py:685)

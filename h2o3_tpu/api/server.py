@@ -703,6 +703,228 @@ def _model_metrics(params, body, mid=None, fid=None):
     return {"model_metrics": [metrics_v3(mm_, m, frame_key=fid)]}
 
 
+@route("POST", "/3/CreateFrame")
+def _create_frame(params, body):
+    """Synthetic frame generator (water/api/CreateFrameHandler →
+    hex/createframe/): randomized numeric/categorical/integer/binary/
+    time/string columns with missing values and optional response."""
+    p = {k: _coerce(v) for k, v in params.items()}
+    dest = _unquote(str(p.get("dest") or p.get("destination_frame")
+                        or "createframe.hex"))
+    rows = int(p.get("rows") or 100)
+    cols_n = int(p.get("cols") or 10)
+    seed = int(p.get("seed") or -1)
+    r = np.random.RandomState(seed & 0x7FFFFFFF if seed >= 0 else None)
+    cat_f = float(p.get("categorical_fraction") or 0.0)
+    int_f = float(p.get("integer_fraction") or 0.0)
+    bin_f = float(p.get("binary_fraction") or 0.0)
+    time_f = float(p.get("time_fraction") or 0.0)
+    str_f = float(p.get("string_fraction") or 0.0)
+    miss_f = float(p.get("missing_fraction") or 0.0)
+    factors = int(p.get("factors") or 100)
+    real_range = float(p.get("real_range") or 100.0)
+    int_range = int(p.get("integer_range") or 100)
+    bin_ones = float(p.get("binary_ones_fraction") or 0.02)
+    counts = {
+        "cat": int(round(cols_n * cat_f)),
+        "int": int(round(cols_n * int_f)),
+        "bin": int(round(cols_n * bin_f)),
+        "time": int(round(cols_n * time_f)),
+        "str": int(round(cols_n * str_f)),
+    }
+    counts["real"] = max(cols_n - sum(counts.values()), 0)
+    job = Job("create frame", dest=dest)
+
+    def _run(j):
+        arrays, cats, strs = {}, [], []
+        ci = 0
+        for kind, cnt in counts.items():
+            for _ in range(cnt):
+                name = f"C{ci + 1}"
+                ci += 1
+                if kind == "cat":
+                    arrays[name] = np.array(
+                        [f"c{ci}.l{v}" for v in
+                         r.randint(0, max(factors, 1), rows)], object)
+                    cats.append(name)
+                elif kind == "int":
+                    arrays[name] = r.randint(-int_range, int_range + 1,
+                                             rows).astype(np.float64)
+                elif kind == "bin":
+                    arrays[name] = (r.rand(rows) < bin_ones
+                                    ).astype(np.float64)
+                elif kind == "time":
+                    arrays[name] = r.randint(0, 2 ** 40,
+                                             rows).astype(np.float64)
+                elif kind == "str":
+                    arrays[name] = np.array(
+                        [f"s{v}" for v in r.randint(0, 10 ** 6, rows)],
+                        object)
+                    strs.append(name)
+                else:
+                    arrays[name] = r.uniform(-real_range, real_range, rows)
+        if miss_f > 0:
+            for name, arr in arrays.items():
+                mask = r.rand(rows) < miss_f
+                if name in strs or name in cats:
+                    a = arr.astype(object)
+                    a[mask] = None
+                    arrays[name] = a
+                else:
+                    arr[mask] = np.nan
+        if str(p.get("has_response", "")).lower() in ("1", "true"):
+            rf = int(p.get("response_factors") or 2)
+            if rf <= 1:
+                arrays["response"] = r.randn(rows)
+            else:
+                arrays["response"] = np.array(
+                    [f"resp.l{v}" for v in r.randint(0, rf, rows)], object)
+                cats.append("response")
+        fr = Frame.from_numpy(arrays, categorical=cats, strings=strs,
+                              key=dest)
+        DKV.put(dest, fr)
+        j.update(1.0)
+        return fr
+
+    job.start(_run, background=True)
+    return {"job": job.to_dict()}
+
+
+@route("POST", "/3/Interaction")
+def _interaction_ep(params, body):
+    """Categorical interaction features (water/api/InteractionHandler →
+    hex/Interaction: pairwise or full combination of factor columns,
+    capped at max_factors levels by occurrence)."""
+    p = {k: _coerce(v) for k, v in params.items()}
+    src = DKV.get(_unquote(str(p.get("source_frame"))))
+    if not isinstance(src, Frame):
+        raise KeyError(f"frame {p.get('source_frame')} not found")
+    dest = _unquote(str(p.get("dest") or "interaction.hex"))
+    factors = [_unquote(f) for f in _wire_list(p.get("factor_columns"))]
+    pairwise = str(p.get("pairwise", "")).lower() in ("1", "true")
+    max_factors = int(p.get("max_factors") or 100)
+    min_occ = int(p.get("min_occurrence") or 1)
+    job = Job("interaction", dest=dest)
+
+    def _run(j):
+        import itertools
+        from h2o3_tpu.frame.column import T_CAT
+        groups = (list(itertools.combinations(factors, 2)) if pairwise
+                  else [tuple(factors)])
+        arrays, cats, doms = {}, [], {}
+        for grp in groups:
+            name = "_".join(grp)
+            codes = None
+            labels = None
+            for g in grp:
+                c = src.col(g)
+                cc = _fetch_np(c.data)[: src.nrows].astype(np.int64)
+                cna = _fetch_np(c.na_mask)[: src.nrows]
+                lab = np.array([c.domain[v] if 0 <= v < len(c.domain)
+                                else "NA" for v in cc], object)
+                lab[cna] = "NA"
+                labels = lab if labels is None else \
+                    np.char.add(np.char.add(labels.astype(str), "_"),
+                                lab.astype(str))
+            vals, cnts = np.unique(labels, return_counts=True)
+            keep = vals[cnts >= min_occ]
+            if len(keep) > max_factors:
+                keep = vals[np.argsort(-cnts)][:max_factors]
+            keep_set = set(keep.tolist())
+            out = np.array([v if v in keep_set else "other"
+                            for v in labels], object)
+            arrays[name] = out
+            cats.append(name)
+        fr = Frame.from_numpy(arrays, categorical=cats, key=dest)
+        DKV.put(dest, fr)
+        j.update(1.0)
+        return fr
+
+    job.start(_run, background=True)
+    return {"job": job.to_dict()}
+
+
+@route("POST", "/3/MissingInserter")
+def _missing_inserter(params, body):
+    """Insert missing values into a frame in place
+    (water/api/MissingInserterHandler)."""
+    p = {k: _coerce(v) for k, v in params.items()}
+    key = _unquote(str(p.get("dataset")))
+    fr = DKV.get(key)
+    if not isinstance(fr, Frame):
+        raise KeyError(f"frame {key} not found")
+    frac = float(p.get("fraction") or 0.0)
+    seed = int(p.get("seed") or -1)
+    job = Job("insert missing", dest=key)
+
+    def _run(j):
+        r = np.random.RandomState(seed & 0x7FFFFFFF if seed >= 0 else None)
+        arrays, cats, doms, strs = {}, [], {}, []
+        for n in fr.names:
+            c = fr.col(n)
+            if c.type == "string":
+                a = c.to_numpy().astype(object).copy()
+                a[r.rand(fr.nrows) < frac] = None
+                arrays[n] = a
+                strs.append(n)
+            elif c.is_categorical:
+                codes = _fetch_np(c.data)[: fr.nrows].astype(np.int32)
+                codes[_fetch_np(c.na_mask)[: fr.nrows]] = -1
+                codes[r.rand(fr.nrows) < frac] = -1
+                arrays[n] = codes
+                cats.append(n)
+                doms[n] = c.domain
+            else:
+                a = c.to_numpy()
+                a[r.rand(fr.nrows) < frac] = np.nan
+                arrays[n] = a
+        new = Frame.from_numpy(arrays, categorical=cats, domains=doms,
+                               strings=strs, key=key)
+        DKV.put(key, new)
+        j.update(1.0)
+        return new
+
+    job.start(_run, background=True)
+    return job.to_dict()
+
+
+@route("GET", r"/3/Typeahead/files")
+def _typeahead(params, body):
+    """File-path completion (water/api/TypeaheadHandler)."""
+    import glob as _g
+    import os
+    src = _unquote(str(params.get("src") or ""))
+    limit = int(float(params.get("limit") or 100))
+    if os.path.isdir(src):
+        pattern = os.path.join(src, "*")
+    else:
+        pattern = src + "*"
+    matches = sorted(_g.glob(pattern))[:limit]
+    return {"src": src, "limit": limit, "matches": matches}
+
+
+@route("GET", "/3/NetworkTest")
+def _network_test(params, body):
+    """Collective micro-bench over the mesh (water/init/NetworkBench):
+    times a small psum across devices — the ICI/DCN path."""
+    import time as _t
+    import jax
+    import jax.numpy as jnp
+    from h2o3_tpu.parallel.mesh import get_mesh
+    from h2o3_tpu.ops.segments import segment_sum
+    mesh = get_mesh()
+    x = jnp.ones((8192,), jnp.float32)
+    t0 = _t.time()
+    s = segment_sum(jnp.zeros((8192,), jnp.int32), x[:, None],
+                    n_nodes=1, mesh=mesh)
+    float(jnp.sum(s))
+    dt = _t.time() - t0
+    return {"table": [{"op": "psum-32KB",
+                       "devices": len(jax.devices()),
+                       "seconds": round(dt, 5)}],
+            "nodes": [str(d) for d in mesh.devices.flat]}
+
+
 @route("POST", "/3/PartialDependence")
 def _pdp(params, body):
     """water/api/PartialDependenceHandler: grid sweep per feature."""
@@ -911,7 +1133,52 @@ def _automl(params, body):
         return aml
 
     job.start(_run, background=True)
-    return {"job": job.to_dict(), "project_name": aml.project_name}
+    return {"job": job.to_dict(), "project_name": aml.project_name,
+            "build_control": {"project_name": aml.project_name}}
+
+
+def _automl_tables(aml):
+    """leaderboard_table + event_log_table TwoDimTables the real h2o-py
+    parses into H2OFrames (h2o-py/h2o/automl/_base.py:333 _fetch_state)."""
+    from h2o3_tpu.api.model_schema import twodim
+    rows = []
+    tab = aml.leaderboard.as_table()
+    metric_cols = [k for k in (tab[0].keys() if tab else [])
+                   if k != "model_id"]
+    for r in tab:
+        rows.append([str(r.get("model_id"))] +
+                    [r.get(k) for k in metric_cols])
+    # col_types feed straight into H2OFrame(column_types=...), whose
+    # vocabulary is "double"/"string" (h2o-py _fetch_table)
+    lb_table = twodim(
+        "Leaderboard", ["model_id"] + metric_cols,
+        ["string"] + ["double"] * len(metric_cols), rows)
+    ev_rows = [[str(e.get("timestamp", "")), "info",
+                e.get("stage", ""), e.get("message", ""), "", ""]
+               for e in getattr(aml, "event_log", [])]
+    ev_table = twodim(
+        "Event Log",
+        ["timestamp", "level", "stage", "message", "name", "value"],
+        ["string"] * 6, ev_rows)
+    return lb_table, ev_table
+
+
+@route("GET", r"/99/AutoML/(?P<project>[^/]+)")
+def _automl_state(params, body, project=None):
+    """AutoML state fetch (water/api + ai/h2o/automl AutoMLV99): the
+    real client reads project_name, leaderboard.models,
+    leaderboard_table and event_log_table."""
+    aml = DKV.get(f"leaderboard_{project}_result")
+    if aml is None:
+        raise KeyError(f"automl project {project} not found")
+    lb_table, ev_table = _automl_tables(aml)
+    return {"project_name": aml.project_name,
+            "leaderboard": {"models": [
+                {"name": m.key, "type": "Key<Model>"}
+                for m in aml.leaderboard.sorted_models()]},
+            "leaderboard_table": lb_table,
+            "event_log_table": ev_table,
+            "training_info": {}}
 
 
 @route("GET", r"/99/Leaderboards/(?P<project>[^/]+)")
@@ -919,8 +1186,11 @@ def _leaderboard(params, body, project=None):
     aml = DKV.get(f"leaderboard_{project}_result")
     if aml is None:
         raise KeyError(f"automl project {project} not found")
+    lb_table, _ = _automl_tables(aml)
     return {"project_name": project,
-            "models": [m.key for m in aml.leaderboard.sorted_models()],
+            "models": [{"name": m.key, "type": "Key<Model>"}
+                       for m in aml.leaderboard.sorted_models()],
+            "table": lb_table,
             "leaderboard_table": aml.leaderboard.as_table()}
 
 

@@ -58,6 +58,19 @@ def init(backend: Optional[str] = None,
                                   model_axis=model_axis, **kwargs)
     _config.ARGS = cfg
 
+    # persistent XLA compilation cache: repeated sessions (tests, bench,
+    # conformance servers) skip recompiling identical programs — this
+    # both cuts cold-start time and shrinks the exposure to the CPU
+    # backend's flaky-compile crashes observed in long processes
+    try:
+        cache_dir = os.environ.get("H2O3TPU_XLA_CACHE",
+                                   "/tmp/h2o3tpu_xla_cache")
+        os.makedirs(cache_dir, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+    except Exception:
+        pass
+
     if coordinator_address is not None and not _STARTED:
         jax.distributed.initialize(coordinator_address=coordinator_address,
                                    num_processes=num_processes,

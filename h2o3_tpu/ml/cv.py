@@ -62,8 +62,10 @@ def subset_frame(frame: Frame, keep: np.ndarray) -> Frame:
 
 
 def train_with_cv(builder, frame: Frame, x: Sequence[str], y: str,
-                  nfolds: int, job):
-    """Train nfolds+1 models; attach CV metrics to the final model."""
+                  nfolds: int, job, validation_frame: Optional[Frame] = None):
+    """Train nfolds+1 models; attach CV metrics to the final model.
+    A validation_frame flows to the final (main) model only, like the
+    reference (ModelBuilder.java cv_main model keeps _valid)."""
     p = dict(builder.params)
     seed = int(p.get("seed") or 0xF01D)
     scheme = str(p.get("fold_assignment", "modulo") or "modulo").lower()
@@ -123,7 +125,8 @@ def train_with_cv(builder, frame: Frame, x: Sequence[str], y: str,
             cv_pred_keys.append(pf.key)
 
     # final model on all data (ModelBuilder.java "main model")
-    final = builder.__class__(**sub_params)._fit(frame, list(x), y, job)
+    final = builder.__class__(**sub_params)._fit(
+        frame, list(x), y, job, validation_frame=validation_frame)
 
     # CV metrics: NA-response rows excluded, user weights applied — same
     # weighting contract as training metrics

@@ -295,7 +295,8 @@ class DeepLearningEstimator(ModelBuilder):
         input_dropout_ratio=0.0, hidden_dropout_ratios=None,
         l1=0.0, l2=0.0, loss="auto", distribution="auto",
         standardize=True, mini_batch_size=1, seed=-1,
-        autoencoder=False, nfolds=0, weights_column=None,
+        autoencoder=False, export_weights_and_biases=False,
+        nfolds=0, weights_column=None,
         fold_column=None, fold_assignment="auto", ignored_columns=None,
         stopping_rounds=5, stopping_metric="auto", stopping_tolerance=0.0,
         score_interval=5.0, train_samples_per_iteration=-2,
@@ -455,6 +456,23 @@ class DeepLearningEstimator(ModelBuilder):
         model = DeepLearningModel(p, output, params_net, stats_of(di),
                                   list(x), act, bool(p["standardize"]),
                                   resp_stats)
+        if p.get("export_weights_and_biases"):
+            # per-layer weight/bias frames in the DKV
+            # (DeepLearningModelInfo export; client model.weights(i) /
+            # .biases(i) fetch them by key)
+            wkeys, bkeys = [], []
+            for li, layer in enumerate(params_net):
+                Wh = np.asarray(layer["W"], np.float64)
+                wf = Frame.from_numpy(
+                    {f"C{j + 1}": Wh[j] for j in range(Wh.shape[0])},
+                    key=f"{model.key}_weights_{li}")
+                bf = Frame.from_numpy(
+                    {"C1": np.asarray(layer["b"], np.float64).ravel()},
+                    key=f"{model.key}_biases_{li}")
+                wkeys.append(wf.key)
+                bkeys.append(bf.key)
+            model.output["weights_keys"] = wkeys
+            model.output["biases_keys"] = bkeys
         model.training_metrics = model.model_performance(frame)
         if category == ModelCategory.BINOMIAL:
             model.output["default_threshold"] = \

@@ -238,7 +238,8 @@ class ModelBuilder:
             t0 = time.time()
             if nfolds >= 2:
                 from h2o3_tpu.ml.cv import train_with_cv
-                model = train_with_cv(self, training_frame, x, y, nfolds, j)
+                model = train_with_cv(self, training_frame, x, y, nfolds, j,
+                                      validation_frame=validation_frame)
             else:
                 model = self._fit(training_frame, x, y, j,
                                   validation_frame=validation_frame)

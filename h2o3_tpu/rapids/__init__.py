@@ -772,6 +772,20 @@ def _table(env, x, *rest):
     return Frame.from_numpy({n: u, "Count": cnt.astype(np.float64)})
 
 
+@prim("naCnt", "na_cnt")
+def _na_cnt(env, fr):
+    """Per-column NA counts (ast/prims/advmath AstNaCnt)."""
+    f = _as_frame(env.ev(fr))
+    out = []
+    for n in f.names:
+        c = f.col(n)
+        if c.type == "string":
+            out.append(int(sum(v is None for v in c.to_numpy())))
+        else:
+            out.append(int(_fetch_np(c.na_mask)[: f.nrows].sum()))
+    return out
+
+
 @prim("h2o.runif")
 def _runif(env, fr, seed):
     f = _as_frame(env.ev(fr))
