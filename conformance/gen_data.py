@@ -162,6 +162,18 @@ def gen_prostate_variants(sd: str) -> None:
                     f.write(",".join(row[i] for i in keep) + "\n")
 
 
+def gen_prostate_complete(sd: str) -> None:
+    """prostate_complete.csv.zip: complete-case prostate stand-in (the
+    real file is the same schema with no missing rows)."""
+    import zipfile
+    src = os.path.join(sd, "prostate/prostate.csv")
+    dst = os.path.join(sd, "prostate/prostate_complete.csv.zip")
+    if not os.path.exists(src) or os.path.exists(dst):
+        return
+    with zipfile.ZipFile(dst, "w") as z:
+        z.write(src, "prostate_complete.csv")
+
+
 def generate_all(sd: str) -> None:
     gen_cars(sd)
     gen_benign(sd)
@@ -169,3 +181,4 @@ def generate_all(sd: str) -> None:
     gen_higgs_sample(sd)
     gen_airlines(sd)
     gen_prostate_variants(sd)
+    gen_prostate_complete(sd)

@@ -46,7 +46,7 @@ PYUNITS = [
     f"{ALGOS}/gbm/pyunit_staged_predict_gbm.py",
     # ---- glm
     f"{ALGOS}/glm/pyunit_benign_glm.py",
-    f"{ALGOS}/glm/pyunit_prostate_glm.py",
+    f"{ALGOS}/glm/pyunit_pubdev_6292_varimp_check.py",
     f"{ALGOS}/glm/pyunit_cv_cars_glm.py",
     f"{ALGOS}/glm/pyunit_solvers_glm.py",
     f"{ALGOS}/glm/pyunit_mean_residual_deviance_glm.py",

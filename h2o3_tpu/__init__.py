@@ -38,6 +38,7 @@ from h2o3_tpu.io.sql import import_sql_select, import_sql_table
 from h2o3_tpu.io.persist import (load_frame, load_model, persist_manager,
                                  save_frame, save_model)
 from h2o3_tpu.core.kv import DKV
+from h2o3_tpu.core.scope import Scope
 
 __all__ = [
     "__version__",

@@ -83,7 +83,7 @@ def _unquote(s):
 
 
 _WIRE_TYPES = {"numeric": "real", "categorical": "enum",
-               "time": "time", "string": "string"}
+               "time": "time", "string": "string", "uuid": "uuid"}
 
 
 def _col_json(fr: Frame, name: str, row_offset: int, rows: int,
@@ -97,7 +97,7 @@ def _col_json(fr: Frame, name: str, row_offset: int, rows: int,
     lo, hi = row_offset, min(row_offset + rows, fr.nrows)
     wire_type = _WIRE_TYPES.get(c.type, c.type)
     data, string_data, domain = None, None, None
-    if c.type == "string":
+    if c.type in ("string", "uuid"):
         vals = c.to_numpy()[lo:hi]
         string_data = [None if v is None else str(v) for v in vals]
         data = []
@@ -1264,6 +1264,29 @@ def _jstack(params, body):
         out.append({"thread": threads.get(tid, str(tid)),
                     "stack": traceback.format_stack(frame)})
     return {"traces": out}
+
+
+@route("GET", "/3/Profiler")
+def _profiler(params, body):
+    """Statistical CPU profile (water/api/ProfilerHandler): sample every
+    thread's Python stack `depth` times at short intervals and count
+    identical stacks — the reference aggregates JVM stack samples the
+    same way."""
+    import sys
+    import time as _t
+    import traceback
+    depth = int(float(params.get("depth") or 10))
+    counts: Dict[str, int] = {}
+    for _ in range(max(1, min(depth, 100))):
+        for tid, frame in sys._current_frames().items():
+            sig = "".join(traceback.format_stack(frame)[-6:])
+            counts[sig] = counts.get(sig, 0) + 1
+        _t.sleep(0.01)
+    nodes = [{"entries": [
+        {"stacktrace": sig, "count": cnt}
+        for sig, cnt in sorted(counts.items(), key=lambda kv: -kv[1])[:30]
+    ]}]
+    return {"nodes": nodes, "depth": depth}
 
 
 @route("GET", "/3/SelfBench")

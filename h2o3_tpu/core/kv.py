@@ -37,8 +37,13 @@ class _DKV:
     def put(self, key: str, value: Any) -> str:
         import time
         with self._lock:
+            new = key not in self._store
             self._store[key] = value
             self._atime[key] = time.monotonic()
+        if new:
+            # per-call lifetime tracking (water/Scope.track role)
+            from h2o3_tpu.core.scope import track
+            track(key)
         return key
 
     def get(self, key: str) -> Optional[Any]:

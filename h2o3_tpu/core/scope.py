@@ -1,0 +1,61 @@
+"""Scope — per-call key lifetime tracking (water/Scope.java:22).
+
+The reference brackets work units with Scope.enter()/exit(): every key
+created inside the scope is tracked and deleted on exit unless
+explicitly untracked (kept). Here the same contract as a context
+manager; the DKV reports new keys via a put-listener so tracking is
+automatic, like the reference's Scope.track hooks inside Vec/Frame
+constructors.
+
+    with Scope() as s:
+        fr = Frame.from_numpy(...)     # auto-tracked
+        model = est.train(fr, y=...)   # auto-tracked
+        s.keep(model.key)              # survives the scope
+    # fr is gone from the DKV, model remains
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import List, Set
+
+from h2o3_tpu.core.kv import DKV
+
+_local = threading.local()
+
+
+def _stack() -> List["Scope"]:
+    if not hasattr(_local, "stack"):
+        _local.stack = []
+    return _local.stack
+
+
+def track(key: str) -> None:
+    """Called by DKV.put for every new key (Scope.track role)."""
+    st = _stack()
+    if st:
+        st[-1]._tracked.add(key)
+
+
+class Scope:
+    def __init__(self):
+        self._tracked: Set[str] = set()
+        self._kept: Set[str] = set()
+
+    def keep(self, *keys: str) -> None:
+        """Exclude keys from cleanup (Scope.untrack)."""
+        self._kept.update(keys)
+
+    def __enter__(self) -> "Scope":
+        _stack().append(self)
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        _stack().pop()
+        for k in self._tracked - self._kept:
+            DKV.remove(k)
+        # keys kept in a nested scope still belong to the outer scope
+        st = _stack()
+        if st:
+            st[-1]._tracked.update(self._kept)
+        return False

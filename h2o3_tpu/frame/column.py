@@ -27,6 +27,7 @@ import jax.numpy as jnp
 import numpy as np
 
 T_NUM, T_CAT, T_TIME, T_STR = "numeric", "categorical", "time", "string"
+T_UUID = "uuid"      # host-side 128-bit ids (C16Chunk role) — never in math
 
 
 @dataclasses.dataclass
@@ -49,6 +50,10 @@ class Column:
         return self.type == T_CAT
 
     @property
+    def is_uuid(self) -> bool:
+        return self.type == T_UUID
+
+    @property
     def cardinality(self) -> int:
         return len(self.domain) if self.domain else 0
 
@@ -69,7 +74,7 @@ class Column:
         tunnel round trip (~100 ms) regardless of size — one batched
         fetch of (data, mask), then reuse.
         """
-        if self.type == T_STR:
+        if self.type in (T_STR, T_UUID):
             return self.strings[: self.nrows]
         host = getattr(self, "_host_cache", None)
         if host is None:
@@ -90,7 +95,8 @@ def prefetch_host(cols: List["Column"]) -> None:
     batches them into one transfer.
     """
     todo = [c for c in cols
-            if c.type != T_STR and getattr(c, "_host_cache", None) is None]
+            if c.type not in (T_STR, T_UUID)
+            and getattr(c, "_host_cache", None) is None]
     if not todo:
         return
     from h2o3_tpu.parallel.mesh import fetch_replicated

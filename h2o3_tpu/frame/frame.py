@@ -39,6 +39,7 @@ class Frame:
                    categorical: Sequence[str] = (),
                    domains: Optional[Dict[str, List[str]]] = None,
                    strings: Sequence[str] = (),
+                   uuids: Sequence[str] = (),
                    key: Optional[str] = None,
                    block: int = 8) -> "Frame":
         """Build a Frame from host columns (upload path, POST /3/ParseSetup).
@@ -48,7 +49,7 @@ class Frame:
         ``strings`` keeps listed columns as host-side T_STR (no interning
         — the CStrChunk role, never entering math paths).
         """
-        from h2o3_tpu.frame.column import Column, T_STR
+        from h2o3_tpu.frame.column import Column, T_STR, T_UUID
         names = list(arrays.keys())
         n = len(next(iter(arrays.values()))) if names else 0
         npad = mesh_mod.padded_rows(n, block=block)
@@ -56,10 +57,12 @@ class Frame:
         cols = []
         for name in names:
             v = np.asarray(arrays[name])
-            if name in strings:
-                cols.append(Column(name=name, type=T_STR, data=None,
-                                   na_mask=None, nrows=n,
-                                   strings=v.astype(object)))
+            if name in strings or name in uuids:
+                cols.append(Column(
+                    name=name,
+                    type=T_UUID if name in uuids else T_STR,
+                    data=None, na_mask=None, nrows=n,
+                    strings=v.astype(object)))
                 continue
             dom = (domains or {}).get(name)
             if name in categorical and dom is None and v.dtype.kind not in "OUS":

@@ -18,6 +18,7 @@ from __future__ import annotations
 
 import glob as _glob
 import os
+import re as _re
 from typing import Dict, List, Optional, Sequence
 
 import numpy as np
@@ -26,6 +27,10 @@ from h2o3_tpu.frame.frame import Frame
 from h2o3_tpu.utils.log import get_logger
 
 log = get_logger("h2o3_tpu.parse")
+
+_UUID_RX = _re.compile(
+    r"^[0-9a-fA-F]{8}-[0-9a-fA-F]{4}-[0-9a-fA-F]{4}-"
+    r"[0-9a-fA-F]{4}-[0-9a-fA-F]{12}$")
 
 
 def parse_setup(path: str, nrows_sample: int = 1000) -> dict:
@@ -115,8 +120,28 @@ def import_file(path: str, destination_frame: Optional[str] = None,
         parsed = _parse_csv_native(paths, col_types)
         if parsed is not None:
             cols, cats, domains = parsed
+            # UUID detection (water/fvec C16Chunk / Vec.T_UUID): a
+            # "categorical" whose levels are all uuid-shaped and nearly
+            # unique is re-typed as a host-side uuid column
+            uuid_cols = []
+            forced = set(col_types or ())
+            for name in list(cats):
+                if name in forced:       # explicit user type wins
+                    continue
+                dom = domains.get(name) or []
+                n_ = len(cols[name])
+                if dom and len(dom) > max(16, 0.8 * n_) and \
+                        all(_UUID_RX.match(v or "") for v in dom[:64]):
+                    lut = np.array(dom, dtype=object)
+                    codes = np.asarray(cols[name])
+                    vals = np.where(codes >= 0, lut[np.maximum(codes, 0)],
+                                    None)
+                    cols[name] = vals.astype(object)
+                    cats.remove(name)
+                    domains.pop(name, None)
+                    uuid_cols.append(name)
             fr = Frame.from_numpy(cols, categorical=cats, domains=domains,
-                                  key=destination_frame)
+                                  uuids=uuid_cols, key=destination_frame)
             log.info("parsed %s (native) -> %s (%d x %d)", path, fr.key,
                      fr.nrows, fr.ncols)
             return fr

@@ -147,6 +147,7 @@ class KMeansEstimator(ModelBuilder):
     DEFAULTS = dict(
         k=1, max_iterations=10, init="Furthest", standardize=True,
         seed=-1, estimate_k=False, max_runtime_secs=0,
+        cluster_size_constraints=None,
         ignored_columns=None, nfolds=0, fold_column=None, weights_column=None,
         fold_assignment="auto",
     )
@@ -158,6 +159,56 @@ class KMeansEstimator(ModelBuilder):
             raise ValueError(f"unknown KMeans params: {sorted(unknown)}")
         merged.update(params)
         super().__init__(**merged)
+
+    def _run_lloyds_constrained(self, X, w, k, init, key, iters, mins):
+        """Lloyd's with minimum-size constraints: device distances, host
+        greedy margin-based rebalancing per iteration."""
+        centers = _init_centers(X, w, k, init, key)
+        wn = np.asarray(jax.device_get(w))
+        valid = wn > 0
+        if sum(mins) > int(valid.sum()):
+            raise ValueError(
+                f"The sum of cluster_size_constraints ({sum(mins)}) "
+                f"exceeds the number of training rows "
+                f"({int(valid.sum())}).")
+        assign = np.where(valid, 0, -1).astype(np.int64)
+        counts = jnp.zeros((k,), jnp.float32)
+        for _ in range(max(iters, 1)):
+            d2 = np.asarray(_dist2(X, centers))
+            assign = d2.argmin(axis=1)
+            assign[~valid] = -1
+            # fill deficits: move rows with the smallest distance margin
+            for c in range(k):
+                deficit = mins[c] - int((assign == c).sum())
+                if deficit <= 0:
+                    continue
+                margin = d2[:, c] - d2[np.arange(len(assign)),
+                                       np.maximum(assign, 0)]
+                margin[~valid | (assign == c)] = np.inf
+                # only steal from clusters that stay above THEIR minimum
+                for r in np.argsort(margin):
+                    if deficit <= 0 or not np.isfinite(margin[r]):
+                        break
+                    src = assign[r]
+                    if src >= 0 and (assign == src).sum() <= mins[src]:
+                        continue
+                    assign[r] = c
+                    deficit -= 1
+            # recompute centers on device from the (host) assignment
+            a_dev = jnp.asarray(np.maximum(assign, 0).astype(np.int32))
+            stats = segment_sum(
+                a_dev, jnp.concatenate(
+                    [X * w[:, None], w[:, None]], axis=1),
+                n_nodes=k, mesh=get_mesh())
+            counts = stats[:, -1]
+            centers = stats[:, :-1] / jnp.maximum(counts[:, None], 1e-12)
+        d2 = np.asarray(_dist2(X, centers))
+        wss = np.zeros(k)
+        for c in range(k):
+            sel = assign == c
+            wss[c] = float((d2[sel, c] * wn[sel]).sum())
+        return (centers, jnp.asarray(np.maximum(assign, 0)),
+                counts, jnp.asarray(wss))
 
     def _run_lloyds(self, X, w, k, init, key, iters):
         centers = _init_centers(X, w, k, init, key)
@@ -187,7 +238,24 @@ class KMeansEstimator(ModelBuilder):
         iters = int(p["max_iterations"])
         k = int(p["k"])
 
-        if p["estimate_k"]:
+        constraints = p.get("cluster_size_constraints")
+        if constraints is not None:
+            # constrained variant (hex/kmeans/KMeans.java:26 / :101 —
+            # minimal cluster sizes): Lloyd's with a greedy reassignment
+            # that fills under-minimum clusters by smallest distance
+            # margin. estimate_k is rejected like the reference
+            # (KMeans.java:84).
+            if p["estimate_k"]:
+                raise ValueError("Cannot estimate k if "
+                                 "cluster_size_constraints are provided.")
+            mins = [int(v) for v in constraints]
+            if len(mins) != k:
+                raise ValueError(
+                    f"cluster_size_constraints must have k={k} entries")
+            centers, assign, counts, withinss = self._run_lloyds_constrained(
+                di.X, w, k, init, key, iters, mins)
+            job.update(1.0, "constrained lloyds done")
+        elif p["estimate_k"]:
             # greedy k sweep: stop when within-SS reduction falls under 20%
             # (the reference's estimate_k heuristic, hex/kmeans/KMeans.java)
             best = None

@@ -180,6 +180,9 @@ class ModelBuilder:
 
     algo: str = "base"
     supervised: bool = True
+    # fold_column implies CV for normal builders; encoders use the fold
+    # column for leakage handling instead (TargetEncoder)
+    cv_from_fold_column: bool = True
 
     def __init__(self, **params):
         self.params = params
@@ -225,7 +228,8 @@ class ModelBuilder:
         nfolds = int(self.params.get("nfolds") or 0)
         # an explicit fold column triggers CV regardless of nfolds
         # (hex/ModelBuilder.java computeCrossValidation entry conditions)
-        if self.params.get("fold_column") and nfolds < 2:
+        if self.params.get("fold_column") and nfolds < 2 \
+                and self.cv_from_fold_column:
             nfolds = 2      # actual count comes from the fold column
         # the model key must exist BEFORE training starts: the real h2o-py
         # captures job.dest at submission time (h2o-py/h2o/job.py:48)

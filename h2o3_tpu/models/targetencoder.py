@@ -150,6 +150,7 @@ class TargetEncoderEstimator(ModelBuilder):
     (h2o-py/h2o/estimators/targetencoder.py)."""
 
     algo = "targetencoder"
+    cv_from_fold_column = False      # fold column = leakage handling here
 
     DEFAULTS = dict(
         blending=False, inflection_point=10.0, smoothing=20.0,

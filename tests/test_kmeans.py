@@ -59,3 +59,23 @@ def test_kmeans_estimate_k():
     m = KMeansEstimator(k=8, estimate_k=True, seed=11,
                         max_iterations=20).train(f)
     assert 2 <= m.output["k"] <= 4, m.output["k"]
+
+
+def test_kmeans_constrained_minimum_sizes():
+    """cluster_size_constraints (hex/kmeans/KMeans.java:26 constrained
+    variant): every cluster must end with at least its minimum rows."""
+    import numpy as np
+    from h2o3_tpu.models.kmeans import KMeansEstimator
+    r = np.random.RandomState(4)
+    # lopsided blobs: unconstrained k-means would starve the far blob
+    X = np.concatenate([r.randn(380, 2), r.randn(20, 2) + 8.0])
+    fr = h2o3_tpu.Frame.from_numpy({"a": X[:, 0], "b": X[:, 1]})
+    m = KMeansEstimator(k=3, cluster_size_constraints=[100, 100, 100],
+                        seed=1, max_iterations=10).train(fr)
+    sizes = m.output.get("sizes") or [
+        int(v) for v in np.asarray(m.training_metrics["centroid_stats"]["size"])]
+    assert all(s >= 100 for s in sizes), sizes
+    import pytest
+    with pytest.raises(ValueError):
+        KMeansEstimator(k=2, estimate_k=True,
+                        cluster_size_constraints=[5, 5]).train(fr)

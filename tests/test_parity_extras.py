@@ -161,3 +161,19 @@ def test_xls_gated(tmp_path):
     p.write_bytes(b"\xd0\xcf\x11\xe0junk")
     with pytest.raises(ValueError, match="xlsx"):
         h2o3_tpu.import_file(str(p))
+
+
+def test_scope_tracks_and_keeps_keys():
+    """water/Scope.java contract: keys made inside a scope die with it
+    unless kept."""
+    import numpy as np
+    import h2o3_tpu
+    from h2o3_tpu.core.kv import DKV
+    with h2o3_tpu.Scope() as s:
+        fr = h2o3_tpu.Frame.from_numpy({"a": np.arange(8.0)})
+        fr2 = h2o3_tpu.Frame.from_numpy({"b": np.arange(8.0)})
+        s.keep(fr2.key)
+        assert DKV.get(fr.key) is not None
+    assert DKV.get(fr.key) is None           # cleaned
+    assert DKV.get(fr2.key) is not None      # kept
+    DKV.remove(fr2.key)
