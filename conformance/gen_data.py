@@ -162,6 +162,43 @@ def gen_prostate_variants(sd: str) -> None:
                     f.write(",".join(row[i] for i in keep) + "\n")
 
 
+def gen_airlines_train_test(sd: str) -> None:
+    """AirlinesTrain/AirlinesTest.csv.zip stand-ins (schema of the real
+    files: factor-prefixed calendar columns + IsDepDelayed)."""
+    import zipfile
+    for fname, seed, n in (("AirlinesTrain.csv.zip", 21, 6000),
+                           ("AirlinesTest.csv.zip", 22, 3000)):
+        path = os.path.join(sd, "airlines", fname)
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        if os.path.exists(path):
+            continue
+        r = np.random.RandomState(seed)
+        carriers = np.array(["UA", "AA", "DL", "WN"])
+        ports = np.array(["SFO", "JFK", "ORD", "ATL", "DEN", "LAX"])
+        import io
+        buf = io.StringIO()
+        hdr = ["fYear", "fMonth", "fDayofMonth", "fDayOfWeek", "DepTime",
+               "ArrTime", "UniqueCarrier", "Origin", "Dest", "Distance",
+               "IsDepDelayed", "IsDepDelayed_REC"]
+        buf.write(",".join(hdr) + "\n")
+        for i in range(n):
+            mo = r.randint(1, 13)
+            dow = r.randint(1, 8)
+            dep = r.randint(0, 2400)
+            carrier = carriers[r.randint(0, len(carriers))]
+            delayed = (0.03 * (dep - 1000) + (carrier == "UA") * 15
+                       + (mo in (12, 1, 6)) * 8 + r.randn() * 25) > 15
+            buf.write(
+                f"f{1987 + r.randint(0, 20)},f{mo},f{r.randint(1, 29)},"
+                f"f{dow},{dep},{(dep + r.randint(30, 300)) % 2400},"
+                f"{carrier},{ports[r.randint(0, len(ports))]},"
+                f"{ports[r.randint(0, len(ports))]},"
+                f"{r.randint(100, 2500)},"
+                f"{'YES' if delayed else 'NO'},{1 if delayed else -1}\n")
+        with zipfile.ZipFile(path, "w") as z:
+            z.writestr(fname[:-4], buf.getvalue())
+
+
 def gen_prostate_complete(sd: str) -> None:
     """prostate_complete.csv.zip: complete-case prostate stand-in (the
     real file is the same schema with no missing rows)."""
@@ -182,3 +219,4 @@ def generate_all(sd: str) -> None:
     gen_airlines(sd)
     gen_prostate_variants(sd)
     gen_prostate_complete(sd)
+    gen_airlines_train_test(sd)

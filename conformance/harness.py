@@ -29,7 +29,9 @@ sys.path.insert(0, REPO)
 SMALLDATA_LINKS = {
     "prostate/prostate.csv": f"{REF_PY}/h2o/h2o_data/prostate.csv",
     "prostate/prostate.csv.zip": None,     # synthesized (zip of the csv)
-    "iris/iris.csv": "/root/reference/h2o-core/src/main/resources/extdata/iris.csv",
+    # the real smalldata/iris/iris.csv is HEADERLESS (pyunits genfromtxt
+    # it); synthesized from the headered extdata copy in build_smalldata
+    "iris/iris.csv": None,
     "iris/iris_wheader.csv": "/root/reference/h2o-r/h2o-package/inst/extdata/iris_wheader.csv",
     "extdata/australia.csv": "/root/reference/h2o-core/src/main/resources/extdata/australia.csv",
     "extdata/housevotes.csv": "/root/reference/h2o-core/src/main/resources/extdata/housevotes.csv",
@@ -45,6 +47,11 @@ def build_smalldata(root: str) -> str:
         os.makedirs(os.path.dirname(dst), exist_ok=True)
         if src and os.path.exists(src) and not os.path.exists(dst):
             os.symlink(src, dst)
+    iris_hl = os.path.join(sd, "iris/iris.csv")
+    if not os.path.exists(iris_hl):
+        src = "/root/reference/h2o-core/src/main/resources/extdata/iris.csv"
+        with open(src) as f, open(iris_hl, "w") as out:
+            out.writelines(f.readlines()[1:])      # drop the header line
     import zipfile
     pz = os.path.join(sd, "prostate/prostate.csv.zip")
     if not os.path.exists(pz):

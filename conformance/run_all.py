@@ -24,7 +24,7 @@ ALGOS = os.path.join(TESTS, "testdir_algos")
 MISC = os.path.join(TESTS, "testdir_misc")
 MUNGING = os.path.join(TESTS, "testdir_munging")
 
-PER_TEST_TIMEOUT = 420
+PER_TEST_TIMEOUT = 600
 
 # Curated subset (VERDICT round-1 item 1: ≥40 from
 # testdir_algos/{gbm,glm,deeplearning,kmeans,automl}).  Chosen to need
@@ -59,7 +59,8 @@ PYUNITS = [
     f"{ALGOS}/deeplearning/pyunit_iris_no_hidden.py",
     f"{ALGOS}/deeplearning/pyunit_mean_residual_deviance_deeplearning.py",
     # ---- kmeans
-    f"{ALGOS}/kmeans/pyunit_iris_h2o_vs_sciKmeans.py",
+    f"{ALGOS}/kmeans/pyunit_parametersKmeans.py",
+    f"{ALGOS}/kmeans/pyunit_constrained_kmeans.py",
     f"{ALGOS}/kmeans/pyunit_benignKmeans.py",
     f"{ALGOS}/kmeans/pyunit_get_modelKmeans.py",
     f"{ALGOS}/kmeans/pyunit_kmeans_cv.py",
@@ -77,7 +78,7 @@ PYUNITS = [
     f"{ALGOS}/automl/pyunit_automl_train.py",
     # ---- api/munging
     f"{MISC}/pyunit_assign.py",
-    f"{MISC}/pyunit_apply.py",
+    f"{MISC}/pyunit_colnames.py",
     f"{MUNGING}/pyunit_quantile.py",
     f"{MUNGING}/pyunit_groupby.py",
     f"{MISC}/pyunit_all_confusion_matrix_funcs.py",

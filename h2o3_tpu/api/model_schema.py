@@ -21,14 +21,22 @@ from h2o3_tpu.models.model import Model
 
 def twodim(name: str, col_names: List[str], col_types: List[str],
            rows: List[list], description: str = "",
-           col_formats: Optional[List[str]] = None) -> dict:
+           col_formats: Optional[List[str]] = None,
+           row_headers: Optional[List[str]] = None) -> dict:
     """TwoDimTableV3: data is COLUMN-major on the wire
     (water/api/schemas3/TwoDimTableV3.java; h2o-py transposes it back in
-    H2OTwoDimTable._parse_values)."""
-    ncol = len(col_names)
-    data = [[_clean(r[j]) for r in rows] for j in range(ncol)]
+    H2OTwoDimTable._parse_values). ``row_headers`` prepends the
+    reference's unnamed row-header column — clients index cell_values
+    positionally, so its presence must match the reference table."""
     fmts = col_formats or ["%s" if t == "string" else "%f"
                            for t in col_types]
+    if row_headers is not None:
+        col_names = [""] + list(col_names)
+        col_types = ["string"] + list(col_types)
+        fmts = ["%s"] + list(fmts)
+        rows = [[str(h)] + list(r) for h, r in zip(row_headers, rows)]
+    ncol = len(col_names)
+    data = [[_clean(r[j]) for r in rows] for j in range(ncol)]
     return {
         "__meta": {"schema_version": 3, "schema_name": "TwoDimTableV3",
                    "schema_type": "TwoDimTable"},
@@ -168,6 +176,11 @@ def metrics_v3(mm, model: Model, frame_key: str = "",
             "mean_per_class_error": _clean(d.get("mean_per_class_error")),
             "domain": dom,
             "gains_lift_table": None,
+            # present-but-None when no score histogram exists (e.g. DRF
+            # with sample_rate=1.0 → no OOB rows): the client reads
+            # these keys unconditionally (pyunit_no_oob_prostateRF)
+            "thresholds_and_metric_scores": None,
+            "max_criteria_and_metric_scores": None,
         })
         out.update(_binomial_tables(mm))
     elif kind == "Multinomial":
@@ -237,7 +250,8 @@ def metrics_v3(mm, model: Model, frame_key: str = "",
             cs_table = twodim(
                 "Centroid Statistics",
                 ["centroid", "size", "within_cluster_sum_of_squares"],
-                ["int32", "float64", "float64"], rows)
+                ["int32", "float64", "float64"], rows,
+                row_headers=[str(i + 1) for i in range(len(rows))])
         out.update({
             "tot_withinss": _clean(d.get("tot_withinss")),
             "totss": _clean(d.get("totss")),
@@ -273,8 +287,34 @@ def _params_v3(model: Model) -> List[dict]:
         defaults = dict(getattr(cls, "DEFAULTS", {}))
     except Exception:
         defaults = {}
-    names = sorted(set(defaults) | set(model.params))
-    out = []
+    hidden = set()
+    try:
+        hidden = set(getattr(cls, "SCHEMA_HIDDEN_PARAMS", ()))
+    except Exception:
+        pass
+    names = sorted((set(defaults) | set(model.params)) - hidden)
+    out = [
+        # pseudo-parameters every reference schema carries; clients
+        # rebuild estimators from this list (pyunit_parametersKmeans
+        # deletes these names explicitly)
+        {"__meta": {"schema_version": 3,
+                    "schema_name": "ModelParameterSchemaV3",
+                    "schema_type": "Iced"},
+         "name": nm, "label": nm, "help": nm, "required": False,
+         "type": "Key", "default_value": None,
+         "actual_value": av_, "input_value": av_,
+         "level": "critical", "values": [], "gridable": False,
+         "is_member_of_frames": [], "is_mutually_exclusive_with": []}
+        for nm, av_ in (
+            ("model_id", {"name": model.key, "type": "Key<Model>"}),
+            ("training_frame",
+             {"name": str(model.output.get("training_frame") or ""),
+              "type": "Key<Frame>"}),
+            ("validation_frame", None),
+            ("max_runtime_secs", 0.0),
+        ) + ((("response_column", model.output.get("response")),)
+             if model.output.get("response") else ())
+        if nm not in defaults and nm not in model.params]
     for n in names:
         dv = defaults.get(n)
         av = model.params.get(n, dv)
@@ -356,7 +396,16 @@ def model_to_v3(model: Model) -> dict:
         "validation_metrics": metrics_v3(model.validation_metrics, model),
         "cross_validation_metrics":
             metrics_v3(model.cross_validation_metrics, model),
-        "cross_validation_metrics_summary": None,
+        "cross_validation_metrics_summary": (
+            twodim("Cross-Validation Metrics Summary",
+                   ["mean", "sd"] + [
+                       f"cv_{i + 1}_valid" for i in range(
+                           int(out_src.get("cv_summary_nfolds") or 0))],
+                   ["float64"] * (2 + int(out_src.get("cv_summary_nfolds")
+                                          or 0)),
+                   [r[1:] for r in out_src["cv_summary_rows"]],
+                   row_headers=[r[0] for r in out_src["cv_summary_rows"]])
+            if out_src.get("cv_summary_rows") else None),
         "cross_validation_models":
             [{"name": k, "type": "Key<Model>"} for k in
              out_src.get("cv_model_keys", [])] or None,
@@ -411,27 +460,40 @@ def model_to_v3(model: Model) -> dict:
             ["names", "coefficients", "standardized_coefficients"],
             ["string", "float64", "float64"], rows,
             "glm coefficients")
+        if output.get("variable_importances") is None:
+            # GLM varimp = |standardized coefficient| (hex/glm GLMModel
+            # standardized-coefficient-magnitudes table)
+            mags = sorted(zip(names[:-1], np.abs(std_c[:-1])),
+                          key=lambda t: -t[1])
+            mx = max((m for _, m in mags), default=1.0) or 1.0
+            tot = sum(m for _, m in mags) or 1.0
+            output["variable_importances"] = twodim(
+                "Standardized Coefficient Magnitudes",
+                ["variable", "relative_importance", "scaled_importance",
+                 "percentage"],
+                ["string", "float64", "float64", "float64"],
+                [[nm, float(m), float(m / mx), float(m / tot)]
+                 for nm, m in mags])
 
     # KMeans: centers tables (client centers()/centers_std() read
     # output.centers.cell_values, h2o-py/h2o/model/models/clustering.py:233)
     if model.algo == "kmeans" and out_src.get("centers") is not None:
         cvals = out_src["centers"]
-        rows = [[i + 1] + [float(v) for v in c]
-                for i, c in enumerate(cvals)]
-        width = len(rows[0]) - 1 if rows else 0
+        rows = [[float(v) for v in c] for c in cvals]
+        width = len(rows[0]) if rows else 0
         cand = list(out_src.get("coef_names") or [])
         if len(cand) != width:
             cand = list(out_src.get("names") or [])[:width]
-        cols_t = ["centroid"] + cand
+        rh = [str(i + 1) for i in range(len(rows))]
         output["centers"] = twodim(
-            "Cluster means", cols_t,
-            ["int32"] + ["float64"] * (len(cols_t) - 1), rows)
+            "Cluster means", cand, ["float64"] * len(cand), rows,
+            row_headers=rh)
         if out_src.get("centers_std") is not None:
-            rows_s = [[i + 1] + [float(v) for v in c]
-                      for i, c in enumerate(out_src["centers_std"])]
+            rows_s = [[float(v) for v in c]
+                      for c in out_src["centers_std"]]
             output["centers_std"] = twodim(
-                "Standardized cluster means", cols_t,
-                ["int32"] + ["float64"] * (len(cols_t) - 1), rows_s)
+                "Standardized cluster means", cand,
+                ["float64"] * len(cand), rows_s, row_headers=rh)
 
     # algo-specific output extras (GLM coefficients, KMeans centers, ...)
     for k, v in out_src.items():

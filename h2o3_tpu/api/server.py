@@ -299,6 +299,19 @@ def _wire_list(src) -> List[str]:
     return out
 
 
+def _wire_map(s: str) -> dict:
+    """Decode stringify_dict_as_map output: near-JSON where bare words
+    (enum/string values like bernoulli) arrive unquoted
+    (h2o-py/h2o/utils/shared_utils.py:167)."""
+    s = s.replace("'", '"')
+    # quote bare identifiers that aren't JSON literals
+    s = re.sub(
+        r'(?<![\w"])(?!true\b|false\b|null\b)'
+        r'([A-Za-z_][A-Za-z0-9_.\-]*)(?!["\w])(?=\s*[,\]\}])',
+        r'"\1"', s)
+    return json.loads(s)
+
+
 def _src_list(params) -> List[str]:
     """source_frames / paths param → clean list of path strings."""
     src = params.get("source_frames") or params.get("paths") or \
@@ -392,15 +405,24 @@ def _parse(params, body):
                 col_types[n] = mapped
     job = Job(f"parse {srcs[0]}", dest=dest)
 
+    ch = params.get("check_header")
+    header = None
+    if ch is not None:
+        ch = int(float(ch))
+        header = True if ch == 1 else (False if ch == -1 else None)
+
     def _run(j):
         if len(srcs) == 1:
             fr = import_file(srcs[0], destination_frame=dest,
-                             col_types=col_types)
+                             col_types=col_types, header=header)
+            if names and len(names) == fr.ncols and \
+                    list(names) != list(fr.names):
+                fr.rename_columns(list(names))
         else:
             import pandas as pd
             parts = []
             for s in srcs:
-                part = import_file(s, col_types=col_types)
+                part = import_file(s, col_types=col_types, header=header)
                 parts.append(part.to_pandas())
                 DKV.remove(part.key)     # intermediate per-file frames
             fr = Frame.from_pandas(pd.concat(parts, ignore_index=True),
@@ -620,6 +642,7 @@ def _predict(params, body, mid=None, fid=None):
         return str(params.get(name, "")).lower() in ("1", "true", "yes")
     for flag, meth in (("leaf_node_assignment", "predict_leaf_node_assignment"),
                        ("predict_staged_proba", "staged_predict_proba"),
+                       ("feature_frequencies", "feature_frequencies"),
                        ("predict_contributions", "predict_contributions")):
         if _flag(flag):
             fn = getattr(m, meth, None)
@@ -633,8 +656,21 @@ def _predict(params, body, mid=None, fid=None):
     DKV.remove(preds.key)
     preds.key = str(dest)
     DKV.put(preds.key, preds)
+    # scoring computes metrics when the response is present (the
+    # reference's BigScore fills a MetricBuilder during predict; the
+    # client's multinomial confusion_matrix(data=...) reads
+    # model_metrics[0].cm from THIS response)
+    metrics_list = [{}]
+    try:
+        resp = m.output.get("response")
+        if resp and resp in fr:
+            from h2o3_tpu.api.model_schema import metrics_v3
+            metrics_list = [metrics_v3(m.model_performance(fr), m,
+                                       frame_key=fr.key)]
+    except Exception:
+        pass
     return {"predictions_frame": {"name": preds.key},
-            "model_metrics": [{}]}
+            "model_metrics": metrics_list}
 
 
 @route("POST", r"/4/Predictions/models/(?P<mid>[^/]+)/frames/(?P<fid>[^/]+)")
@@ -980,10 +1016,10 @@ def _grid_build(params, body, algo=None):
     p = {k: _coerce(v) for k, v in params.items()}
     hyper = p.pop("hyper_parameters", None) or {}
     if isinstance(hyper, str):
-        hyper = json.loads(hyper.replace("'", '"'))
+        hyper = _wire_map(hyper)
     criteria = p.pop("search_criteria", None)
     if isinstance(criteria, str):
-        criteria = json.loads(criteria.replace("'", '"'))
+        criteria = _wire_map(criteria)
     frame_key = str(p.pop("training_frame", None))
     y = p.pop("response_column", None)
     valid_key = p.pop("validation_frame", None)

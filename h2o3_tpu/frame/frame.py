@@ -72,6 +72,19 @@ class Frame:
             cols.append(column_from_numpy(name, v, npad, shard, domain=dom))
         return Frame(cols, n, key=key)
 
+    def rename_columns(self, new_names) -> "Frame":
+        """In-place positional rename (h2o-py set_names / Parse
+        column_names)."""
+        assert len(new_names) == len(self._order)
+        new_cols = {}
+        for old, new in zip(list(self._order), new_names):
+            c = self._cols.pop(old)
+            c.name = new
+            new_cols[new] = c
+        self._cols = new_cols
+        self._order = list(new_names)
+        return self
+
     @staticmethod
     def from_pandas(df, key: Optional[str] = None) -> "Frame":
         import pandas.api.types as pt

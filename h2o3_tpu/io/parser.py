@@ -64,7 +64,11 @@ def parse_setup(path: str, nrows_sample: int = 1000) -> dict:
         DKV.remove(fr.key)
         return {"columns": cols, "types": types, "separator": ",",
                 "header": True}
-    sample = pd.read_csv(path, nrows=nrows_sample)
+    has_header = guess_header(path)
+    sample = pd.read_csv(path, nrows=nrows_sample,
+                         header=0 if has_header else None)
+    if not has_header:
+        sample.columns = [f"C{i + 1}" for i in range(sample.shape[1])]
     types = {}
     for c in sample.columns:
         # pandas >= 3.0 infers text columns as 'str' dtype, not object
@@ -74,11 +78,41 @@ def parse_setup(path: str, nrows_sample: int = 1000) -> dict:
         else:
             types[c] = "numeric"
     return {"columns": list(sample.columns), "types": types,
-            "separator": ",", "header": True}
+            "separator": ",", "header": has_header}
+
+
+def _is_num_token(t: str) -> bool:
+    try:
+        float(t)
+        return True
+    except ValueError:
+        return False
+
+
+def guess_header(path: str) -> bool:
+    """ParseSetup header guess (water/parser/CsvParser.java guess logic):
+    a header exists when the first row is all-non-numeric while a later
+    row has at least one numeric field."""
+    import gzip
+    if not path.endswith((".csv", ".csv.gz")):
+        return True          # containers (zip/parquet) sniff elsewhere
+    op = gzip.open if path.endswith(".gz") else open
+    try:
+        with op(path, "rt", errors="replace") as f:
+            first = f.readline().strip().split(",")
+            second = f.readline().strip().split(",")
+    except OSError:
+        return True
+    if not second or second == [""]:
+        return True
+    first_numeric = any(_is_num_token(t) for t in first if t != "")
+    second_numeric = any(_is_num_token(t) for t in second if t != "")
+    return (not first_numeric) and second_numeric
 
 
 def import_file(path: str, destination_frame: Optional[str] = None,
-                col_types: Optional[Dict[str, str]] = None) -> Frame:
+                col_types: Optional[Dict[str, str]] = None,
+                header: Optional[bool] = None) -> Frame:
     """h2o.import_file analogue (h2o-py/h2o/h2o.py:414).
 
     Accepts a file path, glob, or directory; CSV(.gz/.zip) and Parquet.
@@ -116,8 +150,12 @@ def import_file(path: str, destination_frame: Optional[str] = None,
     # (h2o3_tpu/native/csv_parser.cpp — the water/parser CsvParser role);
     # anything else (parquet, zip containers, unknown extensions) and any
     # native-parse failure fall back to pandas.
+    if header is None and paths[0].endswith((".csv", ".csv.gz")):
+        # only plain text csv: zips/parquet sniff via their own readers
+        header = guess_header(paths[0])
     if all(f.endswith((".csv", ".csv.gz")) for f in paths):
-        parsed = _parse_csv_native(paths, col_types)
+        parsed = _parse_csv_native(paths, col_types,
+                                   header=True if header is None else header)
         if parsed is not None:
             cols, cats, domains = parsed
             # UUID detection (water/fvec C16Chunk / Vec.T_UUID): a
@@ -151,6 +189,10 @@ def import_file(path: str, destination_frame: Optional[str] = None,
     for f in paths:
         if f.endswith((".parquet", ".pq")):
             frames.append(pd.read_parquet(f))
+        elif header is False:
+            df_ = pd.read_csv(f, header=None)
+            df_.columns = [f"C{i + 1}" for i in range(df_.shape[1])]
+            frames.append(df_)
         else:
             frames.append(pd.read_csv(f))
     df = pd.concat(frames, ignore_index=True) if len(frames) > 1 else frames[0]
@@ -166,7 +208,8 @@ def import_file(path: str, destination_frame: Optional[str] = None,
 
 
 def _parse_csv_native(paths: List[str],
-                      col_types: Optional[Dict[str, str]]):
+                      col_types: Optional[Dict[str, str]],
+                      header: bool = True):
     """Multi-file native CSV parse; returns (cols, categorical names) or
     None to fall back. Gzip members are decompressed into the buffer
     (the tokenizer parses bytes, like the reference's ZipUtil front)."""
@@ -182,7 +225,7 @@ def _parse_csv_native(paths: List[str],
                 data = open(f, "rb").read()
         except OSError:
             return None
-        res = parse_csv_bytes(data, decode=False)
+        res = parse_csv_bytes(data, header=header, decode=False)
         if res is None:
             return None
         cols, domains = res

@@ -84,6 +84,40 @@ def train_with_cv(builder, frame: Frame, x: Sequence[str], y: str,
 
     sub_params = {**p, "nfolds": 0, "fold_column": None}
     job._work = nfolds + 1.0  # nfolds CV fits + the final model
+
+    if y is None:
+        # unsupervised CV (KMeans nfolds, hex/ModelBuilder unsupervised
+        # path): train per-fold models + the final model; CV metrics are
+        # the final model's metrics minus centroid_stats (the reference
+        # serves cv metrics with centroid_stats == null —
+        # pyunit_kmeans_cv contract)
+        cv_models = []
+        for f in range(nfolds):
+            mask_tr = (np.arange(frame.nrows) % nfolds) != f
+            tr = subset_frame(frame, mask_tr)
+            m = builder.__class__(**sub_params)._fit(tr, list(x), None, job)
+            cv_models.append(m)
+        final = builder.__class__(**sub_params)._fit(
+            frame, list(x), None, job, validation_frame=validation_frame)
+        import copy
+        cvm = copy.copy(final.training_metrics)
+        if cvm is not None and hasattr(cvm, "extra"):
+            cvm.extra = dict(cvm.extra)
+            cvm.extra["centroid_stats"] = None
+        final.cross_validation_metrics = cvm
+        from h2o3_tpu.core.kv import DKV
+        cv_keys = []
+        for i, m in enumerate(cv_models):
+            new_key = f"{final.key}_cv_{i + 1}"
+            DKV.remove(m.key)
+            m.key = new_key
+            DKV.put(new_key, m)
+            cv_keys.append(new_key)
+        final.output["cv_model_keys"] = cv_keys
+        final.output["nfolds"] = nfolds
+        final._cv_models = cv_models
+        return final
+
     n = frame.nrows
     cv_models = []
     if category == ModelCategory.MULTINOMIAL:
@@ -94,6 +128,7 @@ def train_with_cv(builder, frame: Frame, x: Sequence[str], y: str,
 
     keep_preds = bool(p.get("keep_cross_validation_predictions"))
     cv_pred_keys = []
+    fold_metric_dicts = []
     for f in range(nfolds):
         mask_tr = folds != f
         tr = subset_frame(frame, mask_tr)
@@ -103,6 +138,14 @@ def train_with_cv(builder, frame: Frame, x: Sequence[str], y: str,
         cv_models.append(m)
         preds = m._score_raw(te)
         idx = np.where(~mask_tr)[0]
+        # per-fold holdout metrics feed cross_validation_metrics_summary
+        # (reference cvModelBuilder per-fold _validation metrics)
+        try:
+            fm = m.model_performance(te)
+            fold_metric_dicts.append(fm.to_dict()
+                                     if hasattr(fm, "to_dict") else {})
+        except Exception:
+            fold_metric_dicts.append({})
         if category == ModelCategory.BINOMIAL:
             holdout[idx] = preds["p1"]
         elif category == ModelCategory.MULTINOMIAL:
@@ -165,6 +208,21 @@ def train_with_cv(builder, frame: Frame, x: Sequence[str], y: str,
         DKV.put(new_key, m)
         cv_keys.append(new_key)
     final.output["cv_model_keys"] = cv_keys
+    # mean/sd/per-fold summary rows (client
+    # cross_validation_metrics_summary)
+    keys_union = sorted({k for d in fold_metric_dicts for k, v in d.items()
+                         if isinstance(v, (int, float))})
+    summary_rows = []
+    for kname in keys_union:
+        vals = [d.get(kname) for d in fold_metric_dicts]
+        vals = [float(v) for v in vals if isinstance(v, (int, float))]
+        if not vals:
+            continue
+        summary_rows.append(
+            [kname, float(np.mean(vals)), float(np.std(vals))] +
+            [float(v) for v in vals])
+    final.output["cv_summary_rows"] = summary_rows
+    final.output["cv_summary_nfolds"] = nfolds
     final._cv_holdout = holdout
     final._cv_models = cv_models
     final._cv_folds = folds

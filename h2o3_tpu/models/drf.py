@@ -134,6 +134,12 @@ class DRFModel(Model):
         from h2o3_tpu.models.tree import leaf_assignment_frame
         return leaf_assignment_frame(self, frame)
 
+    def feature_frequencies(self, frame: Frame) -> Frame:
+        """Per-row feature usage counts on decision paths
+        (h2o-py model.feature_frequencies / SharedTreeModel)."""
+        from h2o3_tpu.models.tree import feature_frequencies_frame
+        return feature_frequencies_frame(self, frame)
+
     def predict_contributions(self, frame: Frame) -> Frame:
         """TreeSHAP contributions; rows sum to the (unclipped) averaged
         vote — the reference DRF contributions contract."""

@@ -310,6 +310,12 @@ class GBMModel(Model):
         from h2o3_tpu.models.tree import leaf_assignment_frame
         return leaf_assignment_frame(self, frame)
 
+    def feature_frequencies(self, frame: Frame) -> Frame:
+        """Per-row feature usage counts on decision paths
+        (h2o-py model.feature_frequencies / SharedTreeModel)."""
+        from h2o3_tpu.models.tree import feature_frequencies_frame
+        return feature_frequencies_frame(self, frame)
+
     def staged_predict_proba(self, frame: Frame) -> Frame:
         """Cumulative per-stage probabilities (h2o-py
         staged_predict_proba; SharedTreeModel staged scoring): column

@@ -143,11 +143,15 @@ class KMeansEstimator(ModelBuilder):
 
     algo = "kmeans"
     supervised = False
+    # supported internally but not a reference H2OKMeansEstimator
+    # parameter — hidden from the REST schema so clients can re-create
+    # estimators from the parameters list (pyunit_parametersKmeans)
+    SCHEMA_HIDDEN_PARAMS = {"weights_column"}
 
     DEFAULTS = dict(
         k=1, max_iterations=10, init="Furthest", standardize=True,
         seed=-1, estimate_k=False, max_runtime_secs=0,
-        cluster_size_constraints=None,
+        cluster_size_constraints=None, user_points=None,
         ignored_columns=None, nfolds=0, fold_column=None, weights_column=None,
         fold_assignment="auto",
     )
@@ -160,10 +164,12 @@ class KMeansEstimator(ModelBuilder):
         merged.update(params)
         super().__init__(**merged)
 
-    def _run_lloyds_constrained(self, X, w, k, init, key, iters, mins):
+    def _run_lloyds_constrained(self, X, w, k, init, key, iters, mins,
+                                centers0=None):
         """Lloyd's with minimum-size constraints: device distances, host
         greedy margin-based rebalancing per iteration."""
-        centers = _init_centers(X, w, k, init, key)
+        centers = centers0 if centers0 is not None \
+            else _init_centers(X, w, k, init, key)
         wn = np.asarray(jax.device_get(w))
         valid = wn > 0
         if sum(mins) > int(valid.sum()):
@@ -238,6 +244,42 @@ class KMeansEstimator(ModelBuilder):
         iters = int(p["max_iterations"])
         k = int(p["k"])
 
+        user_pts = p.get("user_points")
+        if user_pts is not None:
+            # user-supplied starting centers (KMeans.java init=User):
+            # raw-space points standardized into the design space
+            from h2o3_tpu.core.kv import DKV as _DKV
+            if isinstance(user_pts, str):
+                user_pts = _DKV.get(user_pts.strip('"'))
+            pts = np.stack([user_pts.col(nm).to_numpy()
+                            for nm in user_pts.names], axis=1)
+            k = pts.shape[0]
+            if bool(p["standardize"]):
+                mus = np.asarray(di.num_means)
+                sds = np.asarray(di.num_sigmas)
+                pts = (pts - mus[None, :len(mus)]) / sds[None, :len(sds)]
+            centers0 = jnp.asarray(pts, jnp.float32)
+            constraints = p.get("cluster_size_constraints")
+            if constraints is not None:
+                mins = [int(v) for v in constraints]
+                if len(mins) != k:
+                    raise ValueError(
+                        f"cluster_size_constraints must have k={k} entries")
+                centers, assign, counts, withinss = \
+                    self._run_lloyds_constrained(
+                        di.X, w, k, init, key, iters, mins,
+                        centers0=centers0)
+            else:
+                centers = centers0
+                assign = counts = withinss = None
+                for _ in range(max(iters, 1)):
+                    centers, assign, counts, withinss = _lloyd_step(
+                        di.X, w, centers, k=k)
+            job.update(1.0, "lloyds done (user init)")
+            return self._finish_model(frame, x, y, p, di, w, centers,
+                                      assign, counts, withinss, k,
+                                      validation_frame)
+
         constraints = p.get("cluster_size_constraints")
         if constraints is not None:
             # constrained variant (hex/kmeans/KMeans.java:26 / :101 —
@@ -274,6 +316,12 @@ class KMeansEstimator(ModelBuilder):
                 di.X, w, k, init, key, iters)
             job.update(1.0, "lloyds done")
 
+        return self._finish_model(frame, x, y, p, di, w, centers, assign,
+                                  counts, withinss, k, validation_frame)
+
+    def _finish_model(self, frame, x, y, p, di, w, centers, assign,
+                      counts, withinss, k, validation_frame):
+        from h2o3_tpu.parallel.mesh import get_mesh as _gm
         # de-standardized centers for reporting (numeric block only)
         cstd = np.asarray(centers)
         c_out = cstd.copy()
@@ -297,7 +345,7 @@ class KMeansEstimator(ModelBuilder):
         model = KMeansModel(p, output, centers, stats_of(di), list(x),
                             bool(p["standardize"]))
         model.training_metrics = _clustering_metrics(di.X, w, counts,
-                                                     withinss, mesh)
+                                                     withinss, _gm())
         if validation_frame is not None:
             model.validation_metrics = model.model_performance(validation_frame)
         return model

@@ -226,6 +226,10 @@ class ModelBuilder:
         training frame and attached to training_metrics as 'custom'."""
         x = self.resolve_x(training_frame, x, y)
         nfolds = int(self.params.get("nfolds") or 0)
+        if nfolds == 1 or nfolds < 0:
+            raise ValueError(
+                "nfolds must be either 0 or >1 (got %d) — reference "
+                "ModelBuilder cross-validation contract" % nfolds)
         # an explicit fold column triggers CV regardless of nfolds
         # (hex/ModelBuilder.java computeCrossValidation entry conditions)
         if self.params.get("fold_column") and nfolds < 2 \

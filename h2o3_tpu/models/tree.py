@@ -315,6 +315,48 @@ def _route(tree: Tree, bins, B: int):
     return nid
 
 
+@partial(jax.jit, static_argnames=("B", "F"))
+def feature_path_counts(stacked: Tree, bins, B: int, F: int):
+    """Per-row counts of feature usage along decision paths, summed over
+    all trees [N, F] — hex/tree SharedTreeModel feature_frequencies
+    (h2o-py model.feature_frequencies)."""
+
+    def step(counts, tree):
+        N = bins.shape[0]
+        D = tree.feat.shape[0]
+        nid = jnp.zeros((N,), jnp.int32)
+        for d in range(D):
+            f_r = tree.feat[d][nid]
+            t_r = tree.thresh[d][nid]
+            nal_r = tree.na_left[d][nid]
+            isp_r = tree.is_split[d][nid]
+            onehot = (f_r[:, None] ==
+                      jnp.arange(F, dtype=jnp.int32)[None, :])
+            counts = counts + jnp.where(isp_r[:, None] & onehot, 1, 0)
+            b_r = row_feature_values(bins, f_r)
+            isna = b_r == (B - 1)
+            goleft = jnp.where(isp_r, jnp.where(isna, nal_r, b_r <= t_r),
+                               True)
+            nid = 2 * nid + jnp.where(goleft, 0, 1)
+        return counts, None
+
+    counts0 = jnp.zeros((bins.shape[0], F), jnp.int32)
+    counts, _ = jax.lax.scan(step, counts0, stacked)
+    return counts
+
+
+def feature_frequencies_frame(model, frame):
+    """Per-feature usage counts as a Frame (h2o-py feature_frequencies)."""
+    from h2o3_tpu.frame.binning import rebin_for_scoring
+    from h2o3_tpu.frame.frame import Frame
+    bm = rebin_for_scoring(model.bm, frame)
+    F = bm.bins.shape[1]
+    counts = np.asarray(feature_path_counts(
+        model.forest, bm.bins, model.bm.nbins_total, F))[: frame.nrows]
+    return Frame.from_numpy({bm.names[j]: counts[:, j].astype(np.float64)
+                             for j in range(F)})
+
+
 @partial(jax.jit, static_argnames=("B",))
 def leaf_assignments(stacked: Tree, bins, B: int):
     """Per-tree terminal leaf id for every row [N, T] — the

@@ -830,7 +830,8 @@ def _sort(env, fr, cols_sel, *asc):
 
 
 _GB_AGGS = {"sum": "sum", "mean": "mean", "min": "min", "max": "max",
-            "count": "count", "nrow": "count", "sd": "sd", "var": "var",
+            "count": "count", "nrow": "count", "sd": "sd", "sdev": "sd",
+            "var": "var", "sumSquares": "ss",
             "median": "median", "mode": "mode"}
 
 
@@ -884,7 +885,7 @@ def _groupby(env, fr, by_sel, *aggs):
         cname = _resolve_cols(f, colsel)[0] if colsel is not None else by[0]
         c = f.col(cname)
         label = f"{aname}_{cname}" if aname != "count" else "nrow"
-        if aname in ("count", "sum", "mean", "var", "sd"):
+        if aname in ("count", "sum", "mean", "var", "sd", "ss"):
             v = c.numeric_view()
             okv = ~jnp.isnan(v)
             w = valid_dev * okv.astype(jnp.float32)
@@ -899,6 +900,8 @@ def _groupby(env, fr, by_sel, *aggs):
                 out[label] = cnt
             elif aname == "sum":
                 out[label] = s1
+            elif aname == "ss":
+                out[label] = s2
             elif aname == "mean":
                 out[label] = s1 / np.maximum(cnt, 1e-12)
             else:
@@ -1134,22 +1137,28 @@ def _nlevels(env, x):
     return float(f.col(f.names[0]).cardinality)
 
 
+def _per_column_flags(f, pred):
+    """Per-column 0/1 list — h2o-py's isfactor()/isnumeric()/isstring()
+    iterate the scalar result (h2o-py/h2o/frame.py:1820)."""
+    return [float(pred(f.col(n))) for n in f.names]
+
+
 @prim("is.factor")
 def _is_factor(env, x):
     f = _as_frame(env.ev(x))
-    return float(all(f.col(n).is_categorical for n in f.names))
+    return _per_column_flags(f, lambda c: c.is_categorical)
 
 
 @prim("is.numeric")
 def _is_numeric(env, x):
     f = _as_frame(env.ev(x))
-    return float(all(f.col(n).is_numeric for n in f.names))
+    return _per_column_flags(f, lambda c: c.is_numeric)
 
 
 @prim("is.character")
 def _is_character(env, x):
     f = _as_frame(env.ev(x))
-    return float(all(f.col(n).type == "string" for n in f.names))
+    return _per_column_flags(f, lambda c: c.type == "string")
 
 
 @prim("anyfactor")
