@@ -148,6 +148,7 @@ def main():
             if not ok:
                 for ln in tail:
                     print("      " + ln)
+            write_report(results)      # incremental: partial runs count
     finally:
         proc.kill()
 

@@ -32,39 +32,57 @@ from h2o3_tpu.models import metrics as mm
 from h2o3_tpu.models.distribution import Distribution, get_distribution
 from h2o3_tpu.models.model import (Model, ModelBuilder, ModelCategory,
                                    infer_category)
-from h2o3_tpu.models.tree import (Tree, TreeParams, grow_tree, predict_forest,
-                                  predict_tree, stack_trees)
+from h2o3_tpu.models.tree import (Tree, TreeParams, TreeScalars, grow_tree,
+                                  predict_forest, predict_tree, stack_trees)
 from h2o3_tpu.parallel.mesh import (get_mesh, put_sharded,
                                     row_sharding)
 
 
-def _sample_columns(k1, k2, F: int, rate: float):
+def _sample_columns(k1, k2, F: int, rate):
     """Per-tree column sampling mask (col_sample_rate_per_tree), with one
-    column always forced in so a tree can never go featureless."""
-    if rate >= 1.0:
-        return jnp.ones((F,), bool)
-    mask = jax.random.bernoulli(k1, rate, shape=(F,))
+    column always forced in so a tree can never go featureless. ``rate``
+    is a TRACED scalar (rate >= 1 keeps every column: bernoulli(1) is
+    always True) so grid/AutoML candidates share one compilation."""
+    mask = jax.random.bernoulli(k1, jnp.clip(rate, 0.0, 1.0), shape=(F,))
     return mask | (jnp.arange(F) == jax.random.randint(k2, (), 0, F))
 
 
-@partial(jax.jit, static_argnames=("tp", "dist", "sample_rate"))
 def _boost_step(bins, nb, y, w, margin, key, constraints=None,
                 interaction_sets=None, *,
                 tp: TreeParams, dist: Distribution, sample_rate: float):
     """One boosting iteration, fully on device (per-tree loop path —
     used when early stopping / validation tracking needs the host
     between trees; otherwise _boost_scan fuses the whole loop)."""
-    return _boost_step_impl(bins, nb, y, w, margin, key, tp=tp, dist=dist,
-                            sample_rate=sample_rate,
+    return _boost_step_jit(bins, nb, y, w, margin, key,
+                           _knobs_of(tp, sample_rate), constraints,
+                           interaction_sets, tp=_neutral_tp(tp),
+                           dist=dist)
+
+
+@partial(jax.jit, static_argnames=("tp", "dist"))
+def _boost_step_jit(bins, nb, y, w, margin, key, knobs, constraints=None,
+                    interaction_sets=None, *,
+                    tp: TreeParams, dist: Distribution):
+    return _boost_step_impl(bins, nb, y, w, margin, key, knobs,
+                            tp=tp, dist=dist,
                             constraints=constraints,
                             interaction_sets=interaction_sets)
 
 
-@partial(jax.jit, static_argnames=("tp", "dist", "sample_rate", "ntrees"))
 def _boost_scan(bins, nb, y, w, margin, key, constraints=None,
                 interaction_sets=None, *,
                 tp: TreeParams, dist: Distribution, sample_rate: float,
                 ntrees: int):
+    return _boost_scan_jit(bins, nb, y, w, margin, key,
+                           _knobs_of(tp, sample_rate), constraints,
+                           interaction_sets, tp=_neutral_tp(tp),
+                           dist=dist, ntrees=ntrees)
+
+
+@partial(jax.jit, static_argnames=("tp", "dist", "ntrees"))
+def _boost_scan_jit(bins, nb, y, w, margin, key, knobs, constraints=None,
+                    interaction_sets=None, *,
+                    tp: TreeParams, dist: Distribution, ntrees: int):
     """All ``ntrees`` boosting iterations as ONE compiled program.
 
     ``lax.scan`` over per-tree PRNG keys removes the per-tree
@@ -76,8 +94,8 @@ def _boost_scan(bins, nb, y, w, margin, key, constraints=None,
 
     def step(margin, k):
         tree, margin, gains = _boost_step_impl(
-            bins, nb, y, w, margin, k, tp=tp, dist=dist,
-            sample_rate=sample_rate, constraints=constraints,
+            bins, nb, y, w, margin, k, knobs, tp=tp, dist=dist,
+            constraints=constraints,
             interaction_sets=interaction_sets)
         return margin, (tree, gains)
 
@@ -85,14 +103,25 @@ def _boost_scan(bins, nb, y, w, margin, key, constraints=None,
     return trees, margin, jnp.sum(gains, axis=0)
 
 
-@partial(jax.jit, static_argnames=("tp", "dist", "sample_rate", "ntrees",
-                                   "B", "use_val"))
 def _boost_scan_scored(bins, nb, y, w, margin, key,
                        vbins, vy, vw, vmargin,
                        constraints=None, interaction_sets=None, *,
                        tp: TreeParams, dist: Distribution,
                        sample_rate: float, ntrees: int, B: int,
                        use_val: bool):
+    return _boost_scan_scored_jit(
+        bins, nb, y, w, margin, key, vbins, vy, vw, vmargin,
+        _knobs_of(tp, sample_rate), constraints, interaction_sets,
+        tp=_neutral_tp(tp), dist=dist, ntrees=ntrees, B=B,
+        use_val=use_val)
+
+
+@partial(jax.jit, static_argnames=("tp", "dist", "ntrees", "B", "use_val"))
+def _boost_scan_scored_jit(bins, nb, y, w, margin, key,
+                           vbins, vy, vw, vmargin, knobs,
+                           constraints=None, interaction_sets=None, *,
+                           tp: TreeParams, dist: Distribution,
+                           ntrees: int, B: int, use_val: bool):
     """``ntrees`` fused boosting steps + ONE device-side deviance score.
 
     This is how early stopping stays on the fused path: deviance is a
@@ -109,8 +138,8 @@ def _boost_scan_scored(bins, nb, y, w, margin, key,
     def step(carry, k):
         margin, vmargin = carry
         tree, margin, gains = _boost_step_impl(
-            bins, nb, y, w, margin, k, tp=tp, dist=dist,
-            sample_rate=sample_rate, constraints=constraints,
+            bins, nb, y, w, margin, k, knobs, tp=tp, dist=dist,
+            constraints=constraints,
             interaction_sets=interaction_sets)
         if use_val:
             vmargin = vmargin + predict_tree(tree, vbins, B)
@@ -126,13 +155,25 @@ def _boost_scan_scored(bins, nb, y, w, margin, key,
     return trees, margin, vmargin, gains, devs
 
 
-@partial(jax.jit, static_argnames=("tp", "sample_rate", "n_class",
-                                   "ntrees", "B", "use_val"))
 def _boost_scan_multi(bins, nb, y_int, w, margins, key,
                       vbins, vy_int, vw, vmargins,
                       interaction_sets=None, *, tp: TreeParams,
                       sample_rate: float, n_class: int, ntrees: int,
                       B: int, use_val: bool):
+    return _boost_scan_multi_jit(
+        bins, nb, y_int, w, margins, key, vbins, vy_int, vw, vmargins,
+        _knobs_of(tp, sample_rate), interaction_sets,
+        tp=_neutral_tp(tp), n_class=n_class, ntrees=ntrees, B=B,
+        use_val=use_val)
+
+
+@partial(jax.jit, static_argnames=("tp", "n_class", "ntrees", "B",
+                                   "use_val"))
+def _boost_scan_multi_jit(bins, nb, y_int, w, margins, key,
+                          vbins, vy_int, vw, vmargins, knobs,
+                          interaction_sets=None, *, tp: TreeParams,
+                          n_class: int, ntrees: int, B: int,
+                          use_val: bool):
     """Fused multinomial boosting: ``ntrees`` iterations x K class trees
     in one compiled scan + device-side multinomial deviance.
 
@@ -145,8 +186,8 @@ def _boost_scan_multi(bins, nb, y_int, w, margins, key,
     def step(carry, kk):
         margins, vmargins = carry
         trees, margins, vmargins, gains = _boost_step_multi_impl(
-            bins, nb, y_int, w, margins, kk, tp=tp,
-            sample_rate=sample_rate, n_class=n_class,
+            bins, nb, y_int, w, margins, kk, knobs, tp=tp,
+            n_class=n_class,
             interaction_sets=interaction_sets,
             vbins=vbins if use_val else None, vmargins=vmargins, B=B)
         if use_val:
@@ -164,55 +205,77 @@ def _boost_scan_multi(bins, nb, y_int, w, margins, key,
     return trees, margins, vmargins, gains, devs
 
 
-def _boost_step_impl(bins, nb, y, w, margin, key, *, tp, dist, sample_rate,
+def _knobs_of(tp: TreeParams, sample_rate: float):
+    """Traced training knobs: [sample_rate, col_sample_rate, learn_rate,
+    min_rows, reg_lambda, min_split_improvement]. Keeping these OUT of
+    the static jit key means one compiled boosting program serves every
+    grid/AutoML candidate of the same depth/nbins."""
+    return jnp.asarray([sample_rate, tp.col_sample_rate, tp.learn_rate,
+                        tp.min_rows, tp.reg_lambda,
+                        tp.min_split_improvement], jnp.float32)
+
+
+def _neutral_tp(tp: TreeParams) -> TreeParams:
+    """Structural-only TreeParams for the jit static key (numeric knobs
+    travel as traced values)."""
+    return TreeParams(max_depth=tp.max_depth, min_rows=0.0,
+                      learn_rate=0.0, reg_lambda=0.0,
+                      min_split_improvement=0.0, col_sample_rate=1.0,
+                      nbins_total=tp.nbins_total,
+                      block_rows=tp.block_rows)
+
+
+def _boost_step_impl(bins, nb, y, w, margin, key, knobs, *, tp, dist,
                      constraints=None, interaction_sets=None):
     """Unjitted body shared by _boost_step and _boost_scan."""
     mesh = get_mesh()
     g = dist.grad(y, margin)
     h = dist.hess(y, margin)
     kr, kc1, kc2 = jax.random.split(key, 3)
-    ws = w
-    if sample_rate < 1.0:
-        keep = jax.random.bernoulli(kr, sample_rate, shape=w.shape)
-        ws = w * keep.astype(jnp.float32)
+    keep = jax.random.bernoulli(kr, jnp.clip(knobs[0], 0.0, 1.0),
+                                shape=w.shape)
+    ws = w * keep.astype(jnp.float32)
     F = bins.shape[1]
-    col_mask = _sample_columns(kc1, kc2, F, tp.col_sample_rate)
+    col_mask = _sample_columns(kc1, kc2, F, knobs[1])
+    sc = TreeScalars(knobs[3], knobs[4], knobs[5])
     tree, nid, gains = grow_tree(bins, nb, ws, g, h, col_mask,
                                  params=tp, mesh=mesh,
                                  constraints=constraints,
-                                 interaction_sets=interaction_sets)
-    tree = tree._replace(leaf=tp.learn_rate * tree.leaf)
+                                 interaction_sets=interaction_sets,
+                                 scalars=sc)
+    tree = tree._replace(leaf=knobs[2] * tree.leaf)
     margin = margin + tree.leaf[nid]
     return tree, margin, gains
 
 
-@partial(jax.jit, static_argnames=("tp", "sample_rate", "n_class"))
 def _boost_step_multi(bins, nb, y_int, w, margins, key,
                       interaction_sets=None, *, tp: TreeParams,
                       sample_rate: float, n_class: int):
-    """One multinomial iteration: K trees on softmax gradients."""
+    """One multinomial iteration: K trees on softmax gradients.
+    (Plain-python wrapper; callers inside jit trace the impl, callers
+    outside get per-call dispatch — only the scan paths are hot.)"""
     trees, margins, _, gains = _boost_step_multi_impl(
-        bins, nb, y_int, w, margins, key, tp=tp,
-        sample_rate=sample_rate, n_class=n_class,
+        bins, nb, y_int, w, margins, key, _knobs_of(tp, sample_rate),
+        tp=_neutral_tp(tp), n_class=n_class,
         interaction_sets=interaction_sets)
     return trees, margins, gains
 
 
-def _boost_step_multi_impl(bins, nb, y_int, w, margins, key, *,
-                           tp: TreeParams, sample_rate: float,
-                           n_class: int, interaction_sets=None,
+def _boost_step_multi_impl(bins, nb, y_int, w, margins, key, knobs, *,
+                           tp: TreeParams, n_class: int,
+                           interaction_sets=None,
                            vbins=None, vmargins=None, B=None):
     """Unjitted multinomial body (K class trees per iteration); when
     ``vbins`` is given the validation margins are advanced too."""
     mesh = get_mesh()
     p = jax.nn.softmax(margins, axis=1)
     kr, kc1, kc2 = jax.random.split(key, 3)
-    ws = w
-    if sample_rate < 1.0:
-        keep = jax.random.bernoulli(kr, sample_rate, shape=w.shape)
-        ws = w * keep.astype(jnp.float32)
+    keep = jax.random.bernoulli(kr, jnp.clip(knobs[0], 0.0, 1.0),
+                                shape=w.shape)
+    ws = w * keep.astype(jnp.float32)
     F = bins.shape[1]
-    col_mask = _sample_columns(kc1, kc2, F, tp.col_sample_rate)
+    col_mask = _sample_columns(kc1, kc2, F, knobs[1])
+    sc = TreeScalars(knobs[3], knobs[4], knobs[5])
     trees = []
     gains_tot = jnp.zeros((F,), jnp.float32)
     new_margins = margins
@@ -222,8 +285,9 @@ def _boost_step_multi_impl(bins, nb, y_int, w, margins, key, *,
         hk = p[:, k] * (1.0 - p[:, k])
         tree, nid, gains = grow_tree(bins, nb, ws, gk, hk, col_mask,
                                      params=tp, mesh=mesh,
-                                     interaction_sets=interaction_sets)
-        tree = tree._replace(leaf=tp.learn_rate * tree.leaf)
+                                     interaction_sets=interaction_sets,
+                                     scalars=sc)
+        tree = tree._replace(leaf=knobs[2] * tree.leaf)
         new_margins = new_margins.at[:, k].add(tree.leaf[nid])
         if vbins is not None:
             vmargins = vmargins.at[:, k].add(predict_tree(tree, vbins, B))

@@ -34,6 +34,23 @@ from h2o3_tpu.ops.histogram import histogram
 from h2o3_tpu.ops.segments import segment_sum
 
 
+class TreeScalars(NamedTuple):
+    """Traced per-call training knobs. These previously rode inside the
+    static TreeParams, so every distinct (min_rows, reg_lambda, msi)
+    combination — e.g. every AutoML/grid candidate — forced a fresh XLA
+    compilation; as traced scalars one compiled program serves them all
+    (structure-affecting fields stay static in TreeParams)."""
+    min_rows: jax.Array
+    reg_lambda: jax.Array
+    msi: jax.Array
+
+
+def scalars_of(params: "TreeParams") -> "TreeScalars":
+    return TreeScalars(jnp.float32(params.min_rows),
+                       jnp.float32(params.reg_lambda),
+                       jnp.float32(params.min_split_improvement))
+
+
 class Tree(NamedTuple):
     """One complete tree; arrays padded to Lmax = 2^(D-1) internal slots."""
     feat: jax.Array       # [D, Lmax] int32 split feature
@@ -71,7 +88,7 @@ def row_feature_values(bins, f_r):
 
 
 def _best_splits(hist, nb, col_mask, params: TreeParams,
-                 constraints=None, lo=None, hi=None):
+                 constraints=None, lo=None, hi=None, scalars=None):
     """Vectorized DTree.findBestSplitPoint over all nodes of a level.
 
     hist: [L, F, B, 3] of {w, g, h}; col_mask [F] (per-tree sampling) or
@@ -83,7 +100,8 @@ def _best_splits(hist, nb, col_mask, params: TreeParams,
     hex/tree/Constraints). Returns per-node best
     (gain, feat, thresh, na_left, left_val, right_val).
     """
-    lam = params.reg_lambda
+    sc = scalars if scalars is not None else scalars_of(params)
+    lam = sc.reg_lambda
     B = hist.shape[2]
     w, g, h = hist[..., 0], hist[..., 1], hist[..., 2]
     # cumulative over value bins (0..B-2); NA bin is B-1
@@ -111,7 +129,7 @@ def _best_splits(hist, nb, col_mask, params: TreeParams,
         wr = tw[:, :, None] - wl
         gr = tg[:, :, None] - gl
         hr = th[:, :, None] - hl
-        ok = (wl >= params.min_rows) & (wr >= params.min_rows)
+        ok = (wl >= sc.min_rows) & (wr >= sc.min_rows)
         lv, rv = child_vals(gl, hl, gr, hr)
         if constraints is not None:
             c = constraints[None, :, None].astype(jnp.float32)
@@ -156,7 +174,7 @@ def _mtries_mask(key, L: int, F: int, mtries: int):
 
 def grow_tree(bins, nb, w, g, h, col_mask, *, params: TreeParams, mesh,
               mtries: int = 0, key=None, constraints=None,
-              interaction_sets=None):
+              interaction_sets=None, scalars=None):
     """Grow one tree; returns (Tree, final_leaf_id_per_row).
 
     bins [Npad, F] int32 row-sharded; w zero on padding rows; col_mask [F]
@@ -173,6 +191,7 @@ def grow_tree(bins, nb, w, g, h, col_mask, *, params: TreeParams, mesh,
     as a per-node allowed mask.
     """
     D = params.max_depth
+    sc = scalars if scalars is not None else scalars_of(params)
     B = params.nbins_total
     F = bins.shape[1]
     Lmax = 2 ** (D - 1) if D > 0 else 1
@@ -220,8 +239,9 @@ def grow_tree(bins, nb, w, g, h, col_mask, *, params: TreeParams, mesh,
         if interaction_sets is not None:
             cm = (cm if cm.ndim == 2 else cm[None, :]) & allowed
         bg, bf, bt, bnal, blv, brv = _best_splits(
-            hist, nb, cm, params, constraints=constraints, lo=lo, hi=hi)
-        split = bg > params.min_split_improvement
+            hist, nb, cm, params, constraints=constraints, lo=lo, hi=hi,
+            scalars=sc)
+        split = bg > sc.msi
         feats = feats.at[d, :L].set(jnp.where(split, bf, 0))
         threshs = threshs.at[d, :L].set(jnp.where(split, bt, B))
         na_lefts = na_lefts.at[d, :L].set(jnp.where(split, bnal, False))
@@ -279,7 +299,7 @@ def grow_tree(bins, nb, w, g, h, col_mask, *, params: TreeParams, mesh,
                              block_rows=params.block_rows)
     G, H = leaf_stats[:, 1], leaf_stats[:, 2]
     leaf = jnp.where(leaf_stats[:, 0] > 0,
-                     -G / (H + params.reg_lambda + 1e-10), 0.0)
+                     -G / (H + sc.reg_lambda + 1e-10), 0.0)
     if constraints is not None:
         leaf = jnp.clip(leaf, lo, hi)   # leaves honor propagated bounds
     tree = Tree(feats, threshs, na_lefts, is_splits, leaf, leaf_stats[:, 0])

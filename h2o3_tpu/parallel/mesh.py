@@ -92,10 +92,13 @@ def padded_rows(n: int, mesh: Optional[Mesh] = None, block: int = 1) -> int:
     """
     d = data_size(mesh) * max(block, 1)
     aligned = ((n + d - 1) // d) * d
-    if aligned <= 16 * d:
+    if aligned <= 4 * d:
         return aligned
-    # round up to the next multiple of 2^(log2(n)-4): 16 buckets/octave
-    q = 1 << (max(aligned.bit_length() - 5, 0))
+    # small frames: 4 buckets/octave (≤25% padding waste, trivial compute
+    # at this scale) — k-fold CV on a small frame otherwise compiles a
+    # fresh program per fold size; large frames: 16/octave (≤6.25%)
+    shift = 3 if aligned < 65536 else 5
+    q = 1 << (max(aligned.bit_length() - shift, 0))
     bucket = ((aligned + q - 1) // q) * q
     # keep mesh/block alignment after bucketing
     return ((bucket + d - 1) // d) * d

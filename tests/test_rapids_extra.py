@@ -26,8 +26,9 @@ def test_match_and_levels(fr):
     assert list(lv.col("levels").to_numpy().astype(str)) == ["0", "1", "2"] \
         or lv.nrows == 3
     assert rapids('(nlevels (cols_py rapx "g"))') == 3
-    assert rapids('(is.factor (cols_py rapx "g"))') == 1.0
-    assert rapids('(is.numeric (cols_py rapx "x"))') == 1.0
+    # per-column flag lists (h2o-py isfactor()/isnumeric() iterate them)
+    assert rapids('(is.factor (cols_py rapx "g"))') == [1.0]
+    assert rapids('(is.numeric (cols_py rapx "x"))') == [1.0]
     assert rapids('(anyfactor rapx)') == 1.0
     assert rapids('(any.na rapx)') == 1.0
 
