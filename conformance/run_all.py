@@ -168,7 +168,12 @@ def write_report(results):
         "Datasets: real in-tree files (prostate, iris) symlinked at runtime;",
         "schema-compatible synthetic stand-ins elsewhere",
         "(`conformance/gen_data.py`). Tests needing data that does not",
-        "exist in this offline image are excluded.",
+        "exist in this offline image are excluded. Known-fail classes:",
+        "float32 exactness asserts (weights_gbm expects 1e-5-relative",
+        "MSE equality under 3x-weight scaling; f64 JVM vs f32 TPU),",
+        "reference-RNG-coupled asserts (benign_glm_grid expects exactly",
+        "5 models from ITS RandomDiscrete sequence), and 600s timeouts",
+        "on this 1-core host for many-model CV pyunits.",
         "",
         f"**Result: {npass}/{len(results)} passing** "
         f"({time.strftime('%Y-%m-%d')})",

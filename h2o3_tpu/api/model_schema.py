@@ -293,6 +293,9 @@ def _params_v3(model: Model) -> List[dict]:
     except Exception:
         pass
     names = sorted((set(defaults) | set(model.params)) - hidden)
+    # wire spellings differ from our internal python-safe names
+    wire_names = {"lambda_": "lambda",
+                  "tweedie_power": "tweedie_variance_power"}
     out = [
         # pseudo-parameters every reference schema carries; clients
         # rebuild estimators from this list (pyunit_parametersKmeans
@@ -322,11 +325,12 @@ def _params_v3(model: Model) -> List[dict]:
             av = str(av)
         if not isinstance(dv, (int, float, str, bool, list, type(None))):
             dv = str(dv)
+        wn = wire_names.get(n, n)
         out.append({
             "__meta": {"schema_version": 3,
                        "schema_name": "ModelParameterSchemaV3",
                        "schema_type": "Iced"},
-            "name": n, "label": n, "help": n, "required": False,
+            "name": wn, "label": wn, "help": wn, "required": False,
             "type": type(av).__name__ if av is not None else "string",
             "default_value": dv, "actual_value": av,
             "input_value": av,
@@ -474,6 +478,25 @@ def model_to_v3(model: Model) -> dict:
                 ["string", "float64", "float64", "float64"],
                 [[nm, float(m), float(m / mx), float(m / tot)]
                  for nm, m in mags])
+
+    # multinomial GLM varimp: mean |standardized coef| across classes
+    if model.algo in ("glm", "gam") and \
+            getattr(model, "coef_multinomial", None) is not None and \
+            out_src.get("coef_names") is not None and \
+            output.get("variable_importances") is None:
+        B = np.asarray(model.coef_multinomial, np.float64)
+        names_m = list(out_src["coef_names"])
+        mags = sorted(zip(names_m, np.abs(B[:-1, :]).mean(axis=1)),
+                      key=lambda t: -t[1])
+        mx = max((m for _, m in mags), default=1.0) or 1.0
+        tot = sum(m for _, m in mags) or 1.0
+        output["variable_importances"] = twodim(
+            "Standardized Coefficient Magnitudes",
+            ["variable", "relative_importance", "scaled_importance",
+             "percentage"],
+            ["string", "float64", "float64", "float64"],
+            [[nm, float(m), float(m / mx), float(m / tot)]
+             for nm, m in mags])
 
     # KMeans: centers tables (client centers()/centers_std() read
     # output.centers.cell_values, h2o-py/h2o/model/models/clustering.py:233)

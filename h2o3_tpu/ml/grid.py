@@ -30,7 +30,9 @@ _ASC = {"logloss", "rmse", "mse", "mae", "mean_per_class_error",
 def sort_value(model, metric: str):
     mmx = model.default_metrics
     d = mmx.to_dict() if hasattr(mmx, "to_dict") else dict(mmx or {})
-    aliases = {"auc": "AUC", "gini": "Gini", "rmse": "RMSE", "mse": "MSE"}
+    aliases = {"auc": "AUC", "gini": "Gini", "rmse": "RMSE", "mse": "MSE",
+               "f1": "max_f1", "aucpr": "pr_auc", "residual_deviance":
+               "mean_residual_deviance"}
     key = aliases.get(metric.lower(), metric)
     if key not in d and metric in d:
         key = metric
@@ -66,6 +68,10 @@ class Grid:
         metric = metric or self.sort_metric
         vals = [(sort_value(m, metric), m) for m in self.models]
         vals = [(v, m) for v, m in vals if v is not None]
+        if not vals and self.models:
+            # unknown sort metric: keep the models, original order —
+            # an empty grid would break clients (get_grid(sort_by=...))
+            return list(self.models)
         if decreasing is None:
             decreasing = metric.lower() not in _ASC
         return [m for _, m in sorted(vals, key=lambda t: t[0],
@@ -89,7 +95,18 @@ class GridSearch:
                  search_criteria: Optional[dict] = None, grid_id: str = None,
                  recovery_dir: Optional[str] = None, **fixed_params):
         self.builder_cls = builder_cls
-        self.hyper_params = {k: list(v) for k, v in hyper_params.items()}
+        # duplicated hyper values are ignored (reference HyperSpaceWalker
+        # dedupes the value lists — pyunit_grid_carsGBM contract)
+        def _dedup(vals):
+            seen, out = set(), []
+            for v in vals:
+                kv = tuple(v) if isinstance(v, list) else v
+                if kv not in seen:
+                    seen.add(kv)
+                    out.append(v)
+            return out
+        self.hyper_params = {k: _dedup(list(v))
+                             for k, v in hyper_params.items()}
         self.criteria = dict(search_criteria or {"strategy": "Cartesian"})
         self.fixed = fixed_params
         self.grid_id = grid_id or make_key(f"grid_{builder_cls.algo}")
@@ -116,9 +133,9 @@ class GridSearch:
             seed = int(self.criteria.get("seed", -1))
             rng = np.random.RandomState(seed if seed >= 0 else None)
             rng.shuffle(all_combos)
-            mx = int(self.criteria.get("max_models", 0))
-            if mx > 0:
-                all_combos = all_combos[:mx]
+            # max_models caps SUCCESSFUL models, enforced in the train
+            # walk (failed combos don't count toward it — the reference
+            # keeps sampling; pyunit_benign_glm_grid max_models contract)
         return all_combos
 
     def train(self, training_frame, y: Optional[str] = None,
@@ -130,6 +147,12 @@ class GridSearch:
         if done:
             combos = [c for c in combos if c not in done]
         budget_s = float(self.criteria.get("max_runtime_secs", 0) or 0)
+        max_models = int(self.criteria.get("max_models", 0) or 0)
+        stop_rounds = int(self.criteria.get("stopping_rounds", 0) or 0)
+        stop_tol = float(self.criteria.get("stopping_tolerance", 1e-3)
+                         or 1e-3)
+        from h2o3_tpu.models.model import EarlyStopper
+        stopper = EarlyStopper(stop_rounds, stop_tol)
         t0 = time.time()
         models = list(_prior_models or [])
         failures: List[dict] = []
@@ -138,6 +161,8 @@ class GridSearch:
         for i, combo in enumerate(combos):
             if budget_s and time.time() - t0 > budget_s:
                 log.info("grid budget exhausted after %d models", len(models))
+                break
+            if max_models and len(models) >= max_models:
                 break
             params = {**self.fixed, **combo}
             try:
@@ -148,6 +173,18 @@ class GridSearch:
                 models.append(m)
                 if self.recovery_dir:
                     self._snapshot(m, combo, done, y, x)
+                if stopper.enabled:
+                    # asymptotic stopping over the walk's best metric
+                    # (HyperSpaceWalker stopping criteria)
+                    sm = (self.criteria.get("sort_metric")
+                          or default_sort_metric(m))
+                    v = sort_value(m, sm)
+                    if v is not None:
+                        asc = sm.lower() in _ASC
+                        if stopper.should_stop(v if asc else -v):
+                            log.info("grid stopping criteria met after "
+                                     "%d models", len(models))
+                            break
             except Exception as e:   # failed combos recorded, walk continues
                 log.warning("grid combo %s failed: %s", combo, e)
                 failures.append({"params": combo, "error": str(e)})

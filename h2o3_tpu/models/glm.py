@@ -63,6 +63,22 @@ class Family:
         # (hex/glm/GLMModel.GLMParameters.Link.family_default)
         if link in ("family_default", "auto", ""):
             link = None
+        allowed = {"gaussian": {"identity", "log", "inverse"},
+                   "binomial": {"logit"},
+                   "quasibinomial": {"logit"},
+                   "fractionalbinomial": {"logit"},
+                   "poisson": {"log", "identity"},
+                   "gamma": {"log", "identity", "inverse"},
+                   "tweedie": {"tweedie"},
+                   "negativebinomial": {"log", "identity"},
+                   "multinomial": {"multinomial"}}
+        if link is not None and name in allowed \
+                and link not in allowed[name]:
+            # family-link compatibility matrix
+            # (hex/glm/GLMModel.GLMParameters validation)
+            raise ValueError(
+                f"Incompatible link function for selected family: "
+                f"link {link} is not supported for family {name}")
         self.link = link or defaults[name]
 
     # mu = linkinv(eta)
@@ -507,9 +523,11 @@ class GLMEstimator(ModelBuilder):
 
     def __init__(self, **params):
         merged = dict(self.DEFAULTS)
-        # h2o-py spells it "Lambda" or "lambda_"
-        if "Lambda" in params:
-            params["lambda_"] = params.pop("Lambda")
+        # h2o-py spells it "Lambda", "lambda_", or bare "lambda" (the
+        # grid wire sends the raw schema name)
+        for alias in ("Lambda", "lambda"):
+            if alias in params:
+                params["lambda_"] = params.pop(alias)
         # h2o-py's name for the tweedie power (GLMModel.GLMParameters)
         if "tweedie_variance_power" in params:
             params["tweedie_power"] = params.pop("tweedie_variance_power")

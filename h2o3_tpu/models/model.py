@@ -226,10 +226,6 @@ class ModelBuilder:
         training frame and attached to training_metrics as 'custom'."""
         x = self.resolve_x(training_frame, x, y)
         nfolds = int(self.params.get("nfolds") or 0)
-        if nfolds == 1 or nfolds < 0:
-            raise ValueError(
-                "nfolds must be either 0 or >1 (got %d) — reference "
-                "ModelBuilder cross-validation contract" % nfolds)
         # an explicit fold column triggers CV regardless of nfolds
         # (hex/ModelBuilder.java computeCrossValidation entry conditions)
         if self.params.get("fold_column") and nfolds < 2 \
@@ -244,6 +240,21 @@ class ModelBuilder:
 
         def _run(j: Job) -> Model:
             t0 = time.time()
+            # CV-contract validation errors surface as FAILED jobs so
+            # clients see them while polling (hex/ModelBuilder error
+            # handling; pyunit_cv_cars_* expect EnvironmentError from
+            # H2OJob.poll)
+            if nfolds == 1 or nfolds < 0:
+                raise ValueError(
+                    "nfolds must be either 0 or >1 (got %d)" % nfolds)
+            if nfolds > training_frame.nrows:
+                raise ValueError(
+                    "nfolds (%d) cannot exceed the number of rows (%d)"
+                    % (nfolds, training_frame.nrows))
+            if self.params.get("fold_column") and \
+                    int(self.params.get("nfolds") or 0) > 0:
+                raise ValueError(
+                    "only one of nfolds or fold_column may be specified")
             if nfolds >= 2:
                 from h2o3_tpu.ml.cv import train_with_cv
                 model = train_with_cv(self, training_frame, x, y, nfolds, j,
