@@ -23,6 +23,23 @@ log = get_logger("h2o3_tpu.job")
 CREATED, RUNNING, DONE, FAILED, CANCELLED = (
     "CREATED", "RUNNING", "DONE", "FAILED", "CANCELLED")
 
+# transient infra failures of the tunneled chip / compile service —
+# distinct from user errors and worth exactly one in-place retry (a
+# remote_compile INTERNAL blip permanently failed an AutoML step in
+# round 2's bench run)
+_INFRA_SIGNS = ("remote_compile", "INTERNAL:", "UNAVAILABLE:",
+                "DEADLINE_EXCEEDED")
+
+
+def is_infra_error(e: BaseException) -> bool:
+    """True for retryable infra-class errors (XlaRuntimeError INTERNAL /
+    remote_compile / UNAVAILABLE), False for user/programming errors."""
+    if isinstance(e, (ValueError, TypeError, KeyError,
+                      JobCancelledException)):
+        return False
+    msg = f"{type(e).__name__}: {e}"
+    return any(s in msg for s in _INFRA_SIGNS)
+
 
 class JobCancelledException(Exception):
     pass
@@ -56,7 +73,21 @@ class Job:
 
         def _run():
             try:
-                self.result = fn(self)
+                try:
+                    self.result = fn(self)
+                except Exception as e:  # noqa: BLE001
+                    # one bounded retry for infra-class errors only —
+                    # the work restarts from scratch (model builds are
+                    # idempotent; progress just re-accumulates)
+                    if not (is_infra_error(e)
+                            and not self._cancel_requested.is_set()):
+                        raise
+                    log.warning("job %s: retrying after infra error: %s",
+                                self.key, e)
+                    _tl("job", f"infra-retry {self.description}",
+                        key=self.key, error=str(e)[:200])
+                    self._worked = 0.0
+                    self.result = fn(self)
                 if self.dest and self.result is not None:
                     DKV.put(self.dest, self.result)
                 self.status = DONE

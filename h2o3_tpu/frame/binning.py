@@ -77,14 +77,30 @@ class BinnedMatrix:
 
 
 def _numeric_edges(x: np.ndarray, nbins: int,
-                   method: str = "quantiles") -> np.ndarray:
+                   method: str = "quantiles",
+                   w: Optional[np.ndarray] = None) -> np.ndarray:
     """Bin edges over valid values. method='quantiles' is the
     QuantilesGlobal histogram type (hex/tree/SharedTree; default hist
     behavior of the reference's XGBoost extension); 'uniform' is the
     equal-width UniformAdaptive type (hex/tree/DHistogram.java min/maxEx
     range binning) — required by IsolationForest, whose random thresholds
-    must be uniform over the VALUE range, not the rank space."""
-    v = x[np.isfinite(x)]
+    must be uniform over the VALUE range, not the rank space.
+
+    Quantile edges come from the WEIGHTED cdf over distinct values, with
+    each cut placed at the midpoint between adjacent distinct values.
+    This makes binning exactly invariant under the reference's row-weight
+    contract (pyunit_weights_gbm): weight=k ≡ k duplicated rows, weight=0
+    ≡ row removed, uniform weights ≡ no weights — properties plain
+    np.quantile over raw rows does NOT have (zero-weight rows would shift
+    edges). Midpoint cuts also never coincide with a data value, so a
+    row's bin is insensitive to float rounding of the edge itself."""
+    finite = np.isfinite(x)
+    v = x[finite]
+    wv = None
+    if w is not None:
+        wv = np.asarray(w, dtype=np.float64)[finite]
+        pos = wv > 0
+        v, wv = v[pos], wv[pos]
     if v.size == 0:
         return np.zeros((0,), dtype=np.float32)
     if method == "uniform":
@@ -102,10 +118,22 @@ def _numeric_edges(x: np.ndarray, nbins: int,
         return np.sort(rng.uniform(lo, hi, nbins - 1)).astype(np.float32)
     if v.size > 200_000:  # sketch on a sample, like the reference's ExactQuantilesToUse cap
         rng = np.random.RandomState(0xC0FFEE)
-        v = v[rng.randint(0, v.size, 200_000)]
-    qs = np.quantile(v, np.linspace(0.0, 1.0, nbins + 1)[1:-1])
-    edges = np.unique(qs.astype(np.float32))
-    return edges
+        idx = rng.randint(0, v.size, 200_000)
+        v = v[idx]
+        wv = None if wv is None else wv[idx]
+    u, inv = np.unique(v, return_inverse=True)
+    if u.size < 2:
+        return np.zeros((0,), dtype=np.float32)
+    wu = np.bincount(inv, weights=wv, minlength=u.size) if wv is not None \
+        else np.bincount(inv, minlength=u.size).astype(np.float64)
+    cdf = np.cumsum(wu)
+    cdf /= cdf[-1]
+    qs = np.linspace(0.0, 1.0, nbins + 1)[1:-1]
+    # first distinct value whose cumulative weight reaches q; cut after it
+    idx = np.searchsorted(cdf, qs, side="left")
+    idx = idx[idx < u.size - 1]
+    mids = (u[idx].astype(np.float64) + u[idx + 1]) * 0.5
+    return np.unique(mids.astype(np.float32))
 
 
 def bin_frame(frame: Frame, features: Sequence[str], nbins: int = 64,
@@ -113,12 +141,15 @@ def bin_frame(frame: Frame, features: Sequence[str], nbins: int = 64,
               edges_override: Optional[List[np.ndarray]] = None,
               nbins_total_override: Optional[int] = None,
               train_domains: Optional[List[Optional[List[str]]]] = None,
-              histogram_type: str = "quantiles") -> BinnedMatrix:
+              histogram_type: str = "quantiles",
+              weights: Optional[np.ndarray] = None) -> BinnedMatrix:
     """Bin ``features`` of ``frame`` into a device int matrix.
 
     ``edges_override``/``train_domains`` re-bin a scoring frame with
     training-time edges and categorical domains — the adaptTestForTrain
     path (hex/Model.java:1850): unseen test levels map to the NA bin.
+    ``weights`` (host [nrows]) makes the quantile sketch weighted so the
+    row-weight ≡ row-multiplicity contract holds (see _numeric_edges).
     """
     F = len(features)
     names = list(features)
@@ -145,14 +176,23 @@ def bin_frame(frame: Frame, features: Sequence[str], nbins: int = 64,
             if edges_override is not None:
                 e = edges_override[i]
             else:
-                e = _numeric_edges(c.to_numpy(), nbins, histogram_type)
+                e = _numeric_edges(c.to_numpy(), nbins, histogram_type,
+                                   w=weights)
             nb[i] = len(e) + 1
             edge_list.append(e)
 
-    B = int(nb.max()) + 1 if F else 2  # +1 shared NA bin at B-1
+    # B is part of the STATIC jit key (TreeParams.nbins_total), so it
+    # must depend only on the binning CONFIG, never the data: a fold
+    # frame whose numeric columns happen to have fewer distinct values
+    # than nbins would otherwise get a smaller B and force a fresh XLA
+    # compile per fold (the round-2 cv/grid 600s timeouts). Unused bin
+    # ids have zero counts and never win a split.
+    B = max(int(nbins), int(nb.max()) if F else 1) + 1  # +1 shared NA bin
     if nbins_total_override is not None:
         B = nbins_total_override
-    emax = max((len(e) for e in edge_list), default=0)
+    # fixed edge-matrix width for the same reason (its shape is static
+    # in _bin_device's program)
+    emax = max(nbins - 1, max((len(e) for e in edge_list), default=0))
     edges = np.full((F, max(emax, 1)), np.inf, dtype=np.float32)
     for i, e in enumerate(edge_list):
         edges[i, : len(e)] = e

@@ -41,18 +41,23 @@ class Frame:
                    strings: Sequence[str] = (),
                    uuids: Sequence[str] = (),
                    key: Optional[str] = None,
-                   block: int = 8) -> "Frame":
+                   block: int = 8,
+                   pad_to: Optional[int] = None) -> "Frame":
         """Build a Frame from host columns (upload path, POST /3/ParseSetup).
 
         ``categorical`` forces listed columns to T_CAT; ``domains`` supplies
         pre-interned level lists for integer-coded categorical columns;
         ``strings`` keeps listed columns as host-side T_STR (no interning
-        — the CStrChunk role, never entering math paths).
+        — the CStrChunk role, never entering math paths). ``pad_to``
+        forces at least that padded row count — CV fold frames pad to the
+        parent frame's shape so one compiled program serves every fold.
         """
         from h2o3_tpu.frame.column import Column, T_STR, T_UUID
         names = list(arrays.keys())
         n = len(next(iter(arrays.values()))) if names else 0
         npad = mesh_mod.padded_rows(n, block=block)
+        if pad_to is not None:
+            npad = max(npad, int(pad_to))
         shard = mesh_mod.row_sharding()
         cols = []
         for name in names:

@@ -15,6 +15,7 @@ from typing import List, Optional, Sequence
 import numpy as np
 
 from h2o3_tpu.parallel.mesh import fetch_replicated as _fetch_np
+from h2o3_tpu.parallel.mesh import padded_rows as _pad_rows
 
 from h2o3_tpu.frame.frame import Frame
 from h2o3_tpu.models import metrics as mm
@@ -38,9 +39,13 @@ def fold_assignment(n: int, nfolds: int, scheme: str = "modulo",
     return rng.randint(0, nfolds, size=n).astype(np.int32)
 
 
-def subset_frame(frame: Frame, keep: np.ndarray) -> Frame:
+def subset_frame(frame: Frame, keep: np.ndarray,
+                 pad_to: Optional[int] = None) -> Frame:
     """Host-side row subset (reference uses fold-weight columns instead;
-    a weights-based device path is the planned optimization)."""
+    a weights-based device path is the planned optimization). ``pad_to``
+    pads the subset to a caller-chosen device shape — CV passes the
+    parent frame's padded size so every fold (and the final full-data
+    fit) compiles ONE program instead of one per fold size."""
     arrays, domains, cats = {}, {}, []
     for name in frame.names:
         c = frame.col(name)
@@ -58,7 +63,8 @@ def subset_frame(frame: Frame, keep: np.ndarray) -> Frame:
             vv = v.astype(np.float64)
             vv[_fetch_np(c.na_mask)[: frame.nrows][keep]] = np.nan
             arrays[name] = vv
-    return Frame.from_numpy(arrays, categorical=cats, domains=domains)
+    return Frame.from_numpy(arrays, categorical=cats, domains=domains,
+                            pad_to=pad_to)
 
 
 def train_with_cv(builder, frame: Frame, x: Sequence[str], y: str,
@@ -93,8 +99,11 @@ def train_with_cv(builder, frame: Frame, x: Sequence[str], y: str,
         # pyunit_kmeans_cv contract)
         cv_models = []
         for f in range(nfolds):
-            mask_tr = (np.arange(frame.nrows) % nfolds) != f
-            tr = subset_frame(frame, mask_tr)
+            # honor the computed fold assignment (fold_column / scheme /
+            # seed) — the unsupervised branch must not silently fall back
+            # to a modulo split
+            mask_tr = folds != f
+            tr = subset_frame(frame, mask_tr, pad_to=frame.nrows_padded)
             m = builder.__class__(**sub_params)._fit(tr, list(x), None, job)
             cv_models.append(m)
         final = builder.__class__(**sub_params)._fit(
@@ -131,8 +140,13 @@ def train_with_cv(builder, frame: Frame, x: Sequence[str], y: str,
     fold_metric_dicts = []
     for f in range(nfolds):
         mask_tr = folds != f
-        tr = subset_frame(frame, mask_tr)
-        te = subset_frame(frame, ~mask_tr)
+        tr = subset_frame(frame, mask_tr, pad_to=frame.nrows_padded)
+        # holdouts share one padded shape too (all ~n/nfolds rows; max
+        # fold size keeps one scoring program across folds)
+        te = subset_frame(frame, ~mask_tr,
+                          pad_to=_pad_rows(int(np.max(
+                              np.bincount(folds, minlength=nfolds))),
+                              block=8))
         sub = builder.__class__(**sub_params)
         m = sub._fit(tr, list(x), y, job)
         cv_models.append(m)
@@ -214,13 +228,17 @@ def train_with_cv(builder, frame: Frame, x: Sequence[str], y: str,
                          if isinstance(v, (int, float))})
     summary_rows = []
     for kname in keys_union:
-        vals = [d.get(kname) for d in fold_metric_dicts]
-        vals = [float(v) for v in vals if isinstance(v, (int, float))]
+        # keep one slot per fold (None where the metric is absent or the
+        # fold's scoring failed): twodim transposes these rows against a
+        # fixed 2+nfolds column set, so a short row 500s GET /3/Models
+        per_fold = [float(d[kname])
+                    if isinstance(d.get(kname), (int, float)) else None
+                    for d in fold_metric_dicts]
+        vals = [v for v in per_fold if v is not None]
         if not vals:
             continue
         summary_rows.append(
-            [kname, float(np.mean(vals)), float(np.std(vals))] +
-            [float(v) for v in vals])
+            [kname, float(np.mean(vals)), float(np.std(vals))] + per_fold)
     final.output["cv_summary_rows"] = summary_rows
     final.output["cv_summary_nfolds"] = nfolds
     final._cv_holdout = holdout

@@ -208,8 +208,6 @@ class DRFEstimator(ModelBuilder):
         ht = str(p.get("histogram_type", "auto")).lower()
         ht = {"auto": "quantiles", "quantilesglobal": "quantiles",
               "uniformadaptive": "uniform"}.get(ht, ht)
-        bm = bin_frame(frame, x, nbins=p["nbins"],
-                       nbins_cats=p["nbins_cats"], histogram_type=ht)
         w = frame.valid_weights()
         if p.get("weights_column"):
             wc = frame.col(p["weights_column"]).numeric_view()
@@ -218,6 +216,9 @@ class DRFEstimator(ModelBuilder):
         resp_na = _fetch_np(rc.na_mask)[: frame.nrows]
         if resp_na.any():
             w = w * jnp.asarray((~resp_na).astype(np.float32))
+        bm = bin_frame(frame, x, nbins=p["nbins"],
+                       nbins_cats=p["nbins_cats"], histogram_type=ht,
+                       weights=_fetch_np(w)[: frame.nrows])
 
         depth = int(p["max_depth"])
         if depth > MAX_COMPLETE_DEPTH:

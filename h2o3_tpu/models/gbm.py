@@ -551,11 +551,6 @@ class GBMEstimator(ModelBuilder):
                     "distribution cannot change across checkpoint restart "
                     f"({ckpt.dist_name} vs {dist_name})")
 
-        if ckpt is not None:
-            bm = rebin_for_scoring(ckpt.bm, frame)
-        else:
-            bm = bin_frame(frame, x, nbins=p["nbins"],
-                           nbins_cats=p["nbins_cats"])
         w = frame.valid_weights()
         if p.get("weights_column"):
             wc = frame.col(p["weights_column"]).numeric_view()
@@ -573,6 +568,15 @@ class GBMEstimator(ModelBuilder):
         resp_na = _fetch_np(rc.na_mask)
         if resp_na[: frame.nrows].any():
             w = w * jnp.asarray((~resp_na).astype(np.float32))
+
+        if ckpt is not None:
+            bm = rebin_for_scoring(ckpt.bm, frame)
+        else:
+            # weighted edges: the row-weight ≡ row-multiplicity contract
+            # (pyunit_weights_gbm) must hold through the bin sketch too
+            bm = bin_frame(frame, x, nbins=p["nbins"],
+                           nbins_cats=p["nbins_cats"],
+                           weights=_fetch_np(w)[: frame.nrows])
 
         tp = TreeParams(
             max_depth=int(p["max_depth"]), min_rows=float(p["min_rows"]),

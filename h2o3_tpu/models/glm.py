@@ -423,6 +423,11 @@ class GLMModel(Model):
         X1 = self._design(frame)
         off = self._frame_offset(frame)
         if self.coef_multinomial is not None:
+            # offset is deliberately NOT applied: a per-row constant
+            # added to every class margin cancels in softmax, so the
+            # reference ignores it for multinomial with a warning
+            # (hex/glm/GLM.java:978 "offset has no effect on
+            # multinomial and will be ignored")
             return X1 @ jnp.asarray(self.coef_multinomial, jnp.float32)
         eta = X1 @ jnp.asarray(self.coef, jnp.float32)
         return eta if off is None else eta + off
@@ -689,8 +694,15 @@ class GLMEstimator(ModelBuilder):
         # offset_column: fixed per-row addition to eta (GLM.java offset)
         off = None
         if p.get("offset_column") and p["offset_column"] in frame:
-            ov = frame.col(p["offset_column"]).numeric_view()
-            off = jnp.where(jnp.isnan(ov), 0.0, ov).astype(jnp.float32)
+            if fam_name == "multinomial":
+                # class-uniform offsets cancel in softmax — warn and
+                # ignore like the reference (hex/glm/GLM.java:978)
+                log.warning("offset_column has no effect on multinomial "
+                            "and will be ignored")
+            else:
+                ov = frame.col(p["offset_column"]).numeric_view()
+                off = jnp.where(jnp.isnan(ov), 0.0,
+                                ov).astype(jnp.float32)
         off_or0 = off if off is not None else \
             jnp.zeros((X1.shape[0],), jnp.float32)
 

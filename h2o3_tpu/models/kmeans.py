@@ -249,15 +249,35 @@ class KMeansEstimator(ModelBuilder):
             # user-supplied starting centers (KMeans.java init=User):
             # raw-space points standardized into the design space
             from h2o3_tpu.core.kv import DKV as _DKV
+            from h2o3_tpu.parallel.mesh import fetch_replicated
             if isinstance(user_pts, str):
                 user_pts = _DKV.get(user_pts.strip('"'))
-            pts = np.stack([user_pts.col(nm).to_numpy()
-                            for nm in user_pts.names], axis=1)
+            # columns match predictors positionally (KMeans.java init=User);
+            # run the points through the SAME DataInfo expansion as the
+            # training frame so categorical predictors one-hot into the
+            # design layout and numerics standardize with training stats
+            if len(user_pts.names) != len(x):
+                raise ValueError(
+                    f"user_points must have one column per predictor "
+                    f"({len(x)}), got {len(user_pts.names)}")
+            upf = user_pts
+            if list(upf.names) != list(x):
+                import copy as _copy
+                upf = _copy.deepcopy(user_pts).rename_columns(list(x))
+            for nm in x:
+                if frame.col(nm).is_categorical != \
+                        upf.col(nm).is_categorical:
+                    kind = ("categorical"
+                            if frame.col(nm).is_categorical else "numeric")
+                    raise ValueError(
+                        f"user_points column for {kind} predictor "
+                        f"'{nm}' must be {kind} too")
+            udi = build_datainfo(upf, x,
+                                 standardize=bool(p["standardize"]),
+                                 use_all_factor_levels=True,
+                                 stats_override=stats_of(di))
+            pts = fetch_replicated(udi.X)[: user_pts.nrows]
             k = pts.shape[0]
-            if bool(p["standardize"]):
-                mus = np.asarray(di.num_means)
-                sds = np.asarray(di.num_sigmas)
-                pts = (pts - mus[None, :len(mus)]) / sds[None, :len(sds)]
             centers0 = jnp.asarray(pts, jnp.float32)
             constraints = p.get("cluster_size_constraints")
             if constraints is not None:

@@ -284,14 +284,15 @@ class UpliftDRFEstimator(ModelBuilder):
         if metric not in ("kl", "euclidean", "chi_squared"):
             raise ValueError(f"unknown uplift_metric '{p['uplift_metric']}'; "
                              "use KL, Euclidean or ChiSquared")
-        bm = bin_frame(frame, x, nbins=p["nbins"], nbins_cats=p["nbins_cats"])
-        npad = bm.bins.shape[0]
         n = frame.nrows
-
         w = frame.valid_weights()
         if p.get("weights_column") and p["weights_column"] in frame:
             wc_ = frame.col(p["weights_column"]).numeric_view()
             w = w * jnp.where(jnp.isnan(wc_), 0.0, wc_)
+        from h2o3_tpu.parallel.mesh import fetch_replicated as _f
+        bm = bin_frame(frame, x, nbins=p["nbins"], nbins_cats=p["nbins_cats"],
+                       weights=_f(w)[:n])
+        npad = bm.bins.shape[0]
         yv = adapt_domain(rc, rc.domain)
         trv = adapt_domain(tc, tc.domain)
         ok = (yv >= 0) & (trv >= 0)

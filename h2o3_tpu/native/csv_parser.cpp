@@ -90,7 +90,11 @@ static inline bool parse_double(const char* p, long n, double* out) {
       p++; digits++; frac_digits++;
     }
   }
-  if (p != e || digits == 0 || digits > 17)
+  // 15-digit cutoff: the integer fits a double exactly and the single
+  // divide rounds once, matching correctly-rounded strtod; 16-17 digit
+  // values would round twice (integer conversion + divide) and can be
+  // 1 ulp off, so they take the slow path
+  if (p != e || digits == 0 || digits > 15)
     return parse_double_slow(start, n, out);
   double v = (double)ip;
   if (frac_digits) v /= kPow10[frac_digits];

@@ -1,0 +1,71 @@
+"""Job-level infra-error retry (round-3 VERDICT item #10).
+
+A transient XLA/remote_compile INTERNAL error must not permanently fail
+a job (in round 2 one such blip killed an AutoML step for good); user
+errors must still fail fast with no retry.
+"""
+
+import pytest
+
+from h2o3_tpu.core.job import FAILED, DONE, Job, is_infra_error
+
+
+class FakeXlaRuntimeError(Exception):
+    pass
+
+
+def test_infra_error_retried_once():
+    calls = {"n": 0}
+
+    def flaky(job):
+        calls["n"] += 1
+        if calls["n"] == 1:
+            raise FakeXlaRuntimeError(
+                "INTERNAL: From /job:tpu_worker/replica:0: remote_compile "
+                "failed: UNAVAILABLE: socket closed")
+        return "ok"
+
+    j = Job("flaky step").start(flaky)
+    assert j.status == DONE
+    assert j.result == "ok"
+    assert calls["n"] == 2
+
+
+def test_infra_error_not_retried_twice():
+    calls = {"n": 0}
+
+    def always_down(job):
+        calls["n"] += 1
+        raise FakeXlaRuntimeError("INTERNAL: remote_compile failed")
+
+    with pytest.raises(FakeXlaRuntimeError):
+        Job("dead step").start(always_down)
+    assert calls["n"] == 2
+
+
+def test_user_error_fails_fast():
+    calls = {"n": 0}
+
+    def bad_params(job):
+        calls["n"] += 1
+        raise ValueError("unknown GBM params: ['nonsense']")
+
+    with pytest.raises(ValueError):
+        Job("user error").start(bad_params)
+    assert calls["n"] == 1
+
+
+def test_background_job_records_failure():
+    def always_down(job):
+        raise FakeXlaRuntimeError("INTERNAL: remote_compile failed")
+
+    j = Job("bg dead").start(always_down, background=True).join(30)
+    assert j.status == FAILED
+    assert "remote_compile" in j.exception
+
+
+def test_is_infra_error_classification():
+    assert is_infra_error(FakeXlaRuntimeError("INTERNAL: boom"))
+    assert is_infra_error(RuntimeError("UNAVAILABLE: socket closed"))
+    assert not is_infra_error(ValueError("INTERNAL: looks alike"))
+    assert not is_infra_error(RuntimeError("plain user-visible failure"))

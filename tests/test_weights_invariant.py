@@ -1,0 +1,123 @@
+"""Row-weight ≡ row-multiplicity invariants for tree training.
+
+Pins the backend-independent contract of the reference's
+pyunit_weights_gbm (h2o-py/tests/testdir_algos/gbm/pyunit_weights_gbm.py):
+  - uniform weight k + min_rows*k  ≡  no weights
+  - weight 0                        ≡  row removed
+  - weight 2                        ≡  row duplicated
+for GBM and DRF across regression / binomial / multinomial. DRF runs with
+sample_rate=1 and mtries=#features: with row sampling on, the PRNG keep
+sequence depends on frame length, so the invariant is only exact when
+per-row randomness is off (true in the reference too).
+"""
+
+import numpy as np
+import pytest
+
+from h2o3_tpu.frame.frame import Frame
+from h2o3_tpu.models.drf import DRFEstimator
+from h2o3_tpu.models.gbm import GBMEstimator
+
+
+def _cars(n=406, seed=42):
+    r = np.random.RandomState(seed)
+    cyl = r.choice([3, 4, 5, 6, 8], n, p=[0.01, 0.5, 0.01, 0.21, 0.27])
+    disp = (cyl * 40 + r.randn(n) * 25).round(1)
+    power = (cyl * 20 + r.randn(n) * 15).round(0)
+    weight = (cyl * 500 + r.randn(n) * 300).round(0)
+    accel = (25 - cyl + r.randn(n) * 2).round(1)
+    year = r.randint(70, 83, n).astype(float)
+    econ = (50 - 3.5 * cyl + (year - 70) * 0.5 + r.randn(n) * 3).round(1)
+    return {"displacement": disp, "power": power, "weight": weight,
+            "acceleration": accel, "year": year, "economy": econ,
+            "economy_20mpg": (econ >= 20).astype(float),
+            "cylinders": cyl.astype(float)}
+
+
+X = ["displacement", "power", "weight", "acceleration", "year"]
+
+
+def _frame(cols, keys, factors=(), extra=None):
+    d = {k: cols[k] for k in keys}
+    if extra is not None:
+        d.update(extra)
+    return Frame.from_numpy(d, categorical=list(factors))
+
+
+def _train(algo, fr, y, dist, min_rows, wcol=None):
+    kw = dict(ntrees=5, seed=20, max_depth=4, min_rows=min_rows)
+    if wcol:
+        kw["weights_column"] = wcol
+    if algo is GBMEstimator:
+        kw["distribution"] = dist
+    else:
+        kw.update(sample_rate=1.0, mtries=len(X))
+    est = algo(**kw)
+    return est.train(x=X, y=y, training_frame=fr)
+
+
+def _metric(model, y):
+    m = model.training_metrics.to_dict()
+    return m.get("AUC", m["MSE"])
+
+
+def _assert_same_model(m1, m2, probe):
+    """Identical forests ⇒ identical predictions on any probe frame —
+    the strongest form of the invariant (OOB/threshold conventions can
+    zero out scalar training metrics, e.g. DRF with sample_rate=1)."""
+    p1 = m1.predict(probe)
+    p2 = m2.predict(probe)
+    name = "predict" if "p1" not in p2.names else "p1"
+    a = p1.col(name).to_numpy()
+    b = p2.col(name).to_numpy()
+    scale = max(float(np.abs(a).max()), 1e-6)
+    assert float(np.abs(a - b).max()) < 1e-4 * scale, (a[:5], b[:5])
+
+
+CASES = [(GBMEstimator, "economy", "gaussian", ()),
+         (GBMEstimator, "economy_20mpg", "bernoulli", ("economy_20mpg",)),
+         (GBMEstimator, "cylinders", "multinomial", ("cylinders",)),
+         (DRFEstimator, "economy", "gaussian", ()),
+         (DRFEstimator, "economy_20mpg", "auto", ("economy_20mpg",))]
+
+
+@pytest.mark.parametrize("algo,y,dist,factors", CASES)
+def test_uniform_weights(algo, y, dist, factors):
+    cols = _cars()
+    f1 = _frame(cols, X + [y], factors)
+    f2 = _frame(cols, X + [y], factors,
+                {"w": np.full(len(cols[y]), 3.0)})
+    m1 = _train(algo, f1, y, dist, 20)
+    m2 = _train(algo, f2, y, dist, 60, wcol="w")
+    _assert_same_model(m1, m2, f1)
+    if algo is GBMEstimator:
+        a, b = _metric(m1, y), _metric(m2, y)
+        assert abs(a - b) < 1e-4 * max(abs(a), 1e-6), (a, b)
+
+
+@pytest.mark.parametrize("algo,y,dist,factors", CASES)
+def test_zero_weights_are_removed_rows(algo, y, dist, factors):
+    cols = _cars()
+    keep = np.random.RandomState(7).randint(0, 2, len(cols[y])) == 1
+    f1 = _frame({k: v[keep] for k, v in cols.items()}, X + [y], factors)
+    f2 = _frame(cols, X + [y], factors, {"w": keep.astype(float)})
+    m1 = _train(algo, f1, y, dist, 20)
+    m2 = _train(algo, f2, y, dist, 20, wcol="w")
+    _assert_same_model(m1, m2, f1)
+    if algo is GBMEstimator:
+        a, b = _metric(m1, y), _metric(m2, y)
+        assert abs(a - b) < 1e-4 * max(abs(a), 1e-6), (a, b)
+
+
+@pytest.mark.parametrize("algo,y,dist,factors", CASES[:3])
+def test_doubled_weights_are_duplicated_rows(algo, y, dist, factors):
+    cols = _cars()
+    w2 = np.random.RandomState(3).randint(1, 3, len(cols[y])).astype(float)
+    dup = np.repeat(np.arange(len(cols[y])), w2.astype(int))
+    f1 = _frame({k: v[dup] for k, v in cols.items()}, X + [y], factors)
+    f2 = _frame(cols, X + [y], factors, {"w": w2})
+    m1 = _train(algo, f1, y, dist, 20)
+    m2 = _train(algo, f2, y, dist, 20, wcol="w")
+    _assert_same_model(m1, m2, f1)
+    a, b = _metric(m1, y), _metric(m2, y)
+    assert abs(a - b) < 1e-4 * max(abs(a), 1e-6), (a, b)
