@@ -25,6 +25,7 @@ MISC = os.path.join(TESTS, "testdir_misc")
 MUNGING = os.path.join(TESTS, "testdir_munging")
 
 PER_TEST_TIMEOUT = 600
+REPORT_NAME = "CONFORMANCE.md"
 
 # Curated subset (VERDICT round-1 item 1: ≥40 from
 # testdir_algos/{gbm,glm,deeplearning,kmeans,automl}).  Chosen to need
@@ -113,6 +114,11 @@ def start_server():
 
 def main():
     filt = sys.argv[1] if len(sys.argv) > 1 else ""
+    # filtered runs must NEVER overwrite the full-suite report: round 2
+    # committed a 5-test GBM-only CONFORMANCE.md over the 38-test table
+    global REPORT_NAME
+    if filt:
+        REPORT_NAME = "CONFORMANCE.partial.md"
     units = [u for u in PYUNITS if filt in u]
     workdir = tempfile.mkdtemp(prefix="h2o3tpu_conf_")
     sys.path.insert(0, REPO)
@@ -168,12 +174,9 @@ def write_report(results):
         "Datasets: real in-tree files (prostate, iris) symlinked at runtime;",
         "schema-compatible synthetic stand-ins elsewhere",
         "(`conformance/gen_data.py`). Tests needing data that does not",
-        "exist in this offline image are excluded. Known-fail classes:",
-        "float32 exactness asserts (weights_gbm expects 1e-5-relative",
-        "MSE equality under 3x-weight scaling; f64 JVM vs f32 TPU),",
-        "reference-RNG-coupled asserts (benign_glm_grid expects exactly",
-        "5 models from ITS RandomDiscrete sequence), and 600s timeouts",
-        "on this 1-core host for many-model CV pyunits.",
+        "exist in this offline image are excluded. This file is ALWAYS",
+        "the full curated suite; filtered runs write",
+        "CONFORMANCE.partial.md instead.",
         "",
         f"**Result: {npass}/{len(results)} passing** "
         f"({time.strftime('%Y-%m-%d')})",
@@ -185,7 +188,7 @@ def write_report(results):
         status = "pass" if ok else "FAIL — `" + \
             (tail[-1][:80].replace("|", "/") if tail else "?") + "`"
         lines.append(f"| {name} | {status} | {dt:.1f}s |")
-    with open(os.path.join(REPO, "CONFORMANCE.md"), "w") as f:
+    with open(os.path.join(REPO, REPORT_NAME), "w") as f:
         f.write("\n".join(lines) + "\n")
 
 

@@ -7,13 +7,17 @@ import time
 # conformance is a correctness surface, not a perf surface: run the
 # backend on host CPU so parallel conformance runs never contend for the
 # single tunneled TPU chip (override with H2O3TPU_CONF_TPU=1)
-if os.environ.get("H2O3TPU_CONF_TPU") != "1":
+_cpu = os.environ.get("H2O3TPU_CONF_TPU") != "1"
+if _cpu:
     os.environ["JAX_PLATFORMS"] = "cpu"
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import h2o3_tpu                               # noqa: E402
-h2o3_tpu.init()
+# backend= is mandatory: the axon TPU plugin shadows JAX_PLATFORMS=cpu,
+# so init() without it silently lands the whole conformance run on the
+# single tunneled chip (contention + ResourceExhausted flakes)
+h2o3_tpu.init(backend="cpu" if _cpu else None)
 from h2o3_tpu.api.server import start_server  # noqa: E402
 
 port = start_server(port=int(sys.argv[1]) if len(sys.argv) > 1 else 0)

@@ -212,8 +212,10 @@ def metrics_v3(mm, model: Model, frame_key: str = "",
             # multinomial AUC/AUCPR exist as fields the client probes
             # unconditionally (metrics_base.py:126); None = "not computed"
             "AUC": _clean(d.get("AUC")), "pr_auc": _clean(d.get("pr_auc")),
-            "multinomial_auc_table": None,
-            "multinomial_aucpr_table": None,
+            "multinomial_auc_table": _multinomial_auc_table(
+                d.get("multinomial_auc_rows"), "AUC"),
+            "multinomial_aucpr_table": _multinomial_auc_table(
+                d.get("multinomial_aucpr_rows"), "auc_pr"),
             "cm": {"__meta": {"schema_version": 3,
                               "schema_name": "ConfusionMatrixV3",
                               "schema_type": "ConfusionMatrix"},
@@ -321,6 +323,13 @@ def _params_v3(model: Model) -> List[dict]:
     for n in names:
         dv = defaults.get(n)
         av = model.params.get(n, dv)
+        # numpy scalars (e.g. np.bool_ from grid hyper expansion) must
+        # become native JSON types, not str() — a wire "False" breaks
+        # pyunit expect_model_param's float(actual) coercion
+        if isinstance(av, np.generic):
+            av = av.item()
+        if isinstance(dv, np.generic):
+            dv = dv.item()
         if not isinstance(av, (int, float, str, bool, list, type(None))):
             av = str(av)
         if not isinstance(dv, (int, float, str, bool, list, type(None))):
@@ -338,6 +347,21 @@ def _params_v3(model: Model) -> List[dict]:
             "is_member_of_frames": [], "is_mutually_exclusive_with": [],
         })
     return out
+
+
+def _multinomial_auc_table(rows, metric: str) -> Optional[dict]:
+    """hex/MultinomialAUC.java getTable wire twin: row headers
+    '<class> vs Rest' / 'Macro OVR' / '<a> vs <b>' / 'Weighted OVO',
+    columns [First class domain, Second class domain, <metric>]."""
+    if not rows:
+        return None
+    return twodim(
+        f"Multinomial {metric} values",
+        ["First class domain", "Second class domain", metric],
+        ["string", "string", "double"],
+        [[r[1], r[2], r[3]] for r in rows],
+        f"Multinomial {metric} values",
+        row_headers=[r[0] for r in rows])
 
 
 def _varimp_table(model: Model) -> Optional[dict]:

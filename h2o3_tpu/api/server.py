@@ -1410,6 +1410,19 @@ class _Handler(BaseHTTPRequestHandler):
                 except KeyError as e:
                     out = _error_json(path, e, 404)
                     code = 404
+                except ValueError as e:
+                    # user-input errors → 412 + H2OErrorV3, which the
+                    # real h2o-py maps to H2OResponseError
+                    # (EnvironmentError) — raw 500s become
+                    # H2OServerError and break every pyunit that
+                    # asserts on invalid parameters
+                    # (water/api/RequestServer.java:371 error path).
+                    # Logged with traceback: an internal bug surfacing
+                    # as ValueError must stay diagnosable server-side.
+                    log.warning("412 on %s %s: %s", method, path, e,
+                                exc_info=True)
+                    out = _error_json(path, e, 412)
+                    code = 412
                 except Exception as e:   # noqa: BLE001 - request boundary
                     log.exception("handler error on %s %s", method, path)
                     out = _error_json(path, e, 500)

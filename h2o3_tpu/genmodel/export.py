@@ -22,6 +22,8 @@ def _tree_artifacts(model) -> Tuple[dict, Dict[str, np.ndarray]]:
         "tree_is_split": np.asarray(f.is_split),
         "tree_leaf": np.asarray(f.leaf),
         "tree_leaf_w": np.asarray(f.leaf_w),
+        "tree_cat_split": np.asarray(f.cat_split),
+        "tree_left_words": np.asarray(f.left_words),
         "edges": np.asarray(bm.edges),
         "nbins": np.asarray(bm.nbins),
         "is_cat": np.asarray(bm.is_cat),

@@ -78,9 +78,10 @@ def bin_raw(meta: dict, arrays: Dict[str, np.ndarray],
             data: Dict[str, np.ndarray]) -> np.ndarray:
     """Bin raw feature columns exactly like frame/binning.py bin_frame.
 
-    Numeric: bin = #(edges <= x); categorical: domain index, folded by
-    ``mod nb`` when the training cardinality exceeded nbins_cats
-    (the DHistogram cat-bin cap); NA / unseen level → bin B-1.
+    Numeric: bin = #(edges <= x); categorical: domain index, with
+    ADJACENT codes grouped by integer divide when the training
+    cardinality exceeded nbins_cats (the DHistogram grouped cat-bin
+    cap); NA / unseen level → bin B-1.
     """
     names = meta["names"]
     B = int(meta["nbins_total"])
@@ -101,7 +102,8 @@ def bin_raw(meta: dict, arrays: Dict[str, np.ndarray],
             code = np.array([lut.get(str(x), -1) if x is not None else -1
                              for x in v], dtype=np.int64)
             card = max(len(dom), 1)
-            b = np.where(nb[i] < card, code % max(nb[i], 1), code)
+            div = -(-card // max(nb[i], 1)) if card > nb[i] else 1
+            b = code // div if div > 1 else code
             b = np.where(code < 0, B - 1, b)
         else:
             x = v.astype(np.float64)
@@ -125,20 +127,25 @@ def walk_forest(arrays: Dict[str, np.ndarray], bins: np.ndarray,
     na_left = arrays["tree_na_left"].astype(bool)
     is_split = arrays["tree_is_split"].astype(bool)
     leaf = arrays["tree_leaf"]        # [T, 2^D]
+    cat_split = arrays.get("tree_cat_split")
+    left_words = arrays.get("tree_left_words")
     T = feat.shape[0]
     out = np.zeros((T, bins.shape[0]), dtype=np.float64)
     for t in range(T):
         nid = route_tree_nids(feat[t], thresh[t], na_left[t], is_split[t],
-                              bins, B)
+                              bins, B,
+                              None if cat_split is None else cat_split[t],
+                              None if left_words is None else left_words[t])
         out[t] = leaf[t][nid]
     return out
 
 
 def route_tree_nids(feat, thresh, na_left, is_split, bins: np.ndarray,
-                    B: int) -> np.ndarray:
+                    B: int, cat_split=None, left_words=None) -> np.ndarray:
     """Terminal leaf id per row for ONE tree [D, L] (RuleFit rule
     membership is a leaf-id range check — models/rulefit.py _route_nids
-    twin on the host)."""
+    twin on the host). Categorical subset splits test the row's bin bit
+    in the node's packed left-set words."""
     D = feat.shape[0]
     n = bins.shape[0]
     nid = np.zeros(n, dtype=np.int64)
@@ -149,7 +156,15 @@ def route_tree_nids(feat, thresh, na_left, is_split, bins: np.ndarray,
         isp = is_split[d][nid]
         b_r = bins[np.arange(n), f_r]
         isna = b_r == (B - 1)
-        goleft = np.where(isp, np.where(isna, nal, b_r <= t_r), True)
+        go = b_r <= t_r
+        if cat_split is not None and cat_split[d].any():
+            lw = left_words[d][nid]                     # [n, W]
+            W = lw.shape[1]
+            widx = np.clip(b_r >> 5, 0, W - 1)
+            word = lw[np.arange(n), widx]
+            inset = ((word >> (b_r & 31).astype(np.uint32)) & 1) == 1
+            go = np.where(cat_split[d][nid], inset, go)
+        goleft = np.where(isp, np.where(isna, nal, go), True)
         nid = 2 * nid + np.where(goleft, 0, 1)
     return nid
 

@@ -120,11 +120,17 @@ class SharedTreeMojoModel(MojoModel):
                              "(exported before TreeSHAP support)")
         B = int(self.meta["nbins_total"])
         bins = bin_raw(self.meta, self.arrays, data)
+        tf = self.arrays["tree_feat"]
         forest = types.SimpleNamespace(
-            feat=self.arrays["tree_feat"], thresh=self.arrays["tree_thresh"],
+            feat=tf, thresh=self.arrays["tree_thresh"],
             na_left=self.arrays["tree_na_left"],
             is_split=self.arrays["tree_is_split"],
-            leaf=self.arrays["tree_leaf"], leaf_w=self.arrays["tree_leaf_w"])
+            leaf=self.arrays["tree_leaf"], leaf_w=self.arrays["tree_leaf_w"],
+            cat_split=self.arrays.get(
+                "tree_cat_split", np.zeros(tf.shape, bool)),
+            left_words=self.arrays.get(
+                "tree_left_words",
+                np.zeros(tf.shape + (1,), np.uint32)))
         T = forest.feat.shape[0]
         scale = 1.0 / T if self.algo == "drf" else 1.0
         phi = forest_contributions(forest, bins, B, scale=scale)
@@ -457,10 +463,14 @@ class RuleFitMojoModel(MojoModel):
             for r in my_rules:
                 by_tree.setdefault(int(r["tree"]), []).append(r)
             for t, rl in sorted(by_tree.items()):
+                cs = sub_arrays.get("tree_cat_split")
+                lw = sub_arrays.get("tree_left_words")
                 nid = route_tree_nids(
                     sub_arrays["tree_feat"][t], sub_arrays["tree_thresh"][t],
                     sub_arrays["tree_na_left"][t].astype(bool),
-                    sub_arrays["tree_is_split"][t].astype(bool), bins, B)
+                    sub_arrays["tree_is_split"][t].astype(bool), bins, B,
+                    None if cs is None else cs[t].astype(bool),
+                    None if lw is None else lw[t])
                 for r in rl:
                     feats[r["name"]] = ((nid >= r["lo"]) & (nid < r["hi"])
                                         ).astype(np.float64)

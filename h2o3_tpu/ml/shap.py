@@ -25,7 +25,8 @@ import numpy as np
 
 
 def _tree_shap_one(feat, thresh, na_left, is_split, leaf, leaf_w,
-                   bins, B: int, phi: np.ndarray) -> float:
+                   bins, B: int, phi: np.ndarray,
+                   cat_split=None, left_words=None) -> float:
     """Accumulate one tree's contributions into phi [N, F]; returns the
     tree's expected value (its BiasTerm share)."""
     D = feat.shape[0]
@@ -88,8 +89,16 @@ def _tree_shap_one(feat, thresh, na_left, is_split, leaf, leaf_w,
             return
         f = int(feat[d, l])
         b = bins[:, f]
+        if cat_split is not None and bool(cat_split[d, l]):
+            # categorical subset split: bit membership in the left-set
+            lw = left_words[d, l]
+            go = (lw[np.clip(b >> 5, 0, lw.shape[0] - 1)]
+                  >> (b & 31).astype(np.uint32)) & 1
+            go = go.astype(bool)
+        else:
+            go = b <= thresh[d, l]
         gl = np.where(b == B - 1, bool(na_left[d, l]),
-                      b <= thresh[d, l]).astype(np.float32)
+                      go).astype(np.float32)
         r_j = max(float(covers[d][l]), 1e-30)
         r_l = float(covers[d + 1][2 * l])
         r_r = float(covers[d + 1][2 * l + 1])
@@ -127,6 +136,8 @@ def forest_contributions(forest, bins: np.ndarray, B: int,
     is_split = np.asarray(forest.is_split)
     leaf = np.asarray(forest.leaf, np.float64) * scale
     leaf_w = np.asarray(forest.leaf_w, np.float64)
+    cat_split = np.asarray(forest.cat_split)
+    left_words = np.asarray(forest.left_words)
     T = feat.shape[0]
     N, F = bins.shape
     out = np.zeros((N, F + 1), np.float64)
@@ -138,7 +149,9 @@ def forest_contributions(forest, bins: np.ndarray, B: int,
         for t in range(T):
             bias += _tree_shap_one(feat[t], thresh[t], na_left[t],
                                    is_split[t], leaf[t], leaf_w[t],
-                                   blk, B, phi)
+                                   blk, B, phi,
+                                   cat_split=cat_split[t],
+                                   left_words=left_words[t])
         out[lo:hi, :F] = phi
         out[lo:hi, F] = bias
     return out

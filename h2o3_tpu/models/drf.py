@@ -238,7 +238,8 @@ class DRFEstimator(ModelBuilder):
             reg_lambda=0.0,
             min_split_improvement=float(p["min_split_improvement"]),
             col_sample_rate=float(p["col_sample_rate_per_tree"]),
-            nbins_total=bm.nbins_total)
+            nbins_total=bm.nbins_total,
+            cat_feats=tuple(bool(v) for v in bm.is_cat))
 
         # target matrix ys [Npad, K]: indicators for classification
         N = bm.bins.shape[0]

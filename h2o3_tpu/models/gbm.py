@@ -222,7 +222,8 @@ def _neutral_tp(tp: TreeParams) -> TreeParams:
                       learn_rate=0.0, reg_lambda=0.0,
                       min_split_improvement=0.0, col_sample_rate=1.0,
                       nbins_total=tp.nbins_total,
-                      block_rows=tp.block_rows)
+                      block_rows=tp.block_rows,
+                      cat_feats=tp.cat_feats)
 
 
 def _boost_step_impl(bins, nb, y, w, margin, key, knobs, *, tp, dist,
@@ -487,7 +488,7 @@ class GBMEstimator(ModelBuilder):
     DEFAULTS = dict(
         ntrees=50, max_depth=5, min_rows=10.0, learn_rate=0.1,
         sample_rate=1.0, col_sample_rate_per_tree=1.0,
-        nbins=64, nbins_cats=64, distribution="auto",
+        nbins=64, nbins_cats=1024, distribution="auto",
         # reg_lambda=0: the reference GammaPass has no ridge term
         # (hex/tree/gbm/GBM.java leaf gamma = sum g / sum h); the
         # xgboost facade passes its own lambda
@@ -584,7 +585,8 @@ class GBMEstimator(ModelBuilder):
             reg_lambda=float(p["reg_lambda"]),
             min_split_improvement=float(p["min_split_improvement"]),
             col_sample_rate=float(p["col_sample_rate_per_tree"]),
-            nbins_total=bm.nbins_total)
+            nbins_total=bm.nbins_total,
+            cat_feats=tuple(bool(v) for v in bm.is_cat))
 
         # monotone constraints (GBM.java monotone_constraints; numeric
         # features only, like the reference's validation)

@@ -283,6 +283,54 @@ def _multinomial_value_grad(flat, X1, y_int, w, l2, K: int):
     return jax.value_and_grad(obj)(flat)
 
 
+@partial(jax.jit, static_argnames=("K", "use_l1"))
+def _multinomial_irls_solve(X1, B, y_int, w, l1, l2, beta_eps, max_iter,
+                            *, K: int, use_l1: bool):
+    """Multinomial IRLSM: block-coordinate IRLS over classes
+    (hex/glm/GLM.java:1995 fitIRLSM multinomial path — one weighted
+    least-squares subproblem per class per sweep, cycled to
+    convergence). Working weights p_c(1-p_c), working response from the
+    class margin; L1 via the same ADMM inner solver as binomial.
+    The whole sweep loop is one compiled while_loop."""
+    Pp1 = X1.shape[1]
+    penalize = jnp.concatenate([jnp.ones(Pp1 - 1),
+                                jnp.zeros(1)]).astype(jnp.float32)
+    nobs = jnp.maximum(jnp.sum(w), 1.0)
+    mesh = get_mesh()
+
+    def one_class(B, c):
+        eta = X1 @ B
+        p = jax.nn.softmax(eta, axis=1)
+        pc = p[:, c]
+        yc = (y_int == c).astype(jnp.float32)
+        d = jnp.maximum(pc * (1.0 - pc), 1e-10)
+        z = eta[:, c] + (yc - pc) / d
+        wc = w * d
+        xtx, xtz, _ = gram(X1, wc, z, mesh=mesh)
+        A = xtx / nobs
+        q = xtz / nobs
+        if use_l1:
+            bc = admm_l1_quadratic(A + l2 * jnp.diag(penalize), q, l1,
+                                   penalize)
+        else:
+            bc = cholesky_solve_regularized(A, q, l2, penalize)
+        return B.at[:, c].set(bc)
+
+    def body(state):
+        B, _, it = state
+        Bn = B
+        for c in range(K):            # K static: unrolled class sweep
+            Bn = one_class(Bn, c)
+        return Bn, jnp.max(jnp.abs(Bn - B)), it + 1
+
+    def cond(state):
+        return (state[1] > beta_eps) & (state[2] < max_iter)
+
+    B, _, _ = jax.lax.while_loop(
+        cond, body, (B, jnp.float32(jnp.inf), jnp.int32(0)))
+    return B
+
+
 @partial(jax.jit, static_argnames=("K",))
 def _ordinal_value_grad(flat, X1, y_int, w, l2, K: int):
     """Proportional-odds (cumulative logit) NLL + gradient
@@ -640,8 +688,17 @@ class GLMEstimator(ModelBuilder):
         return np.asarray(coef)
 
     def _fit_multinomial(self, X1, y_int, w, K: int, l2: float,
-                         nobs: float, max_iter: int):
+                         nobs: float, max_iter: int,
+                         solver: str = "l_bfgs", l1: float = 0.0):
         Pp1 = X1.shape[1]
+        if solver in ("irlsm", "coordinate_descent",
+                      "coordinate_descent_naive"):
+            B0 = jnp.zeros((Pp1, K), jnp.float32)
+            B = _multinomial_irls_solve(
+                X1, B0, y_int, w, jnp.float32(l1), jnp.float32(l2),
+                jnp.float32(1e-5), jnp.int32(max_iter), K=K,
+                use_l1=l1 > 0)
+            return np.asarray(B)
         l2d = jnp.float32(l2)
 
         def vgrad(c):
@@ -772,8 +829,21 @@ class GLMEstimator(ModelBuilder):
             y_dev = put_sharded(yv, row_sharding(mesh))
             nobs = float(jnp.sum(w))
             l2 = _l2_of(p)
+            msolver = str(p["solver"]).lower()
+            if msolver == "auto":
+                # wide designs: K unrolled P×P grams + Cholesky per
+                # sweep is O(K·P²) memory — follow the reference's
+                # AUTO heuristic and fall back to L-BFGS (GLM.java
+                # defaultSolver picks L_BFGS for large column counts)
+                msolver = "irlsm" if X1.shape[1] <= 2000 else "l_bfgs"
+            alpha_m = float(p["alpha"] if p["alpha"] is not None else 0.5)
+            lam_m = p.get("lambda_") or 0.0
+            if isinstance(lam_m, (list, tuple)):
+                lam_m = lam_m[0] if lam_m else 0.0
+            l1_m = float(alpha_m) * float(lam_m)
             B = self._fit_multinomial(X1, y_dev, w, K, l2, nobs,
-                                      int(p["max_iterations"]))
+                                      int(p["max_iterations"]),
+                                      solver=msolver, l1=l1_m)
             model = GLMModel(p, output, B[:, 0], Family("binomial"),
                              stats_of(di), list(x), coef_multinomial=B)
             probs = jax.nn.softmax(X1 @ jnp.asarray(B, jnp.float32), axis=1)

@@ -26,7 +26,8 @@ import numpy as np
 from h2o3_tpu.frame.binning import BinnedMatrix, bin_frame, rebin_for_scoring
 from h2o3_tpu.frame.frame import Frame
 from h2o3_tpu.models.model import Model, ModelBuilder, ModelCategory
-from h2o3_tpu.models.tree import Tree, row_feature_values, stack_trees
+from h2o3_tpu.models.tree import (Tree, row_feature_values,
+                                  stack_trees, zero_catsplit)
 from h2o3_tpu.ops.segments import segment_sum
 from h2o3_tpu.parallel.mesh import get_mesh
 
@@ -75,7 +76,8 @@ def _grow_random_tree(bins, nb, w, key, *, depth: int, B: int):
         nid = 2 * nid + jnp.where(goleft, 0, 1)
     leaf_cnt = segment_sum(nid, w[:, None], n_nodes=2 ** depth, mesh=mesh)[:, 0]
     leaf = _avg_path_correction(leaf_cnt)
-    return Tree(feats, threshs, na_lefts, is_splits, leaf, leaf_cnt)
+    return Tree(feats, threshs, na_lefts, is_splits, leaf, leaf_cnt,
+                *zero_catsplit(feats.shape[0], feats.shape[1]))
 
 
 def _tree_path_length(tree: Tree, bins, B: int):

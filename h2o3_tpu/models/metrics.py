@@ -146,6 +146,23 @@ def _multinomial_pass(probs, y, w, *, mesh):
     return sums[0], cm
 
 
+@partial(jax.jit, static_argnames=("mesh",))
+def _multinomial_score_hists(probs, y, w, *, mesh):
+    """[K, K, AUC_NBINS] — weight of rows with TRUE class j landing in
+    score bin b of class-k probability. One structure serves both
+    one-vs-rest (pos = H[k,k], neg = Σ_{j≠k} H[k,j]) and one-vs-one
+    (pos = H[i,i], neg = H[i,j]) AUCs — hex/MultinomialAUC.java."""
+    K = probs.shape[1]
+    out = []
+    for k in range(K):
+        b = jnp.clip((probs[:, k] * AUC_NBINS).astype(jnp.int32),
+                     0, AUC_NBINS - 1)
+        hk = segment_sum((y * AUC_NBINS + b).astype(jnp.int32), w[:, None],
+                         n_nodes=K * AUC_NBINS, mesh=mesh)
+        out.append(hk.reshape(K, AUC_NBINS))
+    return jnp.stack(out)                    # [K(prob), K(true), B]
+
+
 def multinomial_metrics(probs, y, w=None, mesh=None,
                         domain: Optional[List[str]] = None) -> ModelMetrics:
     """hex/ModelMetricsMultinomial.java: logloss, per-class error, CM."""
@@ -158,13 +175,67 @@ def multinomial_metrics(probs, y, w=None, mesh=None,
     cm = np.asarray(cm).reshape(K, K)
     row = cm.sum(axis=1)
     per_class_err = np.where(row > 0, 1.0 - np.diag(cm) / np.maximum(row, 1e-12), 0.0)
+    extra = {}
+    if 2 <= K <= 30:
+        # one-vs-rest + one-vs-one AUC/PR-AUC tables (PUBDEV-7269,
+        # hex/MultinomialAUC.java; capped K bounds the K² histogram set)
+        H = np.asarray(_multinomial_score_hists(probs, y, w, mesh=mesh),
+                       np.float64)
+        dom = domain or [f"class_{i}" for i in range(K)]
+        frac = row / max(row.sum(), 1e-12)
+        auc_rows, pr_rows = [], []
+        ovr_auc, ovr_pr = np.zeros(K), np.zeros(K)
+        for k in range(K):
+            pos = H[k, k]
+            neg = H[k].sum(axis=0) - pos
+            r = _auc_from_hist(pos, neg)
+            ovr_auc[k], ovr_pr[k] = r["auc"], r["pr_auc"]
+            auc_rows.append([f"{dom[k]} vs Rest", dom[k], "",
+                             float(r["auc"])])
+            pr_rows.append([f"{dom[k]} vs Rest", dom[k], "",
+                            float(r["pr_auc"])])
+        auc_rows.append(["Macro OVR", "", "", float(ovr_auc.mean())])
+        auc_rows.append(["Weighted OVR", "", "",
+                         float((ovr_auc * frac).sum())])
+        pr_rows.append(["Macro OVR", "", "", float(ovr_pr.mean())])
+        pr_rows.append(["Weighted OVR", "", "",
+                        float((ovr_pr * frac).sum())])
+        ovo_auc, ovo_pr, ovo_w = [], [], []
+        for i in range(K):
+            for j in range(i + 1, K):
+                # symmetric pairwise AUC: average of i-scored and
+                # j-scored directions (PairwiseAUC semantics)
+                ri = _auc_from_hist(H[i, i], H[i, j])
+                rj = _auc_from_hist(H[j, j], H[j, i])
+                a = 0.5 * (ri["auc"] + rj["auc"])
+                pr = 0.5 * (ri["pr_auc"] + rj["pr_auc"])
+                ovo_auc.append(a)
+                ovo_pr.append(pr)
+                ovo_w.append(frac[i] + frac[j])
+                auc_rows.append([f"{dom[i]} vs {dom[j]}", dom[i], dom[j],
+                                 float(a)])
+                pr_rows.append([f"{dom[i]} vs {dom[j]}", dom[i], dom[j],
+                                float(pr)])
+        ow = np.asarray(ovo_w) / max(sum(ovo_w), 1e-12)
+        auc_rows.append(["Macro OVO", "", "", float(np.mean(ovo_auc))])
+        auc_rows.append(["Weighted OVO", "", "",
+                         float((np.asarray(ovo_auc) * ow).sum())])
+        pr_rows.append(["Macro OVO", "", "", float(np.mean(ovo_pr))])
+        pr_rows.append(["Weighted OVO", "", "",
+                        float((np.asarray(ovo_pr) * ow).sum())])
+        extra = {"multinomial_auc_rows": auc_rows,
+                 "multinomial_aucpr_rows": pr_rows,
+                 # scalar AUC/PR = weighted OVR (the reference's
+                 # default MultinomialAucType when computed)
+                 "AUC": float((ovr_auc * frac).sum()),
+                 "pr_auc": float((ovr_pr * frac).sum())}
     return ModelMetrics(
         "Multinomial", int(tot), sse_t / max(tot, 1e-12),
         logloss=ll / max(tot, 1e-12),
         mean_per_class_error=float(per_class_err[row > 0].mean()) if (row > 0).any() else 0.0,
         error_rate=err / max(tot, 1e-12),
         confusion_matrix=cm.tolist(),
-        domain=domain)
+        domain=domain, **extra)
 
 
 @partial(jax.jit, static_argnames=("mesh",))

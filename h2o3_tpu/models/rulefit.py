@@ -32,28 +32,38 @@ log = get_logger("h2o3_tpu.rulefit")
 
 def _route_nids(tree, bins, B: int):
     """Final leaf id per row for one tree (predict_tree sans leaf gather)."""
+    from h2o3_tpu.models.tree import _level_goleft
     N = bins.shape[0]
     D = tree.feat.shape[0]
     nid = jnp.zeros((N,), jnp.int32)
     for d in range(D):
-        f_r = tree.feat[d][nid]
-        t_r = tree.thresh[d][nid]
-        nal_r = tree.na_left[d][nid]
-        isp_r = tree.is_split[d][nid]
-        b_r = row_feature_values(bins, f_r)
-        isna = b_r == (B - 1)
-        goleft = jnp.where(isp_r, jnp.where(isna, nal_r, b_r <= t_r), True)
-        nid = 2 * nid + jnp.where(goleft, 0, 1)
+        nid = _level_goleft(tree.feat[d], tree.thresh[d], tree.na_left[d],
+                            tree.is_split[d], tree.cat_split[d],
+                            tree.left_words[d], nid, bins, B)
     return nid
 
 
 def _extract_rules(forest, tree_idx: int, D: int) -> List[dict]:
-    """Walk one complete tree (host) → rules with leaf-id ranges."""
+    """Walk one complete tree (host) → rules with leaf-id ranges.
+
+    Conds are (feat, thresh, na_left, side, binset): binset is None for
+    numeric range splits, else the frozenset of bin ids going left
+    (categorical subset split)."""
     feat = np.asarray(forest.feat[tree_idx])
     thresh = np.asarray(forest.thresh[tree_idx])
     na_left = np.asarray(forest.na_left[tree_idx])
     is_split = np.asarray(forest.is_split[tree_idx])
+    cat_split = np.asarray(forest.cat_split[tree_idx])
+    left_words = np.asarray(forest.left_words[tree_idx])
     rules: List[dict] = []
+
+    def _binset(d, idx):
+        if not bool(cat_split[d, idx]):
+            return None
+        words = left_words[d, idx]
+        return frozenset(
+            int(32 * k + b) for k in range(words.shape[0])
+            for b in range(32) if (int(words[k]) >> b) & 1)
 
     def walk(d, idx, conds):
         if d == D or not is_split[d, idx]:
@@ -63,8 +73,9 @@ def _extract_rules(forest, tree_idx: int, D: int) -> List[dict]:
                               "lo": idx * span, "hi": (idx + 1) * span})
             return
         f, t, nal = int(feat[d, idx]), int(thresh[d, idx]), bool(na_left[d, idx])
-        walk(d + 1, 2 * idx, conds + [(f, t, nal, "left")])
-        walk(d + 1, 2 * idx + 1, conds + [(f, t, nal, "right")])
+        bs = _binset(d, idx)
+        walk(d + 1, 2 * idx, conds + [(f, t, nal, "left", bs)])
+        walk(d + 1, 2 * idx + 1, conds + [(f, t, nal, "right", bs)])
 
     walk(0, 0, [])
     return rules
@@ -74,11 +85,19 @@ def _rule_language(rule: dict, bm) -> str:
     """Human-readable rule string (reference Rule.languageRule)."""
     edges = np.asarray(bm.edges)
     parts = []
-    for f, t, nal, side in rule["conds"]:
+    for f, t, nal, side, binset in rule["conds"]:
         name = bm.names[f]
         if bm.is_cat[f]:
             dom = bm.domains[f] or []
-            levels = [dom[i] for i in range(min(t + 1, len(dom)))]
+            card = max(len(dom), 1)
+            nbf = int(np.asarray(bm.nbins)[f])
+            div = -(-card // nbf) if card > nbf else 1
+            if binset is not None:
+                levels = [dom[i] for i in range(len(dom))
+                          if (i // div) in binset]
+            else:
+                levels = [dom[i] for i in range(len(dom))
+                          if (i // div) <= t]
             s = (f"{name} in {{{', '.join(levels)}}}" if side == "left"
                  else f"{name} not in {{{', '.join(levels)}}}")
         else:

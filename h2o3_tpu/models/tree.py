@@ -62,6 +62,18 @@ class Tree(NamedTuple):
                           # (node covers for TreeSHAP pool up from these;
                           # the reference stores them as node weights in
                           # hex/tree/CompressedTree for contributions)
+    cat_split: jax.Array  # [D, Lmax] bool — split is a category SUBSET
+                          # (bitset) split, not a bin-range split
+    left_words: jax.Array  # [D, Lmax, W] uint32 — bit b of word k set ⇔
+                          # bin 32k+b goes LEFT (DTree.java:619-697
+                          # bitset splits, static-shape bit-packed)
+
+
+def zero_catsplit(D: int, Lmax: int):
+    """(cat_split, left_words) placeholders for builders that never make
+    categorical subset splits (isolation forests, uplift)."""
+    return (jnp.zeros((D, Lmax), bool),
+            jnp.zeros((D, Lmax, 1), jnp.uint32))
 
 
 @dataclasses.dataclass(frozen=True)
@@ -74,6 +86,13 @@ class TreeParams:
     col_sample_rate: float = 1.0     # per-split column sampling is per-tree here
     nbins_total: int = 65            # B incl. NA bin
     block_rows: int = 4096
+    cat_feats: tuple = ()            # per-feature is-categorical flags —
+                                     # schema-static, activates the
+                                     # sorted-prefix subset-split path
+
+    @property
+    def has_cats(self) -> bool:
+        return any(self.cat_feats)
 
 
 def row_feature_values(bins, f_r):
@@ -88,7 +107,8 @@ def row_feature_values(bins, f_r):
 
 
 def _best_splits(hist, nb, col_mask, params: TreeParams,
-                 constraints=None, lo=None, hi=None, scalars=None):
+                 constraints=None, lo=None, hi=None, scalars=None,
+                 is_cat=None):
     """Vectorized DTree.findBestSplitPoint over all nodes of a level.
 
     hist: [L, F, B, 3] of {w, g, h}; col_mask [F] (per-tree sampling) or
@@ -97,17 +117,44 @@ def _best_splits(hist, nb, col_mask, params: TreeParams,
     constrained features must order their (bound-clipped) child Newton
     values per the constraint direction — the monotone-constraints
     contract of the reference GBM (hex/tree/DHistogram constraints +
-    hex/tree/Constraints). Returns per-node best
-    (gain, feat, thresh, na_left, left_val, right_val).
+    hex/tree/Constraints).
+
+    Categorical features (``is_cat`` [F] bool, active when
+    params.has_cats): bins are re-ordered PER NODE by their Newton value
+    -g/(h+λ) and the threshold scan runs over that order, so the best
+    "prefix" is the best category SUBSET — the static-shape formulation
+    of the reference's bitset splits (hex/tree/DTree.java:619-697
+    findBestSplitPoint sorts by prediction then scans). Returns per-node
+    best (gain, feat, thresh, na_left, left_val, right_val, leftmask)
+    where leftmask [L, B-1] marks the ORIGINAL bin ids going left.
     """
     sc = scalars if scalars is not None else scalars_of(params)
     lam = sc.reg_lambda
     B = hist.shape[2]
     w, g, h = hist[..., 0], hist[..., 1], hist[..., 2]
-    # cumulative over value bins (0..B-2); NA bin is B-1
-    cw = jnp.cumsum(w[:, :, : B - 1], axis=2)
-    cg = jnp.cumsum(g[:, :, : B - 1], axis=2)
-    ch = jnp.cumsum(h[:, :, : B - 1], axis=2)
+    wv = w[:, :, : B - 1]
+    gv = g[:, :, : B - 1]
+    hv = h[:, :, : B - 1]
+    order = None
+    if params.has_cats and is_cat is not None:
+        # per-(node, feature) bin order: Newton value ascending for cats,
+        # natural bin order for numerics (identity keeps the exact
+        # numeric semantics). Empty bins key to 0 and sort mid-sequence;
+        # their left/right membership carries no weight either way.
+        # empty bins key to +inf so they sort AFTER every populated bin:
+        # the t <= nb-2 threshold-validity mask then stays correct in
+        # sorted space (populated bins occupy a prefix of it)
+        val = jnp.where(wv > 0, -gv / (hv + lam + 1e-10), jnp.inf)
+        pos = jnp.arange(B - 1, dtype=jnp.float32)
+        key = jnp.where(is_cat[None, :, None], val, pos[None, None, :])
+        order = jnp.argsort(key, axis=2, stable=True)
+        wv = jnp.take_along_axis(wv, order, axis=2)
+        gv = jnp.take_along_axis(gv, order, axis=2)
+        hv = jnp.take_along_axis(hv, order, axis=2)
+    # cumulative over (possibly re-ordered) value bins; NA bin is B-1
+    cw = jnp.cumsum(wv, axis=2)
+    cg = jnp.cumsum(gv, axis=2)
+    ch = jnp.cumsum(hv, axis=2)
     naw, nag, nah = w[:, :, B - 1], g[:, :, B - 1], h[:, :, B - 1]
     tw = cw[:, :, -1] + naw
     tg = cg[:, :, -1] + nag
@@ -160,7 +207,54 @@ def _best_splits(hist, nb, col_mask, params: TreeParams,
     rvals = jnp.stack([rv_nar, rv_nal], axis=-1).reshape(L, -1)
     best_lv = jnp.take_along_axis(lvals, best[:, None], axis=1)[:, 0]
     best_rv = jnp.take_along_axis(rvals, best[:, None], axis=1)[:, 0]
-    return best_gain, best_f, best_t, na_left, best_lv, best_rv
+    if order is not None:
+        # original-bin-id membership of the winning prefix: position of
+        # bin b within the winning feature's order <= t  ⇔  b goes left
+        order_win = jnp.take_along_axis(
+            order, best_f[:, None, None], axis=1)[:, 0]     # [L, B-1]
+        ranks = jnp.argsort(order_win, axis=1)              # inverse perm
+        leftmask = ranks <= best_t[:, None]
+    else:
+        leftmask = (jnp.arange(B - 1, dtype=jnp.int32)[None, :]
+                    <= best_t[:, None])
+    return best_gain, best_f, best_t, na_left, best_lv, best_rv, leftmask
+
+
+def _pack_leftmask(leftmask, W: int):
+    """[L, B-1] bool → [L, W] uint32 bitset words (bit b of word k ⇔
+    bin 32k+b). One-hot matmul keeps it gather-free."""
+    Bm1 = leftmask.shape[1]
+    bpos = jnp.arange(Bm1, dtype=jnp.uint32)
+    contrib = leftmask.astype(jnp.uint32) << (bpos % 32)[None, :]
+    seg = (bpos // 32)[:, None] == jnp.arange(W, dtype=jnp.uint32)[None, :]
+    return jnp.sum(contrib[:, :, None] * seg[None].astype(jnp.uint32),
+                   axis=1)
+
+
+def _level_goleft(feat_d, thresh_d, nal_d, isp_d, cat_d, lw_d, nid, bins,
+                  B: int):
+    """Row routing for one tree level — shared by training, scoring,
+    leaf assignment and path counting (the DecidedNode assignment pass).
+    Numeric splits compare bin <= t; categorical subset splits test the
+    row's bin bit in the node's packed left-set."""
+    f_r = feat_d[nid]
+    t_r = thresh_d[nid]
+    nal_r = nal_d[nid]
+    isp_r = isp_d[nid]
+    b_r = row_feature_values(bins, f_r).astype(jnp.int32)
+    isna = b_r == (B - 1)
+    go_num = b_r <= t_r
+    W = lw_d.shape[1]
+    cs_r = cat_d[nid]
+    lw_r = lw_d[nid]                                        # [N, W]
+    widx = (b_r >> 5).astype(jnp.uint32)
+    word = jnp.sum(jnp.where(
+        widx[:, None] == jnp.arange(W, dtype=jnp.uint32)[None, :],
+        lw_r, jnp.uint32(0)), axis=1)
+    inset = ((word >> (b_r & 31).astype(jnp.uint32)) & 1) == 1
+    go_split = jnp.where(cs_r, inset, go_num)
+    goleft = jnp.where(isp_r, jnp.where(isna, nal_r, go_split), True)
+    return 2 * nid + jnp.where(goleft, 0, 1)
 
 
 def _mtries_mask(key, L: int, F: int, mtries: int):
@@ -202,6 +296,11 @@ def grow_tree(bins, nb, w, g, h, col_mask, *, params: TreeParams, mesh,
     threshs = jnp.full((D, Lmax), B, jnp.int32)
     na_lefts = jnp.zeros((D, Lmax), bool)
     is_splits = jnp.zeros((D, Lmax), bool)
+    is_cat = (jnp.asarray(np.asarray(params.cat_feats, dtype=bool))
+              if params.has_cats else None)
+    W = max(1, (B - 1 + 31) // 32) if params.has_cats else 1
+    cat_splits = jnp.zeros((D, Lmax), bool)
+    left_words = jnp.zeros((D, Lmax, W), jnp.uint32)
     gain_by_feat = jnp.zeros((F,), jnp.float32)  # relative varimp (hex/VarImp)
     lo = jnp.full((1,), -jnp.inf, jnp.float32)
     hi = jnp.full((1,), jnp.inf, jnp.float32)
@@ -238,14 +337,20 @@ def grow_tree(bins, nb, w, g, h, col_mask, *, params: TreeParams, mesh,
             cm = _mtries_mask(sub, L, F, mtries) & col_mask[None, :]
         if interaction_sets is not None:
             cm = (cm if cm.ndim == 2 else cm[None, :]) & allowed
-        bg, bf, bt, bnal, blv, brv = _best_splits(
+        bg, bf, bt, bnal, blv, brv, leftmask = _best_splits(
             hist, nb, cm, params, constraints=constraints, lo=lo, hi=hi,
-            scalars=sc)
+            scalars=sc, is_cat=is_cat)
         split = bg > sc.msi
         feats = feats.at[d, :L].set(jnp.where(split, bf, 0))
         threshs = threshs.at[d, :L].set(jnp.where(split, bt, B))
         na_lefts = na_lefts.at[d, :L].set(jnp.where(split, bnal, False))
         is_splits = is_splits.at[d, :L].set(split)
+        if params.has_cats and is_cat is not None:
+            cs = is_cat[bf] & split
+            cat_splits = cat_splits.at[d, :L].set(cs)
+            words = _pack_leftmask(leftmask, W)
+            left_words = left_words.at[d, :L].set(
+                jnp.where(cs[:, None], words, 0))
         gain_by_feat = gain_by_feat + jnp.sum(
             jnp.where(split, jnp.maximum(bg, 0.0), 0.0)[:, None]
             * (bf[:, None] == jnp.arange(F, dtype=jnp.int32)[None, :]),
@@ -281,16 +386,9 @@ def grow_tree(bins, nb, w, g, h, col_mask, *, params: TreeParams, mesh,
             lo = jnp.stack([lo_l, lo_r], axis=1).reshape(-1)
             hi = jnp.stack([hi_l, hi_r], axis=1).reshape(-1)
         # route rows (the reference's DecidedNode assignment pass)
-        f_r = feats[d][nid]
-        t_r = threshs[d][nid]
-        nal_r = na_lefts[d][nid]
-        isp_r = is_splits[d][nid]
-        b_r = row_feature_values(bins, f_r)
-        isna = b_r == (B - 1)
-        goleft = jnp.where(isp_r,
-                           jnp.where(isna, nal_r, b_r <= t_r),
-                           True)
-        nid = 2 * nid + jnp.where(goleft, 0, 1)
+        nid = _level_goleft(feats[d], threshs[d], na_lefts[d],
+                            is_splits[d], cat_splits[d], left_words[d],
+                            nid, bins, B)
 
     # leaf Newton values from final assignment (GammaPass analogue)
     nleaf = 2 ** D
@@ -302,7 +400,8 @@ def grow_tree(bins, nb, w, g, h, col_mask, *, params: TreeParams, mesh,
                      -G / (H + sc.reg_lambda + 1e-10), 0.0)
     if constraints is not None:
         leaf = jnp.clip(leaf, lo, hi)   # leaves honor propagated bounds
-    tree = Tree(feats, threshs, na_lefts, is_splits, leaf, leaf_stats[:, 0])
+    tree = Tree(feats, threshs, na_lefts, is_splits, leaf,
+                leaf_stats[:, 0], cat_splits, left_words)
     return tree, nid, gain_by_feat
 
 
@@ -324,14 +423,9 @@ def _route(tree: Tree, bins, B: int):
     D = tree.feat.shape[0]
     nid = jnp.zeros((N,), jnp.int32)
     for d in range(D):
-        f_r = tree.feat[d][nid]
-        t_r = tree.thresh[d][nid]
-        nal_r = tree.na_left[d][nid]
-        isp_r = tree.is_split[d][nid]
-        b_r = row_feature_values(bins, f_r)
-        isna = b_r == (B - 1)
-        goleft = jnp.where(isp_r, jnp.where(isna, nal_r, b_r <= t_r), True)
-        nid = 2 * nid + jnp.where(goleft, 0, 1)
+        nid = _level_goleft(tree.feat[d], tree.thresh[d], tree.na_left[d],
+                            tree.is_split[d], tree.cat_split[d],
+                            tree.left_words[d], nid, bins, B)
     return nid
 
 
@@ -347,17 +441,14 @@ def feature_path_counts(stacked: Tree, bins, B: int, F: int):
         nid = jnp.zeros((N,), jnp.int32)
         for d in range(D):
             f_r = tree.feat[d][nid]
-            t_r = tree.thresh[d][nid]
-            nal_r = tree.na_left[d][nid]
             isp_r = tree.is_split[d][nid]
             onehot = (f_r[:, None] ==
                       jnp.arange(F, dtype=jnp.int32)[None, :])
             counts = counts + jnp.where(isp_r[:, None] & onehot, 1, 0)
-            b_r = row_feature_values(bins, f_r)
-            isna = b_r == (B - 1)
-            goleft = jnp.where(isp_r, jnp.where(isna, nal_r, b_r <= t_r),
-                               True)
-            nid = 2 * nid + jnp.where(goleft, 0, 1)
+            nid = _level_goleft(tree.feat[d], tree.thresh[d],
+                                tree.na_left[d], tree.is_split[d],
+                                tree.cat_split[d], tree.left_words[d],
+                                nid, bins, B)
         return counts, None
 
     counts0 = jnp.zeros((bins.shape[0], F), jnp.int32)

@@ -29,6 +29,7 @@ from h2o3_tpu.frame.frame import Frame
 from h2o3_tpu.models import metrics as mm
 from h2o3_tpu.models.model import Model, ModelBuilder, ModelCategory, adapt_domain
 from h2o3_tpu.models.tree import (Tree, _mtries_mask, predict_forest,
+                                  zero_catsplit,
                                   row_feature_values, stack_trees)
 from h2o3_tpu.ops.histogram import histogram
 from h2o3_tpu.ops.segments import segment_sum
@@ -158,7 +159,8 @@ def _grow_uplift_tree(bins, nb, w, y, treat, key, *, depth: int, B: int,
     p_t = _smooth_p(st_t[:, 1], st_t[:, 0])
     p_c = _smooth_p(st_c[:, 1], st_c[:, 0])
     tree = Tree(feats, threshs, na_lefts, is_splits, p_t - p_c,
-                st_t[:, 0] + st_c[:, 0])
+                st_t[:, 0] + st_c[:, 0],
+                *zero_catsplit(feats.shape[0], feats.shape[1]))
     return tree, p_t, p_c
 
 
