@@ -249,6 +249,44 @@ def bench_xgb():
         auc=round(float(m.training_metrics["AUC"]), 4))
 
 
+def bench_sort():
+    """Device radix-order path: 10M-row two-key sort + single-key merge
+    (water/rapids/RadixOrder + BinaryMerge roles)."""
+    import h2o3_tpu
+    from h2o3_tpu.ops.sort import device_sort
+    from h2o3_tpu.rapids import _device_merge
+    n = 1_000_000 if FAST else 10_000_000
+    r = np.random.RandomState(11)
+    fr = h2o3_tpu.Frame.from_numpy({
+        "k": r.randint(0, n // 2, n).astype(float),
+        "b": r.randn(n), "v": np.arange(n, dtype=float)})
+    import jax.numpy as jnp
+    w = device_sort(fr, ["k", "b"], [True, True])  # warmup/compile
+    float(jnp.sum(w.col("k").data))   # force completion (tunnel-safe sync)
+    for c in w.names:                 # drain every async column gather
+        float(jnp.sum(w.col(c).data))
+    t0 = time.time()
+    out = device_sort(fr, ["k", "b"], [True, True])
+    for c in out.names:
+        float(jnp.sum(out.col(c).data))
+    dt = time.time() - t0
+    _emit(f"Sort 2-key {n/1e6:.0f}M rows (device radix-order)",
+          n / dt, "rows/sec/chip",
+          (n / dt) / 5.0e6, "estimated JVM RadixOrder 5.0e6 rows/sec",
+          sort_seconds=round(dt, 2))
+    rf = h2o3_tpu.Frame.from_numpy({
+        "k": r.randint(0, n // 2, n // 4).astype(float),
+        "rv": np.arange(n // 4, dtype=float)})
+    _device_merge(fr, rf, "inner")                 # warmup/compile
+    t0 = time.time()
+    m = _device_merge(fr, rf, "inner")
+    dt = time.time() - t0
+    _emit(f"Merge inner {n/1e6:.0f}M x {n/4e6:.1f}M rows (device join)",
+          n / dt, "rows/sec/chip",
+          (n / dt) / 3.0e6, "estimated JVM BinaryMerge 3.0e6 rows/sec",
+          merge_seconds=round(dt, 2), out_rows=m.nrows)
+
+
 def bench_automl():
     from h2o3_tpu.automl import H2OAutoML
     from h2o3_tpu.io.stream import stream_import_csv
@@ -277,13 +315,13 @@ def bench_automl():
 
 
 CONFIGS = [("gbm", bench_gbm), ("glm", bench_glm), ("dl", bench_dl),
-           ("xgb", bench_xgb), ("automl", bench_automl),
-           ("gbm-full", bench_gbm_full)]
+           ("xgb", bench_xgb), ("sort", bench_sort),
+           ("automl", bench_automl), ("gbm-full", bench_gbm_full)]
 
 # minimum seconds a config plausibly needs; skipped (with a JSON note)
 # rather than started when the remaining budget is below it
-_MIN_NEED = {"gbm": 60, "glm": 90, "dl": 60, "xgb": 60, "automl": 180,
-             "gbm-full": 600}
+_MIN_NEED = {"gbm": 60, "glm": 90, "dl": 60, "xgb": 60, "sort": 60,
+             "automl": 180, "gbm-full": 600}
 
 
 def _run_once(name, fn):

@@ -211,6 +211,75 @@ def gen_prostate_complete(sd: str) -> None:
         z.write(src, "prostate_complete.csv")
 
 
+def gen_munging_files(sd: str) -> None:
+    """Small files the munging pyunits need: cars.csv (cars_20mpg minus
+    the binary response), cars_trim.csv (whitespace-padded name column),
+    names.csv (string columns), prostate variants with injected NAs, and
+    an iris train split."""
+    gen_cars(sd)
+    src = os.path.join(sd, "junit/cars_20mpg.csv")
+    with open(src) as f:
+        header = f.readline().strip().split(",")
+        rows = [ln.rstrip("\n").split(",") for ln in f if ln.strip()]
+    keep = [i for i, h in enumerate(header) if h != "economy_20mpg"]
+    p = os.path.join(sd, "junit/cars.csv")
+    if not os.path.exists(p):
+        with open(p, "w") as f:
+            f.write(",".join(header[i] for i in keep) + "\n")
+            f.writelines(",".join(r[i] for i in keep) + "\n" for r in rows)
+    p = os.path.join(sd, "junit/cars_trim.csv")
+    if not os.path.exists(p):
+        with open(p, "w") as f:
+            f.write(",".join(header[i] for i in keep) + "\n")
+            for r in rows:
+                padded = ['"  %s  "' % r[keep[0]]] + \
+                    [r[i] for i in keep[1:]]
+                f.write(",".join(padded) + "\n")
+    p = os.path.join(sd, "junit/names.csv")
+    if not os.path.exists(p):
+        rng = np.random.RandomState(9)
+        firsts = ["ann", "bob", "carol", "dave", "erin", "frank"]
+        lasts = ["smith", "jones", "lee", "brown", "davis"]
+        with open(p, "w") as f:
+            f.write("name,string_lengths\n")
+            for _ in range(100):
+                nm = (firsts[rng.randint(6)] + " " + lasts[rng.randint(5)])
+                f.write(f"{nm},{len(nm)}\n")
+    # prostate with injected NAs (prostate_missing / prostate_NA roles)
+    psrc = os.path.join(sd, "prostate/prostate.csv")
+    if os.path.exists(psrc):
+        with open(psrc) as f:
+            ph = f.readline()
+            prows = [ln.rstrip("\n").split(",") for ln in f if ln.strip()]
+        rng = np.random.RandomState(13)
+        for rel in ("logreg/prostate_missing.csv",
+                    "parser/csv2orc/prostate_NA.csv"):
+            p = os.path.join(sd, rel)
+            if os.path.exists(p):
+                continue
+            os.makedirs(os.path.dirname(p), exist_ok=True)
+            with open(p, "w") as f:
+                f.write(ph)
+                for r in prows:
+                    out = list(r)
+                    for j in range(2, len(out)):
+                        if rng.rand() < 0.05:
+                            out[j] = ""
+                    f.write(",".join(out) + "\n")
+    # iris train split (multinomial GLM pyunits)
+    isrc = os.path.join(sd, "iris/iris_wheader.csv")
+    p = os.path.join(sd, "iris/iris_train.csv")
+    if os.path.exists(isrc) and not os.path.exists(p):
+        with open(isrc) as f:
+            ih = f.readline()
+            irows = [ln for ln in f if ln.strip()]
+        rng = np.random.RandomState(21)
+        sel = rng.rand(len(irows)) < 0.8
+        with open(p, "w") as f:
+            f.write(ih)
+            f.writelines(ln for i, ln in enumerate(irows) if sel[i])
+
+
 def generate_all(sd: str) -> None:
     gen_cars(sd)
     gen_benign(sd)
@@ -220,3 +289,4 @@ def generate_all(sd: str) -> None:
     gen_prostate_variants(sd)
     gen_prostate_complete(sd)
     gen_airlines_train_test(sd)
+    gen_munging_files(sd)

@@ -83,6 +83,45 @@ PYUNITS = [
     f"{MUNGING}/pyunit_quantile.py",
     f"{MUNGING}/pyunit_groupby.py",
     f"{MISC}/pyunit_all_confusion_matrix_funcs.py",
+    # ---- round-3 breadth: munging (slicing/group-by/sort/string ops)
+    f"{MUNGING}/pyunit_sort.py",
+    f"{MUNGING}/pyunit_cbind.py",
+    f"{MUNGING}/pyunit_rbind.py",
+    f"{MUNGING}/pyunit_unique.py",
+    f"{MUNGING}/pyunit_isna.py",
+    f"{MUNGING}/pyunit_any_all.py",
+    f"{MUNGING}/pyunit_cumsum_cumprod_cummin_cummax.py",
+    f"{MUNGING}/pyunit_table.py",
+    f"{MUNGING}/pyunit_entropy.py",
+    f"{MUNGING}/pyunit_sub_gsub.py",
+    f"{MUNGING}/pyunit_strsplit.py",
+    f"{MUNGING}/pyunit_toupper_tolower.py",
+    f"{MUNGING}/pyunit_substring.py",
+    f"{MUNGING}/pyunit_countmatches.py",
+    f"{MUNGING}/pyunit_nacnt.py",
+    f"{MUNGING}/pyunit_length.py",
+    f"{MUNGING}/pyunit_mmult.py",
+    f"{MUNGING}/pyunit_prod.py",
+    f"{MUNGING}/pyunit_impute.py",
+    f"{MUNGING}/pyunit_insert_missing.py",
+    f"{MUNGING}/pyunit_difflag1.py",
+    f"{MUNGING}/pyunit_rep_len.py",
+    f"{MUNGING}/pyunit_categories.py",
+    f"{MUNGING}/pyunit_ischaracter_isnumeric.py",
+    f"{MUNGING}/pyunit_trim.py",
+    f"{MUNGING}/pyunit_op_precedence.py",
+    f"{MUNGING}/pyunit_in.py",
+    f"{MUNGING}/pyunit_count_temps.py",
+    f"{MUNGING}/pyunit_runif.py",
+    f"{MUNGING}/pyunit_ifelse.py",
+    # ---- round-3 breadth: misc metrics / model introspection
+    f"{MISC}/pyunit_metric_accessors.py",
+    f"{MISC}/pyunit_model_summary.py",
+    f"{MISC}/pyunit_varimp.py",
+    f"{MISC}/pyunit_create_frame.py",
+    f"{MISC}/pyunit_frame_show.py",
+    # ---- round-3: glm multinomial parity (IRLSM solver)
+    f"{ALGOS}/glm/pyunit_PUBDEV_6062_multinomial_coeffNames.py",
 ]
 
 

@@ -304,6 +304,15 @@ def _wire_map(s: str) -> dict:
     (enum/string values like bernoulli) arrive unquoted
     (h2o-py/h2o/utils/shared_utils.py:167)."""
     s = s.replace("'", '"')
+    # python-repr literals (h2o-py stringifies dicts with repr(): the
+    # kmeans-grid pyunit ships standardize: [True, False]) must become
+    # JSON booleans, NOT get caught by the bare-identifier quoting below
+    # — a wire "False" string breaks expect_model_param's coercion
+    # quote guards confine the rewrite to BARE literals — a quoted
+    # string value that happens to be "True"/"None" must survive intact
+    s = re.sub(r'(?<!")\bTrue\b(?!")', "true", s)
+    s = re.sub(r'(?<!")\bFalse\b(?!")', "false", s)
+    s = re.sub(r'(?<!")\bNone\b(?!")', "null", s)
     # quote bare identifiers that aren't JSON literals
     s = re.sub(
         r'(?<![\w"])(?!true\b|false\b|null\b)'

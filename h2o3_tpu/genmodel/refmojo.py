@@ -1,0 +1,365 @@
+"""Reference-format MOJO export for tree models (GBM/DRF).
+
+Emits the ACTUAL reference MOJO zip layout — model.ini + domains/dNNN.txt
++ trees/tCC_TTT.bin — with tree blobs in the SharedTreeMojoModel v1.40
+byte format, so the reference genmodel runtime can score our models:
+
+- blob grammar (hex/tree/DTree.java compress() writer,
+  hex/genmodel/algos/tree/SharedTreeMojoModel.java:129 scoreTree reader):
+  node = [1B nodeType][2B colId][1B naSplitDir]
+         [4B float splitVal | bitset]
+         [left-subtree size (1-4B, width from nodeType bits 0-1,
+          absent when left child is a leaf)]
+         [left subtree][right subtree];  leaf = [4B float]
+  nodeType bits: 0-1 left-size width-1, 2-3 equal (0 numeric,
+  12 bitset via compress3), 48 left-is-leaf, 192 right-is-leaf.
+- bitset (compress3, GenmodelBitSet.fill3): [2B bitoff=0][4B nbits]
+  [ceil(nbits/8) bytes], bit set ⇔ category goes RIGHT (scoreTree:
+  bs.contains(d) → right branch).
+- numeric: go left ⇔ value < splitVal; our bin<=t split maps to
+  splitVal = edges[f][t] exactly (bin counts edges <= x).
+- byte order: native little-endian (ByteBufferWrapper nativeOrder).
+
+`score_reference_mojo` is an independent decoder following the reader
+byte-for-byte — the round-trip contract check this format ships with.
+"""
+
+from __future__ import annotations
+
+import io
+import struct
+import uuid as _uuid
+import zipfile
+from typing import Dict, List, Optional
+
+import numpy as np
+
+NA_LEFT, NA_RIGHT = 2, 3                     # NaSplitDir NALeft / NARight
+
+
+# ---------------------------------------------------------------- writer
+
+
+def _leaf_bytes(val: float) -> bytes:
+    return struct.pack("<f", float(val))
+
+
+def _node_bytes(feat, thresh, na_left, is_split, cat_split, left_words,
+                leaf, edges, cards, divs, d, l, D) -> bytes:
+    """Serialize node (d, l) of a complete-layout tree, recursively."""
+    if d == D or not is_split[d, l]:
+        return _leaf_bytes(leaf[l << (D - d)])
+    f = int(feat[d, l])
+    t = int(thresh[d, l])
+    nal = bool(na_left[d, l])
+    left = _node_bytes(feat, thresh, na_left, is_split, cat_split,
+                       left_words, leaf, edges, cards, divs,
+                       d + 1, 2 * l, D)
+    right = _node_bytes(feat, thresh, na_left, is_split, cat_split,
+                        left_words, leaf, edges, cards, divs,
+                        d + 1, 2 * l + 1, D)
+    left_is_leaf = (d + 1 == D) or not is_split[d + 1, 2 * l]
+    right_is_leaf = (d + 1 == D) or not is_split[d + 1, 2 * l + 1]
+
+    node_type = 0
+    payload = b""
+    if bool(cat_split[d, l]):
+        node_type |= 12                       # bitset split (compress3)
+        card = int(cards[f])
+        div = int(divs[f])
+        words = left_words[d, l]
+        bits = bytearray((card + 7) >> 3)
+        for lvl in range(card):
+            b = lvl // div
+            in_left = (int(words[b >> 5]) >> (b & 31)) & 1
+            if not in_left:                   # bitset marks RIGHT-goers
+                bits[lvl >> 3] |= 1 << (lvl & 7)
+        payload = struct.pack("<HI", 0, card) + bytes(bits)
+    else:
+        e = edges[f]
+        sv = float(e[t]) if t < len(e) else float("inf")
+        payload = struct.pack("<f", sv)
+
+    if left_is_leaf:
+        node_type |= 48
+        size_field = b""
+    else:
+        lsz = len(left)
+        if lsz < 256:
+            slen, size_field = 0, struct.pack("<B", lsz)
+        elif lsz < 65535:
+            slen, size_field = 1, struct.pack("<H", lsz)
+        elif lsz < (1 << 24):
+            slen, size_field = 2, struct.pack("<I", lsz)[:3]
+        else:
+            slen, size_field = 3, struct.pack("<i", lsz)
+        node_type |= slen
+    if right_is_leaf:
+        node_type |= 192
+    head = struct.pack("<BHB", node_type, f, NA_LEFT if nal else NA_RIGHT)
+    return head + payload + size_field + left + right
+
+
+def _root_blob(feat, thresh, na_left, is_split, cat_split, left_words,
+               leaf, edges, cards, divs, D) -> bytes:
+    if not is_split[0, 0]:
+        # root leaf: nodeType byte, colId 0xFFFF sentinel, float value
+        return struct.pack("<BH", 0, 0xFFFF) + _leaf_bytes(leaf[0])
+    return _node_bytes(feat, thresh, na_left, is_split, cat_split,
+                       left_words, leaf, edges, cards, divs, 0, 0, D)
+
+
+def write_reference_mojo(model, path: str) -> str:
+    """Write a reference-layout MOJO zip for a GBM/DRF model."""
+    from h2o3_tpu.models.model import ModelCategory
+    bm = model.bm
+    out = model.output
+    f = model.forest
+    feat = np.asarray(f.feat)
+    thresh = np.asarray(f.thresh)
+    na_left = np.asarray(f.na_left)
+    is_split = np.asarray(f.is_split)
+    cat_split = np.asarray(f.cat_split)
+    left_words = np.asarray(f.left_words)
+    leaf = np.asarray(f.leaf, np.float64)
+    D = feat.shape[1]
+
+    host_edges = np.asarray(bm.edges)
+    edges = [e[np.isfinite(e)] for e in host_edges]
+    cards = [len(d) if d else 1 for d in bm.domains]
+    nb = np.asarray(bm.nbins)
+    divs = [max(1, -(-cards[i] // max(int(nb[i]), 1)))
+            if bm.is_cat[i] and cards[i] > int(nb[i]) else 1
+            for i in range(len(cards))]
+
+    cat = out["category"]
+    K = out.get("nclasses", 1) if cat == ModelCategory.MULTINOMIAL else 1
+    T_total = feat.shape[0]
+    n_groups = T_total // K
+    n_classes = (out.get("nclasses", 2)
+                 if cat in (ModelCategory.BINOMIAL,
+                            ModelCategory.MULTINOMIAL) else 1)
+
+    names = list(bm.names) + [out["response"]]
+    rdom = out.get("domain")
+    domains: List[Optional[List[str]]] = list(bm.domains) + [rdom]
+
+    info = {
+        "h2o_version": "3.46.0.1",
+        "mojo_version": "1.40",
+        "license": "Apache License Version 2.0",
+        "algo": model.algo,
+        "algorithm": ("Gradient Boosting Machine" if model.algo == "gbm"
+                      else "Distributed Random Forest"),
+        "endianness": "LITTLE_ENDIAN",
+        "category": {ModelCategory.BINOMIAL: "Binomial",
+                     ModelCategory.MULTINOMIAL: "Multinomial"}.get(
+                         cat, "Regression"),
+        "uuid": str(abs(hash(model.key)) if model.key else
+                    _uuid.uuid4().int % (1 << 63)),
+        "supervised": "true",
+        "n_features": len(bm.names),
+        "n_classes": n_classes,
+        "n_columns": len(names),
+        "n_domains": sum(1 for d in domains if d is not None),
+        "balance_classes": "false",
+        "default_threshold": out.get("default_threshold", 0.5),
+        "prior_class_distrib": "null",
+        "model_class_distrib": "null",
+        "timestamp": "2026-01-01 00:00:00",
+        "n_trees": n_groups,
+        "n_trees_per_class": K,
+    }
+    if model.algo == "gbm":
+        link = {"bernoulli": "logit", "multinomial": "logit",
+                "poisson": "log", "gamma": "log", "tweedie": "log"}.get(
+                    model.dist_name, "identity")
+        info.update(distribution=model.dist_name,
+                    init_f=float(np.asarray(model.f0).ravel()[0]),
+                    link_function=link)
+    else:
+        info.update(binomial_double_trees="false")
+
+    ini = ["[info]"]
+    ini += [f"{k} = {v}" for k, v in info.items()]
+    ini.append("")
+    ini.append("[columns]")
+    ini += names
+    ini.append("")
+    ini.append("[domains]")
+    dom_files: Dict[str, List[str]] = {}
+    di = 0
+    for i, d in enumerate(domains):
+        if d is None:
+            continue
+        fn = f"d{di:03d}.txt"
+        ini.append(f"{i}: {len(d)} {fn}")
+        dom_files[fn] = list(d)
+        di += 1
+
+    with zipfile.ZipFile(path, "w", zipfile.ZIP_DEFLATED) as z:
+        z.writestr("model.ini", "\n".join(ini) + "\n")
+        for fn, lvls in dom_files.items():
+            z.writestr(f"domains/{fn}", "\n".join(lvls) + "\n")
+        for g in range(n_groups):
+            for k in range(K):
+                idx = g * K + k
+                blob = _root_blob(feat[idx], thresh[idx], na_left[idx],
+                                  is_split[idx], cat_split[idx],
+                                  left_words[idx], leaf[idx],
+                                  edges, cards, divs, D)
+                z.writestr(f"trees/t{k:02d}_{g:03d}.bin", blob)
+    return path
+
+
+# ------------------------------------------------- reference-contract reader
+
+
+def _score_tree(blob: bytes, row: np.ndarray, domains_len) -> float:
+    """Byte-faithful port of SharedTreeMojoModel.scoreTree (v1.40)."""
+    pos = 0
+
+    def get1():
+        nonlocal pos
+        v = blob[pos]
+        pos += 1
+        return v
+
+    def get2():
+        nonlocal pos
+        v = struct.unpack_from("<H", blob, pos)[0]
+        pos += 2
+        return v
+
+    def get4f():
+        nonlocal pos
+        v = struct.unpack_from("<f", blob, pos)[0]
+        pos += 4
+        return v
+
+    def getsize(w):
+        nonlocal pos
+        if w == 0:
+            return get1()
+        if w == 1:
+            return get2()
+        if w == 2:
+            v = blob[pos] | (blob[pos + 1] << 8) | (blob[pos + 2] << 16)
+            pos += 3
+            return v
+        v = struct.unpack_from("<i", blob, pos)[0]
+        pos += 4
+        return v
+
+    while True:
+        node_type = get1()
+        col_id = get2()
+        if col_id == 65535:
+            return get4f()
+        na_split_dir = get1()
+        na_vs_rest = na_split_dir == 1
+        leftward = na_split_dir in (2, 4)
+        lmask = node_type & 51
+        equal = node_type & 12
+
+        split_val = None
+        bs = None
+        if not na_vs_rest:
+            if equal == 0:
+                split_val = get4f()
+            else:
+                if equal == 8:
+                    bitoff, nbits, bs_off = 0, 32, pos
+                    pos += 4
+                else:
+                    bitoff = get2()
+                    nbits = struct.unpack_from("<i", blob, pos)[0]
+                    pos += 4
+                    bs_off = pos
+                    pos += ((nbits - 1) >> 3) + 1
+                bs = (bitoff, nbits, bs_off)
+
+        d = row[col_id]
+        out_of_bs = False
+        if equal != 0 and bs is not None and not np.isnan(d):
+            b = int(d) - bs[0]
+            out_of_bs = not (0 <= b < bs[1])
+        dlen = domains_len[col_id]
+        out_of_dom = (dlen is not None and not np.isnan(d)
+                      and dlen <= int(d))
+        if np.isnan(d) or out_of_bs or out_of_dom:
+            go_right = not leftward
+        elif na_vs_rest:
+            go_right = False
+        elif equal == 0:
+            go_right = d >= split_val
+        else:
+            idx = int(d) - bs[0]
+            go_right = bool(blob[bs[2] + (idx >> 3)] & (1 << (idx & 7)))
+
+        if go_right:
+            if lmask <= 3:
+                skip = getsize(lmask)
+                pos += skip
+            elif lmask == 48:
+                pos += 4                     # skip the left-leaf float
+            lmask = (node_type & 0xC0) >> 2
+        else:
+            if lmask <= 3:
+                pos += lmask + 1             # skip the size field
+        if lmask & 16:
+            return get4f()
+
+
+def score_reference_mojo(path: str, rows: Dict[str, np.ndarray]):
+    """Score raw rows with a reference-layout MOJO using the ported
+    reader — validates our zips honor the reference contract. Returns
+    the raw per-group margins [n, n_groups_or_K] (no link applied)."""
+    with zipfile.ZipFile(path) as z:
+        ini = z.read("model.ini").decode().splitlines()
+        info: Dict[str, str] = {}
+        columns: List[str] = []
+        domain_spec: Dict[int, str] = {}
+        section = None
+        for ln in ini:
+            ln = ln.strip()
+            if not ln or ln.startswith("#"):
+                continue
+            if ln in ("[info]", "[columns]", "[domains]"):
+                section = ln
+                continue
+            if section == "[info]":
+                k, _, v = ln.partition("=")
+                info[k.strip()] = v.strip()
+            elif section == "[columns]":
+                columns.append(ln)
+            elif section == "[domains]":
+                ci, _, rest = ln.partition(":")
+                domain_spec[int(ci)] = rest.strip().split(" ", 1)[1]
+        n_features = int(info["n_features"])
+        n_groups = int(info["n_trees"])
+        tpc = int(info["n_trees_per_class"])
+        domains = {}
+        for ci, fn in domain_spec.items():
+            domains[ci] = z.read(f"domains/{fn}").decode().splitlines()
+        # rows → double[] in column order (categoricals as domain index)
+        n = len(next(iter(rows.values())))
+        mat = np.full((n, n_features), np.nan)
+        domains_len = [None] * n_features
+        for i in range(n_features):
+            cn = columns[i]
+            v = rows[cn]
+            if i in domains:
+                lut = {s: j for j, s in enumerate(domains[i])}
+                mat[:, i] = [lut.get(str(x), np.nan)
+                             if x is not None else np.nan for x in v]
+                domains_len[i] = len(domains[i])
+            else:
+                mat[:, i] = np.asarray(v, np.float64)
+        out = np.zeros((n, tpc))
+        for k in range(tpc):
+            for g in range(n_groups):
+                blob = z.read(f"trees/t{k:02d}_{g:03d}.bin")
+                for r in range(n):
+                    out[r, k] += _score_tree(blob, mat[r], domains_len)
+        return out, info

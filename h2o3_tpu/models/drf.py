@@ -221,10 +221,20 @@ class DRFEstimator(ModelBuilder):
                        weights=_fetch_np(w)[: frame.nrows])
 
         depth = int(p["max_depth"])
-        if depth > MAX_COMPLETE_DEPTH:
-            log.warning("DRF max_depth=%d clamped to %d (complete-tree TPU "
-                        "layout)", depth, MAX_COMPLETE_DEPTH)
-            depth = MAX_COMPLETE_DEPTH
+        # complete-tree layout: a level costs 2^d histogram node slots
+        # whether or not rows reach them, so cap depth by the DATA size
+        # too — the reference's depth-20 default on a 400-row pyunit
+        # frame would otherwise build 8K-node histograms of emptiness.
+        # log2(n)+3 leaves room for moderately unbalanced trees (a
+        # min_rows=1 spine deeper than that is approximated, as it
+        # already was by MAX_COMPLETE_DEPTH). Padded count keeps CV
+        # folds on one compiled shape.
+        data_cap = int(np.ceil(np.log2(max(frame.nrows_padded, 4)))) + 3
+        eff = min(depth, MAX_COMPLETE_DEPTH, data_cap)
+        if eff < depth:
+            log.warning("DRF max_depth=%d capped to %d (complete-tree TPU "
+                        "layout, %d rows)", depth, eff, frame.nrows)
+            depth = eff
         F = len(x)
         mtries = int(p["mtries"])
         if mtries == -1:

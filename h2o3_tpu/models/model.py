@@ -136,9 +136,22 @@ class Model:
     def model_performance(self, frame: Frame):
         raise NotImplementedError
 
-    def download_mojo(self, path: str) -> str:
+    def download_mojo(self, path: str, format: str = "native") -> str:
         """Export this model as a MOJO zip for offline scoring
-        (Model.getMojo + hex/genmodel readers; see h2o3_tpu/genmodel/)."""
+        (Model.getMojo + hex/genmodel readers; see h2o3_tpu/genmodel/).
+
+        format="native" (default): the npz fast path our offline
+        readers consume. format="reference": the reference MOJO zip
+        layout (model.ini + domains/ + SharedTreeMojoModel v1.40 tree
+        blobs) so the reference genmodel runtime can score the model —
+        tree algorithms (GBM/DRF) only.
+        """
+        if format == "reference":
+            from h2o3_tpu.genmodel.refmojo import write_reference_mojo
+            if self.algo not in ("gbm", "drf"):
+                raise ValueError("reference-format MOJO export supports "
+                                 f"GBM/DRF only (got {self.algo})")
+            return write_reference_mojo(self, path)
         from h2o3_tpu.genmodel.export import mojo_artifacts
         from h2o3_tpu.genmodel.mojo import write_mojo
         meta, arrays = mojo_artifacts(self)

@@ -819,6 +819,13 @@ def _sort(env, fr, cols_sel, *asc):
     else:
         ascending = [bool(env.ev(a)) for a in asc]
     ascending = ascending or [True] * len(names)
+    # device radix-order path (water/rapids/RadixOrder.java role): sort
+    # permutation + column gathers stay on the mesh; the controller
+    # never holds the data. Host lexsort remains the tiny-frame path.
+    from h2o3_tpu.ops.sort import device_sort
+    df = device_sort(f, names, ascending)
+    if df is not None:
+        return df
     keys = []
     for n, a in list(zip(names, ascending))[::-1]:
         c = f.col(n)
@@ -937,8 +944,93 @@ def _merge(env, l, r, all_left=("num", 0), all_right=("num", 0), *rest):
         how = "left"
     if int(env.ev(all_right)):
         how = "outer" if how == "left" else "right"
-    m = lf.to_pandas().merge(rf.to_pandas(), how=how)
+    dm = _device_merge(lf, rf, how)
+    if dm is not None:
+        return dm
+    ldf = lf.to_pandas()
+    rdf = rf.to_pandas()
+    # NA keys never match (reference Merge.java / SQL semantics; pandas
+    # would join NaN==NaN): drop NA-key rows from the non-preserved side
+    shared = [n for n in ldf.columns if n in set(rdf.columns)]
+    if shared:
+        if how in ("inner", "left"):
+            rdf = rdf.dropna(subset=shared)
+        if how in ("inner", "right"):
+            ldf = ldf.dropna(subset=shared)
+    m = ldf.merge(rdf, how=how)
     return Frame.from_pandas(m)
+
+
+def _device_merge(lf: Frame, rf: Frame, how: str) -> Optional[Frame]:
+    """BinaryMerge.java role: single-shared-key equi-join with the sort
+    + binary searches on device; the controller only expands match
+    ranges. Multi-key / string-key / right-outer joins fall back to the
+    host hash join."""
+    from h2o3_tpu.ops.sort import DEVICE_SORT_MIN_ROWS, device_join_index
+    shared = [n for n in lf.names if n in set(rf.names)]
+    if len(shared) != 1 or how not in ("inner", "left"):
+        return None
+    if max(lf.nrows, rf.nrows) < DEVICE_SORT_MIN_ROWS:
+        return None
+    key = shared[0]
+    lc, rc = lf.col(key), rf.col(key)
+    if lc.data is None or rc.data is None:
+        return None
+    if lc.is_categorical != rc.is_categorical:
+        return None
+    if lc.is_categorical and lc.domain != rc.domain:
+        return None                     # domain remap → host path
+    l_idx, r_idx = device_join_index(lc.numeric_view(), rc.numeric_view(),
+                                     lf.nrows, rf.nrows)
+    if how == "left":
+        import numpy as _np
+        matched = _np.zeros(lf.nrows, bool)
+        matched[l_idx] = True
+        miss = _np.flatnonzero(~matched)
+        l_idx = _np.concatenate([l_idx, miss])
+        r_idx = _np.concatenate([r_idx, _np.full(len(miss), -1)])
+        order = _np.argsort(l_idx, kind="stable")
+        l_idx, r_idx = l_idx[order], r_idx[order]
+    # pandas-compatible suffixing so the schema is identical whichever
+    # path (device or host fallback) a given frame size takes
+    collide = {n for n in rf.names if n != key and n in set(lf.names)}
+    left_part = _take_rows(lf, l_idx)
+    arrays, cats, doms = {}, [], {}
+    for n in left_part.names:
+        c = left_part.col(n)
+        out_name = n + "_x" if n in collide else n
+        if c.is_categorical:
+            arrays[out_name] = _cat_codes(left_part, n)
+            cats.append(out_name)
+            doms[out_name] = c.domain
+        elif c.type == "string":
+            arrays[out_name] = c.to_numpy()
+        else:
+            arrays[out_name] = _col_np(left_part, n)
+    rmask = r_idx < 0
+    r_safe = np.where(rmask, 0, r_idx)
+    right_part = _take_rows(rf, r_safe)
+    for n in rf.names:
+        if n == key:
+            continue
+        out_name = n + "_y" if n in collide else n
+        c = right_part.col(n)
+        if c.is_categorical:
+            v = _cat_codes(right_part, n).astype(np.float64)
+            v[rmask] = np.nan
+            codes = np.where(np.isnan(v), -1, v).astype(np.int32)
+            arrays[out_name] = codes
+            cats.append(out_name)
+            doms[out_name] = c.domain
+        elif c.type == "string":
+            v = c.to_numpy().astype(object)
+            v[rmask] = None
+            arrays[out_name] = v
+        else:
+            v = _col_np(right_part, n)
+            v[rmask] = np.nan
+            arrays[out_name] = v
+    return Frame.from_numpy(arrays, categorical=cats, domains=doms)
 
 
 @prim("na.omit")

@@ -1,0 +1,72 @@
+"""Device-side sort / merge vs host oracles.
+
+The Rapids sort and single-key merge run on device above
+DEVICE_SORT_MIN_ROWS (water/rapids/RadixOrder + BinaryMerge roles);
+these tests pin exact agreement with numpy lexsort / pandas merge at a
+size that takes the device path.
+"""
+
+import numpy as np
+import pandas as pd
+
+from h2o3_tpu.frame.frame import Frame
+from h2o3_tpu.ops.sort import DEVICE_SORT_MIN_ROWS, device_sort
+from h2o3_tpu.rapids import _device_merge
+
+N = DEVICE_SORT_MIN_ROWS + 1234
+
+
+def test_device_sort_matches_lexsort():
+    r = np.random.RandomState(0)
+    a = r.randint(0, 50, N).astype(float)
+    # f32: device columns store float32, so the host oracle must sort
+    # the same representation
+    b = r.randn(N).astype(np.float32).astype(float)
+    b[::97] = np.nan
+    fr = Frame.from_numpy({"a": a, "b": b, "v": np.arange(N, dtype=float)})
+    out = device_sort(fr, ["a", "b"], [True, False])
+    assert out is not None
+    got_a = out.col("a").to_numpy()[:N]
+    got_b = out.col("b").to_numpy()[:N]
+    # oracle: stable lexsort, descending b, NaN last within group
+    bk = np.where(np.isnan(b), np.inf, -b)
+    order = np.lexsort((bk, a))
+    assert np.array_equal(got_a, a[order])
+    exp_b = b[order]
+    both_nan = np.isnan(got_b) & np.isnan(exp_b)
+    assert np.all(both_nan | (got_b == exp_b))
+
+
+def test_device_sort_ignores_padding_rows():
+    r = np.random.RandomState(1)
+    a = r.randint(0, 9, N).astype(float)
+    fr = Frame.from_numpy({"a": a})
+    out = device_sort(fr, ["a"], [True])
+    assert out is not None
+    assert out.nrows == N
+    got = out.col("a").to_numpy()[:N]
+    assert np.array_equal(got, np.sort(a, kind="stable"))
+
+
+def test_device_merge_inner_and_left():
+    r = np.random.RandomState(2)
+    lk = r.randint(0, 1000, N).astype(float)
+    rk = r.randint(500, 1500, N // 3).astype(float)
+    lf = Frame.from_numpy({"k": lk, "lv": np.arange(N, dtype=float)})
+    rf = Frame.from_numpy({"k": rk, "rv": np.arange(len(rk), dtype=float)})
+    ldf = pd.DataFrame({"k": lk, "lv": np.arange(N, dtype=float)})
+    rdf = pd.DataFrame({"k": rk, "rv": np.arange(len(rk), dtype=float)})
+    for how in ("inner", "left"):
+        got = _device_merge(lf, rf, how)
+        assert got is not None
+        exp = ldf.merge(rdf, how=how)
+        g = got.to_pandas().sort_values(["k", "lv", "rv"],
+                                        na_position="last").reset_index(drop=True)
+        e = exp.sort_values(["k", "lv", "rv"],
+                            na_position="last").reset_index(drop=True)
+        assert len(g) == len(e), (how, len(g), len(e))
+        for col in ("k", "lv", "rv"):
+            ga = g[col].to_numpy()
+            ea = e[col].to_numpy()
+            nn = ~(np.isnan(ga) & np.isnan(ea))
+            assert np.allclose(ga[nn], ea[nn]), (how, col)
