@@ -39,9 +39,13 @@ from h2o3_tpu.io.persist import (load_frame, load_model, persist_manager,
                                  save_frame, save_model)
 from h2o3_tpu.core.kv import DKV
 from h2o3_tpu.core.scope import Scope
+from h2o3_tpu.core.udf import (upload_custom_distribution,
+                               upload_custom_metric)
 
 __all__ = [
     "__version__",
+    "upload_custom_distribution",
+    "upload_custom_metric",
     "init",
     "cluster_info",
     "shutdown",

@@ -10,6 +10,7 @@ trailing columns are zero-filled.
 from __future__ import annotations
 
 import re
+import struct as _struct
 from typing import Dict, List, Optional, Tuple
 
 import numpy as np
@@ -225,3 +226,226 @@ def parse_xlsx(path: str, key: Optional[str] = None) -> Frame:
                 [None if v is None else str(v) for v in vals], dtype=object)
             cats.append(name)
     return Frame.from_numpy(cols, categorical=cats, key=key)
+
+
+# ---- columnar container formats (h2o-parsers/{parquet,orc,avro}) -----
+
+
+def frame_from_arrow(table, key: Optional[str] = None) -> Frame:
+    """Arrow table → Frame without a pandas detour (the h2o-parsers
+    ParquetParser/OrcParser role): numeric columns become dtype-narrowed
+    device arrays + NA masks, string/dictionary columns intern into
+    categorical domains."""
+    import pyarrow as pa
+    arrays: Dict[str, np.ndarray] = {}
+    cats: List[str] = []
+    doms: Dict[str, List[str]] = {}
+    for name, col in zip(table.column_names, table.columns):
+        col = col.combine_chunks() if col.num_chunks != 1 else col.chunk(0)
+        t = col.type
+        if pa.types.is_dictionary(t):
+            idx = col.indices.to_numpy(zero_copy_only=False).astype(
+                np.int32, copy=True)
+            if col.null_count:
+                idx[np.asarray(col.is_null())] = -1
+            arrays[name] = idx
+            cats.append(name)
+            doms[name] = [str(v) for v in col.dictionary.to_pylist()]
+        elif (pa.types.is_string(t) or pa.types.is_large_string(t)
+              or pa.types.is_binary(t)):
+            if pa.types.is_binary(t):
+                col = col.cast(pa.string())   # utf-8 labels, not b'..' reprs
+            enc = col.dictionary_encode()     # Arrow-native interning
+            idx = enc.indices.to_numpy(zero_copy_only=False).astype(
+                np.int32, copy=True)
+            if enc.null_count:
+                idx[np.asarray(enc.is_null())] = -1
+            arrays[name] = idx
+            cats.append(name)
+            doms[name] = [str(v) for v in enc.dictionary.to_pylist()]
+        elif pa.types.is_boolean(t):
+            v = col.to_numpy(zero_copy_only=False).astype(np.float64)
+            arrays[name] = v
+        elif pa.types.is_timestamp(t) or pa.types.is_date(t):
+            # repo time convention is epoch-MILLIS (frame/column.py):
+            # normalize whatever unit the container carries
+            v = col.cast(pa.int64()).to_numpy(zero_copy_only=False)
+            v = v.astype(np.float64)
+            if pa.types.is_timestamp(t):
+                scale = {"s": 1e3, "ms": 1.0, "us": 1e-3,
+                         "ns": 1e-6}[t.unit]
+            elif pa.types.is_date32(t):
+                scale = 86400e3                   # days → millis
+            else:
+                scale = 1.0                       # date64 is millis
+            v = v * scale
+            if col.null_count:
+                v[np.asarray(col.is_null())] = np.nan
+            arrays[name] = v
+        else:
+            v = col.to_numpy(zero_copy_only=False).astype(np.float64)
+            if col.null_count:
+                v[np.asarray(col.is_null())] = np.nan
+            arrays[name] = v
+    return Frame.from_numpy(arrays, categorical=cats, domains=doms,
+                            key=key)
+
+
+def parse_parquet(path: str, key: Optional[str] = None) -> Frame:
+    import pyarrow.parquet as pq
+    return frame_from_arrow(pq.read_table(path), key=key)
+
+
+def parse_orc(path: str, key: Optional[str] = None) -> Frame:
+    import pyarrow.orc as po
+    return frame_from_arrow(po.ORCFile(path).read(), key=key)
+
+
+# ---- Avro object-container reader (h2o-parsers/h2o-avro-parser) ------
+
+
+def _avro_varint(buf: bytes, pos: int) -> Tuple[int, int]:
+    """zigzag-encoded long."""
+    shift = 0
+    acc = 0
+    while True:
+        b = buf[pos]
+        pos += 1
+        acc |= (b & 0x7F) << shift
+        if not b & 0x80:
+            break
+        shift += 7
+    return (acc >> 1) ^ -(acc & 1), pos
+
+
+def _avro_read(buf: bytes, pos: int, schema):
+    """Decode one value of ``schema`` (JSON-decoded avro schema)."""
+    if isinstance(schema, list):                 # union: long index first
+        idx, pos = _avro_varint(buf, pos)
+        return _avro_read(buf, pos, schema[idx])
+    if isinstance(schema, dict):
+        st = schema["type"]
+        if st == "record":
+            out = {}
+            for f in schema["fields"]:
+                out[f["name"]], pos = _avro_read(buf, pos, f["type"])
+            return out, pos
+        if st == "enum":
+            i, pos = _avro_varint(buf, pos)
+            return schema["symbols"][i], pos
+        if st == "array":
+            items = []
+            while True:
+                n, pos = _avro_varint(buf, pos)
+                if n == 0:
+                    break
+                if n < 0:
+                    _, pos = _avro_varint(buf, pos)   # block byte size
+                    n = -n
+                for _ in range(n):
+                    v, pos = _avro_read(buf, pos, schema["items"])
+                    items.append(v)
+            return items, pos
+        return _avro_read(buf, pos, st)
+    if schema == "null":
+        return None, pos
+    if schema == "boolean":
+        return buf[pos] != 0, pos + 1
+    if schema in ("int", "long"):
+        return _avro_varint(buf, pos)
+    if schema == "float":
+        return _struct.unpack_from("<f", buf, pos)[0], pos + 4
+    if schema == "double":
+        return _struct.unpack_from("<d", buf, pos)[0], pos + 8
+    if schema in ("bytes", "string"):
+        n, pos = _avro_varint(buf, pos)
+        raw = buf[pos:pos + n]
+        return (raw.decode("utf-8", "replace") if schema == "string"
+                else raw), pos + n
+    raise ValueError(f"unsupported avro type {schema!r}")
+
+
+def parse_avro(path: str, key: Optional[str] = None) -> Frame:
+    """Avro object-container file → Frame (flat record schemas;
+    null/deflate codecs) — the h2o-avro-parser role, stdlib-only."""
+    import json
+    import zlib
+    with open(path, "rb") as f:
+        data = f.read()
+    if data[:4] != b"Obj\x01":
+        raise ValueError(f"{path} is not an avro container file")
+    pos = 4
+    meta = {}
+    while True:
+        n, pos = _avro_varint(data, pos)
+        if n == 0:
+            break
+        if n < 0:
+            _, pos = _avro_varint(data, pos)
+            n = -n
+        for _ in range(n):
+            klen, pos = _avro_varint(data, pos)
+            k = data[pos:pos + klen].decode()
+            pos += klen
+            vlen, pos = _avro_varint(data, pos)
+            meta[k] = data[pos:pos + vlen]
+            pos += vlen
+    sync = data[pos:pos + 16]
+    pos += 16
+    schema = json.loads(meta["avro.schema"])
+    codec = meta.get("avro.codec", b"null").decode()
+    if schema.get("type") != "record":
+        raise ValueError("only flat record avro schemas are supported")
+
+    def _flat(ft) -> bool:
+        if isinstance(ft, list):
+            return all(_flat(x) for x in ft)
+        if isinstance(ft, dict):
+            return ft.get("type") == "enum"
+        return ft in ("null", "boolean", "int", "long", "float",
+                      "double", "bytes", "string")
+
+    bad = [f["name"] for f in schema["fields"] if not _flat(f["type"])]
+    if bad:
+        # loud error beats silently-NaN columns for nested/array fields
+        raise ValueError("avro fields with nested/array types are not "
+                         f"supported: {bad}")
+    records: List[dict] = []
+    while pos < len(data):
+        cnt, pos = _avro_varint(data, pos)
+        size, pos = _avro_varint(data, pos)
+        block = data[pos:pos + size]
+        pos += size
+        if data[pos:pos + 16] != sync:
+            raise ValueError("avro sync marker mismatch")
+        pos += 16
+        if codec == "deflate":
+            block = zlib.decompress(block, -15)
+        elif codec != "null":
+            raise ValueError(f"unsupported avro codec '{codec}'")
+        bpos = 0
+        for _ in range(cnt):
+            rec, bpos = _avro_read(block, bpos, schema)
+            records.append(rec)
+    names = [f["name"] for f in schema["fields"]]
+    arrays, cats, doms = {}, [], {}
+    for name in names:
+        vals = [r.get(name) for r in records]
+        non_null = [v for v in vals if v is not None]
+        if non_null and all(isinstance(v, (str, bytes)) for v in non_null):
+            def _s(v):
+                return (v.decode("utf-8", "replace")
+                        if isinstance(v, bytes) else str(v))
+            levels = sorted({_s(v) for v in non_null})
+            lut = {v: i for i, v in enumerate(levels)}
+            arrays[name] = np.array(
+                [lut.get(_s(v), -1) if v is not None else -1
+                 for v in vals], np.int32)
+            cats.append(name)
+            doms[name] = levels
+        else:
+            arrays[name] = np.array(
+                [float(v) if isinstance(v, (int, float, bool)) else np.nan
+                 for v in vals], np.float64)
+    return Frame.from_numpy(arrays, categorical=cats, domains=doms,
+                            key=key)

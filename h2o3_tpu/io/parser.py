@@ -146,10 +146,30 @@ def import_file(path: str, destination_frame: Optional[str] = None,
                  fr.nrows, fr.ncols)
         return fr
 
+    # columnar containers: Arrow-native ingest, no pandas detour
+    # (h2o-parsers/{parquet,orc,avro} roles)
+    if len(paths) == 1:
+        from h2o3_tpu.io import formats as _fmt
+        kind = None
+        if paths[0].endswith((".parquet", ".pq")):
+            fr = _fmt.parse_parquet(paths[0], key=destination_frame)
+            kind = "parquet"
+        elif paths[0].endswith(".orc"):
+            fr = _fmt.parse_orc(paths[0], key=destination_frame)
+            kind = "orc"
+        elif paths[0].endswith(".avro"):
+            fr = _fmt.parse_avro(paths[0], key=destination_frame)
+            kind = "avro"
+        if kind:
+            log.info("parsed %s (%s/arrow) -> %s (%d x %d)", path, kind,
+                     fr.key, fr.nrows, fr.ncols)
+            return fr
+
     # CSV goes through the native multithreaded tokenizer
     # (h2o3_tpu/native/csv_parser.cpp — the water/parser CsvParser role);
-    # anything else (parquet, zip containers, unknown extensions) and any
-    # native-parse failure fall back to pandas.
+    # zip containers, MULTI-file parquet globs, unknown extensions and
+    # any native-parse failure fall back to pandas (single columnar
+    # files returned above via the Arrow branch).
     if header is None and paths[0].endswith((".csv", ".csv.gz")):
         # only plain text csv: zips/parquet sniff via their own readers
         header = guess_header(paths[0])

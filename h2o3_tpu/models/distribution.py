@@ -129,6 +129,38 @@ def huber(delta: float = 0.9) -> Distribution:
             delta * (jnp.abs(y - f) - 0.5 * delta)))
 
 
+_LINKS = {
+    "identity": (lambda f: f, lambda m: m),
+    "log": (jnp.exp, lambda m: float(jnp.log(max(m, EPS)))),
+    "logit": (_sigmoid,
+              lambda m: float(jnp.log(max(m, EPS) / max(1.0 - m, EPS)))),
+}
+
+
+def custom(obj, ref: str) -> Distribution:
+    """Wrap an uploaded custom-distribution object (water/udf CFunc /
+    hex CustomDistribution role). gradient() compiles straight into the
+    boosting scan; hessian defaults to 1 (plain gradient boosting),
+    deviance to |gradient| (a monotone progress proxy for early
+    stopping when the user supplies none)."""
+    link_name = obj.link() if callable(getattr(obj, "link", None)) \
+        else "identity"
+    if link_name not in _LINKS:
+        raise ValueError(f"custom distribution link '{link_name}' must "
+                         f"be one of {sorted(_LINKS)}")
+    link_inv, default_init = _LINKS[link_name]
+    grad = obj.gradient
+    hess = (obj.hessian if callable(getattr(obj, "hessian", None))
+            else (lambda y, f: jnp.ones_like(f)))
+    dev = (obj.deviance if callable(getattr(obj, "deviance", None))
+           else (lambda y, f: jnp.abs(grad(y, f))))
+    init = (obj.init if callable(getattr(obj, "init", None))
+            else default_init)
+    return Distribution(f"custom:{ref}", grad=grad, hess=hess,
+                        init_margin=init, link_inv=link_inv,
+                        deviance=dev)
+
+
 _FACTORY = {
     "gaussian": gaussian, "bernoulli": bernoulli, "poisson": poisson,
     "gamma": gamma, "laplace": laplace,
@@ -145,6 +177,21 @@ def get_distribution(name: str, **kw) -> Distribution:
     name = name.lower()
     if name in ("auto", "multinomial"):
         raise ValueError(f"{name} resolved at the algorithm level")
+    if name == "custom":
+        ref = kw.get("custom_distribution_func")
+        if not ref:
+            raise ValueError("distribution='custom' requires "
+                             "custom_distribution_func (upload via "
+                             "h2o3_tpu.upload_custom_distribution)")
+        from h2o3_tpu.core.udf import resolve_udf
+        obj = resolve_udf(ref)
+        # memoize per UPLOADED OBJECT, not per ref string: re-uploading
+        # under the same DKV key must not reuse a stale compiled loss,
+        # while repeat trains on one upload keep one compiled program
+        key = ("custom", str(ref), id(obj))
+        if key not in _CACHE:
+            _CACHE[key] = custom(obj, str(ref))
+        return _CACHE[key]
     if name == "tweedie":
         key = (name, float(kw.get("tweedie_power", 1.5)))
     elif name == "quantile":

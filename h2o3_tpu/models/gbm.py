@@ -489,6 +489,7 @@ class GBMEstimator(ModelBuilder):
         ntrees=50, max_depth=5, min_rows=10.0, learn_rate=0.1,
         sample_rate=1.0, col_sample_rate_per_tree=1.0,
         nbins=64, nbins_cats=1024, distribution="auto",
+        custom_distribution_func=None,
         # reg_lambda=0: the reference GammaPass has no ridge term
         # (hex/tree/gbm/GBM.java leaf gamma = sum g / sum h); the
         # xgboost facade passes its own lambda

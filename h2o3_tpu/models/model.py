@@ -276,13 +276,16 @@ class ModelBuilder:
                 model = self._fit(training_frame, x, y, j,
                                   validation_frame=validation_frame)
             if custom_metric_func is not None and y is not None:
+                # "python:key" CFunc references (water/udf/CFuncRef)
+                from h2o3_tpu.core.udf import resolve_udf
+                cmf = resolve_udf(custom_metric_func)
                 yv = training_frame.col(y).to_numpy()   # enum → float codes
                 preds = model._score_raw(training_frame)
                 wv = np.ones(training_frame.nrows)
                 wc = self.params.get("weights_column")
                 if wc and wc in training_frame:
                     wv = np.nan_to_num(training_frame.col(wc).to_numpy())
-                val = float(custom_metric_func(yv, preds, wv))
+                val = float(cmf(yv, preds, wv))
                 if model.training_metrics is not None and \
                         hasattr(model.training_metrics, "extra"):
                     model.training_metrics.extra["custom"] = val
