@@ -64,10 +64,14 @@ def build_smalldata(root: str) -> str:
 
 
 def start_backend(port: int = 0) -> int:
-    if os.environ.get("H2O3TPU_CONF_TPU") != "1":
+    """Same backend contract as server_main.py: TPU by default,
+    H2O3TPU_CONF_CPU=1 opts into host CPU — and backend= is mandatory
+    for the CPU case because the axon plugin shadows JAX_PLATFORMS."""
+    cpu = os.environ.get("H2O3TPU_CONF_CPU") == "1"
+    if cpu:
         os.environ["JAX_PLATFORMS"] = "cpu"
     import h2o3_tpu
-    h2o3_tpu.init()
+    h2o3_tpu.init(backend="cpu" if cpu else None)
     from h2o3_tpu.api.server import start_server
     return start_server(port=port)
 

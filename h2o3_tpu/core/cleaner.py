@@ -30,6 +30,8 @@ class SpilledFrame:
     """DKV stub for a frame currently living on ice (Value swapped to
     disk, water/Value.java isPersisted role)."""
 
+    _is_lazy_stub = True
+
     def __init__(self, key: str, uri: str, nrows: int, names: List[str],
                  nbytes: int):
         self.key = key
@@ -129,8 +131,22 @@ class Cleaner:
         from h2o3_tpu.core.kv import DKV
         from h2o3_tpu.io.persist import persist_manager, save_frame
         fr = DKV.get_raw(key)
-        if isinstance(fr, SpilledFrame) or fr is None:
+        if fr is None or getattr(fr, "_is_lazy_stub", False):
             return fr
+        # frames parsed from a file and never mutated evict straight
+        # back to a FileBackedFrame stub — the source IS the ice copy
+        # (water/fvec/FileVec.java role), no npz write needed
+        src = getattr(fr, "_source_paths", None)
+        if src:
+            from h2o3_tpu.io.lazy import FileBackedFrame
+            stub = FileBackedFrame(key, src[0], src, list(fr.names),
+                                   fr.nrows, _frame_nbytes(fr),
+                                   getattr(fr, "_source_kwargs", None))
+            if not DKV.replace_if(key, fr, stub):
+                return None
+            self.spilled_count += 1
+            log.info("evicted %s back to source %s", key, src[0])
+            return stub
         from urllib.parse import quote
         # keys come from user-supplied destination_frame strings: encode
         # so '..'/'/' cannot escape the ice directory

@@ -53,18 +53,23 @@ class _DKV:
             if v is not None:
                 self._atime[key] = time.monotonic()
         # transparent un-spill (Value swap-in, water/Value.java role);
-        # outside the lock: restore does file IO + device_put
-        from h2o3_tpu.core.cleaner import SpilledFrame, cleaner
-        while isinstance(v, SpilledFrame):
+        # outside the lock: restore does file IO + device_put. Lazy
+        # stubs (SpilledFrame on ice, FileBackedFrame on its source
+        # file) share the restore/discard duck type.
+        from h2o3_tpu.core.cleaner import cleaner
+        while v is not None and getattr(v, "_is_lazy_stub", False):
             fr = v.restore()
             cleaner.restored_count += 1
             with self._lock:
-                # CAS: another thread may have restored or re-spilled it
+                # restore() paths end in Frame.__init__, which re-puts
+                # the key itself — so the store already holds `fr` (the
+                # common case), or a concurrent writer's newer value
                 cur = self._store.get(key)
                 if cur is v:
                     self._store[key] = fr
-            if cur is v:
-                v.discard()     # reclaim the ice file
+                    cur = fr
+            if cur is fr:
+                v.discard()     # our restore won: reclaim the ice file
                 return fr
             v = cur             # retry until we hold a live value
         return v
@@ -92,7 +97,7 @@ class _DKV:
         with self._lock:
             v = self._store.pop(key, None)
             self._atime.pop(key, None)
-        if v is not None and type(v).__name__ == "SpilledFrame":
+        if v is not None and getattr(v, "_is_lazy_stub", False):
             v.discard()     # drop the orphaned ice file with the key
 
     def keys(self, prefix: str = "") -> Iterator[str]:

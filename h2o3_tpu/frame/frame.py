@@ -88,6 +88,9 @@ class Frame:
             new_cols[new] = c
         self._cols = new_cols
         self._order = list(new_names)
+        # a mutated frame no longer matches its source file — the
+        # Cleaner must not evict it back to a FileBackedFrame stub
+        self._source_paths = None
         return self
 
     @staticmethod
@@ -149,6 +152,7 @@ class Frame:
         self._cols[col.name] = col
         if col.name not in self._order:
             self._order.append(col.name)
+        self._source_paths = None    # mutated: no source-stub eviction
 
     def drop(self, names: Sequence[str]) -> "Frame":
         keep = [self.col(n) for n in self._order if n not in set(names)]

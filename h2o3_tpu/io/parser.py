@@ -112,11 +112,44 @@ def guess_header(path: str) -> bool:
 
 def import_file(path: str, destination_frame: Optional[str] = None,
                 col_types: Optional[Dict[str, str]] = None,
-                header: Optional[bool] = None) -> Frame:
+                header: Optional[bool] = None, lazy: bool = False):
     """h2o.import_file analogue (h2o-py/h2o/h2o.py:414).
 
     Accepts a file path, glob, or directory; CSV(.gz/.zip) and Parquet.
+
+    ``lazy=True`` registers a FileBackedFrame stub (the water/fvec
+    FileVec role): no bytes are parsed until the key is first fetched
+    from the DKV; under memory pressure the Cleaner evicts unmutated
+    file-backed frames back to their stub instead of writing spill npz.
     """
+    if lazy:
+        from h2o3_tpu.core.kv import DKV, make_key
+        from h2o3_tpu.io.lazy import FileBackedFrame, sniff_meta
+        lp = sorted(_glob.glob(path)) if any(ch in path for ch in "*?[") \
+            else [path]
+        if not lp or not all(os.path.exists(f) for f in lp):
+            raise FileNotFoundError(path)
+        names, nrows, nbytes = (sniff_meta(lp[0]) if len(lp) == 1
+                                else (None, None,
+                                      sum(os.path.getsize(f) for f in lp)))
+        key = destination_frame or make_key("frame")
+        stub = FileBackedFrame(key, path, lp, names, nrows, nbytes,
+                               {"col_types": col_types, "header": header})
+        DKV.put(key, stub)
+        log.info("registered lazy frame %s -> %s (unparsed, %.1f MB on "
+                 "disk)", key, path, (nbytes or 0) / 1e6)
+        return stub
+    fr = _import_file_eager(path, destination_frame, col_types, header)
+    # provenance for the Cleaner's cheap eviction path: an unmutated
+    # file-backed frame can drop straight back to its stub
+    fr._source_paths = [path] if not isinstance(path, list) else path
+    fr._source_kwargs = {"col_types": col_types, "header": header}
+    return fr
+
+
+def _import_file_eager(path: str, destination_frame: Optional[str] = None,
+                       col_types: Optional[Dict[str, str]] = None,
+                       header: Optional[bool] = None) -> Frame:
     paths: List[str] = []
     if os.path.isdir(path):
         paths = sorted(os.path.join(path, f) for f in os.listdir(path))

@@ -1,0 +1,76 @@
+"""Lazy file-backed frames (water/fvec FileVec role).
+
+import_file(lazy=True) registers a stub with header metadata but parses
+nothing; the first DKV.get materializes; the Cleaner evicts unmutated
+file-backed frames straight back to their stub (no spill npz)."""
+
+import numpy as np
+
+from h2o3_tpu.core.cleaner import cleaner
+from h2o3_tpu.core.kv import DKV
+from h2o3_tpu.frame.frame import Frame
+from h2o3_tpu.io.lazy import FileBackedFrame
+from h2o3_tpu.io.parser import import_file
+
+
+def _csv(tmp_path, n=400):
+    p = str(tmp_path / "lazy.csv")
+    r = np.random.RandomState(0)
+    with open(p, "w") as f:
+        f.write("a,b,c\n")
+        for i in range(n):
+            f.write(f"{r.randn():.5f},{r.randint(0, 5)},lvl{r.randint(3)}\n")
+    return p
+
+
+def test_lazy_import_defers_parse(tmp_path):
+    p = _csv(tmp_path)
+    stub = import_file(p, destination_frame="lazyfr", lazy=True)
+    assert isinstance(stub, FileBackedFrame)
+    assert stub.names == ["a", "b", "c"]
+    assert stub.nrows == 400
+    assert isinstance(DKV.get_raw("lazyfr"), FileBackedFrame)
+    fr = DKV.get("lazyfr")                 # first touch materializes
+    assert isinstance(fr, Frame)
+    assert fr.nrows == 400 and fr.names == ["a", "b", "c"]
+    assert isinstance(DKV.get_raw("lazyfr"), Frame)
+    DKV.remove("lazyfr")
+
+
+def test_cleaner_evicts_to_source_stub(tmp_path):
+    p = _csv(tmp_path)
+    fr = import_file(p, destination_frame="evictfr")
+    assert fr._source_paths == [p]
+    stub = cleaner.spill("evictfr")
+    assert isinstance(stub, FileBackedFrame)     # no npz written
+    assert isinstance(DKV.get_raw("evictfr"), FileBackedFrame)
+    back = DKV.get("evictfr")                    # re-parse on touch
+    assert isinstance(back, Frame)
+    assert np.allclose(back.col("a").to_numpy(), fr.col("a").to_numpy())
+    DKV.remove("evictfr")
+
+
+def test_mutated_frame_not_evicted_to_source(tmp_path):
+    p = _csv(tmp_path)
+    fr = import_file(p, destination_frame="mutfr")
+    fr.rename_columns(["x", "y", "z"])
+    assert fr._source_paths is None
+    out = cleaner.spill("mutfr")
+    # falls back to a real spill (npz ice copy), not the source stub
+    assert not isinstance(out, FileBackedFrame)
+    restored = DKV.get("mutfr")
+    assert restored.names == ["x", "y", "z"]
+    DKV.remove("mutfr")
+
+
+def test_lazy_parquet_metadata(tmp_path):
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+    t = pa.table({"q": np.arange(123, dtype=float)})
+    p = str(tmp_path / "l.parquet")
+    pq.write_table(t, p)
+    stub = import_file(p, lazy=True)
+    assert stub.names == ["q"] and stub.nrows == 123
+    fr = DKV.get(stub.key)
+    assert fr.nrows == 123
+    DKV.remove(stub.key)
