@@ -193,16 +193,16 @@ def main():
             if not ok:
                 for ln in tail:
                     print("      " + ln)
-            write_report(results)      # incremental: partial runs count
+            write_report(results, total=len(units))  # incremental
     finally:
         proc.kill()
 
     npass = sum(1 for _, ok, _, _ in results if ok)
     print(f"\n{npass}/{len(results)} passed")
-    write_report(results)
+    write_report(results, total=len(units))
 
 
-def write_report(results):
+def write_report(results, total=None):
     npass = sum(1 for _, ok, _, _ in results if ok)
     lines = [
         "# CONFORMANCE — reference h2o-py pyunits vs h2o3-tpu",
@@ -218,7 +218,9 @@ def write_report(results):
         "CONFORMANCE.partial.md instead.",
         "",
         f"**Result: {npass}/{len(results)} passing** "
-        f"({time.strftime('%Y-%m-%d')})",
+        f"({time.strftime('%Y-%m-%d')})"
+        + (f" — **RUN IN PROGRESS: {len(results)}/{total} executed**"
+           if total and len(results) < total else ""),
         "",
         "| pyunit | status | time |",
         "|---|---|---|",

@@ -471,10 +471,9 @@ def model_to_v3(model: Model) -> dict:
         sds = np.asarray(out_src.get("coef_sds") or
                          [1.0] * (len(names) - 1), np.float64)
         if out_src.get("standardized"):
+            from h2o3_tpu.models.glm import destandardize_coefs
             std_c = coefs.copy()
-            raw = coefs.copy()
-            raw[:-1] = std_c[:-1] / sds
-            raw[-1] = std_c[-1] - float(np.sum(std_c[:-1] * mus / sds))
+            raw = destandardize_coefs(coefs, mus, sds)
         else:
             raw = coefs.copy()
             std_c = coefs.copy()

@@ -448,10 +448,17 @@ def _parse(params, body):
 def _frames(params, body):
     out = []
     for k in DKV.keys():
-        v = DKV.get(k)
+        # get_raw: listing must NOT materialize lazy/spilled stubs — a
+        # catalog poll would otherwise parse every lazy import and
+        # un-evict everything the Cleaner just spilled
+        v = DKV.get_raw(k)
         if isinstance(v, Frame):
             out.append({"frame_id": {"name": k}, "rows": v.nrows,
                         "num_columns": v.ncols})
+        elif getattr(v, "_is_lazy_stub", False):
+            out.append({"frame_id": {"name": k},
+                        "rows": getattr(v, "nrows", None) or 0,
+                        "num_columns": len(getattr(v, "names", []) or [])})
     return {"frames": out}
 
 
@@ -1252,8 +1259,11 @@ def _index(params, body):
     """Minimal landing page (the h2o-web Flow-serving role: the node
     itself answers a browser with a live cluster view)."""
     info = cloud_mod.cluster_info()
-    frames = sum(1 for k in DKV.keys() if isinstance(DKV.get(k), Frame))
-    models = sum(1 for k in DKV.keys() if isinstance(DKV.get(k), Model))
+    frames = sum(1 for k in DKV.keys()
+                 if isinstance(DKV.get_raw(k), Frame)
+                 or getattr(DKV.get_raw(k), "_is_lazy_stub", False))
+    models = sum(1 for k in DKV.keys()
+                 if isinstance(DKV.get_raw(k), Model))
     html = f"""<!doctype html><html><head><title>h2o3-tpu</title></head>
 <body style="font-family:monospace">
 <h2>h2o3-tpu cloud '{info["cloud_name"]}'</h2>

@@ -53,10 +53,12 @@ class FileBackedFrame:
         """Nothing to reclaim — the backing file is user data."""
 
 
-def sniff_meta(path: str):
+def sniff_meta(path: str, header=None):
     """(names, nrows, nbytes) as cheaply as the format allows: parquet
     from footer metadata, CSV from the header line + a buffered newline
-    count; None where the format would require a full parse."""
+    count; None where the format would require a full parse.
+    ``header`` carries the caller's explicit choice so the stub metadata
+    agrees with the frame the materializing parse will build."""
     import os
     nbytes = os.path.getsize(path)
     if path.endswith((".parquet", ".pq")):
@@ -67,7 +69,7 @@ def sniff_meta(path: str):
         import csv as _csv
         from h2o3_tpu.io.parser import guess_header
         with open(path, "rb") as f:
-            header = f.readline().decode("utf-8", "replace")
+            first_line = f.readline().decode("utf-8", "replace")
             n = 0
             last = b"\n"
             while True:
@@ -82,9 +84,9 @@ def sniff_meta(path: str):
         # UPPER BOUND when quoted fields embed newlines (exact count
         # would need a full tokenize — the stub metadata is advisory,
         # the materializing parse is authoritative)
-        names = next(_csv.reader([header]), [])
+        names = next(_csv.reader([first_line]), [])
         names = [c.strip() for c in names]
-        has_header = guess_header(path)
+        has_header = guess_header(path) if header is None else bool(header)
         if not has_header:
             names = [f"C{i + 1}" for i in range(len(names))]
         return names, n + (0 if has_header else 1), nbytes

@@ -125,11 +125,16 @@ def import_file(path: str, destination_frame: Optional[str] = None,
     if lazy:
         from h2o3_tpu.core.kv import DKV, make_key
         from h2o3_tpu.io.lazy import FileBackedFrame, sniff_meta
-        lp = sorted(_glob.glob(path)) if any(ch in path for ch in "*?[") \
-            else [path]
+        if os.path.isdir(path):        # same expansion as the eager path
+            lp = sorted(os.path.join(path, f) for f in os.listdir(path))
+        elif any(ch in path for ch in "*?["):
+            lp = sorted(_glob.glob(path))
+        else:
+            lp = [path]
         if not lp or not all(os.path.exists(f) for f in lp):
             raise FileNotFoundError(path)
-        names, nrows, nbytes = (sniff_meta(lp[0]) if len(lp) == 1
+        names, nrows, nbytes = (sniff_meta(lp[0], header=header)
+                                if len(lp) == 1
                                 else (None, None,
                                       sum(os.path.getsize(f) for f in lp)))
         key = destination_frame or make_key("frame")

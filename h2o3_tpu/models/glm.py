@@ -543,12 +543,39 @@ class GLMModel(Model):
 
     @property
     def coefficients(self) -> Dict[str, float]:
+        """RAW-scale coefficients (h2o-py model.coef() semantics): when
+        the model trained on a standardized design, model-space coefs
+        de-standardize exactly like the wire coefficients_table does.
+        Multinomial/ordinal keep model space (same exclusions as the
+        wire table — ordinal's trailing coef is a placeholder, the real
+        thresholds live in output['ordinal_alphas'])."""
         names = self.output["coef_names"] + ["Intercept"]
         if self.coef_multinomial is not None:
             K = self.coef_multinomial.shape[1]
             return {f"{nm}_class{k}": float(self.coef_multinomial[i, k])
                     for i, nm in enumerate(names) for k in range(K)}
-        return {nm: float(c) for nm, c in zip(names, self.coef)}
+        coefs = np.asarray(self.coef, np.float64)
+        if self.output.get("standardized") and \
+                self.output.get("family") != "ordinal":
+            coefs = destandardize_coefs(
+                coefs,
+                self.output.get("coef_means"),
+                self.output.get("coef_sds"))
+        return {nm: float(c) for nm, c in zip(names, coefs)}
+
+
+def destandardize_coefs(coefs: np.ndarray, mus, sds) -> np.ndarray:
+    """Standardized-design coefs → raw scale: raw_j = std_j/σ_j,
+    intercept shifts by Σ std_j·μ_j/σ_j. ONE implementation shared by
+    the python surface and the wire coefficients_table
+    (hex/glm GLMModel coefficients semantics)."""
+    p = len(coefs) - 1
+    mus = np.asarray(mus if mus is not None else [0.0] * p, np.float64)
+    sds = np.asarray(sds if sds is not None else [1.0] * p, np.float64)
+    raw = np.asarray(coefs, np.float64).copy()
+    raw[:-1] = coefs[:-1] / sds
+    raw[-1] = coefs[-1] - float(np.sum(coefs[:-1] * mus / sds))
+    return raw
 
 
 class GLMEstimator(ModelBuilder):
