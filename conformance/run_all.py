@@ -226,8 +226,11 @@ def write_report(results, total=None):
         "|---|---|---|",
     ]
     for name, ok, dt, tail in results:
+        # keep the whole assertion line: round-2's 80-char cut turned
+        # "...22.543315116995075, and 22.542878951149426" into "...and
+        # 2", making a 2e-5 float mismatch read as a 10x bug
         status = "pass" if ok else "FAIL — `" + \
-            (tail[-1][:80].replace("|", "/") if tail else "?") + "`"
+            (tail[-1][:200].replace("|", "/") if tail else "?") + "`"
         lines.append(f"| {name} | {status} | {dt:.1f}s |")
     with open(os.path.join(REPO, REPORT_NAME), "w") as f:
         f.write("\n".join(lines) + "\n")

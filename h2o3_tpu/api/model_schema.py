@@ -440,8 +440,14 @@ def model_to_v3(model: Model) -> dict:
         "cross_validation_predictions":
             [{"name": k, "type": "Key<Frame>"} for k in
              (out_src.get("cv_predictions_keys") or [])] or None,
-        "cross_validation_holdout_predictions_frame_id": None,
-        "cross_validation_fold_assignment_frame_id": None,
+        "cross_validation_holdout_predictions_frame_id":
+            ({"name": out_src["cv_holdout_frame_key"],
+              "type": "Key<Frame>"}
+             if out_src.get("cv_holdout_frame_key") else None),
+        "cross_validation_fold_assignment_frame_id":
+            ({"name": out_src["cv_fold_assignment_key"],
+              "type": "Key<Frame>"}
+             if out_src.get("cv_fold_assignment_key") else None),
         "scoring_history": _history_table(model),
         "variable_importances": _varimp_table(model),
         "model_summary": None,

@@ -1197,6 +1197,20 @@ def _automl_tables(aml):
     tab = aml.leaderboard.as_table()
     metric_cols = [k for k in (tab[0].keys() if tab else [])
                    if k != "model_id"]
+    if not metric_cols:
+        # an empty leaderboard must still carry the metric columns: the
+        # client slices fr[1:] off the parsed table
+        # (h2o-py/h2o/automl/_base.py:328), which asserts on ncol == 1.
+        # Column set follows the task's sort metric.
+        sm = (getattr(aml.leaderboard, "sort_metric", None) or "auc").lower()
+        if sm in ("auc", "logloss", "aucpr"):
+            metric_cols = ["auc", "logloss", "aucpr",
+                           "mean_per_class_error", "rmse", "mse"]
+        elif sm == "mean_per_class_error":
+            metric_cols = ["mean_per_class_error", "logloss", "rmse", "mse"]
+        else:
+            metric_cols = ["mean_residual_deviance", "rmse", "mse",
+                           "mae", "rmsle"]
     for r in tab:
         rows.append([str(r.get("model_id"))] +
                     [r.get(k) for k in metric_cols])

@@ -53,7 +53,10 @@ class H2OAutoML:
         self.max_models = int(max_models)
         self.max_runtime_secs = float(max_runtime_secs)
         self.seed = int(seed) if int(seed) >= 0 else 5723
-        self.nfolds = int(nfolds)
+        # h2o-py sends nfolds=-1 for "auto" (H2OAutoML default since
+        # 3.46); the reference resolves it to 5-fold CV (AutoML.java
+        # nfolds default) — builders reject a literal -1
+        self.nfolds = 5 if int(nfolds) == -1 else int(nfolds)
         self.project_name = project_name or f"automl_{int(time.time())}"
         self.sort_metric = sort_metric
         self.include = ({a.lower() for a in include_algos}

@@ -144,32 +144,25 @@ def write_reference_mojo(model, path: str) -> str:
     rdom = out.get("domain")
     domains: List[Optional[List[str]]] = list(bm.domains) + [rdom]
 
-    info = {
-        "h2o_version": "3.46.0.1",
+    info = _base_info(
+        model,
+        category={ModelCategory.BINOMIAL: "Binomial",
+                  ModelCategory.MULTINOMIAL: "Multinomial"}.get(
+                      cat, "Regression"),
+        n_features=len(bm.names), n_classes=n_classes,
+        n_columns=len(names),
+        n_domains=sum(1 for d in domains if d is not None))
+    info.update({
         "mojo_version": "1.40",
-        "license": "Apache License Version 2.0",
         "algo": model.algo,
         "algorithm": ("Gradient Boosting Machine" if model.algo == "gbm"
                       else "Distributed Random Forest"),
-        "endianness": "LITTLE_ENDIAN",
-        "category": {ModelCategory.BINOMIAL: "Binomial",
-                     ModelCategory.MULTINOMIAL: "Multinomial"}.get(
-                         cat, "Regression"),
-        "uuid": str(abs(hash(model.key)) if model.key else
-                    _uuid.uuid4().int % (1 << 63)),
-        "supervised": "true",
-        "n_features": len(bm.names),
-        "n_classes": n_classes,
-        "n_columns": len(names),
-        "n_domains": sum(1 for d in domains if d is not None),
-        "balance_classes": "false",
-        "default_threshold": out.get("default_threshold", 0.5),
         "prior_class_distrib": "null",
         "model_class_distrib": "null",
         "timestamp": "2026-01-01 00:00:00",
         "n_trees": n_groups,
         "n_trees_per_class": K,
-    }
+    })
     if model.algo == "gbm":
         link = {"bernoulli": "logit", "multinomial": "logit",
                 "poisson": "log", "gamma": "log", "tweedie": "log"}.get(
@@ -180,13 +173,53 @@ def write_reference_mojo(model, path: str) -> str:
     else:
         info.update(binomial_double_trees="false")
 
+    def _blobs():
+        for g in range(n_groups):
+            for k in range(K):
+                idx = g * K + k
+                yield (f"trees/t{k:02d}_{g:03d}.bin", _root_blob(
+                    feat[idx], thresh[idx], na_left[idx], is_split[idx],
+                    cat_split[idx], left_words[idx], leaf[idx],
+                    edges, cards, divs, D))
+    return _emit_mojo_zip(path, info, names, domains, _blobs())
+
+
+# ------------------------------------------------------ shared ini emission
+
+
+def _base_info(model, category: str, n_features: int, n_classes: int,
+               n_columns: int, n_domains: int) -> Dict[str, object]:
+    """[info] fields every reference MOJO carries (ModelMojoReader
+    readAll contract)."""
+    return {
+        "h2o_version": "3.46.0.1",
+        "license": "Apache License Version 2.0",
+        "endianness": "LITTLE_ENDIAN",
+        "category": category,
+        "uuid": str(abs(hash(model.key)) if model.key else
+                    _uuid.uuid4().int % (1 << 63)),
+        "supervised": "true",
+        "n_features": n_features,
+        "n_classes": n_classes,
+        "n_columns": n_columns,
+        "n_domains": n_domains,
+        "balance_classes": "false",
+        "default_threshold": model.output.get("default_threshold", 0.5),
+    }
+
+
+def _emit_mojo_zip(path: str, info: Dict[str, object], names: List[str],
+                   domains: List[Optional[List[str]]],
+                   blobs=None) -> str:
+    """Write model.ini + domains/dNNN.txt (+ extra binary entries) —
+    the zip layout both the tree and GLM writers share. ``blobs`` is an
+    iterable of (entry_name, bytes) pairs, consumed lazily so a large
+    forest never materializes every serialized tree at once."""
     ini = ["[info]"]
     ini += [f"{k} = {v}" for k, v in info.items()]
-    ini.append("")
-    ini.append("[columns]")
+    ini += ["", "[columns]"]
     ini += names
-    ini.append("")
-    ini.append("[domains]")
+    ini += ["", "[domains]"]
     dom_files: Dict[str, List[str]] = {}
     di = 0
     for i, d in enumerate(domains):
@@ -196,20 +229,196 @@ def write_reference_mojo(model, path: str) -> str:
         ini.append(f"{i}: {len(d)} {fn}")
         dom_files[fn] = list(d)
         di += 1
-
     with zipfile.ZipFile(path, "w", zipfile.ZIP_DEFLATED) as z:
         z.writestr("model.ini", "\n".join(ini) + "\n")
         for fn, lvls in dom_files.items():
             z.writestr(f"domains/{fn}", "\n".join(lvls) + "\n")
-        for g in range(n_groups):
-            for k in range(K):
-                idx = g * K + k
-                blob = _root_blob(feat[idx], thresh[idx], na_left[idx],
-                                  is_split[idx], cat_split[idx],
-                                  left_words[idx], leaf[idx],
-                                  edges, cards, divs, D)
-                z.writestr(f"trees/t{k:02d}_{g:03d}.bin", blob)
+        for name, blob in (blobs or ()):
+            z.writestr(name, blob)
     return path
+
+
+# ----------------------------------------------------------- GLM writer
+
+
+def _jarr(vals) -> str:
+    """Java ``Arrays.toString`` serialization — the writekv array format
+    (hex/genmodel/AbstractMojoWriter.java writekv(String, double[]))."""
+    return "[" + ", ".join(repr(float(v)) if isinstance(v, float)
+                           else str(int(v)) for v in vals) + "]"
+
+
+def write_reference_glm_mojo(model, path: str) -> str:
+    """Write a reference-layout GLM MOJO zip (GlmMojoReader v1.00
+    contract, hex/glm/GLMMojoWriter.java writeModelData):
+
+    - beta layout: categorical one-hot blocks first (catOffsets), then
+      numerics, then intercept — RAW scale (GlmMojoModel.glmScore0
+      applies no standardization).
+    - columns reordered categoricals-first to match the data[] indexing
+      ``i < cats ⇒ categorical code`` (GlmMojoModelBase).
+    - NA semantics: cat_modes[i] = cardinality (an out-of-range code)
+      reproduces our all-zero-indicator NA block exactly — glmScore0
+      skips the coefficient when ival reaches catOffsets[i+1].
+    """
+    from h2o3_tpu.models.model import ModelCategory
+    if model.coef_multinomial is not None or \
+            model.output.get("family") == "ordinal":
+        # ordinal trains with a placeholder intercept + separate
+        # thresholds (ordinal_alphas) that GlmMojoModel cannot express
+        raise ValueError("reference-format GLM MOJO export does not "
+                         "cover multinomial/ordinal yet")
+    feats = list(model.features)
+    domains_by_feat = model.di_stats["domains"]
+    use_all = bool(model.params.get("use_all_factor_levels", False))
+    first = 0 if use_all else 1
+    coefs = model.coefficients  # raw scale, keyed by coef name
+
+    cats_i = [i for i, d in enumerate(domains_by_feat) if d is not None]
+    nums_i = [i for i, d in enumerate(domains_by_feat) if d is None]
+    cat_offsets = [0]
+    beta: List[float] = []
+    cat_modes: List[int] = []
+    for i in cats_i:
+        dom = domains_by_feat[i]
+        for l in range(first, max(len(dom), 1)):
+            beta.append(coefs[f"{feats[i]}.{dom[l]}"])
+        cat_offsets.append(len(beta))
+        cat_modes.append(max(len(dom), 1))
+    num_means = [float(m) for m in model.di_stats["num_means"]]
+    for i in nums_i:
+        beta.append(coefs[feats[i]])
+    beta.append(coefs["Intercept"])
+
+    fam = model.family
+    cat = model.output["category"]
+    binomial = cat == ModelCategory.BINOMIAL
+    names = ([feats[i] for i in cats_i] + [feats[i] for i in nums_i]
+             + [model.output["response"]])
+    domains: List[Optional[List[str]]] = (
+        [list(domains_by_feat[i]) for i in cats_i]
+        + [None] * len(nums_i) + [model.output.get("domain")])
+
+    info = _base_info(model, category="Binomial" if binomial
+                      else "Regression", n_features=len(feats),
+                      n_classes=2 if binomial else 1,
+                      n_columns=len(names),
+                      n_domains=sum(1 for d in domains if d is not None))
+    info.update({
+        "mojo_version": "1.00",
+        "algo": "glm",
+        "algorithm": "Generalized Linear Model",
+        # GLMMojoWriter.writeModelData kv block
+        "use_all_factor_levels": "true" if use_all else "false",
+        "cats": len(cats_i),
+        "cat_offsets": _jarr(cat_offsets),
+        "nums": len(nums_i),
+        "mean_imputation": "true",
+        "num_means": _jarr(num_means),
+        "cat_modes": _jarr(cat_modes),
+        "beta": _jarr([float(b) for b in beta]),
+        "family": fam.name,
+        "link": fam.link,
+    })
+    if fam.name == "tweedie":
+        # our tweedie linkinv is exp (log link); power 0 selects
+        # Math.exp in GenModel.GLM_tweedieInv
+        info["tweedie_link_power"] = 0.0
+    return _emit_mojo_zip(path, info, names, domains)
+
+
+def _parse_jarr(s: str) -> List[float]:
+    s = s.strip()[1:-1].strip()
+    return [float(x) for x in s.split(",")] if s else []
+
+
+def score_reference_glm_mojo(path: str, rows: Dict[str, np.ndarray]):
+    """Faithful port of GlmMojoModel.score0 (mean imputation +
+    glmScore0 + link inverse) reading our reference-layout GLM zip —
+    the round-trip contract check. Returns mu [n]."""
+    with zipfile.ZipFile(path) as z:
+        ini = z.read("model.ini").decode().splitlines()
+        info: Dict[str, str] = {}
+        columns: List[str] = []
+        domain_spec: Dict[int, str] = {}
+        section = None
+        for ln in ini:
+            ln = ln.strip()
+            if not ln:
+                continue
+            if ln in ("[info]", "[columns]", "[domains]"):
+                section = ln
+                continue
+            if section == "[info]":
+                k, _, v = ln.partition("=")
+                info[k.strip()] = v.strip()
+            elif section == "[columns]":
+                columns.append(ln)
+            elif section == "[domains]":
+                ci, _, rest = ln.partition(":")
+                domain_spec[int(ci)] = rest.strip().split(" ", 1)[1]
+        domains = {ci: z.read(f"domains/{fn}").decode().splitlines()
+                   for ci, fn in domain_spec.items()}
+
+    cats = int(info["cats"])
+    nums = int(info["nums"])
+    cat_offsets = [int(v) for v in _parse_jarr(info["cat_offsets"])]
+    cat_modes = [int(v) for v in _parse_jarr(info["cat_modes"])]
+    num_means = _parse_jarr(info["num_means"])
+    beta = _parse_jarr(info["beta"])
+    use_all = info["use_all_factor_levels"] == "true"
+    link = info["link"]
+    tlp = float(info.get("tweedie_link_power", 0.0))
+
+    n = len(next(iter(rows.values())))
+    data = np.full((n, cats + nums), np.nan)
+    for i in range(cats + nums):
+        cn = columns[i]
+        v = rows[cn]
+        if i < cats:
+            lut = {s: j for j, s in enumerate(domains[i])}
+            data[:, i] = [lut.get(str(x), np.nan)
+                          if x is not None else np.nan for x in v]
+        else:
+            data[:, i] = np.asarray(v, np.float64)
+
+    mu = np.empty(n)
+    for r in range(n):
+        row = data[r].copy()
+        for i in range(cats):                 # imputeMissingWithMeans
+            if np.isnan(row[i]):
+                row[i] = cat_modes[i]
+        for i in range(nums):
+            if np.isnan(row[cats + i]):
+                row[cats + i] = num_means[i]
+        eta = 0.0
+        for i in range(cats):                 # glmScore0 cat walk
+            ival = int(row[i]) - (0 if use_all else 1)
+            if not use_all and row[i] == 0:
+                continue
+            ival += cat_offsets[i]
+            if ival < cat_offsets[i + 1]:
+                eta += beta[ival]
+        noff = cat_offsets[cats] - cats
+        for i in range(cats, len(beta) - 1 - noff):
+            eta += beta[noff + i] * row[i]
+        eta += beta[-1]
+        if link == "identity":
+            m = eta
+        elif link == "logit":
+            m = 1.0 / (1.0 + np.exp(-eta))
+        elif link == "log":
+            m = np.exp(eta)
+        elif link == "inverse":
+            xx = min(-1e-5, eta) if eta < 0 else max(1e-5, eta)
+            m = 1.0 / xx
+        elif link == "tweedie":
+            m = max(2e-16, np.exp(eta)) if tlp == 0 \
+                else float(np.power(eta, 1.0 / tlp))
+        else:
+            raise ValueError(link)
+        mu[r] = m
+    return mu, info
 
 
 # ------------------------------------------------- reference-contract reader

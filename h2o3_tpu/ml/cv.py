@@ -138,28 +138,73 @@ def train_with_cv(builder, frame: Frame, x: Sequence[str], y: str,
     keep_preds = bool(p.get("keep_cross_validation_predictions"))
     cv_pred_keys = []
     fold_metric_dicts = []
+
+    # CV fast path (tree builders): fold models train on the PARENT
+    # frame with held-out rows weight-masked and the main model's bin
+    # edges shared, so the whole sweep reuses ONE compiled program and
+    # never rebuilds frames — leave-one-out CV (nfolds == nrows,
+    # pyunit_cv_cars_gbm boundary case) costs one dispatch per fold
+    # instead of a frame rebuild + bin re-sketch per fold.
+    fast = bool(getattr(builder, "cv_fold_masking", False)) \
+        and not p.get("checkpoint")
+    if fast and builder.algo == "glm" and (
+            p.get("lambda_search") or
+            (p.get("lambda_") not in (None, 0, 0.0))):
+        # penalized GLM folds must standardize per fold (the penalty
+        # couples to the sigma scaling), so the shared-design fast path
+        # only covers unpenalized fits; regularized CV keeps the
+        # subset-frame path with per-fold DataInfo like the reference
+        fast = False
+    final = None
+    shared_bm = None
+    if fast:
+        # main model FIRST: folds reuse its full-data binning (GLM has
+        # no binned matrix — folds share the design implicitly, since
+        # the masked rows ride the same parent frame)
+        final = builder.__class__(**sub_params)._fit(
+            frame, list(x), y, job, validation_frame=validation_frame)
+        shared_bm = getattr(final, "bm", None)
+
     for f in range(nfolds):
         mask_tr = folds != f
-        tr = subset_frame(frame, mask_tr, pad_to=frame.nrows_padded)
-        # holdouts share one padded shape too (all ~n/nfolds rows; max
-        # fold size keeps one scoring program across folds)
-        te = subset_frame(frame, ~mask_tr,
-                          pad_to=_pad_rows(int(np.max(
-                              np.bincount(folds, minlength=nfolds))),
-                              block=8))
-        sub = builder.__class__(**sub_params)
-        m = sub._fit(tr, list(x), y, job)
-        cv_models.append(m)
-        preds = m._score_raw(te)
         idx = np.where(~mask_tr)[0]
-        # per-fold holdout metrics feed cross_validation_metrics_summary
-        # (reference cvModelBuilder per-fold _validation metrics)
-        try:
-            fm = m.model_performance(te)
-            fold_metric_dicts.append(fm.to_dict()
-                                     if hasattr(fm, "to_dict") else {})
-        except Exception:
-            fold_metric_dicts.append({})
+        if fast:
+            sub = builder.__class__(**sub_params)
+            sub._cv_fold_mask = mask_tr
+            sub._cv_shared_bm = shared_bm
+            m = sub._fit(frame, list(x), y, job)
+            cv_models.append(m)
+            full_preds = m._score_raw(frame)
+            preds = {k: np.asarray(v)[idx] for k, v in full_preds.items()}
+            hold_w = np.zeros(frame.nrows_padded, np.float32)
+            hold_w[idx] = 1.0
+            try:
+                fm = m.model_performance(frame, mask_weights=hold_w)
+                fold_metric_dicts.append(fm.to_dict()
+                                         if hasattr(fm, "to_dict") else {})
+            except Exception:
+                fold_metric_dicts.append({})
+        else:
+            tr = subset_frame(frame, mask_tr, pad_to=frame.nrows_padded)
+            # holdouts share one padded shape too (all ~n/nfolds rows;
+            # max fold size keeps one scoring program across folds)
+            te = subset_frame(frame, ~mask_tr,
+                              pad_to=_pad_rows(int(np.max(
+                                  np.bincount(folds, minlength=nfolds))),
+                                  block=8))
+            sub = builder.__class__(**sub_params)
+            m = sub._fit(tr, list(x), y, job)
+            cv_models.append(m)
+            preds = m._score_raw(te)
+            # per-fold holdout metrics feed
+            # cross_validation_metrics_summary (reference cvModelBuilder
+            # per-fold _validation metrics)
+            try:
+                fm = m.model_performance(te)
+                fold_metric_dicts.append(fm.to_dict()
+                                         if hasattr(fm, "to_dict") else {})
+            except Exception:
+                fold_metric_dicts.append({})
         if category == ModelCategory.BINOMIAL:
             holdout[idx] = preds["p1"]
         elif category == ModelCategory.MULTINOMIAL:
@@ -181,9 +226,11 @@ def train_with_cv(builder, frame: Frame, x: Sequence[str], y: str,
             pf = Frame.from_numpy(cols)
             cv_pred_keys.append(pf.key)
 
-    # final model on all data (ModelBuilder.java "main model")
-    final = builder.__class__(**sub_params)._fit(
-        frame, list(x), y, job, validation_frame=validation_frame)
+    # final model on all data (ModelBuilder.java "main model") — the
+    # fast path trained it up front to share its binning with the folds
+    if final is None:
+        final = builder.__class__(**sub_params)._fit(
+            frame, list(x), y, job, validation_frame=validation_frame)
 
     # CV metrics: NA-response rows excluded, user weights applied — same
     # weighting contract as training metrics
@@ -207,6 +254,32 @@ def train_with_cv(builder, frame: Frame, x: Sequence[str], y: str,
         wv = wv * (~np.isnan(yraw)).astype(np.float32)
         yv = np.nan_to_num(yraw).astype(np.float32)
         final.cross_validation_metrics = mm.regression_metrics(holdout, yv, wv)
+    # combined holdout-prediction frame + fold-assignment frame
+    # (reference cross_validation_holdout_predictions_frame_id /
+    # cross_validation_fold_assignment_frame_id outputs)
+    if keep_preds:
+        if category == ModelCategory.MULTINOMIAL:
+            hcols = {f"p{k}": holdout[:, k].astype(np.float64)
+                     for k in range(holdout.shape[1])}
+            hcols = {"predict": holdout.argmax(axis=1).astype(np.float64),
+                     **hcols}
+        elif category == ModelCategory.BINOMIAL:
+            t = final.output.get("default_threshold", 0.5)
+            hcols = {"predict": (holdout >= t).astype(np.float64),
+                     "p0": (1.0 - holdout).astype(np.float64),
+                     "p1": holdout.astype(np.float64)}
+        else:
+            hcols = {"predict": holdout.astype(np.float64)}
+        hf = Frame.from_numpy(hcols)
+        final.output["cv_holdout_frame_key"] = hf.key
+    else:
+        final.output["cv_holdout_frame_key"] = None
+    if p.get("keep_cross_validation_fold_assignment"):
+        faf = Frame.from_numpy({"fold_assignment":
+                                folds.astype(np.float64)})
+        final.output["cv_fold_assignment_key"] = faf.key
+    else:
+        final.output["cv_fold_assignment_key"] = None
     final.output["cv_holdout_predictions"] = None
     final.output["cv_predictions_keys"] = cv_pred_keys or None
     final.output["nfolds"] = nfolds

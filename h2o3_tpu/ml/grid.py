@@ -27,6 +27,38 @@ _ASC = {"logloss", "rmse", "mse", "mae", "mean_per_class_error",
         "mean_residual_deviance", "error_rate", "rmsle"}
 
 
+def stop_early_windowed(scores: List[float], k: int, tol: float,
+                        less_is_better: bool) -> bool:
+    """ScoreKeeper.stopEarly (hex/ScoreKeeper.java:278): k+1 simple
+    moving averages of window k over the last 2k scores (the first
+    score is excluded from the length requirement), converged when the
+    best new window fails to improve on the reference window by the
+    relative tolerance. Reproduces the reference's exact model counts
+    (e.g. 2k+1 models for an immediately-flat random grid)."""
+    if k <= 0 or len(scores) - 1 < 2 * k:
+        return False
+    mov = []
+    for i in range(k + 1):
+        start = len(scores) - 2 * k + i
+        m = float(np.mean(scores[start:start + k]))
+        if np.isnan(m):
+            return False
+        mov.append(m)
+    last_before, rest = mov[0], mov[1:]
+    mn, mx = min(rest), max(rest)
+    if less_is_better and last_before == 0.0:
+        return True                    # converged to the lower bound
+    if np.sign(max(mov)) != np.sign(min(mov)):
+        return False                   # zero crossing — not converged
+    extreme = mn if less_is_better else mx
+    if np.sign(extreme) != np.sign(last_before):
+        return False
+    ratio = extreme / last_before
+    if np.isnan(ratio):
+        return False
+    return (ratio >= 1 - tol) if less_is_better else (ratio <= 1 + tol)
+
+
 def sort_value(model, metric: str):
     mmx = model.default_metrics
     d = mmx.to_dict() if hasattr(mmx, "to_dict") else dict(mmx or {})
@@ -151,8 +183,7 @@ class GridSearch:
         stop_rounds = int(self.criteria.get("stopping_rounds", 0) or 0)
         stop_tol = float(self.criteria.get("stopping_tolerance", 1e-3)
                          or 1e-3)
-        from h2o3_tpu.models.model import EarlyStopper
-        stopper = EarlyStopper(stop_rounds, stop_tol)
+        stop_scores: List[float] = []
         t0 = time.time()
         models = list(_prior_models or [])
         failures: List[dict] = []
@@ -173,15 +204,17 @@ class GridSearch:
                 models.append(m)
                 if self.recovery_dir:
                     self._snapshot(m, combo, done, y, x)
-                if stopper.enabled:
-                    # asymptotic stopping over the walk's best metric
-                    # (HyperSpaceWalker stopping criteria)
+                if stop_rounds > 0:
+                    # asymptotic stopping over the walk's metric history
+                    # (HyperSpaceWalker → ScoreKeeper.stopEarly windows)
                     sm = (self.criteria.get("sort_metric")
                           or default_sort_metric(m))
                     v = sort_value(m, sm)
                     if v is not None:
-                        asc = sm.lower() in _ASC
-                        if stopper.should_stop(v if asc else -v):
+                        stop_scores.append(float(v))
+                        if stop_early_windowed(stop_scores, stop_rounds,
+                                               stop_tol,
+                                               sm.lower() in _ASC):
                             log.info("grid stopping criteria met after "
                                      "%d models", len(models))
                             break

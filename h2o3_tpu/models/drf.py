@@ -33,8 +33,8 @@ from h2o3_tpu.frame.frame import Frame
 from h2o3_tpu.models import metrics as mm
 from h2o3_tpu.models.model import (Model, ModelBuilder, ModelCategory,
                                    adapt_domain, infer_category)
-from h2o3_tpu.models.tree import (Tree, TreeParams, grow_tree, predict_forest,
-                                  stack_trees)
+from h2o3_tpu.models.tree import (Tree, TreeParams, exact_f32_for,
+                                  grow_tree, predict_forest, stack_trees)
 from h2o3_tpu.parallel.mesh import get_mesh, row_sharding
 from h2o3_tpu.utils.log import get_logger
 
@@ -146,7 +146,9 @@ class DRFModel(Model):
         from h2o3_tpu.ml.shap import contributions_frame
         return contributions_frame(self, frame, scale=1.0 / self.ntrees)
 
-    def model_performance(self, frame: Frame):
+    def model_performance(self, frame: Frame, mask_weights=None):
+        """``mask_weights``: see GBMModel.model_performance (CV fast
+        path holdout metrics on the parent frame)."""
         y = self.output["response"]
         bm = rebin_for_scoring(self.bm, frame)
         w = frame.valid_weights()
@@ -154,6 +156,8 @@ class DRFModel(Model):
         if wc and wc in frame:
             v = frame.col(wc).numeric_view()
             w = w * jnp.where(jnp.isnan(v), 0.0, v)
+        if mask_weights is not None:
+            w = w * jnp.asarray(mask_weights, jnp.float32)
         cat = self.output["category"]
         if cat == ModelCategory.REGRESSION:
             yv = frame.col(y).numeric_view()
@@ -178,6 +182,8 @@ class DRFModel(Model):
 class DRFEstimator(ModelBuilder):
     """h2o-py H2ORandomForestEstimator-compatible surface."""
 
+    cv_fold_masking = True   # ml/cv.py fast path: folds = masked weights
+
     algo = "drf"
 
     DEFAULTS = dict(
@@ -185,6 +191,9 @@ class DRFEstimator(ModelBuilder):
         mtries=-1, sample_rate=0.632, col_sample_rate_per_tree=1.0,
         min_split_improvement=1e-5, seed=-1, nfolds=0,
         weights_column=None, fold_column=None, fold_assignment="auto",
+        keep_cross_validation_models=True,
+        keep_cross_validation_predictions=False,
+        keep_cross_validation_fold_assignment=False,
         ignored_columns=None, stopping_rounds=0, stopping_metric="auto",
         stopping_tolerance=1e-3, binomial_double_trees=False,
         distribution="auto", calibrate_model=False,
@@ -212,13 +221,18 @@ class DRFEstimator(ModelBuilder):
         if p.get("weights_column"):
             wc = frame.col(p["weights_column"]).numeric_view()
             w = w * jnp.where(jnp.isnan(wc), 0.0, wc)
+        w = self._cv_masked_weights(w, frame)
         rc = frame.col(y)
-        resp_na = _fetch_np(rc.na_mask)[: frame.nrows]
-        if resp_na.any():
+        resp_na = _fetch_np(rc.na_mask)            # padded length, like w
+        if resp_na[: frame.nrows].any():
             w = w * jnp.asarray((~resp_na).astype(np.float32))
-        bm = bin_frame(frame, x, nbins=p["nbins"],
-                       nbins_cats=p["nbins_cats"], histogram_type=ht,
-                       weights=_fetch_np(w)[: frame.nrows])
+        shared_bm = getattr(self, "_cv_shared_bm", None)
+        if shared_bm is not None:
+            bm = shared_bm
+        else:
+            bm = bin_frame(frame, x, nbins=p["nbins"],
+                           nbins_cats=p["nbins_cats"], histogram_type=ht,
+                           weights=_fetch_np(w)[: frame.nrows])
 
         depth = int(p["max_depth"])
         # complete-tree layout: a level costs 2^d histogram node slots
@@ -243,13 +257,17 @@ class DRFEstimator(ModelBuilder):
                       else max(1, F // 3))
         elif mtries <= 0:
             mtries = F
+        w, w_scale = self._normalize_uniform_weights(w, frame)
+
         tp = TreeParams(
-            max_depth=depth, min_rows=float(p["min_rows"]), learn_rate=1.0,
-            reg_lambda=0.0,
-            min_split_improvement=float(p["min_split_improvement"]),
+            max_depth=depth, min_rows=float(p["min_rows"]) / w_scale,
+            learn_rate=1.0, reg_lambda=0.0,
+            min_split_improvement=float(p["min_split_improvement"])
+            / w_scale,
             col_sample_rate=float(p["col_sample_rate_per_tree"]),
             nbins_total=bm.nbins_total,
-            cat_feats=tuple(bool(v) for v in bm.is_cat))
+            cat_feats=tuple(bool(v) for v in bm.is_cat),
+            exact_f32=exact_f32_for(bm))
 
         # target matrix ys [Npad, K]: indicators for classification
         N = bm.bins.shape[0]
@@ -260,7 +278,7 @@ class DRFEstimator(ModelBuilder):
             y_int = None
         else:
             codes = _fetch_np(rc.data)[: frame.nrows].astype(np.int32)
-            codes[resp_na] = 0
+            codes[resp_na[: frame.nrows]] = 0
             codes = np.pad(codes, (0, N - frame.nrows))
             K = 1 if category == ModelCategory.BINOMIAL else rc.cardinality
             if K == 1:

@@ -32,8 +32,9 @@ from h2o3_tpu.models import metrics as mm
 from h2o3_tpu.models.distribution import Distribution, get_distribution
 from h2o3_tpu.models.model import (Model, ModelBuilder, ModelCategory,
                                    infer_category)
-from h2o3_tpu.models.tree import (Tree, TreeParams, TreeScalars, grow_tree,
-                                  predict_forest, predict_tree, stack_trees)
+from h2o3_tpu.models.tree import (Tree, TreeParams, TreeScalars,
+                                  exact_f32_for, grow_tree, predict_forest,
+                                  predict_tree, stack_trees)
 from h2o3_tpu.parallel.mesh import (get_mesh, put_sharded,
                                     row_sharding)
 
@@ -223,7 +224,8 @@ def _neutral_tp(tp: TreeParams) -> TreeParams:
                       min_split_improvement=0.0, col_sample_rate=1.0,
                       nbins_total=tp.nbins_total,
                       block_rows=tp.block_rows,
-                      cat_feats=tp.cat_feats)
+                      cat_feats=tp.cat_feats,
+                      exact_f32=tp.exact_f32)   # static: changes the program
 
 
 def _boost_step_impl(bins, nb, y, w, margin, key, knobs, *, tp, dist,
@@ -442,7 +444,10 @@ class GBMModel(Model):
                              "(got Multinomial)")
         return contributions_frame(self, frame, bias_offset=float(self.f0))
 
-    def model_performance(self, frame: Frame):
+    def model_performance(self, frame: Frame, mask_weights=None):
+        """``mask_weights`` (padded [nrows_padded] float) restricts the
+        metric pass to a row subset — the CV fast path scores fold
+        holdouts on the parent frame without building a subset frame."""
         y = self.output["response"]
         bm = rebin_for_scoring(self.bm, frame)
         marg = self._margins(bm, self._frame_offset(frame,
@@ -452,6 +457,8 @@ class GBMModel(Model):
         if wc_name and wc_name in frame:
             wc = frame.col(wc_name).numeric_view()
             w = w * jnp.where(jnp.isnan(wc), 0.0, wc)
+        if mask_weights is not None:
+            w = w * jnp.asarray(mask_weights, jnp.float32)
         cat = self.output["category"]
         if cat in (ModelCategory.BINOMIAL, ModelCategory.MULTINOMIAL):
             from h2o3_tpu.models.model import adapt_domain
@@ -484,6 +491,7 @@ class GBMEstimator(ModelBuilder):
     (h2o-py/h2o/estimators/gbm.py)."""
 
     algo = "gbm"
+    cv_fold_masking = True   # ml/cv.py fast path: folds = masked weights
 
     DEFAULTS = dict(
         ntrees=50, max_depth=5, min_rows=10.0, learn_rate=0.1,
@@ -557,6 +565,7 @@ class GBMEstimator(ModelBuilder):
         if p.get("weights_column"):
             wc = frame.col(p["weights_column"]).numeric_view()
             w = w * jnp.where(jnp.isnan(wc), 0.0, wc)
+        w = self._cv_masked_weights(w, frame)
         # rows with a missing response are excluded from training and
         # training metrics (reference ModelBuilder drops them)
         rc = frame.col(y)
@@ -571,8 +580,15 @@ class GBMEstimator(ModelBuilder):
         if resp_na[: frame.nrows].any():
             w = w * jnp.asarray((~resp_na).astype(np.float32))
 
+        shared_bm = getattr(self, "_cv_shared_bm", None)
         if ckpt is not None:
             bm = rebin_for_scoring(ckpt.bm, frame)
+        elif shared_bm is not None:
+            # CV fold models reuse the main model's full-data bin edges
+            # (deliberate: per-fold edge re-sketches cost more than the
+            # sketch approximation is worth; the histogram is adaptive
+            # per node anyway)
+            bm = shared_bm
         else:
             # weighted edges: the row-weight ≡ row-multiplicity contract
             # (pyunit_weights_gbm) must hold through the bin sketch too
@@ -580,14 +596,19 @@ class GBMEstimator(ModelBuilder):
                            nbins_cats=p["nbins_cats"],
                            weights=_fetch_np(w)[: frame.nrows])
 
+        w, w_scale = self._normalize_uniform_weights(w, frame)
+
         tp = TreeParams(
-            max_depth=int(p["max_depth"]), min_rows=float(p["min_rows"]),
+            max_depth=int(p["max_depth"]),
+            min_rows=float(p["min_rows"]) / w_scale,
             learn_rate=float(p["learn_rate"]),
-            reg_lambda=float(p["reg_lambda"]),
-            min_split_improvement=float(p["min_split_improvement"]),
+            reg_lambda=float(p["reg_lambda"]) / w_scale,
+            min_split_improvement=float(p["min_split_improvement"])
+            / w_scale,
             col_sample_rate=float(p["col_sample_rate_per_tree"]),
             nbins_total=bm.nbins_total,
-            cat_feats=tuple(bool(v) for v in bm.is_cat))
+            cat_feats=tuple(bool(v) for v in bm.is_cat),
+            exact_f32=exact_f32_for(bm))
 
         # monotone constraints (GBM.java monotone_constraints; numeric
         # features only, like the reference's validation)

@@ -511,7 +511,9 @@ class GLMModel(Model):
                     "p0": 1.0 - mu, "p1": mu}
         return {"predict": mu}
 
-    def model_performance(self, frame: Frame):
+    def model_performance(self, frame: Frame, mask_weights=None):
+        """``mask_weights``: see GBMModel.model_performance (CV fast
+        path holdout metrics on the parent frame)."""
         y = self.output["response"]
         cat = self.output["category"]
         eta = self._eta(frame)
@@ -520,6 +522,8 @@ class GLMModel(Model):
         if wc_name and wc_name in frame:
             wc = frame.col(wc_name).numeric_view()
             w = w * jnp.where(jnp.isnan(wc), 0.0, wc)
+        if mask_weights is not None:
+            w = w * jnp.asarray(mask_weights, jnp.float32)
         npad = eta.shape[0]
         if cat == ModelCategory.BINOMIAL:
             yv = adapt_domain(frame.col(y), self.output["domain"])
@@ -583,6 +587,7 @@ class GLMEstimator(ModelBuilder):
     (h2o-py/h2o/estimators/glm.py)."""
 
     algo = "glm"
+    cv_fold_masking = True   # ml/cv.py fast path: folds = masked weights
 
     DEFAULTS = dict(
         family="auto", link=None, solver="auto", alpha=0.5,
@@ -599,6 +604,7 @@ class GLMEstimator(ModelBuilder):
         beta_constraints=None, non_negative=False, interactions=None,
         keep_cross_validation_models=True,
         keep_cross_validation_predictions=False,
+        keep_cross_validation_fold_assignment=False,
     )
 
     def __init__(self, **params):
@@ -774,6 +780,9 @@ class GLMEstimator(ModelBuilder):
         if p.get("weights_column"):
             wc = frame.col(p["weights_column"]).numeric_view()
             w = w * jnp.where(jnp.isnan(wc), 0.0, wc)
+        # (CV fast path: standardization stats stay full-frame, like
+        # the shared bin edges on the tree side)
+        w = self._cv_masked_weights(w, frame)
 
         # offset_column: fixed per-row addition to eta (GLM.java offset)
         off = None

@@ -177,10 +177,16 @@ class KMeansEstimator(ModelBuilder):
                 f"The sum of cluster_size_constraints ({sum(mins)}) "
                 f"exceeds the number of training rows "
                 f"({int(valid.sum())}).")
+        # the greedy margin rebalance is inherently sequential, so the
+        # whole loop runs on host from ONE device fetch: a per-iteration
+        # device round trip (the previous design) costs more than the
+        # entire iris-scale solve on a remote-attached chip
+        # (pyunit_constrained_kmeans trains 20 such models)
+        Xh = np.asarray(jax.device_get(X), np.float64)
+        ch = np.asarray(jax.device_get(centers), np.float64)
         assign = np.where(valid, 0, -1).astype(np.int64)
-        counts = jnp.zeros((k,), jnp.float32)
         for _ in range(max(iters, 1)):
-            d2 = np.asarray(_dist2(X, centers))
+            d2 = ((Xh[:, None, :] - ch[None, :, :]) ** 2).sum(axis=2)
             assign = d2.argmin(axis=1)
             assign[~valid] = -1
             # fill deficits: move rows with the smallest distance margin
@@ -200,21 +206,21 @@ class KMeansEstimator(ModelBuilder):
                         continue
                     assign[r] = c
                     deficit -= 1
-            # recompute centers on device from the (host) assignment
-            a_dev = jnp.asarray(np.maximum(assign, 0).astype(np.int32))
-            stats = segment_sum(
-                a_dev, jnp.concatenate(
-                    [X * w[:, None], w[:, None]], axis=1),
-                n_nodes=k, mesh=get_mesh())
-            counts = stats[:, -1]
-            centers = stats[:, :-1] / jnp.maximum(counts[:, None], 1e-12)
-        d2 = np.asarray(_dist2(X, centers))
+            for c in range(k):
+                sel = (assign == c)
+                tot = wn[sel].sum()
+                if tot > 0:
+                    ch[c] = (Xh[sel] * wn[sel, None]).sum(axis=0) / tot
+        d2 = ((Xh[:, None, :] - ch[None, :, :]) ** 2).sum(axis=2)
         wss = np.zeros(k)
+        counts = np.zeros(k, np.float32)
         for c in range(k):
             sel = assign == c
             wss[c] = float((d2[sel, c] * wn[sel]).sum())
-        return (centers, jnp.asarray(np.maximum(assign, 0)),
-                counts, jnp.asarray(wss))
+            counts[c] = wn[sel].sum()
+        return (jnp.asarray(ch, jnp.float32),
+                jnp.asarray(np.maximum(assign, 0)),
+                jnp.asarray(counts), jnp.asarray(wss))
 
     def _run_lloyds(self, X, w, k, init, key, iters):
         centers = _init_centers(X, w, k, init, key)

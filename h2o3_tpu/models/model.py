@@ -143,14 +143,19 @@ class Model:
         format="native" (default): the npz fast path our offline
         readers consume. format="reference": the reference MOJO zip
         layout (model.ini + domains/ + SharedTreeMojoModel v1.40 tree
-        blobs) so the reference genmodel runtime can score the model —
-        tree algorithms (GBM/DRF) only.
+        blobs; GlmMojoReader v1.00 kv block for GLM) so the reference
+        genmodel runtime can score the model — GBM/DRF/GLM.
         """
         if format == "reference":
+            if self.algo == "glm":
+                from h2o3_tpu.genmodel.refmojo import \
+                    write_reference_glm_mojo
+                return write_reference_glm_mojo(self, path)
             from h2o3_tpu.genmodel.refmojo import write_reference_mojo
             if self.algo not in ("gbm", "drf"):
-                raise ValueError("reference-format MOJO export supports "
-                                 f"GBM/DRF only (got {self.algo})")
+                raise ValueError(
+                    "reference-format MOJO export supports GBM/DRF/GLM "
+                    f"only (got {self.algo})")
             return write_reference_mojo(self, path)
         from h2o3_tpu.genmodel.export import mojo_artifacts
         from h2o3_tpu.genmodel.mojo import write_mojo
@@ -211,6 +216,34 @@ class ModelBuilder:
     def _fit(self, frame: Frame, x: Sequence[str], y: Optional[str],
              job: Job, validation_frame: Optional[Frame] = None) -> Model:
         raise NotImplementedError
+
+    # -- shared weight plumbing (one impl; GBM/DRF/GLM all use these) --
+    def _cv_masked_weights(self, w, frame: Frame):
+        """CV fast path (ml/cv.py): fold models train on the PARENT
+        frame with held-out rows weight-masked — no per-fold frame or
+        bin rebuild, one compiled program across folds."""
+        fold_mask = getattr(self, "_cv_fold_mask", None)
+        if fold_mask is None:
+            return w
+        import jax.numpy as jnp
+        fm = np.zeros(frame.nrows_padded, np.float32)
+        fm[: frame.nrows] = fold_mask.astype(np.float32)
+        return w * jnp.asarray(fm)
+
+    def _normalize_uniform_weights(self, w, frame: Frame):
+        """(w', scale): a constant weight column rescales to exactly 1.0
+        so 'uniform weights ≡ no weights' holds bit-for-bit
+        (pyunit_weights_gbm asserts 1e-5-relative metric equality, which
+        f32 rounding of w*k misses). Callers divide every ABSOLUTE
+        training threshold (min_rows, min_split_improvement,
+        reg_lambda) by the returned scale — that reproduces raw-weight
+        reference semantics exactly in real arithmetic."""
+        wf = _fetch_np(w)[: frame.nrows]
+        pos = wf[wf > 0]
+        if pos.size and pos.min() == pos.max() and float(pos[0]) != 1.0:
+            s = float(pos[0])
+            return w / s, s
+        return w, 1.0
 
     # -- public train --------------------------------------------------
     def resolve_x(self, frame: Frame, x: Optional[Sequence[str]],

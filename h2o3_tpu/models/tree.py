@@ -89,10 +89,22 @@ class TreeParams:
     cat_feats: tuple = ()            # per-feature is-categorical flags —
                                      # schema-static, activates the
                                      # sorted-prefix subset-split path
+    exact_f32: bool = False          # true-f32 histogram/leaf matmuls
+                                     # (vs TPU bf16x3) — small problems
+                                     # where pyunits assert 1e-5 metric
+                                     # equality; ~free at that scale
 
     @property
     def has_cats(self) -> bool:
         return any(self.cat_feats)
+
+
+def exact_f32_for(bm) -> bool:
+    """True-f32 matmul mode for pyunit-scale problems: TPU bf16x3
+    residue (~1e-5 relative) fails reference metric-equality
+    assertions, and below this size the MXU-rate trade is free."""
+    return (bm.bins.shape[0] * bm.bins.shape[1] * bm.nbins_total
+            <= (1 << 26))
 
 
 def row_feature_values(bins, f_r):
@@ -307,12 +319,14 @@ def grow_tree(bins, nb, w, g, h, col_mask, *, params: TreeParams, mesh,
     allowed = jnp.ones((1, F), bool)   # per-node feature set (interactions)
     pair_allow = None                  # lazy [F, F] compatibility matrix
 
+    prec = jax.lax.Precision.HIGHEST if params.exact_f32 else None
     prev_hist = None
     for d in range(D):
         L = 2 ** d
         if prev_hist is None:
             hist = histogram(bins, nid, w, g, h, n_nodes=L, n_bins=B,
-                             mesh=mesh, block_rows=params.block_rows)
+                             mesh=mesh, block_rows=params.block_rows,
+                             precision=prec)
         else:
             # sibling subtraction: histogram only the LEFT children (even
             # node slots), derive right = parent − left. Halves the
@@ -322,7 +336,8 @@ def grow_tree(bins, nb, w, g, h, col_mask, *, params: TreeParams, mesh,
             # hex/tree/ScoreBuildHistogram2.java).
             even = (nid % 2 == 0).astype(jnp.float32)
             lh = histogram(bins, nid >> 1, w * even, g, h, n_nodes=L // 2,
-                           n_bins=B, mesh=mesh, block_rows=params.block_rows)
+                           n_bins=B, mesh=mesh, block_rows=params.block_rows,
+                           precision=prec)
             rh = prev_hist - lh
             # f32 cancellation guard: w and h are nonnegative sums, so
             # clamp tiny negative residue (|err| ≲ parent·2^-23); g may
@@ -394,7 +409,7 @@ def grow_tree(bins, nb, w, g, h, col_mask, *, params: TreeParams, mesh,
     nleaf = 2 ** D
     stats = jnp.stack([w, w * g, w * h], axis=1)
     leaf_stats = segment_sum(nid, stats, n_nodes=nleaf, mesh=mesh,
-                             block_rows=params.block_rows)
+                             block_rows=params.block_rows, precision=prec)
     G, H = leaf_stats[:, 1], leaf_stats[:, 2]
     leaf = jnp.where(leaf_stats[:, 0] > 0,
                      -G / (H + sc.reg_lambda + 1e-10), 0.0)

@@ -17,7 +17,8 @@ from jax.sharding import PartitionSpec as P
 from h2o3_tpu.parallel.mesh import DATA_AXIS, MODEL_AXIS
 
 
-def _local_segment_sum(nid, vals, n_nodes: int, block_rows: int):
+def _local_segment_sum(nid, vals, n_nodes: int, block_rows: int,
+                       precision=None):
     N = nid.shape[0]
     K = vals.shape[1]
     C = min(block_rows, N)
@@ -34,7 +35,8 @@ def _local_segment_sum(nid, vals, n_nodes: int, block_rows: int):
         oh = (n[:, None] == jnp.arange(n_nodes, dtype=jnp.int32)[None, :])
         part = jax.lax.dot_general(
             oh.astype(jnp.float32).T, v.astype(jnp.float32),
-            (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+            (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32,
+            precision=precision)
         return acc + part, None
 
     init = jnp.zeros((n_nodes, K), jnp.float32)
@@ -42,7 +44,8 @@ def _local_segment_sum(nid, vals, n_nodes: int, block_rows: int):
     return acc
 
 
-def segment_sum(nid, vals, *, n_nodes: int, mesh, block_rows: int = 16384):
+def segment_sum(nid, vals, *, n_nodes: int, mesh, block_rows: int = 16384,
+                precision=None):
     """All-reduced per-node sums: vals [N, K] → [n_nodes, K].
 
     Rows with all-zero vals (padding) contribute nothing; nid must be in
@@ -60,7 +63,8 @@ def segment_sum(nid, vals, *, n_nodes: int, mesh, block_rows: int = 16384):
         in_specs=(P(DATA_AXIS), P(DATA_AXIS)),
         out_specs=P(), check_vma=False)
     def _task(nid_l, vals_l):
-        s = _local_segment_sum(nid_l, vals_l, n_nodes, block_rows)
+        s = _local_segment_sum(nid_l, vals_l, n_nodes, block_rows,
+                               precision=precision)
         return jax.lax.psum(s, DATA_AXIS)
 
     return _task(nid, vals)

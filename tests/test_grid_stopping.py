@@ -1,0 +1,58 @@
+"""Grid asymptotic stopping = ScoreKeeper.stopEarly window semantics.
+
+The reference stops a random grid after 2k+1 models when the metric is
+immediately flat (hex/ScoreKeeper.java:278: needs len-1 >= 2k scores,
+then compares k-window moving averages) — pyunit_benign_glm_grid pins
+len(models) == 5 for stopping_rounds=2, tolerance=0.1.
+"""
+
+import numpy as np
+
+import h2o3_tpu
+from h2o3_tpu.ml.grid import GridSearch, stop_early_windowed
+
+
+def test_window_semantics_flat_stops_at_2k_plus_1():
+    k, tol = 2, 0.1
+    scores = []
+    for i in range(10):
+        scores.append(0.75)                       # flat AUC
+        if stop_early_windowed(scores, k, tol, less_is_better=False):
+            break
+    assert len(scores) == 2 * k + 1
+
+
+def test_window_semantics_improving_does_not_stop():
+    k, tol = 2, 0.01
+    scores = []
+    for i in range(8):
+        scores.append(1.0 / (i + 1.0))            # logloss, 2x better each
+        assert not stop_early_windowed(scores, k, tol,
+                                       less_is_better=True)
+
+
+def test_window_semantics_needs_2k_history():
+    assert not stop_early_windowed([1.0, 1.0, 1.0, 1.0], 2, 0.1, True)
+    assert stop_early_windowed([1.0] * 5, 2, 0.1, True)
+
+
+def test_random_grid_flat_metric_trains_exactly_5_models():
+    r = np.random.RandomState(1)
+    n = 200
+    a, b = r.randn(n), r.randn(n)
+    y = (a + 0.2 * r.randn(n) > 0).astype(float)
+    fr = h2o3_tpu.Frame.from_numpy({"a": a, "b": b, "y": y},
+                                   categorical=["y"])
+    from h2o3_tpu.models.glm import GLMEstimator
+    gs = GridSearch(
+        GLMEstimator, {"alpha": [0.01, 0.3, 0.5],
+                       "lambda_": [1e-5, 1e-6, 1e-7, 1e-8]},
+        search_criteria={"strategy": "RandomDiscrete", "seed": 42,
+                         "stopping_metric": "AUTO",
+                         "stopping_tolerance": 0.1,
+                         "stopping_rounds": 2},
+        family="binomial")
+    grid = gs.train(fr, y="y")
+    # tiny lambdas are metric-indistinguishable ⇒ the window converges
+    # at the first legal check: exactly 2k+1 models (reference count)
+    assert len(grid.models) == 5, len(grid.models)

@@ -103,6 +103,56 @@ def test_gbm_multinomial_roundtrip(tmp_path):
     assert np.abs(pref - ours).max() < 1e-4
 
 
+@pytest.mark.parametrize("family", ["gaussian", "binomial", "poisson"])
+def test_glm_roundtrip(tmp_path, family):
+    from h2o3_tpu.models.glm import GLMEstimator
+    code, x1, x2, y, dom = _data(n=1200, seed=11, levels=12)
+    if family == "binomial":
+        yv = (y > 0).astype(float)
+    elif family == "poisson":
+        yv = np.floor(np.exp(np.clip(y, -2, 2))).astype(float)
+    else:
+        yv = y
+    fr = Frame.from_numpy({"c": code.astype(float), "x1": x1, "x2": x2,
+                           "y": yv},
+                          categorical=["c"] + (["y"] if family == "binomial"
+                                               else []))
+    m = GLMEstimator(family=family, lambda_=0.0).train(
+        fr, x=["c", "x1", "x2"], y="y")
+    p = str(tmp_path / "refglm.zip")
+    m.download_mojo(p, format="reference")
+    from h2o3_tpu.genmodel.refmojo import score_reference_glm_mojo
+    mu, info = score_reference_glm_mojo(p, _raw_rows(fr, code, x1, x2))
+    assert info["algo"] == "glm" and info["mojo_version"] == "1.00"
+    ours = (m.predict(fr).col("p1" if family == "binomial" else
+                              "predict").to_numpy())
+    assert np.abs(mu - ours).max() < 2e-4, np.abs(mu - ours).max()
+
+
+def test_glm_roundtrip_na_rows(tmp_path):
+    """NA categorical + NA numeric rows must score identically — the
+    cat_modes=cardinality sentinel reproduces the all-zero NA block."""
+    from h2o3_tpu.models.glm import GLMEstimator
+    code, x1, x2, y, dom = _data(n=800, seed=3, levels=8)
+    fr = Frame.from_numpy({"c": code.astype(float), "x1": x1, "x2": x2,
+                           "y": y}, categorical=["c"])
+    m = GLMEstimator(family="gaussian", lambda_=0.0).train(
+        fr, x=["c", "x1", "x2"], y="y")
+    p = str(tmp_path / "refglm.zip")
+    m.download_mojo(p, format="reference")
+    from h2o3_tpu.genmodel.refmojo import score_reference_glm_mojo
+    rows = _raw_rows(fr, code, x1, x2)
+    rows["c"] = rows["c"].copy()
+    rows["c"][::7] = None                       # NA categorical
+    codes_na = code.astype(float).copy()
+    codes_na[::7] = np.nan
+    fr2 = Frame.from_numpy({"c": codes_na, "x1": x1, "x2": x2, "y": y},
+                           categorical=["c"])
+    mu, _ = score_reference_glm_mojo(p, rows)
+    ours = m.predict(fr2).col("predict").to_numpy()
+    assert np.abs(mu - ours).max() < 2e-4, np.abs(mu - ours).max()
+
+
 def test_drf_roundtrip(tmp_path):
     code, x1, x2, y, dom = _data(seed=9)
     fr = _frame(code, x1, x2, y)
