@@ -237,14 +237,16 @@ def gen_munging_files(sd: str) -> None:
                 f.write(",".join(padded) + "\n")
     p = os.path.join(sd, "junit/names.csv")
     if not os.path.exists(p):
+        # pyunit_length contract: name1 (UTF), name2 (ASCII), numeric;
+        # first three rows have nchar 4, 3, 4 in both name columns
         rng = np.random.RandomState(9)
-        firsts = ["ann", "bob", "carol", "dave", "erin", "frank"]
-        lasts = ["smith", "jones", "lee", "brown", "davis"]
-        with open(p, "w") as f:
-            f.write("name,string_lengths\n")
-            for _ in range(100):
-                nm = (firsts[rng.randint(6)] + " " + lasts[rng.randint(5)])
-                f.write(f"{nm},{len(nm)}\n")
+        utf = ["ánna", "bób", "cárl", "dóra", "érin", "fráu"]
+        ascii_ = ["anna", "bob", "carl", "dora", "erin", "fran"]
+        with open(p, "w", encoding="utf-8") as f:
+            f.write("name1,name2,string_lengths\n")
+            for i in range(100):
+                j = i % 6 if i >= 3 else i
+                f.write(f"{utf[j]},{ascii_[j]},{len(ascii_[j])}\n")
     # prostate with injected NAs (prostate_missing / prostate_NA roles)
     psrc = os.path.join(sd, "prostate/prostate.csv")
     if os.path.exists(psrc):
@@ -280,6 +282,91 @@ def gen_munging_files(sd: str) -> None:
             f.writelines(ln for i, ln in enumerate(irows) if sel[i])
 
 
+def gen_jira_files(sd: str) -> None:
+    """pub-180.csv (12x4, pyunit_cbind asserts names/dims) + v-11.csv
+    (different row count, used as the unequal-rows cbind failure)."""
+    r = np.random.RandomState(18)
+    n = 12
+    _write_csv(os.path.join(sd, "jira/pub-180.csv"),
+               ["colgroup", "colgroup2", "col1", "col2"],
+               [r.randint(0, 5, n), r.randint(0, 5, n),
+                r.randint(0, 10, n), r.randint(0, 10, n)])
+    m = 11
+    _write_csv(os.path.join(sd, "jira/v-11.csv"),
+               ["vcol1", "vcol2"],
+               [r.randint(0, 9, m), np.round(r.rand(m), 3)])
+
+
+def gen_chicago_crimes(sd: str) -> None:
+    """chicagoCrimes10k.csv.zip: a Date column in the real data's
+    'MM/dd/yyyy hh:mm:ss a' format (pyunit_count_temps date munging)."""
+    import zipfile
+    path = os.path.join(sd, "chicago/chicagoCrimes10k.csv.zip")
+    if os.path.exists(path):
+        return
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    r = np.random.RandomState(23)
+    n = 10_000
+    mo = r.randint(1, 13, n)
+    day = r.randint(1, 29, n)
+    hr12 = r.randint(1, 13, n)
+    mi = r.randint(0, 60, n)
+    se = r.randint(0, 60, n)
+    ampm = np.where(r.rand(n) < 0.5, "AM", "PM")
+    dates = [f"{mo[i]:02d}/{day[i]:02d}/2015 "
+             f"{hr12[i]:02d}:{mi[i]:02d}:{se[i]:02d} {ampm[i]}"
+             for i in range(n)]
+    ptype = r.choice(["THEFT", "BATTERY", "NARCOTICS", "ASSAULT"], n)
+    arrest = r.choice(["true", "false"], n)
+    rows = ["ID,Date,Primary Type,Arrest,Beat"]
+    rows += [f"{100000 + i},{dates[i]},{ptype[i]},{arrest[i]},"
+             f"{r.randint(111, 2535)}" for i in range(n)]
+    with zipfile.ZipFile(path, "w", zipfile.ZIP_DEFLATED) as z:
+        z.writestr("chicagoCrimes10k.csv", "\n".join(rows) + "\n")
+
+
+def gen_allyears2k(sd: str) -> None:
+    """allyears2k.zip: airlines-schema zip (pyunit_frame_show only
+    displays it — schema-compatible sample, 2000 rows)."""
+    import zipfile
+    path = os.path.join(sd, "airlines/allyears2k.zip")
+    if os.path.exists(path):
+        return
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    r = np.random.RandomState(2000)
+    n = 2000
+    carriers = ["UA", "AA", "DL", "WN", "US", "NW"]
+    rows = ["Year,Month,DayofMonth,DayOfWeek,DepTime,UniqueCarrier,"
+            "Origin,Dest,Distance,IsDepDelayed"]
+    for i in range(n):
+        rows.append(
+            f"{r.randint(1987, 2009)},{r.randint(1, 13)},"
+            f"{r.randint(1, 29)},{r.randint(1, 8)},{r.randint(0, 2400)},"
+            f"{carriers[r.randint(0, len(carriers))]},"
+            f"ORD,SFO,{r.randint(100, 2500)},"
+            f"{'YES' if r.rand() < 0.5 else 'NO'}")
+    with zipfile.ZipFile(path, "w", zipfile.ZIP_DEFLATED) as z:
+        z.writestr("allyears2k.csv", "\n".join(rows) + "\n")
+
+
+def gen_small_int_floats(sd: str) -> None:
+    """smallIntFloats.csv.zip: two numeric columns with ties (the
+    property-checked descending/ascending sort pyunit)."""
+    import zipfile
+    path = os.path.join(sd, "synthetic/smallIntFloats.csv.zip")
+    if os.path.exists(path):
+        return
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    r = np.random.RandomState(44)
+    n = 5000
+    a = r.randint(-50, 50, n)
+    b = np.round(r.randn(n) * 100, 4)
+    rows = ["IntCol,FloatCol"]
+    rows += [f"{a[i]},{b[i]}" for i in range(n)]
+    with zipfile.ZipFile(path, "w", zipfile.ZIP_DEFLATED) as z:
+        z.writestr("smallIntFloats.csv", "\n".join(rows) + "\n")
+
+
 def generate_all(sd: str) -> None:
     gen_cars(sd)
     gen_benign(sd)
@@ -290,3 +377,7 @@ def generate_all(sd: str) -> None:
     gen_prostate_complete(sd)
     gen_airlines_train_test(sd)
     gen_munging_files(sd)
+    gen_jira_files(sd)
+    gen_chicago_crimes(sd)
+    gen_allyears2k(sd)
+    gen_small_int_floats(sd)

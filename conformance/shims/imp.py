@@ -19,3 +19,12 @@ def load_source(name, path):
 def new_module(name):
     import types
     return types.ModuleType(name)
+
+
+def find_module(name, path=None):
+    """utilsPY.py:350 probes numpy availability; mimic the old
+    contract: raise ImportError when absent, return a truthy spec."""
+    spec = importlib.util.find_spec(name)
+    if spec is None:
+        raise ImportError(f"No module named {name!r}")
+    return spec

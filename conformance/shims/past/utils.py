@@ -1,0 +1,12 @@
+"""`past.utils` shim (python-future, removed): only old_div is used
+by the reference pyunits (e.g. testdir_munging/pyunit_ifelse.py)."""
+
+
+def old_div(a, b):
+    """Py2 `/` semantics: floor division for two ints, true division
+    otherwise — including elementwise objects like H2OFrame, whose
+    __div__/__floordiv__ operators the expression layer provides."""
+    import numbers
+    if isinstance(a, numbers.Integral) and isinstance(b, numbers.Integral):
+        return a // b
+    return a / b

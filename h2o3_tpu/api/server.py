@@ -105,14 +105,17 @@ def _col_json(fr: Frame, name: str, row_offset: int, rows: int,
         domain = list(c.domain or [])
         codes = _fetch_np(c.data)[lo:hi].astype(np.int64)
         na = _fetch_np(c.na_mask)[lo:hi]
-        data = [None if m else int(v) for v, m in zip(codes, na)]
+        # NA cells ride as JSON NaN (json.dumps allow_nan): the client
+        # probes math.isnan(cell) before indexing the domain
+        # (h2o-py/h2o/expr.py:416 _tabulate) — None breaks it
+        data = [float("nan") if m else int(v) for v, m in zip(codes, na)]
     else:
         vals = np.asarray(c.to_numpy()[lo:hi], np.float64)
         if wire_type == "real" and vals.size and \
                 np.all(np.isnan(vals) | (vals == np.round(vals))) and \
                 np.nanmax(np.abs(vals), initial=0) < 2**53:
             wire_type = "int"
-        data = [None if np.isnan(v) else
+        data = [float("nan") if np.isnan(v) else
                 (int(v) if wire_type in ("int", "time") else float(v))
                 for v in vals]
     try:
@@ -788,7 +791,7 @@ def _create_frame(params, body):
     job = Job("create frame", dest=dest)
 
     def _run(j):
-        arrays, cats, strs = {}, [], []
+        arrays, cats, strs, times = {}, [], [], []
         ci = 0
         for kind, cnt in counts.items():
             for _ in range(cnt):
@@ -808,6 +811,7 @@ def _create_frame(params, body):
                 elif kind == "time":
                     arrays[name] = r.randint(0, 2 ** 40,
                                              rows).astype(np.float64)
+                    times.append(name)
                 elif kind == "str":
                     arrays[name] = np.array(
                         [f"s{v}" for v in r.randint(0, 10 ** 6, rows)],
@@ -833,7 +837,7 @@ def _create_frame(params, body):
                     [f"resp.l{v}" for v in r.randint(0, rf, rows)], object)
                 cats.append("response")
         fr = Frame.from_numpy(arrays, categorical=cats, strings=strs,
-                              key=dest)
+                              times=times, key=dest)
         DKV.put(dest, fr)
         j.update(1.0)
         return fr

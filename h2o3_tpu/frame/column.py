@@ -108,7 +108,8 @@ def prefetch_host(cols: List["Column"]) -> None:
 
 
 def column_from_numpy(name: str, values: np.ndarray, nrows_padded: int,
-                      sharding, domain: Optional[List[str]] = None) -> Column:
+                      sharding, domain: Optional[List[str]] = None,
+                      time: bool = False) -> Column:
     """Build a Column from host data, narrowing dtype (codec selection).
 
     The reference picks a Chunk codec per 1K-1M-element chunk
@@ -158,8 +159,23 @@ def column_from_numpy(name: str, values: np.ndarray, nrows_padded: int,
     data = np.pad(data, (0, pad))
     na = np.pad(na, (0, pad), constant_values=True)  # padding rows are NA
     from h2o3_tpu.parallel.mesh import put_sharded
-    return Column(
+    if time and ctype == T_NUM:
+        # Vec.T_TIME: epoch millis. Device storage remains f32 (x64 is
+        # off under jit — int64 would silently truncate to int32), so
+        # device math on times is ~65-131s-granular; all host paths
+        # (rapids time ops, downloads) read the exact f64 cache below.
+        ctype = T_TIME
+    col = Column(
         name=name, type=ctype,
         data=put_sharded(data, sharding),
         na_mask=put_sharded(na, sharding),
         nrows=n, domain=domain)
+    if ctype in (T_NUM, T_TIME) and data.dtype == np.float32:
+        # seed the host cache with the ORIGINAL float64 values: the
+        # munging/metadata path (rapids reducers, quantiles, mmult)
+        # then matches f64 oracles exactly, while the device keeps the
+        # f32 math-path copy. Same layout to_numpy would build.
+        host64 = vals64.copy()
+        host64[na[:n]] = np.nan
+        object.__setattr__(col, "_host_cache", host64)
+    return col

@@ -40,6 +40,7 @@ class Frame:
                    domains: Optional[Dict[str, List[str]]] = None,
                    strings: Sequence[str] = (),
                    uuids: Sequence[str] = (),
+                   times: Sequence[str] = (),
                    key: Optional[str] = None,
                    block: int = 8,
                    pad_to: Optional[int] = None) -> "Frame":
@@ -74,7 +75,9 @@ class Frame:
                 import pandas as pd
                 codes, uniques = pd.factorize(v, sort=True)
                 dom, v = [str(u) for u in uniques], codes.astype(np.int32)
-            cols.append(column_from_numpy(name, v, npad, shard, domain=dom))
+            cols.append(column_from_numpy(name, v, npad, shard,
+                                          domain=dom,
+                                          time=name in times))
         return Frame(cols, n, key=key)
 
     def rename_columns(self, new_names) -> "Frame":

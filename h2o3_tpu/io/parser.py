@@ -236,8 +236,12 @@ def _import_file_eager(path: str, destination_frame: Optional[str] = None,
                     cats.remove(name)
                     domains.pop(name, None)
                     uuid_cols.append(name)
+            str_cols = [c for c, t in (col_types or {}).items()
+                        if t == "string" and c in cols
+                        and np.asarray(cols[c]).dtype == object]
             fr = Frame.from_numpy(cols, categorical=cats, domains=domains,
-                                  uuids=uuid_cols, key=destination_frame)
+                                  strings=str_cols, uuids=uuid_cols,
+                                  key=destination_frame)
             log.info("parsed %s (native) -> %s (%d x %d)", path, fr.key,
                      fr.nrows, fr.ncols)
             return fr
@@ -337,6 +341,14 @@ def _parse_csv_native(paths: List[str],
             codes, uniq = pd.factorize(strs, sort=True)
             merged[c] = codes.astype(np.int32)
             domains[c] = [str(u) for u in uniq]
+        elif t == "string" and c in domains:
+            # client forced a string column the sniffer typed enum
+            # (H2OFrame column_types={"D": "string"} — pyunit_isna)
+            dom = domains.pop(c)
+            lut = np.asarray([str(s) for s in dom], dtype=object)
+            codes = merged[c]
+            merged[c] = np.asarray(
+                [lut[k] if k >= 0 else None for k in codes], dtype=object)
         elif t in ("numeric", "real", "int") and c in domains:
             dom = np.asarray(domains.pop(c))
 

@@ -223,16 +223,19 @@ class DRFEstimator(ModelBuilder):
             w = w * jnp.where(jnp.isnan(wc), 0.0, wc)
         w = self._cv_masked_weights(w, frame)
         rc = frame.col(y)
-        resp_na = _fetch_np(rc.na_mask)            # padded length, like w
-        if resp_na[: frame.nrows].any():
-            w = w * jnp.asarray((~resp_na).astype(np.float32))
+        wh_host = self._host_weights(frame, y)     # host mirror of w
+        resp_na_host = np.isnan(rc.to_numpy())
+        if resp_na_host.any():
+            w = w * jnp.asarray(np.pad(
+                (~resp_na_host).astype(np.float32),
+                (0, frame.nrows_padded - frame.nrows)))
         shared_bm = getattr(self, "_cv_shared_bm", None)
         if shared_bm is not None:
             bm = shared_bm
         else:
             bm = bin_frame(frame, x, nbins=p["nbins"],
                            nbins_cats=p["nbins_cats"], histogram_type=ht,
-                           weights=_fetch_np(w)[: frame.nrows])
+                           weights=wh_host)
 
         depth = int(p["max_depth"])
         # complete-tree layout: a level costs 2^d histogram node slots
@@ -257,7 +260,7 @@ class DRFEstimator(ModelBuilder):
                       else max(1, F // 3))
         elif mtries <= 0:
             mtries = F
-        w, w_scale = self._normalize_uniform_weights(w, frame)
+        w, w_scale = self._normalize_uniform_weights(w, wh_host)
 
         tp = TreeParams(
             max_depth=depth, min_rows=float(p["min_rows"]) / w_scale,
@@ -277,8 +280,7 @@ class DRFEstimator(ModelBuilder):
             ys = np.pad(yv, (0, N - frame.nrows))[:, None]
             y_int = None
         else:
-            codes = _fetch_np(rc.data)[: frame.nrows].astype(np.int32)
-            codes[resp_na[: frame.nrows]] = 0
+            codes = np.nan_to_num(rc.to_numpy()).astype(np.int32)  # host
             codes = np.pad(codes, (0, N - frame.nrows))
             K = 1 if category == ModelCategory.BINOMIAL else rc.cardinality
             if K == 1:

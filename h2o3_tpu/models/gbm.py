@@ -537,6 +537,9 @@ class GBMEstimator(ModelBuilder):
         mesh = get_mesh()
         category = infer_category(frame, y)
         dist_name = self._resolve_distribution(category)
+        # light mode (ml/cv.py large-nfolds folds): skip varimp/metric
+        # device syncs — leave-one-out CV pays per-fold for each one
+        light = bool(getattr(self, "_cv_light", False))
 
         # checkpoint restart (SharedTree _checkpoint via
         # hex/util/CheckpointUtils + ReconstructTreeState): reuse the
@@ -561,6 +564,10 @@ class GBMEstimator(ModelBuilder):
                     "distribution cannot change across checkpoint restart "
                     f"({ckpt.dist_name} vs {dist_name})")
 
+        # device weights + an equal HOST mirror (_host_weights): every
+        # host-side consumer (bin sketch, init means, priors) reads the
+        # mirror instead of syncing the device — a CV sweep calls _fit
+        # once per fold, and per-fold fetches dominate leave-one-out CV
         w = frame.valid_weights()
         if p.get("weights_column"):
             wc = frame.col(p["weights_column"]).numeric_view()
@@ -576,9 +583,12 @@ class GBMEstimator(ModelBuilder):
                 raise ValueError(
                     "Response cannot be constant - check your response "
                     "column, or set check_constant_response=False")
-        resp_na = _fetch_np(rc.na_mask)
-        if resp_na[: frame.nrows].any():
-            w = w * jnp.asarray((~resp_na).astype(np.float32))
+        wh_host = self._host_weights(frame, y)
+        resp_na_host = np.isnan(rc.to_numpy())   # cached host view
+        if resp_na_host.any():
+            w = w * jnp.asarray(np.pad(
+                (~resp_na_host).astype(np.float32),
+                (0, frame.nrows_padded - frame.nrows)))
 
         shared_bm = getattr(self, "_cv_shared_bm", None)
         if ckpt is not None:
@@ -593,10 +603,11 @@ class GBMEstimator(ModelBuilder):
             # weighted edges: the row-weight ≡ row-multiplicity contract
             # (pyunit_weights_gbm) must hold through the bin sketch too
             bm = bin_frame(frame, x, nbins=p["nbins"],
-                           nbins_cats=p["nbins_cats"],
-                           weights=_fetch_np(w)[: frame.nrows])
+                           nbins_cats=p["nbins_cats"], weights=wh_host)
 
-        w, w_scale = self._normalize_uniform_weights(w, frame)
+        w, w_scale = self._normalize_uniform_weights(w, wh_host)
+        if w_scale != 1.0:
+            wh_host = wh_host / np.float32(w_scale)
 
         tp = TreeParams(
             max_depth=int(p["max_depth"]),
@@ -703,14 +714,12 @@ class GBMEstimator(ModelBuilder):
         if category == ModelCategory.MULTINOMIAL:
             from h2o3_tpu.models.model import adapt_domain
             K = rc.cardinality
-            yv = _fetch_np(rc.data)[: frame.nrows].astype(np.int32)
+            yv = np.nan_to_num(rc.to_numpy()).astype(np.int32)  # host cache
             yv = np.pad(yv, (0, bm.bins.shape[0] - frame.nrows))
             y_dev = put_sharded(yv, row_sharding(mesh))
-            # weighted class priors over rows that actually train (weights
-            # already zero NA-response and padding rows)
-            from h2o3_tpu.parallel.mesh import fetch_replicated
-            w_host = fetch_replicated(w)[: frame.nrows]
-            counts = np.bincount(yv[: frame.nrows], weights=w_host,
+            # weighted class priors over rows that actually train, from
+            # the host weight mirror (no device sync)
+            counts = np.bincount(yv[: frame.nrows], weights=wh_host,
                                  minlength=K).astype(np.float64)
             pri = np.clip(counts / max(counts.sum(), 1e-12), 1e-10, 1.0)
             if ckpt is not None:
@@ -760,7 +769,8 @@ class GBMEstimator(ModelBuilder):
                 chunks_m.append(Tree(*(
                     a[:keep].reshape((keep * K,) + a.shape[2:])
                     for a in tr_k)))
-                gains_total += np.asarray(gains)[:keep].sum(axis=0)
+                if not light:
+                    gains_total += np.asarray(gains)[:keep].sum(axis=0)
                 done += keep
                 job.update(kk / ntrees, f"tree {done}/{ntrees}")
                 if keep < kk:
@@ -774,22 +784,22 @@ class GBMEstimator(ModelBuilder):
                                                  getattr(forest, f)])
                                 for f in Tree._fields))
             model = GBMModel(p, output, forest, bm, f0, "multinomial")
-            probs = jax.nn.softmax(model._margins(bm), axis=1)
-            model.training_metrics = mm.multinomial_metrics(
-                probs, y_dev, w, domain=rc.domain)
+            if not light:
+                probs = jax.nn.softmax(model._margins(bm), axis=1)
+                model.training_metrics = mm.multinomial_metrics(
+                    probs, y_dev, w, domain=rc.domain)
         else:
             if category == ModelCategory.BINOMIAL:
                 dist = get_distribution("bernoulli")
-                yv = _fetch_np(rc.data)[: frame.nrows].astype(np.float32)
-                yv[_fetch_np(rc.na_mask)[: frame.nrows]] = 0.0
             else:
                 dist = get_distribution(dist_name, **p)
-                yv = np.nan_to_num(rc.to_numpy()).astype(np.float32)
+            yv = np.nan_to_num(rc.to_numpy()).astype(np.float32)
+            # host weighted mean from the weight mirror — no device
+            # sync (w is numerically equal, host caches are replicated)
+            mean_y = (float(np.sum(yv * wh_host))
+                      / max(float(np.sum(wh_host)), 1e-12))
             yv = np.pad(yv, (0, bm.bins.shape[0] - frame.nrows))
             y_dev = put_sharded(yv, row_sharding(mesh))
-            # device-side weighted mean: w may shard across processes
-            mean_y = float(jnp.sum(y_dev * w)) / max(float(jnp.sum(w)),
-                                                     1e-12)
             # offset_column: per-row base margin (GBM.java offset
             # handling; init_f solved WITH the offset in place)
             off = None
@@ -854,7 +864,8 @@ class GBMEstimator(ModelBuilder):
                         dist=dist, sample_rate=float(p["sample_rate"]),
                         ntrees=k)
                     chunks.append(tr_k)
-                    gains_total += np.asarray(gains)
+                    if not light:
+                        gains_total += np.asarray(gains)
                     done += k
                     job.update(k / ntrees, f"tree {done}/{ntrees}")
                 forest = (chunks[0] if len(chunks) == 1 else
@@ -903,7 +914,9 @@ class GBMEstimator(ModelBuilder):
                                                  getattr(forest, f)])
                                 for f in Tree._fields))
             model = GBMModel(p, output, forest, bm, f0, dist_name)
-            if category == ModelCategory.BINOMIAL:
+            if light:
+                model.output["default_threshold"] = 0.5
+            elif category == ModelCategory.BINOMIAL:
                 pfin = dist.link_inv(model._margins(bm, off))
                 model.training_metrics = mm.binomial_metrics(pfin, y_dev, w)
                 model.output["default_threshold"] = \
@@ -917,13 +930,16 @@ class GBMEstimator(ModelBuilder):
                     deviance_fn=lambda yy, pp: dist.deviance(yy, mfin))
 
         model.output["scoring_history"] = scoring_history
-        # scaled relative importance (hex/VarImp semantics)
-        vi = gains_total
-        order = np.argsort(-vi)
-        tot = vi.sum() or 1.0
-        model.output["varimp"] = [
-            (x[i], float(vi[i]), float(vi[i] / max(vi.max(), 1e-12)),
-             float(vi[i] / tot)) for i in order]
+        if light:
+            model.output["varimp"] = None
+        else:
+            # scaled relative importance (hex/VarImp semantics)
+            vi = gains_total
+            order = np.argsort(-vi)
+            tot = vi.sum() or 1.0
+            model.output["varimp"] = [
+                (x[i], float(vi[i]), float(vi[i] / max(vi.max(), 1e-12)),
+                 float(vi[i] / tot)) for i in order]
         if validation_frame is not None:
             model.validation_metrics = model.model_performance(validation_frame)
         from h2o3_tpu.ml.calibration import maybe_calibrate

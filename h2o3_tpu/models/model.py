@@ -230,16 +230,37 @@ class ModelBuilder:
         fm[: frame.nrows] = fold_mask.astype(np.float32)
         return w * jnp.asarray(fm)
 
-    def _normalize_uniform_weights(self, w, frame: Frame):
+    def _host_weights(self, frame: Frame, y: Optional[str]) -> np.ndarray:
+        """HOST mirror of the effective training weights: user weight
+        column × CV fold mask × response-NA exclusion, [frame.nrows]
+        float32. ONE implementation — GBM/DRF mirror the device vector
+        with this, and uniformity detection classifies it; all reads
+        come from cached host views, so no device sync (a per-fold
+        fetch dominates leave-one-out CV)."""
+        wc_name = self.params.get("weights_column")
+        if wc_name and wc_name in frame:
+            wh = np.nan_to_num(
+                frame.col(wc_name).to_numpy()).astype(np.float32)
+        else:
+            wh = np.ones(frame.nrows, np.float32)
+        fold_mask = getattr(self, "_cv_fold_mask", None)
+        if fold_mask is not None:
+            wh = wh * fold_mask.astype(np.float32)
+        if y is not None and y in frame and \
+                frame.col(y).type not in ("string", "uuid"):
+            wh = wh * (~np.isnan(frame.col(y).to_numpy())).astype(np.float32)
+        return wh
+
+    def _normalize_uniform_weights(self, w, wh_host: np.ndarray):
         """(w', scale): a constant weight column rescales to exactly 1.0
         so 'uniform weights ≡ no weights' holds bit-for-bit
         (pyunit_weights_gbm asserts 1e-5-relative metric equality, which
         f32 rounding of w*k misses). Callers divide every ABSOLUTE
         training threshold (min_rows, min_split_improvement,
         reg_lambda) by the returned scale — that reproduces raw-weight
-        reference semantics exactly in real arithmetic."""
-        wf = _fetch_np(w)[: frame.nrows]
-        pos = wf[wf > 0]
+        reference semantics exactly in real arithmetic. ``wh_host`` is
+        the _host_weights mirror of ``w``."""
+        pos = wh_host[wh_host > 0]
         if pos.size and pos.min() == pos.max() and float(pos[0]) != 1.0:
             s = float(pos[0])
             return w / s, s
@@ -301,6 +322,12 @@ class ModelBuilder:
                     int(self.params.get("nfolds") or 0) > 0:
                 raise ValueError(
                     "only one of nfolds or fold_column may be specified")
+            if self.params.get("fold_column") and \
+                    str(self.params.get("fold_assignment", "auto")
+                        or "auto").lower() != "auto":
+                raise ValueError(
+                    "fold_assignment is incompatible with fold_column "
+                    "(hex/ModelBuilder fold-spec validation)")
             if nfolds >= 2:
                 from h2o3_tpu.ml.cv import train_with_cv
                 model = train_with_cv(self, training_frame, x, y, nfolds, j,

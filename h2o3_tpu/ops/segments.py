@@ -50,21 +50,39 @@ def segment_sum(nid, vals, *, n_nodes: int, mesh, block_rows: int = 16384,
 
     Rows with all-zero vals (padding) contribute nothing; nid must be in
     [0, n_nodes).
+
+    n_nodes is bucketed up to the next power of two internally (result
+    sliced back): every distinct group count would otherwise compile its
+    own XLA program — a group-by sweep over many cardinalities (the
+    munging pyunits) pays 20-40s of TPU compile per distinct count.
     """
+    want = n_nodes
+    if n_nodes > 1:
+        n_nodes = 1 << (n_nodes - 1).bit_length()
     ndata = mesh.shape[DATA_AXIS]
     N = nid.shape[0]
     if N % ndata != 0:
         pad = ndata - N % ndata
         nid = jnp.pad(nid, (0, pad))
         vals = jnp.pad(vals, ((0, pad), (0, 0)))
+    out = _segment_sum_jit(nid, vals, n_nodes=n_nodes,
+                           block_rows=block_rows, mesh=mesh,
+                           precision=precision)
+    return out if want == n_nodes else out[:want]
 
-    @functools.partial(
-        shard_map, mesh=mesh,
-        in_specs=(P(DATA_AXIS), P(DATA_AXIS)),
-        out_specs=P(), check_vma=False)
-    def _task(nid_l, vals_l):
-        s = _local_segment_sum(nid_l, vals_l, n_nodes, block_rows,
-                               precision=precision)
-        return jax.lax.psum(s, DATA_AXIS)
 
-    return _task(nid, vals)
+@functools.partial(jax.jit, static_argnames=("n_nodes", "block_rows",
+                                             "mesh", "precision"))
+def _segment_sum_jit(nid, vals, *, n_nodes, block_rows, mesh, precision):
+    # module-level jit: eager callers (rapids group-by sweeps) hit the
+    # trace cache across calls — a per-call closure would re-trace and
+    # re-lower the shard_map every time
+    task = functools.partial(_local_segment_sum, n_nodes=n_nodes,
+                             block_rows=block_rows, precision=precision)
+
+    def _body(nid_l, vals_l):
+        return jax.lax.psum(task(nid_l, vals_l), DATA_AXIS)
+
+    return shard_map(_body, mesh=mesh,
+                     in_specs=(P(DATA_AXIS), P(DATA_AXIS)),
+                     out_specs=P(), check_vma=False)(nid, vals)

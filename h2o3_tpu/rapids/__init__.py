@@ -79,6 +79,10 @@ class _Parser:
             j += 1
         tok = self.s[self.i:j]
         self.i = j
+        if tok in ("TRUE", "True", "true"):
+            return ("num", 1.0)
+        if tok in ("FALSE", "False", "false"):
+            return ("num", 0.0)
         try:
             return ("num", float(tok))
         except ValueError:
@@ -246,6 +250,9 @@ def _binop(op, name: str = ""):
         pairs = _broadcast2(l, r)
         out = {}
         for n, (a, b) in pairs.items():
+            # equality against literals is exact in f64: columns carry
+            # a seeded float64 host view (frame/column.py host cache),
+            # so `5.1 in fr` compares the original parsed values
             with np.errstate(all="ignore"):
                 out[n] = np.asarray(
                     op(np.asarray(a, np.float64), np.asarray(b, np.float64)),
@@ -335,7 +342,11 @@ def _reducer(np_fn, na_fn):
         acc = []
         for v in vals:
             if isinstance(v, Frame):
-                acc += [_col_np(v, n) for n in v.names]
+                # f64 accumulation: the client recomputes oracles in
+                # float64 over the same (f32-parsed) values, so an f32
+                # running product/sum would diverge at ~1e-7 relative
+                acc += [_col_np(v, n).astype(np.float64)
+                        for n in v.names]
             else:
                 acc.append(np.array([float(v)]))
         flat = np.concatenate(acc)
@@ -357,17 +368,54 @@ for _name, _f, _fna in [
     PRIMS[_name] = _reducer(_f, _fna)
 
 
-def _cumop(op):
-    def fn(env, x):
+# NA-skipping scalar rollups (AstNaRollupOp subclasses: sumNA/minNA/
+# maxNA/prodNA — h2o-py sends these for skipna=True, its default)
+for _name, _fna in [("sumNA", np.nansum), ("minNA", np.nanmin),
+                    ("maxNA", np.nanmax), ("prodNA", np.nanprod)]:
+    PRIMS[_name] = _reducer(_fna, _fna)
+
+
+@prim("flatten")
+def _flatten_prim(env, x):
+    """1x1 frame → scalar Val (AstFlatten.java:16); anything else
+    passes through unchanged — the client's _eager_scalar path."""
+    v = env.ev(x)
+    if not isinstance(v, Frame) or v.ncols != 1 or v.nrows != 1:
+        return v
+    c = v.col(v.names[0])
+    if c.is_categorical:
+        k = int(_cat_codes(v, v.names[0])[0])
+        return "NA" if k < 0 else str((c.domain or [])[k])
+    val = c.to_numpy()[0]
+    if c.type in ("string", "uuid"):
+        return "NA" if val is None else str(val)
+    return float(val)
+
+
+def _cumop(op, axis1_op):
+    def fn(env, x, axis=0):
         v = env.ev(x)
-        return _rebuild(v, {n: op(_col_np(v, n)) for n in v.names}, False)
+        ax = int(env.ev(axis)) if not isinstance(axis, (int, float)) \
+            else int(axis)
+        if ax == 0:
+            return _rebuild(v, {n: op(_col_np(v, n)) for n in v.names},
+                            False)
+        # axis=1: accumulate across columns within each row (AstCumu)
+        m = np.stack([_col_np(v, n) for n in v.names], axis=1)
+        acc = axis1_op(m)
+        return _rebuild(v, {n: acc[:, j]
+                            for j, n in enumerate(v.names)}, False)
     return fn
 
 
-for _name, _op in [("cumsum", np.cumsum), ("cumprod", np.cumprod),
-                   ("cummax", np.maximum.accumulate),
-                   ("cummin", np.minimum.accumulate)]:
-    PRIMS[_name] = _cumop(_op)
+for _name, _op, _op1 in [
+        ("cumsum", np.cumsum, lambda m: np.cumsum(m, axis=1)),
+        ("cumprod", np.cumprod, lambda m: np.cumprod(m, axis=1)),
+        ("cummax", np.maximum.accumulate,
+         lambda m: np.maximum.accumulate(m, axis=1)),
+        ("cummin", np.minimum.accumulate,
+         lambda m: np.minimum.accumulate(m, axis=1))]:
+    PRIMS[_name] = _cumop(_op, _op1)
 
 
 # ---- structural (ast/prims/mungers) ---------------------------------
@@ -738,38 +786,113 @@ def _as_character(env, x):
             out[n] = dom[codes]
         else:
             out[n] = np.array([str(v) for v in _col_np(f, n)], dtype=object)
-    return Frame.from_numpy(out, categorical=list(out))
+    # as.character yields STRING columns (AstAsCharacter → Vec.T_STR),
+    # not a re-interned enum — isstring()/ischaracter() observe the type
+    return Frame.from_numpy(out, strings=list(out))
 
 
 @prim("unique")
 def _unique(env, x, *rest):
+    """AstUnique; optional include_nas flag appends one NA row when the
+    column has missing values (h2o-py unique(include_nas=True))."""
+    include_nas = any(bool(a[1] if isinstance(a, tuple) else env.ev(a))
+                      for a in rest)
     f = _as_frame(env.ev(x))
     n = f.names[0]
     c = f.col(n)
     if c.is_categorical:
         codes = _cat_codes(f, n)
-        u = np.unique(codes[codes >= 0])
-        return Frame.from_numpy({n: u.astype(np.int32)},
-                                categorical=[n], domains={n: c.domain})
+        u = np.unique(codes[codes >= 0]).astype(np.float64)
+        if include_nas and (codes < 0).any():
+            u = np.concatenate([u, [np.nan]])
+        out = Frame.from_numpy({n: u}, categorical=[n],
+                               domains={n: c.domain})
+        return out
     v = _col_np(f, n)
-    return Frame.from_numpy({n: np.unique(v[~np.isnan(v)])})
+    u = np.unique(v[~np.isnan(v)])
+    if include_nas and np.isnan(v).any():
+        u = np.concatenate([u, [np.nan]])
+    out = Frame.from_numpy({n: u},
+                           times=[n] if c.type == "time" else ())
+    return out
+
+
+def _table_values(fr, nm):
+    c = fr.col(nm)
+    if c.is_categorical:
+        dom = np.asarray(list(c.domain or []), dtype=object)
+        codes = _cat_codes(fr, nm)
+        return np.asarray([dom[k] if k >= 0 else None for k in codes],
+                          dtype=object)
+    return _col_np(fr, nm)
 
 
 @prim("table")
 def _table(env, x, *rest):
+    """AstTable: single-column counts, or a two-column cross tabulation
+    — dense=True emits (v1, v2, Counts) rows, dense=False a wide
+    cross-tab whose columns are the second variable's levels."""
     f = _as_frame(env.ev(x))
-    n = f.names[0]
-    c = f.col(n)
-    if c.is_categorical:
-        codes = _cat_codes(f, n)
-        cnt = np.bincount(codes[codes >= 0], minlength=len(c.domain or []))
-        return Frame.from_numpy(
-            {n: np.arange(len(cnt), dtype=np.int32),
-             "Count": cnt.astype(np.float64)},
-            categorical=[n], domains={n: c.domain})
-    v = _col_np(f, n)
-    u, cnt = np.unique(v[~np.isnan(v)], return_counts=True)
-    return Frame.from_numpy({n: u, "Count": cnt.astype(np.float64)})
+    f2, dense = None, True
+    for a in rest:
+        v = a[1] if isinstance(a, tuple) else env.ev(a)
+        if isinstance(v, Frame):
+            f2 = v
+        elif isinstance(v, (bool, int, float)):
+            dense = bool(v)
+    if f2 is not None:
+        pairs = ((f, f.names[0]), (f2, f2.names[0]))
+    elif f.ncols == 2:
+        pairs = ((f, f.names[0]), (f, f.names[1]))
+    else:
+        n = f.names[0]
+        c = f.col(n)
+        if c.is_categorical:
+            codes = _cat_codes(f, n)
+            cnt = np.bincount(codes[codes >= 0],
+                              minlength=len(c.domain or []))
+            return Frame.from_numpy(
+                {n: np.arange(len(cnt), dtype=np.int32),
+                 "Count": cnt.astype(np.float64)},
+                categorical=[n], domains={n: c.domain})
+        v = _col_np(f, n)
+        u, cnt = np.unique(v[~np.isnan(v)], return_counts=True)
+        return Frame.from_numpy({n: u, "Count": cnt.astype(np.float64)})
+
+    (fr1, n1), (fr2, n2) = pairs
+    a1, a2 = _table_values(fr1, n1), _table_values(fr2, n2)
+    from collections import Counter
+    cnt = Counter((v1, v2) for v1, v2 in zip(a1, a2)
+                  if v1 is not None and v2 is not None
+                  and not (isinstance(v1, float) and np.isnan(v1))
+                  and not (isinstance(v2, float) and np.isnan(v2)))
+    u1 = sorted({k[0] for k in cnt})
+    u2 = sorted({k[1] for k in cnt})
+    if n2 == n1:
+        n2 = n2 + "2"
+    if dense:
+        rows = sorted(cnt.items())
+        c1 = np.asarray([r[0][0] for r in rows], dtype=object)
+        c2 = np.asarray([r[0][1] for r in rows], dtype=object)
+        counts = np.asarray([r[1] for r in rows], np.float64)
+        out = {}
+        for nm, arr in ((n1, c1), (n2, c2)):
+            if all(isinstance(v, (int, float, np.floating, np.integer))
+                   for v in arr):
+                out[nm] = arr.astype(np.float64)
+            else:
+                out[nm] = arr
+        out["Counts"] = counts
+        return Frame.from_numpy(out)
+    # wide cross-tab: one row per u1 value, one column per u2 level
+    out = {n1: (np.asarray(u1, np.float64)
+                if all(isinstance(v, (int, float, np.floating,
+                                      np.integer)) for v in u1)
+                else np.asarray(u1, dtype=object))}
+    for lvl in u2:
+        out[str(lvl)] = np.asarray(
+            [float(cnt.get((v1, lvl), 0)) for v1 in u1], np.float64)
+    return Frame.from_numpy(out)
 
 
 @prim("naCnt", "na_cnt")
@@ -1092,11 +1215,15 @@ def _strop(fn):
     def wrapper(env, x, *args):
         f = _as_frame(env.ev(x))
         extra = [a[1] if isinstance(a, tuple) else env.ev(a) for a in args]
-        out, cats = {}, []
+        out, cats, strs = {}, [], []
         for n in f.names:
             c = f.col(n)
             if c.is_categorical:
+                # transformed labels re-intern: duplicates collapse and
+                # '' becomes NA (the reference drops empty levels —
+                # substring past the end must shrink the domain)
                 dom = [fn(s, *extra) for s in (c.domain or [])]
+                dom = [None if d == "" else d for d in dom]
                 codes = _fetch_np(c.data)[: f.nrows].astype(np.int64)
                 codes = np.where(_fetch_np(c.na_mask)[: f.nrows],
                                  len(dom), codes)
@@ -1105,9 +1232,10 @@ def _strop(fn):
             elif c.type == "string":
                 out[n] = np.array([fn(s, *extra) if s is not None else None
                                    for s in c.to_numpy()], dtype=object)
+                strs.append(n)   # string in, string out (AstStrOp)
             else:
                 out[n] = c.to_numpy()
-        return Frame.from_numpy(out, categorical=cats)
+        return Frame.from_numpy(out, categorical=cats, strings=strs)
     return wrapper
 
 
@@ -1144,7 +1272,10 @@ def _nchar(env, x):
 @prim("substring")
 def _substring(env, x, start, end=("num", 1e9)):
     s0 = int(env.ev(start))
-    e0 = int(min(env.ev(end), 1e9))
+    ev = env.ev(end)
+    e0 = int(1e9) if (isinstance(ev, float) and np.isnan(ev)) \
+        else int(min(ev, 1e9))
+    s0 = max(s0, 0)
     return _strop(lambda s: s[s0:e0])(env, x)
 
 
@@ -1494,7 +1625,9 @@ def _as_pylist(env, node):
 
 
 def _num_matrix(f: Frame) -> np.ndarray:
-    return np.stack([_col_np(f, n) for n in f.names], axis=1)
+    # f64: matrix ops feed pyunit oracles computed in float64
+    return np.stack([_col_np(f, n).astype(np.float64)
+                     for n in f.names], axis=1)
 
 
 @prim("t")
@@ -1732,13 +1865,33 @@ def _seq(env, fro, to, by=("num", 1)):
 
 @prim("rep_len")
 def _rep_len(env, x, length):
+    """AstRepLen: single column → repeat ROWS to length; multi-column
+    frame → repeat COLUMNS cyclically to length columns."""
     n = int(env.ev(length))
     v = env.ev(x)
-    if isinstance(v, Frame):
-        a = _col_np(v, v.names[0])
+    if not isinstance(v, Frame):
+        return Frame.from_numpy({"C1": np.full(n, float(v))})
+    if v.ncols == 1:
+        nm = v.names[0]
+        c = v.col(nm)
+        if c.is_categorical:
+            return Frame.from_numpy(
+                {nm: np.resize(_cat_codes(v, nm), n)},
+                categorical=[nm], domains={nm: c.domain})
         return Frame.from_numpy(
-            {"C1": np.resize(a, n).astype(np.float64)})
-    return Frame.from_numpy({"C1": np.full(n, float(v))})
+            {nm: np.resize(_col_np(v, nm), n).astype(np.float64)})
+    out, cats, doms = {}, [], {}
+    for i in range(n):
+        src = v.names[i % v.ncols]
+        nm = f"C{i + 1}"
+        c = v.col(src)
+        if c.is_categorical:
+            out[nm] = _cat_codes(v, src)
+            cats.append(nm)
+            doms[nm] = c.domain
+        else:
+            out[nm] = _col_np(v, src)
+    return Frame.from_numpy(out, categorical=cats, domains=doms)
 
 
 @prim("distance")
