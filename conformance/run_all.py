@@ -193,6 +193,16 @@ def main():
             results.append((name, ok, dt, tail))
             print(f"{'PASS' if ok else 'FAIL'}  {name}  ({dt:.1f}s)",
                   flush=True)
+            # clear the cloud between pyunits (scripts/run.py resets
+            # state too): leaked frames/models otherwise accumulate in
+            # HBM until the chip ResourceExhausts mid-suite (~60 tests)
+            try:
+                import urllib.request
+                req = urllib.request.Request(f"{url}/3/DKV",
+                                             method="DELETE")
+                urllib.request.urlopen(req, timeout=60).read()
+            except Exception as e:
+                print(f"  [dkv clear failed: {e}]", flush=True)
             if not ok:
                 for ln in tail:
                     print("      " + ln)

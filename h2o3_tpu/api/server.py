@@ -539,6 +539,11 @@ def _dkv_del_all(params, body):
     for k in list(DKV.keys()):
         if k not in retained:
             DKV.remove(k)
+    # release dropped device buffers NOW: deferred GC lets HBM pile up
+    # across many remove_all cycles (the conformance suite exhausted the
+    # chip after ~60 pyunits without this)
+    import gc
+    gc.collect()
     return {}
 
 

@@ -625,8 +625,8 @@ class GLMEstimator(ModelBuilder):
 
     # ---- solvers -----------------------------------------------------
     def _fit_irlsm(self, X1, yv, w, fam: Family, l1: float, l2: float,
-                   coef0: np.ndarray, nobs: float, max_iter: int,
-                   beta_eps: float, off=None) -> np.ndarray:
+                   coef0, nobs: float, max_iter: int,
+                   beta_eps: float, off=None) -> jax.Array:
         if off is None:
             off = jnp.zeros((X1.shape[0],), jnp.float32)
         coef = jnp.asarray(coef0, jnp.float32)
@@ -635,7 +635,9 @@ class GLMEstimator(ModelBuilder):
                            jnp.int32(max_iter),
                            fam.name, fam.link, jnp.float32(fam.p),
                            jnp.float32(fam.theta), use_l1=l1 > 0)
-        return np.asarray(coef)
+        return coef   # device array: the lambda path warm-starts from it
+        # without a host sync per lambda (30-step searches × CV folds
+        # paid a blocking round trip each — pyunit_glm_seed timeout)
 
     def _fit_cod(self, X1, yv, w, fam: Family, l1: float, l2: float,
                  coef0: np.ndarray, max_iter: int, beta_eps: float,
@@ -942,7 +944,7 @@ class GLMEstimator(ModelBuilder):
                                        off=off_or0)
             job.update(1.0 / len(lambdas), f"lambda {li + 1}/{len(lambdas)}")
             best = coef
-        coef = best
+        coef = np.asarray(best)   # ONE host materialization after the path
 
         output["lambda_best"] = float(lambdas[-1])
 

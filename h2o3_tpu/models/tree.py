@@ -89,10 +89,16 @@ class TreeParams:
     cat_feats: tuple = ()            # per-feature is-categorical flags —
                                      # schema-static, activates the
                                      # sorted-prefix subset-split path
-    exact_f32: bool = False          # true-f32 histogram/leaf matmuls
-                                     # (vs TPU bf16x3) — small problems
-                                     # where pyunits assert 1e-5 metric
-                                     # equality; ~free at that scale
+    exact_f32: bool = False          # true-f32 LEAF-value sums (vs TPU
+                                     # bf16x3) on small problems where
+                                     # pyunits assert 1e-5 metric
+                                     # equality. Histograms stay bf16x3
+                                     # (HIGHEST inside the level loop
+                                     # multiplies compile time); split
+                                     # ties may still flip across row
+                                     # orders — uniform-weight
+                                     # normalization covers the exact-
+                                     # equality contracts instead
 
     @property
     def has_cats(self) -> bool:
@@ -100,9 +106,10 @@ class TreeParams:
 
 
 def exact_f32_for(bm) -> bool:
-    """True-f32 matmul mode for pyunit-scale problems: TPU bf16x3
-    residue (~1e-5 relative) fails reference metric-equality
-    assertions, and below this size the MXU-rate trade is free."""
+    """True-f32 LEAF-sum mode for pyunit-scale problems: TPU bf16x3
+    residue (~1e-5 relative) in leaf values fails reference
+    metric-equality assertions, and a single leaf matmul at HIGHEST is
+    free below this size (histograms are excluded — see TreeParams)."""
     return (bm.bins.shape[0] * bm.bins.shape[1] * bm.nbins_total
             <= (1 << 26))
 
@@ -319,14 +326,19 @@ def grow_tree(bins, nb, w, g, h, col_mask, *, params: TreeParams, mesh,
     allowed = jnp.ones((1, F), bool)   # per-node feature set (interactions)
     pair_allow = None                  # lazy [F, F] compatibility matrix
 
+    # exact_f32 scopes to the LEAF value sums only: HIGHEST-precision
+    # matmuls inside the level loop multiply XLA compile time (6-pass
+    # f32 emulation unrolled through the boosting scan — observed 600s+
+    # pyunit wallclock vs 90s), while the leaf segment_sum is a single
+    # small matmul whose exactness the weight≡duplication metric
+    # contracts actually observe
     prec = jax.lax.Precision.HIGHEST if params.exact_f32 else None
     prev_hist = None
     for d in range(D):
         L = 2 ** d
         if prev_hist is None:
             hist = histogram(bins, nid, w, g, h, n_nodes=L, n_bins=B,
-                             mesh=mesh, block_rows=params.block_rows,
-                             precision=prec)
+                             mesh=mesh, block_rows=params.block_rows)
         else:
             # sibling subtraction: histogram only the LEFT children (even
             # node slots), derive right = parent − left. Halves the
@@ -336,8 +348,7 @@ def grow_tree(bins, nb, w, g, h, col_mask, *, params: TreeParams, mesh,
             # hex/tree/ScoreBuildHistogram2.java).
             even = (nid % 2 == 0).astype(jnp.float32)
             lh = histogram(bins, nid >> 1, w * even, g, h, n_nodes=L // 2,
-                           n_bins=B, mesh=mesh, block_rows=params.block_rows,
-                           precision=prec)
+                           n_bins=B, mesh=mesh, block_rows=params.block_rows)
             rh = prev_hist - lh
             # f32 cancellation guard: w and h are nonnegative sums, so
             # clamp tiny negative residue (|err| ≲ parent·2^-23); g may
