@@ -1,8 +1,12 @@
 """Conformance backend: boot the cloud + REST server, print the port."""
 
+import faulthandler
 import os
+import signal
 import sys
 import time
+
+faulthandler.register(signal.SIGUSR1)   # kill -USR1 <pid> dumps stacks
 
 # Default TPU: per-test wallclock is compile+dispatch bound and the
 # tunneled chip clears the many-model pyunits ~4x faster than this

@@ -105,8 +105,18 @@ def guess_header(path: str) -> bool:
         return True
     if not second or second == [""]:
         return True
-    first_numeric = any(_is_num_token(t) for t in first if t != "")
-    second_numeric = any(_is_num_token(t) for t in second if t != "")
+
+    def _unq(t: str) -> str:
+        # quotes are field escaping, not content: a fully-quoted CSV
+        # (h2o-py python-object uploads use QUOTE_ALL) must sniff
+        # "42.4" as numeric or the header joins the data and every
+        # column collapses to categorical
+        t = t.strip()
+        if len(t) >= 2 and t[0] == '"' and t[-1] == '"':
+            return t[1:-1]
+        return t
+    first_numeric = any(_is_num_token(_unq(t)) for t in first if t != "")
+    second_numeric = any(_is_num_token(_unq(t)) for t in second if t != "")
     return (not first_numeric) and second_numeric
 
 
