@@ -372,7 +372,12 @@ _SETUP_TYPES_BACK = {"numeric": "numeric", "enum": "categorical",
 def _parse_setup(params, body):
     from h2o3_tpu.io.parser import parse_setup
     srcs = _src_list(params)
-    setup = parse_setup(srcs[0])
+    ch = params.get("check_header")
+    hint = None
+    if ch is not None:
+        ch = int(float(ch))
+        hint = True if ch == 1 else (False if ch == -1 else None)
+    setup = parse_setup(srcs[0], header=hint)
     dest = srcs[0].split("/")[-1]
     for ext in (".zip", ".gz", ".csv", ".parquet", ".pq", ".xlsx",
                 ".arff", ".svm", ".svmlight"):
@@ -544,6 +549,22 @@ def _dkv_del_all(params, body):
     # chip after ~60 pyunits without this)
     import gc
     gc.collect()
+    # compiled executables pin HBM too (program binaries + baked
+    # constants live on chip, and jit caches keep them forever): when
+    # the device crosses half full, drop the caches — the next train
+    # recompiles, which beats ResourceExhausted killing the suite tail
+    try:
+        import jax
+        st = jax.devices()[0].memory_stats() or {}
+        used = int(st.get("bytes_in_use", 0) or 0)
+        cap = int(st.get("bytes_limit", 0) or 0)
+        if cap and used > 0.5 * cap:
+            jax.clear_caches()
+            gc.collect()
+            log.info("remove_all: cleared jit caches (HBM %.1f/%.1f GB)",
+                     used / 1e9, cap / 1e9)
+    except Exception:
+        pass
     return {}
 
 

@@ -33,7 +33,8 @@ _UUID_RX = _re.compile(
     r"[0-9a-fA-F]{4}-[0-9a-fA-F]{12}$")
 
 
-def parse_setup(path: str, nrows_sample: int = 1000) -> dict:
+def parse_setup(path: str, nrows_sample: int = 1000,
+                header: Optional[bool] = None) -> dict:
     """Schema guess on a sample (ParseSetup.guessSetup).
 
     CSV guesses from a pandas sample; non-CSV formats (xlsx, parquet,
@@ -64,7 +65,10 @@ def parse_setup(path: str, nrows_sample: int = 1000) -> dict:
         DKV.remove(fr.key)
         return {"columns": cols, "types": types, "separator": ",",
                 "header": True}
-    has_header = guess_header(path)
+    # the client's check_header hint wins over sniffing: python-object
+    # uploads are all-string QUOTE_ALL CSVs whose header is
+    # indistinguishable from data (h2o.py:835 sends check_header=1)
+    has_header = guess_header(path) if header is None else bool(header)
     sample = pd.read_csv(path, nrows=nrows_sample,
                          header=0 if has_header else None)
     if not has_header:

@@ -300,8 +300,33 @@ for _name, _op in [("abs", np.abs), ("ceiling", np.ceil), ("floor", np.floor),
                    ("!", lambda a: np.asarray(a == 0, float)),
                    ("lgamma", np.vectorize(math.lgamma)),
                    ("gamma", np.vectorize(math.gamma)),
-                   ("is.na", lambda a: np.isnan(a).astype(float))]:
+                   ]:
     PRIMS[_name] = _unop(_op)
+
+
+@prim("is.na")
+def _is_na(env, x):
+    """AstIsNa — per-cell 0/1; string columns test None (the numeric
+    _unop path would try float('oneteen'))."""
+    v = env.ev(x)
+    if not isinstance(v, Frame):
+        if isinstance(v, str):
+            return 0.0            # a string scalar is a value, not NA
+        try:
+            return float(np.isnan(float(v)))
+        except (TypeError, ValueError):
+            return 1.0 if v is None else 0.0
+    out = {}
+    for n in v.names:
+        c = v.col(n)
+        if c.type in ("string", "uuid"):
+            out[n] = np.asarray([1.0 if s is None else 0.0
+                                 for s in c.to_numpy()])
+        elif c.is_categorical:
+            out[n] = (_cat_codes(v, n) < 0).astype(np.float64)
+        else:
+            out[n] = np.isnan(_col_np(v, n)).astype(np.float64)
+    return _rebuild(v, out, keep_domains=False)
 
 
 @prim("round")
