@@ -320,13 +320,14 @@ def _is_na(env, x):
     for n in v.names:
         c = v.col(n)
         if c.type in ("string", "uuid"):
-            out[n] = np.asarray([1.0 if s is None else 0.0
-                                 for s in c.to_numpy()])
+            flags = np.asarray([1.0 if s is None else 0.0
+                                for s in c.to_numpy()])
         elif c.is_categorical:
-            out[n] = (_cat_codes(v, n) < 0).astype(np.float64)
+            flags = (_cat_codes(v, n) < 0).astype(np.float64)
         else:
-            out[n] = np.isnan(_col_np(v, n)).astype(np.float64)
-    return _rebuild(v, out, keep_domains=False)
+            flags = np.isnan(_col_np(v, n)).astype(np.float64)
+        out[f"isNA({n})"] = flags         # AstIsNa output naming
+    return Frame.from_numpy(out)
 
 
 @prim("round")
@@ -1530,8 +1531,10 @@ def _entropy(env, x):
     vals = _str_values(f, f.names[0])
 
     def ent(s):
-        if not isinstance(s, str) or not s:
+        if not isinstance(s, str):
             return np.nan
+        if not s:
+            return 0.0           # AstEntropy: empty string = 0 bits
         _, cnt = np.unique(list(s), return_counts=True)
         p = cnt / cnt.sum()
         return float(-(p * np.log2(p)).sum())
