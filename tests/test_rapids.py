@@ -136,7 +136,8 @@ def test_na_handling(sess):
     sess.assign("N", f)
     assert np.isnan(rapids('(sum N)', sess))
     assert rapids('(sum N 1)', sess) == 4.0       # na_rm
-    isna = rapids('(is.na N)', sess).col("x").to_numpy()
+    # AstIsNa names outputs isNA(col) (pyunit_isna contract)
+    isna = rapids('(is.na N)', sess).col("isNA(x)").to_numpy()
     np.testing.assert_allclose(isna, [0, 1, 0])
     imp = rapids('(h2o.impute N [0] "mean")', sess)
     np.testing.assert_allclose(imp.col("x").to_numpy(), [1.0, 2.0, 3.0])
