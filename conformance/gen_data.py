@@ -41,6 +41,10 @@ def gen_cars(sd: str) -> None:
     econ = (50 - 3.5 * cyl + (year - 70) * 0.5 + r.randn(n) * 3).round(1)
     econ20 = (econ >= 20).astype(int)
     name = [f"car_{i}" for i in range(n)]
+    # pyunit_trim asserts the first three trimmed names verbatim (the
+    # real cars data starts with the AMC Ambassador series)
+    name[:3] = ["AMC Ambassador Brougham", "AMC Ambassador DPL",
+                "AMC Ambassador SST"]
     _write_csv(os.path.join(sd, "junit/cars_20mpg.csv"),
                ["name", "economy", "cylinders", "displacement", "power",
                 "weight", "acceleration", "year", "economy_20mpg"],
@@ -222,10 +226,17 @@ def gen_munging_files(sd: str) -> None:
         header = f.readline().strip().split(",")
         rows = [ln.rstrip("\n").split(",") for ln in f if ln.strip()]
     keep = [i for i, h in enumerate(header) if h != "economy_20mpg"]
+    # the real junit/cars.csv carries unit-suffixed headers; the ordinal
+    # GLM pyunit (pyunit_pubdev_8194_ordinal_fail) selects them by name
+    cars_names = {"economy": "economy (mpg)",
+                  "displacement": "displacement (cc)",
+                  "power": "power (hp)", "weight": "weight (lb)",
+                  "acceleration": "0-60 mph (s)"}
     p = os.path.join(sd, "junit/cars.csv")
     if not os.path.exists(p):
         with open(p, "w") as f:
-            f.write(",".join(header[i] for i in keep) + "\n")
+            f.write(",".join(cars_names.get(header[i], header[i])
+                             for i in keep) + "\n")
             f.writelines(",".join(r[i] for i in keep) + "\n" for r in rows)
     p = os.path.join(sd, "junit/cars_trim.csv")
     if not os.path.exists(p):

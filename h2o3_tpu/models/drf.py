@@ -11,10 +11,11 @@ Differences from GBM that this file reproduces:
 - training metrics are OOB: every row is scored only by the trees whose
   bag excluded it (DRF.java OOB scoring via Sample/Score).
 
-TPU redesign: one jitted `_bag_step` per tree — bag mask, grow_tree with
-(g=-y, h=1) so the Newton leaf value is the bag-weighted mean of y, and
-OOB accumulator updates — all on device; rows stay sharded on the mesh
-'data' axis throughout.
+TPU redesign: the whole forest is ONE compiled ``lax.scan`` over trees
+(`_bag_scan`) — per tree: bag mask, grow_tree with (g=-y, h=1) so the
+Newton leaf value is the bag-weighted mean of y, and OOB accumulator
+updates — all on device; rows stay sharded on the mesh 'data' axis
+throughout, and one model costs one dispatch.
 """
 
 from __future__ import annotations
@@ -33,8 +34,9 @@ from h2o3_tpu.frame.frame import Frame
 from h2o3_tpu.models import metrics as mm
 from h2o3_tpu.models.model import (Model, ModelBuilder, ModelCategory,
                                    adapt_domain, infer_category)
-from h2o3_tpu.models.tree import (Tree, TreeParams, exact_f32_for,
-                                  grow_tree, predict_forest, stack_trees)
+from h2o3_tpu.models.tree import (Tree, TreeParams, bucket_depth,
+                                  exact_f32_for, grow_tree, predict_forest,
+                                  scalars_of, stack_trees)
 from h2o3_tpu.parallel.mesh import get_mesh, row_sharding
 from h2o3_tpu.utils.log import get_logger
 
@@ -43,12 +45,48 @@ log = get_logger("h2o3_tpu.drf")
 MAX_COMPLETE_DEPTH = 14  # complete-tree layout: histograms are 2^d·F·B·3
 
 
-@partial(jax.jit, static_argnames=("tp", "sample_rate", "mtries", "n_class"))
-def _bag_step(bins, nb, ys, w, oob_sum, oob_cnt, key, *, tp: TreeParams,
-              sample_rate: float, mtries: int, n_class: int):
-    """One forest iteration: bag rows, grow n_class mean-value trees,
-    update OOB accumulators. ys: [N, n_class] float targets."""
+@partial(jax.jit,
+         static_argnames=("tp", "sample_rate", "mtries", "n_class",
+                          "ntrees"))
+def _bag_scan(bins, nb, ys, w, key, depth_limit, *, tp: TreeParams,
+              sample_rate: float, mtries: int, n_class: int, ntrees: int):
+    """The whole forest as ONE compiled ``lax.scan`` over trees.
+
+    The per-tree Python loop cost one dispatch + one host gains sync per
+    tree — leave-one-out CV (pyunit_cv_carsRF boundary: nfolds == nrows)
+    multiplied that into 20K tunnel round trips and a 600s timeout. The
+    scan leaves one dispatch per MODEL. The key chain reproduces the
+    sequential `key, sub = split(key)` of the loop exactly, so forests
+    are bit-identical to the unfused path."""
+    N = w.shape[0]
+    oob_sum = jnp.zeros((N, n_class), jnp.float32)
+    oob_cnt = jnp.zeros((N,), jnp.float32)
+
+    def gen(carry, _):
+        k, s = jax.random.split(carry)
+        return k, s
+
+    _, subs = jax.lax.scan(gen, key, None, length=ntrees)
+
+    def step(carry, sub):
+        osum, ocnt = carry
+        tr, osum, ocnt, gains = _bag_body(
+            bins, nb, ys, w, osum, ocnt, sub, depth_limit, tp=tp,
+            sample_rate=sample_rate, mtries=mtries, n_class=n_class)
+        return (osum, ocnt), (tr, gains)
+
+    (oob_sum, oob_cnt), (trees, gains) = jax.lax.scan(
+        step, (oob_sum, oob_cnt), subs)
+    # [T, K, ...] per-scan-step stacked class trees → flat [T*K, ...]
+    forest = Tree(*(a.reshape((-1,) + a.shape[2:]) for a in trees))
+    return forest, oob_sum, oob_cnt, jnp.sum(gains, axis=0)
+
+
+def _bag_body(bins, nb, ys, w, oob_sum, oob_cnt, key, depth_limit, *,
+              tp: TreeParams, sample_rate: float, mtries: int,
+              n_class: int):
     mesh = get_mesh()
+    sc = scalars_of(tp)._replace(depth_limit=depth_limit)
     kb, kc1, kc2, kt = jax.random.split(key, 4)
     keep = jax.random.bernoulli(kb, sample_rate, shape=w.shape)
     wbag = w * keep.astype(jnp.float32)
@@ -68,7 +106,7 @@ def _bag_step(bins, nb, ys, w, oob_sum, oob_cnt, key, *, tp: TreeParams,
         # g=-y, h=1 ⇒ leaf value = Σ w·y / (Σ w + λ): the bagged leaf mean
         tree, nid, gains = grow_tree(bins, nb, wbag, -yk, jnp.ones_like(yk),
                                      col_mask, params=tp, mesh=mesh,
-                                     mtries=mtries, key=sub)
+                                     mtries=mtries, key=sub, scalars=sc)
         trees.append(tree)
         gains_tot = gains_tot + gains
         pred = tree.leaf[nid]          # routing nid is bag-independent
@@ -252,6 +290,11 @@ class DRFEstimator(ModelBuilder):
             log.warning("DRF max_depth=%d capped to %d (complete-tree TPU "
                         "layout, %d rows)", depth, eff, frame.nrows)
             depth = eff
+        # compile at the depth BUCKET (never past the caps) and mask
+        # splits beyond the actual depth — candidates of nearby depths
+        # share one compiled forest program (tree.py DEPTH_BUCKETS)
+        compile_depth = min(bucket_depth(depth), MAX_COMPLETE_DEPTH,
+                            data_cap)
         F = len(x)
         mtries = int(p["mtries"])
         if mtries == -1:
@@ -263,7 +306,8 @@ class DRFEstimator(ModelBuilder):
         w, w_scale = self._normalize_uniform_weights(w, wh_host)
 
         tp = TreeParams(
-            max_depth=depth, min_rows=float(p["min_rows"]) / w_scale,
+            max_depth=compile_depth,
+            min_rows=float(p["min_rows"]) / w_scale,
             learn_rate=1.0, reg_lambda=0.0,
             min_split_improvement=float(p["min_split_improvement"])
             / w_scale,
@@ -293,23 +337,12 @@ class DRFEstimator(ModelBuilder):
         seed = int(p["seed"]) if int(p["seed"]) >= 0 else 0xD2F
         key = jax.random.PRNGKey(seed)
         ntrees = int(p["ntrees"])
-        oob_sum = jnp.zeros((N, K), jnp.float32)
-        oob_cnt = jnp.zeros((N,), jnp.float32)
-        oob_sum = jax.device_put(oob_sum, row_sharding(mesh))
-        oob_cnt = jax.device_put(oob_cnt, row_sharding(mesh))
-        trees: List[Tree] = []
-        gains_total = np.zeros(F, np.float32)
-        for t in range(ntrees):
-            key, sub = jax.random.split(key)
-            tr, oob_sum, oob_cnt, gains = _bag_step(
-                bm.bins, bm.nbins, ys, w, oob_sum, oob_cnt, sub, tp=tp,
-                sample_rate=float(p["sample_rate"]), mtries=mtries, n_class=K)
-            trees.append(tr)
-            gains_total += np.asarray(gains)
-            job.update(1.0 / ntrees, f"tree {t + 1}/{ntrees}")
-
-        forest = Tree(*(jnp.concatenate([getattr(t, f) for t in trees])
-                        for f in Tree._fields))
+        forest, oob_sum, oob_cnt, gains_dev = _bag_scan(
+            bm.bins, bm.nbins, ys, w, key, jnp.int32(depth), tp=tp,
+            sample_rate=float(p["sample_rate"]), mtries=mtries,
+            n_class=K, ntrees=ntrees)
+        gains_total = np.asarray(gains_dev)
+        job.update(1.0, f"{ntrees} trees")
         output = {"category": category, "response": y, "names": list(x),
                   "nclasses": rc.cardinality if rc.is_categorical else 1,
                   "domain": rc.domain}

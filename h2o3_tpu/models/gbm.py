@@ -33,8 +33,8 @@ from h2o3_tpu.models.distribution import Distribution, get_distribution
 from h2o3_tpu.models.model import (Model, ModelBuilder, ModelCategory,
                                    infer_category)
 from h2o3_tpu.models.tree import (Tree, TreeParams, TreeScalars,
-                                  exact_f32_for, grow_tree, predict_forest,
-                                  predict_tree, stack_trees)
+                                  bucket_depth, exact_f32_for, grow_tree,
+                                  predict_forest, predict_tree, stack_trees)
 from h2o3_tpu.parallel.mesh import (get_mesh, put_sharded,
                                     row_sharding)
 
@@ -208,18 +208,21 @@ def _boost_scan_multi_jit(bins, nb, y_int, w, margins, key,
 
 def _knobs_of(tp: TreeParams, sample_rate: float):
     """Traced training knobs: [sample_rate, col_sample_rate, learn_rate,
-    min_rows, reg_lambda, min_split_improvement]. Keeping these OUT of
-    the static jit key means one compiled boosting program serves every
-    grid/AutoML candidate of the same depth/nbins."""
+    min_rows, reg_lambda, min_split_improvement, max_depth]. Keeping
+    these OUT of the static jit key means one compiled boosting program
+    serves every grid/AutoML candidate of the same depth-BUCKET/nbins
+    (max_depth rides as the traced depth_limit; the program compiles at
+    bucket_depth(max_depth))."""
     return jnp.asarray([sample_rate, tp.col_sample_rate, tp.learn_rate,
                         tp.min_rows, tp.reg_lambda,
-                        tp.min_split_improvement], jnp.float32)
+                        tp.min_split_improvement,
+                        float(tp.max_depth)], jnp.float32)
 
 
 def _neutral_tp(tp: TreeParams) -> TreeParams:
     """Structural-only TreeParams for the jit static key (numeric knobs
-    travel as traced values)."""
-    return TreeParams(max_depth=tp.max_depth, min_rows=0.0,
+    travel as traced values; depth compiles at its bucket)."""
+    return TreeParams(max_depth=bucket_depth(tp.max_depth), min_rows=0.0,
                       learn_rate=0.0, reg_lambda=0.0,
                       min_split_improvement=0.0, col_sample_rate=1.0,
                       nbins_total=tp.nbins_total,
@@ -240,7 +243,8 @@ def _boost_step_impl(bins, nb, y, w, margin, key, knobs, *, tp, dist,
     ws = w * keep.astype(jnp.float32)
     F = bins.shape[1]
     col_mask = _sample_columns(kc1, kc2, F, knobs[1])
-    sc = TreeScalars(knobs[3], knobs[4], knobs[5])
+    sc = TreeScalars(knobs[3], knobs[4], knobs[5],
+                     knobs[6].astype(jnp.int32))
     tree, nid, gains = grow_tree(bins, nb, ws, g, h, col_mask,
                                  params=tp, mesh=mesh,
                                  constraints=constraints,
@@ -278,7 +282,8 @@ def _boost_step_multi_impl(bins, nb, y_int, w, margins, key, knobs, *,
     ws = w * keep.astype(jnp.float32)
     F = bins.shape[1]
     col_mask = _sample_columns(kc1, kc2, F, knobs[1])
-    sc = TreeScalars(knobs[3], knobs[4], knobs[5])
+    sc = TreeScalars(knobs[3], knobs[4], knobs[5],
+                     knobs[6].astype(jnp.int32))
     trees = []
     gains_tot = jnp.zeros((F,), jnp.float32)
     new_margins = margins
@@ -673,7 +678,11 @@ class GBMEstimator(ModelBuilder):
             K_ck = (ckpt.output.get("nclasses", 1)
                     if ckpt.output["category"] == ModelCategory.MULTINOMIAL
                     else 1)
-            if ckpt.forest.feat.shape[1] != int(p["max_depth"]):
+            # forest arrays are sized at the compile BUCKET of max_depth,
+            # so compare the recorded param, not the array shape
+            ck_depth = int(ckpt.params.get("max_depth",
+                                           ckpt.forest.feat.shape[1]))
+            if ck_depth != int(p["max_depth"]):
                 raise ValueError("max_depth cannot change across checkpoint "
                                  "restart (reference non-modifiable param)")
             prior_T = ckpt.forest.feat.shape[0] // K_ck

@@ -13,8 +13,10 @@ single-threaded Cholesky bottleneck disappears into LAX. Multinomial
 runs L-BFGS on the full softmax objective (the reference's default for
 multinomial is also L_BFGS).
 
-Families supported now: gaussian, binomial, poisson, gamma, tweedie,
-multinomial. (negativebinomial/ordinal/quasibinomial: follow-ups.)
+All reference families are supported: gaussian, binomial,
+quasibinomial, fractionalbinomial, poisson, gamma, tweedie,
+negativebinomial (theta), multinomial, ordinal (proportional-odds
+L-BFGS path) — see the Family class below and tests/test_glm_surface.py.
 """
 
 from __future__ import annotations

@@ -39,16 +39,38 @@ class TreeScalars(NamedTuple):
     static TreeParams, so every distinct (min_rows, reg_lambda, msi)
     combination — e.g. every AutoML/grid candidate — forced a fresh XLA
     compilation; as traced scalars one compiled program serves them all
-    (structure-affecting fields stay static in TreeParams)."""
+    (structure-affecting fields stay static in TreeParams).
+
+    ``depth_limit`` extends the trick to max_depth: programs compile at
+    a BUCKETED static depth (DEPTH_BUCKETS) and mask splits past the
+    traced actual depth, so AutoML/grid candidates of depths 3..6 (or
+    7..10, 11..14) all share one compiled boosting program instead of
+    paying a fresh 20-40s XLA compile each."""
     min_rows: jax.Array
     reg_lambda: jax.Array
     msi: jax.Array
+    depth_limit: jax.Array = None
 
 
 def scalars_of(params: "TreeParams") -> "TreeScalars":
     return TreeScalars(jnp.float32(params.min_rows),
                        jnp.float32(params.reg_lambda),
-                       jnp.float32(params.min_split_improvement))
+                       jnp.float32(params.min_split_improvement),
+                       jnp.int32(params.max_depth))
+
+
+# static compile-depth buckets: levels past the actual depth cost one
+# masked row-pass each, so the padding overhead is bounded by
+# bucket/actual while compile count drops from one-per-depth to
+# one-per-bucket (AutoML trains depths {3..15} in one session)
+DEPTH_BUCKETS = (6, 10, 14)
+
+
+def bucket_depth(d: int) -> int:
+    for b in DEPTH_BUCKETS:
+        if d <= b:
+            return b
+    return d
 
 
 class Tree(NamedTuple):
@@ -367,6 +389,10 @@ def grow_tree(bins, nb, w, g, h, col_mask, *, params: TreeParams, mesh,
             hist, nb, cm, params, constraints=constraints, lo=lo, hi=hi,
             scalars=sc, is_cat=is_cat)
         split = bg > sc.msi
+        if sc.depth_limit is not None:
+            # depth-bucketed program: levels past the ACTUAL depth never
+            # split (one compiled program per DEPTH_BUCKET, not per depth)
+            split = split & (jnp.int32(d) < sc.depth_limit)
         feats = feats.at[d, :L].set(jnp.where(split, bf, 0))
         threshs = threshs.at[d, :L].set(jnp.where(split, bt, B))
         na_lefts = na_lefts.at[d, :L].set(jnp.where(split, bnal, False))

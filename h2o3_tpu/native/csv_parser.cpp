@@ -115,6 +115,9 @@ struct SpecialTable {
   }
 };
 
+// cb(col, ptr, len, quoted): `quoted` distinguishes a quoted empty field
+// ("" = empty STRING token, CsvParser.java addStrCol path) from a bare
+// empty field (missing, addInvalidCol path)
 template <typename F>
 static const char* scan_line(const char* p, const char* limit, char sep,
                              const bool* special, F&& cb) {
@@ -157,7 +160,7 @@ static const char* scan_line(const char* p, const char* limit, char sep,
           fn = (long)unq.size();
         }
       }
-      cb(col++, fp, fn);
+      cb(col++, fp, fn, qstart != nullptr);
       if (c == sep) { p++; fstart = p; qstart = nullptr; has_esc = false; continue; }
       // line end
       if (c == '\r' && p + 1 < limit && p[1] == '\n') p++;
@@ -178,7 +181,7 @@ static const char* scan_line(const char* p, const char* limit, char sep,
     fp = unq.data();
     fn = (long)unq.size();
   }
-  cb(col++, fp, fn);
+  cb(col++, fp, fn, qstart != nullptr);
   return p;
 }
 
@@ -216,6 +219,8 @@ struct ThreadChunk {
   std::vector<std::unordered_map<std::string, int>> interns;  // per col
   std::vector<std::vector<std::string>> local_levels;
   std::vector<char> col_is_str;                    // pass-1 flags
+  std::vector<char> col_has_num;                   // saw a numeric token
+  std::vector<char> col_has_qempty;                // saw a quoted ""
 };
 
 }  // namespace
@@ -232,7 +237,8 @@ void* csv_parse(const char* data, long len, char sep, int header,
   // header row
   std::vector<std::string> names;
   if (header) {
-    body = scan_line(data, limit, sep, st.special, [&](int, const char* p, long n) {
+    body = scan_line(data, limit, sep, st.special,
+                     [&](int, const char* p, long n, bool) {
       names.emplace_back(p, (size_t)n);
     });
   }
@@ -257,7 +263,8 @@ void* csv_parse(const char* data, long len, char sep, int header,
   if (!ncols_guess) {
     // count fields of first line
     size_t c = 0;
-    scan_line(body, limit, sep, st.special, [&](int, const char*, long) { c++; });
+    scan_line(body, limit, sep, st.special,
+              [&](int, const char*, long, bool) { c++; });
     ncols_guess = c;
   }
   const size_t NC = ncols_guess;
@@ -268,15 +275,23 @@ void* csv_parse(const char* data, long len, char sep, int header,
     pool.emplace_back([&, t]() {
       ThreadChunk& ch = chunks[t];
       ch.col_is_str.assign(NC, 0);
+      ch.col_has_num.assign(NC, 0);
+      ch.col_has_qempty.assign(NC, 0);
       const char* p = ch.begin;
       while (p < ch.end) {
         if (*p == '\n') { p++; continue; }                      // blank line
         if (*p == '\r' && p + 1 < ch.end && p[1] == '\n') { p += 2; continue; }
-        p = scan_line(p, limit, sep, st.special, [&](int col, const char* fp, long fn) {
+        p = scan_line(p, limit, sep, st.special,
+                      [&](int col, const char* fp, long fn, bool q) {
           if ((size_t)col >= NC) return;
+          if (fn == 0) {
+            if (q) ch.col_has_qempty[col] = 1;  // quoted "": string token
+            return;
+          }
           if (ch.col_is_str[col] || is_na_token(fp, fn)) return;
           double v;
           if (!parse_double(fp, fn, &v)) ch.col_is_str[col] = 1;
+          else ch.col_has_num[col] = 1;
         });
         ch.nrows++;
       }
@@ -285,12 +300,22 @@ void* csv_parse(const char* data, long len, char sep, int header,
   for (auto& th : pool) th.join();
   pool.clear();
 
-  std::vector<char> is_str(NC, 0);
+  std::vector<char> is_str(NC, 0), has_num(NC, 0), has_qe(NC, 0);
   long total_rows = 0;
   for (auto& ch : chunks) {
     total_rows += ch.nrows;
-    for (size_t j = 0; j < NC; j++) is_str[j] |= ch.col_is_str[j];
+    for (size_t j = 0; j < NC; j++) {
+      is_str[j] |= ch.col_is_str[j];
+      has_num[j] |= ch.col_has_num[j];
+      has_qe[j] |= ch.col_has_qempty[j];
+    }
   }
+  // a column whose only non-missing tokens are quoted "" is a string
+  // column with the {""} domain (PreviewParseWriter.guessType: all-same-
+  // string domain → T_CAT); any numeric token keeps it numeric
+  // (nnums >= nstrings tie goes numeric) and "" degrades to NA there
+  for (size_t j = 0; j < NC; j++)
+    if (!is_str[j] && has_qe[j] && !has_num[j]) is_str[j] = 1;
 
   // ---- pass 2: typed fill with per-thread interning ----
   for (int t = 0; t < nthreads; t++) {
@@ -310,10 +335,15 @@ void* csv_parse(const char* data, long len, char sep, int header,
         if (*p == '\n') { p++; continue; }                      // blank line
         if (*p == '\r' && p + 1 < ch.end && p[1] == '\n') { p += 2; continue; }
         long before = filled;
-        p = scan_line(p, limit, sep, st.special, [&](int col, const char* fp, long fn) {
+        p = scan_line(p, limit, sep, st.special,
+                      [&](int col, const char* fp, long fn, bool q) {
           if ((size_t)col >= NC) return;
           if (is_str[col]) {
-            if (is_na_token(fp, fn)) { ch.local_codes[col].push_back(-1); return; }
+            // quoted "" is the empty STRING, bare empty is missing
+            if (is_na_token(fp, fn) && !(fn == 0 && q)) {
+              ch.local_codes[col].push_back(-1);
+              return;
+            }
             std::string s(fp, (size_t)fn);
             auto it = ch.interns[col].find(s);
             int code;

@@ -566,10 +566,14 @@ def _append(env, *args):
     seen = set()
     for f in frames:
         for n in f.names:
+            # duplicate names take integer suffixes FROM ZERO:
+            # Frame.uniquify (water/fvec/Frame.java:227) appends cnt++
+            # per collision — colgroup → colgroup0, colgroup2 →
+            # colgroup20 → colgroup21 when colgroup20 is taken
             nm, k = n, 0
             while nm in seen:
-                k += 1
                 nm = f"{n}{k}"
+                k += 1
             seen.add(nm)
             c = f.col(n)
             if c.is_categorical:
@@ -680,8 +684,14 @@ def _rect_assign(env, dst, src, col_sel, row_sel):
             if isinstance(val, Frame):
                 j = cols.index(n) if val.ncols > 1 else 0
                 vc = val.col(val.names[j])
-                v = (_cat_codes(val, val.names[j]).astype(np.float64)
-                     if vc.is_categorical else vc.to_numpy())
+                if vc.is_categorical:
+                    # NA codes are -1; as float they must become NaN
+                    # BEFORE the domain remap or mp[-1] silently maps
+                    # every NA row to the LAST level
+                    v = _cat_codes(val, val.names[j]).astype(np.float64)
+                    v[v < 0] = np.nan
+                else:
+                    v = vc.to_numpy()
                 full = len(rows) == f.nrows
                 if full and vc.is_categorical and dom is None:
                     # whole-column replace with a factor: the column
@@ -963,10 +973,13 @@ def _quantile(env, fr, probs, method=("str", "interpolate"), *rest):
 def _sort(env, fr, cols_sel, *asc):
     f = _as_frame(env.ev(fr))
     names = _resolve_cols(f, cols_sel)
+    # h2o-py encodes direction as +1 (asc) / -1 (desc), never 0
+    # (h2o-py/h2o/frame.py sort(): ascendingI[index]=1 if ... else -1),
+    # so bool() is wrong — bool(-1) is True. Sign is the contract.
     if asc and isinstance(asc[0], tuple) and asc[0][0] == "list":
-        ascending = [bool(a[1]) for a in asc[0][1]]
+        ascending = [float(a[1]) > 0 for a in asc[0][1]]
     else:
-        ascending = [bool(env.ev(a)) for a in asc]
+        ascending = [float(env.ev(a)) > 0 for a in asc]
     ascending = ascending or [True] * len(names)
     # device radix-order path (water/rapids/RadixOrder.java role): sort
     # permutation + column gathers stay on the mesh; the controller
@@ -1082,10 +1095,15 @@ def _groupby(env, fr, by_sel, *aggs):
 
 
 @prim("merge")
-def _merge(env, l, r, all_left=("num", 0), all_right=("num", 0), *rest):
-    """Hash join on shared column names (water/rapids/Merge.java role; the
-    reference's distributed radix merge, RadixOrder/BinaryMerge.java,
-    collapses to a driver-side hash join here)."""
+def _merge(env, l, r, all_left=("num", 0), all_right=("num", 0),
+           by_x=None, by_y=None, method=None):
+    """Equi-join (water/rapids/Merge.java + BinaryMerge.java roles).
+
+    h2o-py always ships by_x/by_y as column-index lists (defaulting to
+    all shared names, h2o-py/h2o/frame.py merge()). Large frames with
+    same-named keys run fully on device (ops/merge.py sort-merge join);
+    everything else — string keys, right/outer, renamed key pairs,
+    tiny frames — takes the host hash join."""
     lf = _as_frame(env.ev(l))
     rf = _as_frame(env.ev(r))
     how = "inner"
@@ -1093,93 +1111,68 @@ def _merge(env, l, r, all_left=("num", 0), all_right=("num", 0), *rest):
         how = "left"
     if int(env.ev(all_right)):
         how = "outer" if how == "left" else "right"
-    dm = _device_merge(lf, rf, how)
-    if dm is not None:
-        return dm
+    shared = [n for n in lf.names if n in set(rf.names)]
+    bx = by = shared
+    if by_x is not None and isinstance(by_x, tuple) \
+            and by_x[0] == "list" and by_x[1]:
+        bx = _resolve_cols(lf, by_x)
+        by = _resolve_cols(rf, by_y) if by_y is not None else bx
+    if bx == by:
+        from h2o3_tpu.ops.merge import device_merge
+        dm = device_merge(lf, rf, bx, how)
+        if dm is not None:
+            return dm
     ldf = lf.to_pandas()
     rdf = rf.to_pandas()
     # NA keys never match (reference Merge.java / SQL semantics; pandas
     # would join NaN==NaN): drop NA-key rows from the non-preserved side
-    shared = [n for n in ldf.columns if n in set(rdf.columns)]
-    if shared:
+    if bx:
         if how in ("inner", "left"):
-            rdf = rdf.dropna(subset=shared)
+            rdf = rdf.dropna(subset=by)
         if how in ("inner", "right"):
-            ldf = ldf.dropna(subset=shared)
-    m = ldf.merge(rdf, how=how)
+            ldf = ldf.dropna(subset=bx)
+    if how == "outer" and bx:
+        # both sides preserved: join the non-NA-key rows, then append
+        # each side's NA-key rows unmatched (pandas would pair NaN==NaN).
+        # Appended slices must carry the SAME schema as the merge result:
+        # colliding non-key columns take pandas' _x/_y suffixes and
+        # renamed right keys fold under the left key names.
+        import pandas as _pd
+        lna = ldf[bx].isna().any(axis=1)
+        rna = rdf[by].isna().any(axis=1)
+        if bx == by:
+            m = ldf[~lna].merge(rdf[~rna], how="outer", on=bx)
+        else:
+            m = ldf[~lna].merge(rdf[~rna], how="outer",
+                                left_on=bx, right_on=by)
+            m = m.drop(columns=[c for c in by if c not in bx and c in m])
+        collide = {c for c in rdf.columns
+                   if c not in by and c in set(ldf.columns) - set(bx)}
+        l_tail = ldf[lna].rename(
+            columns={c: c + "_x" for c in collide})
+        r_tail = rdf[rna].rename(columns={**dict(zip(by, bx)),
+                                          **{c: c + "_y" for c in collide}})
+        r_tail = r_tail.loc[:, [c for c in r_tail.columns if c in m.columns]]
+        m = _pd.concat([m, l_tail, r_tail], ignore_index=True)
+        return Frame.from_pandas(m)
+    if bx == by:
+        m = ldf.merge(rdf, how=how, on=bx or None)
+    else:
+        # renamed key pairs: the reference keeps ONE key column under
+        # the left frame's names (BinaryMerge result layout)
+        m = ldf.merge(rdf, how=how, left_on=bx, right_on=by)
+        m = m.drop(columns=[c for c in by if c not in bx and c in m])
     return Frame.from_pandas(m)
 
 
 def _device_merge(lf: Frame, rf: Frame, how: str) -> Optional[Frame]:
-    """BinaryMerge.java role: single-shared-key equi-join with the sort
-    + binary searches on device; the controller only expands match
-    ranges. Multi-key / string-key / right-outer joins fall back to the
-    host hash join."""
-    from h2o3_tpu.ops.sort import DEVICE_SORT_MIN_ROWS, device_join_index
+    """Back-compat shim over ops/merge.py device_merge (joins on all
+    shared column names, like the h2o-py default)."""
+    from h2o3_tpu.ops.merge import device_merge
     shared = [n for n in lf.names if n in set(rf.names)]
-    if len(shared) != 1 or how not in ("inner", "left"):
+    if not shared:
         return None
-    if max(lf.nrows, rf.nrows) < DEVICE_SORT_MIN_ROWS:
-        return None
-    key = shared[0]
-    lc, rc = lf.col(key), rf.col(key)
-    if lc.data is None or rc.data is None:
-        return None
-    if lc.is_categorical != rc.is_categorical:
-        return None
-    if lc.is_categorical and lc.domain != rc.domain:
-        return None                     # domain remap → host path
-    l_idx, r_idx = device_join_index(lc.numeric_view(), rc.numeric_view(),
-                                     lf.nrows, rf.nrows)
-    if how == "left":
-        import numpy as _np
-        matched = _np.zeros(lf.nrows, bool)
-        matched[l_idx] = True
-        miss = _np.flatnonzero(~matched)
-        l_idx = _np.concatenate([l_idx, miss])
-        r_idx = _np.concatenate([r_idx, _np.full(len(miss), -1)])
-        order = _np.argsort(l_idx, kind="stable")
-        l_idx, r_idx = l_idx[order], r_idx[order]
-    # pandas-compatible suffixing so the schema is identical whichever
-    # path (device or host fallback) a given frame size takes
-    collide = {n for n in rf.names if n != key and n in set(lf.names)}
-    left_part = _take_rows(lf, l_idx)
-    arrays, cats, doms = {}, [], {}
-    for n in left_part.names:
-        c = left_part.col(n)
-        out_name = n + "_x" if n in collide else n
-        if c.is_categorical:
-            arrays[out_name] = _cat_codes(left_part, n)
-            cats.append(out_name)
-            doms[out_name] = c.domain
-        elif c.type == "string":
-            arrays[out_name] = c.to_numpy()
-        else:
-            arrays[out_name] = _col_np(left_part, n)
-    rmask = r_idx < 0
-    r_safe = np.where(rmask, 0, r_idx)
-    right_part = _take_rows(rf, r_safe)
-    for n in rf.names:
-        if n == key:
-            continue
-        out_name = n + "_y" if n in collide else n
-        c = right_part.col(n)
-        if c.is_categorical:
-            v = _cat_codes(right_part, n).astype(np.float64)
-            v[rmask] = np.nan
-            codes = np.where(np.isnan(v), -1, v).astype(np.int32)
-            arrays[out_name] = codes
-            cats.append(out_name)
-            doms[out_name] = c.domain
-        elif c.type == "string":
-            v = c.to_numpy().astype(object)
-            v[rmask] = None
-            arrays[out_name] = v
-        else:
-            v = _col_np(right_part, n)
-            v[rmask] = np.nan
-            arrays[out_name] = v
-    return Frame.from_numpy(arrays, categorical=cats, domains=doms)
+    return device_merge(lf, rf, shared, how)
 
 
 @prim("na.omit")
@@ -1245,11 +1238,11 @@ def _strop(fn):
         for n in f.names:
             c = f.col(n)
             if c.is_categorical:
-                # transformed labels re-intern: duplicates collapse and
-                # '' becomes NA (the reference drops empty levels —
-                # substring past the end must shrink the domain)
+                # transformed labels re-intern: duplicates collapse.
+                # '' stays a REAL level — AstSubstring keeps a {""}
+                # domain server-side and h2o-py levels() filters ''
+                # client-side (h2o-py/h2o/frame.py levels()).
                 dom = [fn(s, *extra) for s in (c.domain or [])]
-                dom = [None if d == "" else d for d in dom]
                 codes = _fetch_np(c.data)[: f.nrows].astype(np.int64)
                 codes = np.where(_fetch_np(c.na_mask)[: f.nrows],
                                  len(dom), codes)
@@ -1275,8 +1268,10 @@ PRIMS["replacefirst"] = PRIMS["sub"]
 PRIMS["replaceall"] = PRIMS["gsub"]
 
 
-@prim("nchar")
+@prim("nchar", "strlen")
 def _nchar(env, x):
+    """String length (AstStrLength, str()='strlen' — the op h2o-py
+    nchar() actually sends; 'nchar' kept as a courtesy alias)."""
     f = _as_frame(env.ev(x))
     out = {}
     for n in f.names:
@@ -1297,11 +1292,20 @@ def _nchar(env, x):
 
 @prim("substring")
 def _substring(env, x, start, end=("num", 1e9)):
+    """AstSubstring: start clamps to 0; end sent as an empty AstNumList
+    ([] — h2o-py substring(end_index=None)) means MAX; start >= end
+    yields '' for every row (the reference's {\"\"} domain), so a
+    negative end must NOT fall through to Python negative slicing."""
     s0 = int(env.ev(start))
-    ev = env.ev(end)
-    e0 = int(1e9) if (isinstance(ev, float) and np.isnan(ev)) \
-        else int(min(ev, 1e9))
+    if isinstance(end, tuple) and end[0] == "list":
+        e0 = int(1e9)                       # [] → Integer.MAX_VALUE
+    else:
+        ev = env.ev(end)
+        e0 = int(1e9) if (isinstance(ev, float) and np.isnan(ev)) \
+            else int(min(ev, 1e9))
     s0 = max(s0, 0)
+    if e0 <= s0:
+        return _strop(lambda s: "")(env, x)
     return _strop(lambda s: s[s0:e0])(env, x)
 
 
@@ -1900,14 +1904,16 @@ def _rep_len(env, x, length):
     if not isinstance(v, Frame):
         return Frame.from_numpy({"C1": np.full(n, float(v))})
     if v.ncols == 1:
+        # output vec is wrapped in an UNNAMED frame → default name C1
+        # (AstRepLen.java:50 `new Frame(vec)`)
         nm = v.names[0]
         c = v.col(nm)
         if c.is_categorical:
             return Frame.from_numpy(
-                {nm: np.resize(_cat_codes(v, nm), n)},
-                categorical=[nm], domains={nm: c.domain})
+                {"C1": np.resize(_cat_codes(v, nm), n)},
+                categorical=["C1"], domains={"C1": c.domain})
         return Frame.from_numpy(
-            {nm: np.resize(_col_np(v, nm), n).astype(np.float64)})
+            {"C1": np.resize(_col_np(v, nm), n).astype(np.float64)})
     out, cats, doms = {}, [], {}
     for i in range(n):
         src = v.names[i % v.ncols]

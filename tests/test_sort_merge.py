@@ -48,6 +48,90 @@ def test_device_sort_ignores_padding_rows():
     assert np.array_equal(got, np.sort(a, kind="stable"))
 
 
+def _cmp_merge(got, exp, keys, cols):
+    g = got.to_pandas().sort_values(cols, na_position="last") \
+        .reset_index(drop=True)
+    e = exp.sort_values(cols, na_position="last").reset_index(drop=True)
+    assert len(g) == len(e), (len(g), len(e))
+    for col in cols:
+        ga = pd.to_numeric(g[col], errors="coerce").to_numpy(float)
+        ea = pd.to_numeric(e[col], errors="coerce").to_numpy(float)
+        nn = ~(np.isnan(ga) & np.isnan(ea))
+        assert np.allclose(ga[nn], ea[nn]), col
+
+
+def test_device_merge_multikey():
+    """Two-key join with NAs in a key column: NA keys never match
+    (Merge.java semantics) and multi-key equality is exact."""
+    r = np.random.RandomState(7)
+    k1 = r.randint(0, 200, N).astype(float)
+    k2 = r.randint(0, 5, N).astype(float)
+    k1[::101] = np.nan
+    nr = N // 3
+    rk1 = r.randint(100, 300, nr).astype(float)
+    rk2 = r.randint(0, 5, nr).astype(float)
+    lf = Frame.from_numpy({"k1": k1, "k2": k2,
+                           "lv": np.arange(N, dtype=float)})
+    rf = Frame.from_numpy({"k1": rk1, "k2": rk2,
+                           "rv": np.arange(nr, dtype=float)})
+    ldf = lf.to_pandas()
+    rdf = rf.to_pandas()
+    from h2o3_tpu.ops.merge import device_merge
+    for how in ("inner", "left"):
+        got = device_merge(lf, rf, ["k1", "k2"], how)
+        assert got is not None
+        rr = rdf.dropna(subset=["k1", "k2"])
+        ll = ldf.dropna(subset=["k1", "k2"]) if how == "inner" else ldf
+        exp = ll.merge(rr, how=how, on=["k1", "k2"])
+        _cmp_merge(got, exp, ["k1", "k2"], ["k1", "k2", "lv", "rv"])
+
+
+def test_device_merge_categorical_key_domain_remap():
+    """Categorical keys with DIFFERENT domains remap right→left; unseen
+    right levels never match."""
+    r = np.random.RandomState(8)
+    ldom = ["a", "b", "c", "d"]
+    rdom = ["b", "c", "d", "e"]          # e unseen on the left
+    lcode = r.randint(0, 4, N)
+    rcode = r.randint(0, 4, N // 4)
+    lf = Frame.from_numpy(
+        {"k": lcode.astype(np.int32), "lv": np.arange(N, dtype=float)},
+        categorical=["k"], domains={"k": ldom})
+    rf = Frame.from_numpy(
+        {"k": rcode.astype(np.int32), "rv": np.arange(N // 4, dtype=float)},
+        categorical=["k"], domains={"k": rdom})
+    from h2o3_tpu.ops.merge import device_merge
+    got = device_merge(lf, rf, ["k"], "inner")
+    assert got is not None
+    llab = np.array(ldom, object)[lcode]
+    rlab = np.array(rdom, object)[rcode]
+    ldf = pd.DataFrame({"k": llab, "lv": np.arange(N, dtype=float)})
+    rdf = pd.DataFrame({"k": rlab, "rv": np.arange(N // 4, dtype=float)})
+    exp = ldf.merge(rdf, how="inner", on="k")
+    g = got.to_pandas().sort_values(["k", "lv", "rv"]).reset_index(drop=True)
+    e = exp.sort_values(["k", "lv", "rv"]).reset_index(drop=True)
+    assert len(g) == len(e)
+    assert list(g["k"]) == list(e["k"])
+    assert np.allclose(g["lv"], e["lv"]) and np.allclose(g["rv"], e["rv"])
+
+
+def test_device_merge_int_keys_exact_above_f32():
+    """int32 keys beyond the f32-exact range (2^24) must still join
+    exactly — the device path compares ints as ints."""
+    base = 20_000_000
+    lk = base + np.arange(N)
+    rk = base + np.arange(0, N, 7)
+    lf = Frame.from_numpy({"k": lk.astype(np.int64),
+                           "lv": np.arange(N, dtype=float)})
+    rf = Frame.from_numpy({"k": rk.astype(np.int64),
+                           "rv": np.arange(len(rk), dtype=float)})
+    from h2o3_tpu.ops.merge import device_merge
+    got = device_merge(lf, rf, ["k"], "inner")
+    assert got is not None
+    # every 7th left row matches exactly once
+    assert got.nrows == len(rk)
+
+
 def test_device_merge_inner_and_left():
     r = np.random.RandomState(2)
     lk = r.randint(0, 1000, N).astype(float)
