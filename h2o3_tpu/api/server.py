@@ -564,11 +564,10 @@ def _dkv_del_all(params, body):
         used = int(st.get("bytes_in_use", 0) or 0)
         cap = int(st.get("bytes_limit", 0) or 0)
         if (cap and used > 0.8 * cap) or \
-                (not cap and _RMALL_COUNT % 15 == 0):
-            jax.clear_caches()
-            gc.collect()
-            log.info("remove_all #%d: cleared jit caches (HBM %.1f/%.1f "
-                     "GB)", _RMALL_COUNT, used / 1e9, cap / 1e9)
+                (not cap and _RMALL_COUNT % 10 == 0):
+            from h2o3_tpu.core.job import free_device_memory
+            free_device_memory(f"remove_all #{_RMALL_COUNT}, HBM "
+                               f"{used / 1e9:.1f}/{cap / 1e9:.1f} GB")
     except Exception:
         pass
     return {}
@@ -1038,7 +1037,16 @@ def _rapids_ep(params, body):
     (h2o-py/h2o/expr.py:116-128); errors must be H2OErrorV3."""
     from h2o3_tpu.rapids import rapids
     expr = params.get("ast") or ""
-    val = rapids(expr)
+    try:
+        val = rapids(expr)
+    except Exception as e:   # noqa: BLE001
+        # HBM pressure shows up as RESOURCE_EXHAUSTED (the axon plugin
+        # reports no memory gauge): purge jit caches and retry once
+        if "RESOURCE_EXHAUSTED" not in f"{e}":
+            raise
+        from h2o3_tpu.core.job import free_device_memory
+        free_device_memory("rapids RESOURCE_EXHAUSTED retry")
+        val = rapids(expr)
     if isinstance(val, Frame):
         return {"__meta": {"schema_version": 3,
                            "schema_name": "RapidsFrameV3",

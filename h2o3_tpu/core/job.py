@@ -26,9 +26,12 @@ CREATED, RUNNING, DONE, FAILED, CANCELLED = (
 # transient infra failures of the tunneled chip / compile service —
 # distinct from user errors and worth exactly one in-place retry (a
 # remote_compile INTERNAL blip permanently failed an AutoML step in
-# round 2's bench run)
+# round 2's bench run). RESOURCE_EXHAUSTED is retryable because the
+# retry is preceded by a jit-cache purge (see free_device_memory): the
+# executable cache pins HBM and the axon plugin reports no memory
+# stats, so pressure shows up as this error, not as a readable gauge.
 _INFRA_SIGNS = ("remote_compile", "INTERNAL:", "UNAVAILABLE:",
-                "DEADLINE_EXCEEDED")
+                "DEADLINE_EXCEEDED", "RESOURCE_EXHAUSTED")
 
 
 def is_infra_error(e: BaseException) -> bool:
@@ -39,6 +42,20 @@ def is_infra_error(e: BaseException) -> bool:
         return False
     msg = f"{type(e).__name__}: {e}"
     return any(s in msg for s in _INFRA_SIGNS)
+
+
+def free_device_memory(reason: str = "") -> None:
+    """Best-effort HBM pressure release: drop jit executable caches and
+    collect dropped buffers (the water/Cleaner.java role for a device
+    whose backend reports no memory stats)."""
+    import gc
+    try:
+        import jax
+        jax.clear_caches()
+    except Exception:
+        pass
+    gc.collect()
+    log.info("freed device caches%s", f" ({reason})" if reason else "")
 
 
 class JobCancelledException(Exception):
@@ -86,6 +103,10 @@ class Job:
                                 self.key, e)
                     _tl("job", f"infra-retry {self.description}",
                         key=self.key, error=str(e)[:200])
+                    if "RESOURCE_EXHAUSTED" in f"{e}":
+                        # HBM pressure: purge executable caches before
+                        # the retry or it just exhausts again
+                        free_device_memory("RESOURCE_EXHAUSTED retry")
                     self._worked = 0.0
                     self.result = fn(self)
                 if self.dest and self.result is not None:

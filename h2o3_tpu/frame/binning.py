@@ -74,10 +74,18 @@ class BinnedMatrix:
     nrows: int
     domains: List[Optional[List[str]]]
     nbins_cats: int = 64       # cat-bin cap used at train time
+    source_ref: Optional[object] = None  # weakref to the built-from frame
 
     @property
     def nfeatures(self) -> int:
         return len(self.names)
+
+    def __getstate__(self):
+        # weakrefs don't pickle (model save/load path); the rebin
+        # short-circuit simply doesn't survive serialization
+        d = dict(self.__dict__)
+        d["source_ref"] = None
+        return d
 
 
 def _numeric_edges(x: np.ndarray, nbins: int,
@@ -241,14 +249,28 @@ def bin_frame(frame: Frame, features: Sequence[str], nbins: int = 64,
         from h2o3_tpu.parallel.mesh import put_sharded
         bins = put_sharded(bins, row_sharding())
 
+    import weakref
+    try:
+        src_ref = weakref.ref(frame)
+    except TypeError:
+        src_ref = None
     return BinnedMatrix(bins=bins, nbins=nb_dev, edges=edges_dev,
                         is_cat=is_cat, names=names, nbins_total=B,
                         nrows=frame.nrows, domains=domains,
-                        nbins_cats=nbins_cats)
+                        nbins_cats=nbins_cats, source_ref=src_ref)
 
 
 def rebin_for_scoring(train_bm: BinnedMatrix, frame: Frame) -> BinnedMatrix:
-    """Bin a new frame with the training matrix's edges/domains."""
+    """Bin a new frame with the training matrix's edges/domains.
+
+    Scoring the SAME frame object the matrix was built from returns it
+    as-is — CV fold models share the parent frame and the parent bin
+    edges, so a rebin per fold (hundreds in near-LOO sweeps) would redo
+    identical work. Identity is by weakref (a mutated/replaced frame is
+    a new object and rebins normally)."""
+    ref = getattr(train_bm, "source_ref", None)
+    if ref is not None and ref() is frame:
+        return train_bm
     host_edges = np.asarray(train_bm.edges)
     per_feat = []
     for i in range(train_bm.nfeatures):

@@ -572,3 +572,250 @@ def score_reference_mojo(path: str, rows: Dict[str, np.ndarray]):
                 for r in range(n):
                     out[r, k] += _score_tree(blob, mat[r], domains_len)
         return out, info
+
+
+# ------------------------------------------------- KMeans writer/reader
+
+
+def write_reference_kmeans_mojo(model, path: str) -> str:
+    """Reference-layout K-means MOJO (KMeansMojoReader v1.00 contract):
+    model.ini kv pairs ``standardize``/``standardize_means``/
+    ``standardize_mults``/``standardize_modes``/``center_num``/
+    ``center_i``. Centers are written in STANDARDIZED space when
+    standardize=true — KMeansMojoModel.score0 preprocesses the row with
+    (x - mean) * mult before KMeans_closest. Numeric feature sets only:
+    the reference handles categoricals through domain indices while our
+    KMeans one-hot expands them (different geometry)."""
+    if any(d is not None for d in model.di_stats["domains"]):
+        raise ValueError("reference-format KMeans MOJO export covers "
+                         "numeric feature sets (our KMeans one-hot "
+                         "expands categoricals; the reference does not)")
+    feats = list(model.features)
+    centers = np.asarray(model.centers_std, np.float64)
+    means = [float(m) for m in model.di_stats["num_means"]]
+    sds = [float(s) if s > 0 else 1.0
+           for s in model.di_stats["num_sigmas"]]
+    info = _base_info(model, category="Clustering",
+                      n_features=len(feats), n_classes=1,
+                      n_columns=len(feats), n_domains=0)
+    info.update({
+        "mojo_version": "1.00",
+        "algo": "kmeans",
+        "algorithm": "K-means",
+        "supervised": "false",
+        "standardize": "true" if model.standardize else "false",
+        "center_num": centers.shape[0],
+    })
+    if model.standardize:
+        info["standardize_means"] = _jarr(means)
+        info["standardize_mults"] = _jarr([1.0 / s for s in sds])
+        info["standardize_modes"] = _jarr([0] * len(feats))
+    for i in range(centers.shape[0]):
+        info[f"center_{i}"] = _jarr([float(v) for v in centers[i]])
+    return _emit_mojo_zip(path, info, feats, [None] * len(feats))
+
+
+def score_reference_kmeans_mojo(path: str, rows: Dict[str, np.ndarray]):
+    """Cluster assignment from a reference KMeans MOJO — the ported
+    KMeansMojoModel.score0 (preprocess + KMeans_closest)."""
+    info, columns, _ = _read_ini(path)
+    n_feat = int(info["n_features"])
+    k = int(info["center_num"])
+    centers = np.stack([_parse_jarr(info[f"center_{i}"])
+                        for i in range(k)])
+    n = len(next(iter(rows.values())))
+    mat = np.zeros((n, n_feat))
+    for i in range(n_feat):
+        mat[:, i] = np.asarray(rows[columns[i]], np.float64)
+    if info.get("standardize") == "true":
+        means = np.asarray(_parse_jarr(info["standardize_means"]))
+        mults = np.asarray(_parse_jarr(info["standardize_mults"]))
+        mat = (mat - means) * mults
+    d2 = ((mat[:, None, :] - centers[None, :, :]) ** 2).sum(axis=2)
+    return d2.argmin(axis=1), info
+
+
+# ------------------------------------------- DeepLearning writer/reader
+
+
+def _dl_ref_layout(model):
+    """Our feature-order design vs the reference's cats-first layout.
+
+    Returns (cats_i, nums_i, ref_to_ours): reference input unit j maps
+    to our design-matrix column ref_to_ours[j]
+    (DeeplearningMojoModel.score0 fills neuronsInput as [one-hot cat
+    blocks..., standardized nums...]; our DataInfo expands in feature
+    order)."""
+    domains = model.di_stats["domains"]
+    use_all = bool(model.params.get("use_all_factor_levels", True))
+    first = 0 if use_all else 1
+    ours = []           # per feature: list of our design column indices
+    pos = 0
+    for d in domains:
+        if d is not None:
+            kk = max(len(d), 1) - first
+            ours.append(list(range(pos, pos + kk)))
+            pos += kk
+        else:
+            ours.append([pos])
+            pos += 1
+    cats_i = [i for i, d in enumerate(domains) if d is not None]
+    nums_i = [i for i, d in enumerate(domains) if d is None]
+    ref_to_ours = []
+    for i in cats_i:
+        ref_to_ours += ours[i]
+    for i in nums_i:
+        ref_to_ours += ours[i]
+    return cats_i, nums_i, ref_to_ours
+
+
+def write_reference_dl_mojo(model, path: str) -> str:
+    """Reference-layout DeepLearning MOJO (DeeplearningMojoReader v1.10
+    contract): model.ini kv with per-layer ``weight_layerN`` (row-major
+    [out, in] doubles) / ``bias_layerN``, normalization stats, and the
+    cats-first input layout — first-layer weight columns are permuted
+    from our feature-order design accordingly. NA categorical rows
+    diverge (we encode NA as the all-zero indicator block; the reference
+    imputes the mode level)."""
+    from h2o3_tpu.models.model import ModelCategory
+    cat = model.output["category"]
+    feats = list(model.features)
+    domains = model.di_stats["domains"]
+    cats_i, nums_i, ref_to_ours = _dl_ref_layout(model)
+    use_all = bool(model.params.get("use_all_factor_levels", True))
+    first = 0 if use_all else 1
+
+    means = [float(m) for m in model.di_stats["num_means"]]
+    sds = [float(s) if s > 0 else 1.0
+           for s in model.di_stats["num_sigmas"]]
+    cat_offsets = [0]
+    for i in cats_i:
+        cat_offsets.append(cat_offsets[-1]
+                           + max(len(domains[i]), 1) - first)
+
+    layers = [(np.asarray(p["W"], np.float64), np.asarray(p["b"], np.float64))
+              for p in model.net]
+    units = [layers[0][0].shape[0]] + [b.shape[0] for _, b in layers]
+
+    n_classes = (model.output.get("nclasses", 1)
+                 if cat in (ModelCategory.BINOMIAL,
+                            ModelCategory.MULTINOMIAL) else 1)
+    names = ([feats[i] for i in cats_i] + [feats[i] for i in nums_i]
+             + [model.output["response"]])
+    doms: List[Optional[List[str]]] = (
+        [list(domains[i]) for i in cats_i] + [None] * len(nums_i)
+        + [model.output.get("domain")])
+    info = _base_info(model, category={
+        ModelCategory.BINOMIAL: "Binomial",
+        ModelCategory.MULTINOMIAL: "Multinomial"}.get(cat, "Regression"),
+        n_features=len(feats), n_classes=max(n_classes, 1),
+        n_columns=len(names),
+        n_domains=sum(1 for d in doms if d is not None))
+    dist = "bernoulli" if cat == ModelCategory.BINOMIAL else \
+        ("multinomial" if cat == ModelCategory.MULTINOMIAL else "gaussian")
+    info.update({
+        "mojo_version": "1.10",
+        "algo": "deeplearning",
+        "algorithm": "Deep Learning",
+        "mini_batch_size": 1,
+        "nums": len(nums_i),
+        "cats": len(cats_i),
+        "cat_offsets": _jarr(cat_offsets),
+        "norm_mul": _jarr([1.0 / s for s in sds]),
+        "norm_sub": _jarr(means),
+        "use_all_factor_levels": "true" if use_all else "false",
+        "activation": str(model.params.get("activation", "Rectifier")),
+        "mean_imputation": "false",
+        "distribution": dist,
+        "neural_network_sizes": _jarr(units),
+        "hidden_dropout_ratios": _jarr([]),
+        "_genmodel_encoding": "AUTO",
+    })
+    if cat == ModelCategory.REGRESSION and model.resp_stats is not None:
+        mu, sd = model.resp_stats
+        info["norm_resp_mul"] = _jarr([1.0 / (sd if sd else 1.0)])
+        info["norm_resp_sub"] = _jarr([float(mu)])
+    for li, (W, b) in enumerate(layers):
+        # ours: z = x @ W ([in, out]); reference: w[out_row * in + col]
+        Wr = W.T.copy()                        # [out, in]
+        if li == 0:
+            Wr = Wr[:, ref_to_ours]            # permute to cats-first
+        info[f"weight_layer{li}"] = _jarr([float(v)
+                                           for v in Wr.ravel()])
+        info[f"bias_layer{li}"] = _jarr([float(v) for v in b])
+    return _emit_mojo_zip(path, info, names, doms)
+
+
+def _read_ini(path: str):
+    with zipfile.ZipFile(path) as z:
+        ini = z.read("model.ini").decode().splitlines()
+        info: Dict[str, str] = {}
+        columns: List[str] = []
+        domain_spec: Dict[int, List[str]] = {}
+        section = None
+        for ln in ini:
+            ln = ln.strip()
+            if not ln:
+                continue
+            if ln in ("[info]", "[columns]", "[domains]"):
+                section = ln
+                continue
+            if section == "[info]":
+                k, _, v = ln.partition("=")
+                info[k.strip()] = v.strip()
+            elif section == "[columns]":
+                columns.append(ln)
+            elif section == "[domains]":
+                ci, _, rest = ln.partition(":")
+                fn = rest.strip().split(" ", 1)[1]
+                domain_spec[int(ci)] = \
+                    z.read(f"domains/{fn}").decode().splitlines()
+    return info, columns, domain_spec
+
+
+def score_reference_dl_mojo(path: str, rows: Dict[str, np.ndarray]):
+    """Forward pass from a reference DL MOJO — the ported
+    DeeplearningMojoModel.score0/NeuralNetwork semantics (cats-first
+    input assembly, (x-sub)*mul normalization, row-major weights,
+    hidden activation + linear output). Returns the raw output layer
+    [n, out] plus the info dict (caller applies softmax/response
+    denorm per category, as the reference's caller does)."""
+    info, columns, domain_spec = _read_ini(path)
+    n_cats = int(info["cats"])
+    n_nums = int(info["nums"])
+    cat_offsets = [int(v) for v in _parse_jarr(info["cat_offsets"])]
+    norm_mul = np.asarray(_parse_jarr(info["norm_mul"]))
+    norm_sub = np.asarray(_parse_jarr(info["norm_sub"]))
+    use_all = info.get("use_all_factor_levels") == "true"
+    units = [int(v) for v in _parse_jarr(info["neural_network_sizes"])]
+    act = info.get("activation", "Rectifier").lower()
+
+    n = len(next(iter(rows.values())))
+    X = np.zeros((n, units[0]))
+    first = 0 if use_all else 1
+    for ci in range(n_cats):
+        dom = domain_spec[ci]
+        lut = {s: j for j, s in enumerate(dom)}
+        codes = np.asarray([lut.get(str(v), -1)
+                            for v in rows[columns[ci]]])
+        base = cat_offsets[ci]
+        for r in range(n):
+            c = codes[r]
+            if c >= first:
+                X[r, base + c - first] = 1.0
+    for ni in range(n_nums):
+        v = np.asarray(rows[columns[n_cats + ni]], np.float64)
+        X[:, cat_offsets[n_cats] + ni] = (v - norm_sub[ni]) * norm_mul[ni]
+
+    h = X
+    for li in range(len(units) - 1):
+        W = np.asarray(_parse_jarr(info[f"weight_layer{li}"]))
+        b = np.asarray(_parse_jarr(info[f"bias_layer{li}"]))
+        W = W.reshape(units[li + 1], units[li])
+        h = h @ W.T + b
+        if li < len(units) - 2:
+            if "tanh" in act:
+                h = np.tanh(h)
+            else:
+                h = np.maximum(h, 0.0)
+    return h, info

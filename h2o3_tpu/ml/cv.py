@@ -67,16 +67,62 @@ def subset_frame(frame: Frame, keep: np.ndarray,
                             pad_to=pad_to)
 
 
+def _glm_path_holdout_deviance(m, te: Frame, y: str, p: dict) -> np.ndarray:
+    """Per-lambda deviance of a GLM fold model's coefficient path on
+    its holdout frame — the statistic the reference's lambda-search CV
+    minimizes (GLM.java xval deviance). Honors the user weights column
+    and the offset column, like the CV metrics themselves."""
+    import jax.numpy as jnp
+    from h2o3_tpu.models.model import ModelCategory, adapt_domain
+    X1 = m._design(te)                         # [n_pad, P+1]
+    path = m._coef_path                        # [L, P+1]
+    n = te.nrows
+    w = np.asarray(te.valid_weights())[:n]
+    if p.get("weights_column") and p["weights_column"] in te:
+        wraw = te.col(p["weights_column"]).to_numpy()
+        w = w * np.nan_to_num(wraw).astype(np.float32)
+    yc = te.col(y)
+    if m.output["category"] == ModelCategory.BINOMIAL:
+        yv = adapt_domain(yc, m.output["domain"])
+        w = w * (yv >= 0)
+        yv = np.maximum(yv, 0).astype(np.float32)
+    else:
+        yraw = yc.to_numpy()
+        w = w * (~np.isnan(yraw))
+        yv = np.nan_to_num(yraw).astype(np.float32)
+    yv = np.pad(yv, (0, X1.shape[0] - n))
+    w = np.pad(w, (0, X1.shape[0] - n))
+    etas = X1 @ jnp.asarray(path.T, jnp.float32)              # [n, L]
+    off = m._frame_offset(te)
+    if off is not None:
+        etas = etas + off[:, None]
+    fam = m.family
+    mus = np.asarray(fam.linkinv(etas))
+    devs = np.asarray(fam.deviance(jnp.asarray(yv)[:, None],
+                                   jnp.asarray(mus)))
+    return (w[:, None] * devs).sum(axis=0)
+
+
 def train_with_cv(builder, frame: Frame, x: Sequence[str], y: str,
                   nfolds: int, job, validation_frame: Optional[Frame] = None):
     """Train nfolds+1 models; attach CV metrics to the final model.
     A validation_frame flows to the final (main) model only, like the
     reference (ModelBuilder.java cv_main model keeps _valid)."""
     p = dict(builder.params)
-    seed = int(p.get("seed") or 0xF01D)
-    scheme = str(p.get("fold_assignment", "modulo") or "modulo").lower()
+    scheme = str(p.get("fold_assignment", "auto") or "auto").lower()
     if scheme == "auto":
-        scheme = "modulo"
+        # AUTO resolves to seeded Random (ModelBuilder.cv_AssignFold:
+        # `case AUTO: case Random:` share the kfoldColumn branch) — a
+        # modulo default made different seeds produce IDENTICAL CV
+        # models (pyunit_glm_seed's seed-difference assertion)
+        scheme = "random"
+    raw_seed = p.get("seed")
+    if raw_seed is None or int(raw_seed) < 0:
+        # getOrMakeRealSeed: unset seed draws a REAL random one, so two
+        # unseeded Random-fold runs genuinely differ (pyunit_cv_carsRF)
+        seed = int(np.random.SeedSequence().entropy % (2 ** 31))
+    else:
+        seed = int(raw_seed)
     category = infer_category(frame, y)
 
     if p.get("fold_column"):
@@ -138,6 +184,7 @@ def train_with_cv(builder, frame: Frame, x: Sequence[str], y: str,
     keep_preds = bool(p.get("keep_cross_validation_predictions"))
     cv_pred_keys = []
     fold_metric_dicts = []
+    path_devs = []      # per-fold per-lambda holdout deviance (GLM search)
 
     # CV fast path (tree builders): fold models train on the PARENT
     # frame with held-out rows weight-masked and the main model's bin
@@ -177,6 +224,21 @@ def train_with_cv(builder, frame: Frame, x: Sequence[str], y: str,
             "near-LOO CV (nfolds=%d on %d rows): skipping per-fold "
             "metric/varimp frills", nfolds, frame.nrows)
 
+    # GLM lambda search under CV: train the MAIN model first to fix one
+    # full-frame lambda path, have every fold walk that SAME path (so
+    # per-lambda holdout deviances align index-wise), then re-fit the
+    # main model at the CV-selected lambda (GLM.java xval-deviance
+    # lambda selection).
+    shared_lambda_path = None
+    glm_search = (getattr(builder, "algo", "") == "glm"
+                  and p.get("lambda_search") and not fast)
+    if glm_search:
+        probe = builder.__class__(**sub_params)._fit(frame, list(x), y, job)
+        shared_lambda_path = getattr(probe, "_lambda_path_vals", None)
+        from h2o3_tpu.core.kv import DKV as _DKV
+        _DKV.remove(probe.key)
+        del probe
+
     for f in range(nfolds):
         mask_tr = folds != f
         idx = np.where(~mask_tr)[0]
@@ -186,12 +248,20 @@ def train_with_cv(builder, frame: Frame, x: Sequence[str], y: str,
             sub._cv_shared_bm = shared_bm
             sub._cv_light = light
             m = sub._fit(frame, list(x), y, job)
-            cv_models.append(m)
             full_preds = m._score_raw(frame)
             preds = {k: np.asarray(v)[idx] for k, v in full_preds.items()}
             if light:
+                # near-LOO: fold models are NOT retained — hundreds of
+                # padded complete-tree forests (~100MB each on device)
+                # exhaust HBM long before the sweep ends; the merged
+                # holdout predictions (the CV metric contract) are
+                # already extracted above
+                from h2o3_tpu.core.kv import DKV as _DKV
+                _DKV.remove(m.key)
+                del m
                 fold_metric_dicts.append({})
             else:
+                cv_models.append(m)
                 hold_w = np.zeros(frame.nrows_padded, np.float32)
                 hold_w[idx] = 1.0
                 try:
@@ -209,8 +279,13 @@ def train_with_cv(builder, frame: Frame, x: Sequence[str], y: str,
                                   np.bincount(folds, minlength=nfolds))),
                                   block=8))
             sub = builder.__class__(**sub_params)
+            if shared_lambda_path:
+                sub.params["_lambda_path_override"] = shared_lambda_path
             m = sub._fit(tr, list(x), y, job)
             cv_models.append(m)
+            if shared_lambda_path and \
+                    getattr(m, "_coef_path", None) is not None:
+                path_devs.append(_glm_path_holdout_deviance(m, te, y, p))
             preds = m._score_raw(te)
             # per-fold holdout metrics feed
             # cross_validation_metrics_summary (reference cvModelBuilder
@@ -245,7 +320,18 @@ def train_with_cv(builder, frame: Frame, x: Sequence[str], y: str,
     # final model on all data (ModelBuilder.java "main model") — the
     # fast path trained it up front to share its binning with the folds
     if final is None:
-        final = builder.__class__(**sub_params)._fit(
+        fb = builder.__class__(**sub_params)
+        if path_devs:
+            # GLM lambda search under CV selects the lambda minimizing
+            # the SUMMED holdout deviance over the folds' SHARED path
+            # (the reference's xval-deviance selection) — this is why
+            # two different CV seeds legitimately yield different final
+            # coefficients (pyunit_glm_seed h2oglm_3 != h2oglm_4)
+            tot = np.sum(np.stack(path_devs), axis=0)
+            lam_best = shared_lambda_path[int(np.argmin(tot))]
+            fb.params["_lambda_path_override"] = shared_lambda_path
+            fb.params["_cv_selected_lambda"] = float(lam_best)
+        final = fb._fit(
             frame, list(x), y, job, validation_frame=validation_frame)
 
     # CV metrics: NA-response rows excluded, user weights applied — same

