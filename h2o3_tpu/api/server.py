@@ -303,6 +303,71 @@ def _wire_list(src) -> List[str]:
     return out
 
 
+def _wire_nested_list(src):
+    """Decode stringify_list of a list-of-lists — the na_strings wire
+    format: '[["NA","x"],[],[""]]' with each item quoted() by the client
+    (h2o-py/h2o/h2o.py:925 builds it, shared_utils.py:171 stringifies).
+    Returns a list of per-column string lists, or None if unparseable.
+    A flat list (h2o-py list-form semantics: same tokens for EVERY
+    column) returns [tokens] and the caller broadcasts; null/None per
+    column means 'no NA strings for that column'."""
+    def _norm(lst):
+        if not isinstance(lst, list):
+            return None
+        if all(x is None or isinstance(x, str) for x in lst) and \
+                not any(isinstance(x, list) for x in lst):
+            flat = [_unquote(x) for x in lst if isinstance(x, str)]
+            return [flat] if flat else None
+        out = []
+        for inner in lst:
+            if inner is None:
+                out.append([])
+            elif isinstance(inner, list):
+                out.append([_unquote(str(x)) for x in inner
+                            if x is not None])
+            else:
+                out.append([_unquote(str(inner))])
+        return out
+    if isinstance(src, list):
+        return _norm(src)
+    s = str(src).strip()
+    try:
+        import json as _json
+        parsed = _json.loads(s)
+        if isinstance(parsed, list):
+            return _norm(parsed)
+    except ValueError:
+        pass                      # stringify_list fallback below
+    if not (s.startswith("[") and s.endswith("]")):
+        return None
+    s, out, i, n = s[1:-1], [], 0, len(s) - 2
+    while i < n:
+        if s[i] != "[":
+            i += 1
+            continue
+        j, inq = i + 1, False
+        while j < n and (inq or s[j] != "]"):
+            if s[j] == '"':
+                inq = not inq
+            j += 1
+        inner = s[i + 1:j]
+        items, cur, inq = [], [], False
+        for ch in inner:
+            if ch == '"':
+                inq = not inq
+                cur.append(ch)
+            elif ch == "," and not inq:
+                items.append("".join(cur))
+                cur = []
+            else:
+                cur.append(ch)
+        if cur or items:
+            items.append("".join(cur))
+        out.append([_unquote(t.strip()) for t in items])
+        i = j + 1
+    return out
+
+
 def _wire_map(s: str) -> dict:
     """Decode stringify_dict_as_map output: near-JSON where bare words
     (enum/string values like bernoulli) arrive unquoted
@@ -421,6 +486,18 @@ def _parse(params, body):
             mapped = _SETUP_TYPES_BACK.get(str(t).lower())
             if mapped:
                 col_types[n] = mapped
+    # na_strings: column-indexed list of lists (water/parser/ParseSetup
+    # naStrings contract — tokens matched BEFORE type inference).
+    # Passed POSITIONALLY: keying by the client's column_names breaks
+    # when those rename the file's own header columns.
+    na_map = None
+    if params.get("na_strings"):
+        nested = _wire_nested_list(params["na_strings"])
+        if nested and any(nested):
+            if len(nested) == 1 and names and len(names) > 1:
+                # flat-list form: the same tokens apply to every column
+                nested = nested * len(names)
+            na_map = [lst or None for lst in nested]
     job = Job(f"parse {srcs[0]}", dest=dest)
 
     ch = params.get("check_header")
@@ -432,7 +509,8 @@ def _parse(params, body):
     def _run(j):
         if len(srcs) == 1:
             fr = import_file(srcs[0], destination_frame=dest,
-                             col_types=col_types, header=header)
+                             col_types=col_types, header=header,
+                             na_strings=na_map)
             if names and len(names) == fr.ncols and \
                     list(names) != list(fr.names):
                 fr.rename_columns(list(names))
@@ -440,7 +518,8 @@ def _parse(params, body):
             import pandas as pd
             parts = []
             for s in srcs:
-                part = import_file(s, col_types=col_types, header=header)
+                part = import_file(s, col_types=col_types, header=header,
+                                   na_strings=na_map)
                 parts.append(part.to_pandas())
                 DKV.remove(part.key)     # intermediate per-file frames
             fr = Frame.from_pandas(pd.concat(parts, ignore_index=True),
@@ -1566,10 +1645,35 @@ def _json_default(o):
     return str(o)
 
 
+def _nan_str_list(vals):
+    """ColV3 data cells: NaN→"NaN", ±inf→"Infinity"/"-Infinity"
+    (AutoBuffer JSON_NAN/JSON_POS_INF strings)."""
+    out = []
+    for v in vals:
+        if isinstance(v, np.generic):
+            v = v.item()
+        if isinstance(v, float):
+            if np.isnan(v):
+                v = "NaN"
+            elif np.isinf(v):
+                v = "Infinity" if v > 0 else "-Infinity"
+        out.append(v)
+    return out
+
+
 def _json_sanitize(o):
-    """Strict-JSON cleanup: the real h2o-py parses responses with a
-    strict decoder, so NaN/Infinity literals are wire errors."""
+    """Strict-JSON cleanup: NaN/Infinity become null everywhere EXCEPT
+    ColV3 ``data`` arrays — there NA cells ride as the STRING "NaN",
+    exactly the reference wire (AutoBuffer.putJSON8d emits the quoted
+    JSON_NAN string, water/AutoBuffer.java:2006); h2o-py decodes
+    'x == "NaN"' back to float nan (h2o-py/h2o/expr.py:392) before
+    probing math.isnan (expr.py:416)."""
     if isinstance(o, dict):
+        meta = o.get("__meta")
+        if isinstance(meta, dict) and meta.get("schema_name") == "ColV3":
+            return {k: (_nan_str_list(o[k]) if k == "data" and o[k]
+                        else _json_sanitize(v))
+                    for k, v in o.items()}
         return {k: _json_sanitize(v) for k, v in o.items()}
     if isinstance(o, (list, tuple)):
         return [_json_sanitize(v) for v in o]

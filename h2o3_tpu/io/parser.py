@@ -126,7 +126,8 @@ def guess_header(path: str) -> bool:
 
 def import_file(path: str, destination_frame: Optional[str] = None,
                 col_types: Optional[Dict[str, str]] = None,
-                header: Optional[bool] = None, lazy: bool = False):
+                header: Optional[bool] = None, lazy: bool = False,
+                na_strings=None):
     """h2o.import_file analogue (h2o-py/h2o/h2o.py:414).
 
     Accepts a file path, glob, or directory; CSV(.gz/.zip) and Parquet.
@@ -153,22 +154,27 @@ def import_file(path: str, destination_frame: Optional[str] = None,
                                       sum(os.path.getsize(f) for f in lp)))
         key = destination_frame or make_key("frame")
         stub = FileBackedFrame(key, path, lp, names, nrows, nbytes,
-                               {"col_types": col_types, "header": header})
+                               {"col_types": col_types, "header": header,
+                                "na_strings": na_strings})
         DKV.put(key, stub)
         log.info("registered lazy frame %s -> %s (unparsed, %.1f MB on "
                  "disk)", key, path, (nbytes or 0) / 1e6)
         return stub
-    fr = _import_file_eager(path, destination_frame, col_types, header)
+    fr = _import_file_eager(path, destination_frame, col_types, header,
+                            na_strings)
     # provenance for the Cleaner's cheap eviction path: an unmutated
-    # file-backed frame can drop straight back to its stub
+    # file-backed frame can drop straight back to its stub —
+    # na_strings included, or rehydrate reparses without NA mapping
     fr._source_paths = [path] if not isinstance(path, list) else path
-    fr._source_kwargs = {"col_types": col_types, "header": header}
+    fr._source_kwargs = {"col_types": col_types, "header": header,
+                         "na_strings": na_strings}
     return fr
 
 
 def _import_file_eager(path: str, destination_frame: Optional[str] = None,
                        col_types: Optional[Dict[str, str]] = None,
-                       header: Optional[bool] = None) -> Frame:
+                       header: Optional[bool] = None,
+                       na_strings=None) -> Frame:
     paths: List[str] = []
     if os.path.isdir(path):
         paths = sorted(os.path.join(path, f) for f in os.listdir(path))
@@ -227,7 +233,8 @@ def _import_file_eager(path: str, destination_frame: Optional[str] = None,
         header = guess_header(paths[0])
     if all(f.endswith((".csv", ".csv.gz")) for f in paths):
         parsed = _parse_csv_native(paths, col_types,
-                                   header=True if header is None else header)
+                                   header=True if header is None else header,
+                                   na_strings=na_strings)
         if parsed is not None:
             cols, cats, domains = parsed
             # UUID detection (water/fvec C16Chunk / Vec.T_UUID): a
@@ -261,16 +268,46 @@ def _import_file_eager(path: str, destination_frame: Optional[str] = None,
             return fr
 
     import pandas as pd
+
+    def _na_kw(f):
+        """read_csv na_values for this file: positional na_strings map
+        to int labels (headerless) or the file's own header names —
+        keying by the client's renamed columns would silently no-op."""
+        if not na_strings:
+            return {}
+        if header is False:
+            if isinstance(na_strings, dict):
+                # headerless columns are ints at read time; the C1..Cn
+                # rename happens after — translate, else pandas
+                # silently ignores the unknown name keys
+                vals = {}
+                for k, lst in na_strings.items():
+                    m = _re.match(r"^C(\d+)$", str(k))
+                    if m and lst:
+                        vals[int(m.group(1)) - 1] = list(lst)
+                return {"na_values": vals} if vals else {}
+            vals = {i: list(lst) for i, lst in enumerate(na_strings)
+                    if lst}
+            return {"na_values": vals} if vals else {}
+        if isinstance(na_strings, dict):
+            return {"na_values": na_strings}
+        try:
+            hdr_names = list(pd.read_csv(f, nrows=0).columns)
+        except Exception:
+            return {}
+        vals = _na_by_name(na_strings, hdr_names)
+        return {"na_values": vals} if vals else {}
+
     frames = []
     for f in paths:
         if f.endswith((".parquet", ".pq")):
             frames.append(pd.read_parquet(f))
         elif header is False:
-            df_ = pd.read_csv(f, header=None)
+            df_ = pd.read_csv(f, header=None, **_na_kw(f))
             df_.columns = [f"C{i + 1}" for i in range(df_.shape[1])]
             frames.append(df_)
         else:
-            frames.append(pd.read_csv(f))
+            frames.append(pd.read_csv(f, **_na_kw(f)))
     df = pd.concat(frames, ignore_index=True) if len(frames) > 1 else frames[0]
     if col_types:
         for c, t in col_types.items():
@@ -283,9 +320,26 @@ def _import_file_eager(path: str, destination_frame: Optional[str] = None,
     return fr
 
 
+def _na_by_name(na_strings, names_in_order: List[str]) -> Dict[str, List[str]]:
+    """Normalize na_strings — a name-keyed dict OR a positional
+    list-of-lists in file column order (the ParseSetup naStrings wire
+    shape, which stays correct even when the client renames columns at
+    parse) — to a dict keyed by the PARSED column names."""
+    if not na_strings:
+        return {}
+    if isinstance(na_strings, dict):
+        return {k: list(v) for k, v in na_strings.items() if v}
+    out = {}
+    for i, lst in enumerate(na_strings):
+        if lst and i < len(names_in_order):
+            out[names_in_order[i]] = list(lst)
+    return out
+
+
 def _parse_csv_native(paths: List[str],
                       col_types: Optional[Dict[str, str]],
-                      header: bool = True):
+                      header: bool = True,
+                      na_strings=None):
     """Multi-file native CSV parse; returns (cols, categorical names) or
     None to fall back. Gzip members are decompressed into the buffer
     (the tokenizer parses bytes, like the reference's ZipUtil front)."""
@@ -341,6 +395,51 @@ def _parse_csv_native(paths: List[str],
             domains[name] = global_dom
         else:
             merged[name] = parts[0] if len(parts) == 1 else np.concatenate(parts)
+
+    # na_strings apply at parse, BEFORE type coercion and before quoted
+    # "" becomes a string token (water/parser/ParseSetup naStrings):
+    # matching levels of a sniffed-categorical column become NA (level
+    # dropped, codes renumbered); a column left all-numeric afterwards
+    # reverts to numeric exactly as the reference's post-NA inference
+    # would have typed it.
+    for c, nas in _na_by_name(na_strings, list(merged)).items():
+        if c not in merged or not nas:
+            continue
+        nas_set = set(nas)
+        if c in domains:
+            dom = domains[c]
+            keep = [lvl for lvl in dom if lvl not in nas_set]
+            if len(keep) != len(dom):
+                lut = {lvl: i for i, lvl in enumerate(keep)}
+                remap = np.asarray([lut.get(lvl, -1) for lvl in dom] or [-1],
+                                   dtype=np.int32)
+                codes = merged[c]
+                merged[c] = np.where(codes >= 0,
+                                     remap[np.maximum(codes, 0)],
+                                     -1).astype(np.int32)
+                domains[c] = keep
+                forced = (col_types or {}).get(c)
+                if forced not in ("enum", "categorical", "string") and \
+                        all(_is_num_token(lvl) for lvl in keep):
+                    lutv = np.asarray([float(lvl) for lvl in keep] or [0.0])
+                    codes = merged[c]
+                    merged[c] = np.where(codes >= 0,
+                                         lutv[np.maximum(codes, 0)], np.nan)
+                    domains.pop(c)
+        else:
+            # numeric column: na tokens that parse numeric were already
+            # folded into values — null them back out by VALUE. Known
+            # divergence from the reference's token-level match
+            # (na_strings=["1"] also nulls cells written "1.0"): the
+            # raw tokens are gone after the native tokenizer, and
+            # value-match is what "-999 means missing" users intend.
+            vals = merged[c]
+            for s in nas_set:
+                try:
+                    vals = np.where(vals == float(s), np.nan, vals)
+                except ValueError:
+                    pass
+            merged[c] = vals
 
     # honor explicit client types (POST /3/ParseSetup column_types)
     for c, t in (col_types or {}).items():

@@ -43,8 +43,35 @@ import numpy as np
 from h2o3_tpu.frame.column import Column
 from h2o3_tpu.frame.frame import Frame
 from h2o3_tpu.parallel import mesh as mesh_mod
+from h2o3_tpu.utils.log import get_logger
+
+log = get_logger("h2o3_tpu.merge")
 
 DEVICE_MERGE_MIN_ROWS = 65536
+
+
+def _merge_out_budget() -> int:
+    """Max bytes the device join result may occupy.
+
+    CPU meshes (the 8-virtual-device test topology, usually on a small
+    host) get a conservative 2GB; accelerators use half the reported
+    HBM limit, or 16GB when the plugin exports no memory stats (axon)."""
+    import os
+    env = os.environ.get("H2O3TPU_MERGE_MAX_OUT_BYTES")
+    if env:
+        return int(env)
+    # the mesh's devices, NOT jax.devices(): the axon plugin shadows
+    # JAX_PLATFORMS, so jax.devices() reports the tunneled chip even
+    # when the cloud (and this merge) runs on the CPU mesh
+    dev = mesh_mod.get_mesh().devices.flat[0]
+    if dev.platform == "cpu":
+        return 2 << 30
+    try:
+        stats = dev.memory_stats() or {}
+    except Exception:
+        stats = {}
+    lim = stats.get("bytes_limit")
+    return int(lim * 0.5) if lim else 16 << 30
 
 
 def _all_float(keys) -> bool:
@@ -271,6 +298,19 @@ def device_merge(lf: Frame, rf: Frame, key_names: List[str],
     total = int(t_left) if left_join else int(t_inner)
     if total == 0:
         return _empty_like(lf, rf, key_names)
+    # Low-cardinality keys make equi-joins quadratic (a 66K x 16K join
+    # on a 4-level key is 208M output rows). Materializing that on the
+    # device mesh starves XLA's CPU collective rendezvous (40s
+    # termination timeout -> hard process abort, the round-4 crash) and
+    # would OOM small HBM slices; size the output BEFORE allocating and
+    # hand oversized joins to the host path, like BinaryMerge's
+    # per-chunk result sizing (water/rapids/BinaryMerge.java).
+    out_cells = total * (len(l_cols) + len(r_cols))
+    if out_cells * 9 > _merge_out_budget():      # 8B data + 1B mask
+        log.warning("device merge result %d rows x %d cols (%.1f GB) "
+                    "exceeds device budget - host merge path",
+                    total, len(l_cols) + len(r_cols), out_cells * 9 / 1e9)
+        return None
     out_n = mesh_mod.padded_rows(total, block=8)
 
     out_l, out_r = _gather_out(

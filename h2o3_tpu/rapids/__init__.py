@@ -667,7 +667,7 @@ def _rect_assign(env, dst, src, col_sel, row_sel):
             else _row_indices(f, row_sel, env))
     val = env.ev(src)
 
-    arrays, cats, doms = {}, [], {}
+    arrays, cats, doms, strs = {}, [], {}, []
     for i, n in enumerate(f.names):
         c = f.col(n)
         if c.is_categorical:
@@ -693,7 +693,34 @@ def _rect_assign(env, dst, src, col_sel, row_sel):
                 else:
                     v = vc.to_numpy()
                 full = len(rows) == f.nrows
-                if full and vc.is_categorical and dom is None:
+                if vc.type in ("string", "uuid"):
+                    # string-typed source (AstRectangleAssign string
+                    # path): a full-column replace converts the dest to
+                    # T_STR; a partial assign into an enum interns the
+                    # labels into the destination domain
+                    v = np.asarray(v, dtype=object)
+                    if full:
+                        dom = None
+                        arr = np.empty(f.nrows, dtype=object)
+                    elif dom is not None:
+                        lut = {lvl: k for k, lvl in enumerate(dom)}
+                        vv = np.full(len(v), np.nan)
+                        for k2, s in enumerate(v):
+                            # non-strings (None, float NaN cells a
+                            # numeric assign left in a T_STR column)
+                            # stay NA, never become levels
+                            if not isinstance(s, str):
+                                continue
+                            if s not in lut:
+                                lut[s] = len(dom)
+                                dom.append(s)
+                            vv[k2] = lut[s]
+                        v = vv
+                    elif c.type != "string":
+                        raise ValueError(
+                            f"cannot assign string rows into numeric "
+                            f"column '{n}'")
+                elif full and vc.is_categorical and dom is None:
                     # whole-column replace with a factor: the column
                     # BECOMES categorical (fr["y"] = fr["y"].asfactor())
                     dom = list(vc.domain or [])
@@ -737,7 +764,12 @@ def _rect_assign(env, dst, src, col_sel, row_sel):
             doms[n] = dom
         else:
             arrays[n] = arr
-    out = Frame.from_numpy(arrays, categorical=cats, domains=doms)
+            if arr.dtype == object:
+                # string columns must stay T_STR — from_numpy would
+                # otherwise re-intern the object array into an enum
+                strs.append(n)
+    out = Frame.from_numpy(arrays, categorical=cats, domains=doms,
+                           strings=strs)
     # preserve column order
     return out[f.names]
 

@@ -88,12 +88,20 @@ def test_device_merge_multikey():
 
 def test_device_merge_categorical_key_domain_remap():
     """Categorical keys with DIFFERENT domains remap right→left; unseen
-    right levels never match."""
+    right levels never match.
+
+    Cardinality must be realistic: a 4-level key made this join
+    quadratic (66K x 16K rows -> 208M output rows), which starved the
+    XLA CPU collective rendezvous into a 40s termination abort on the
+    8-virtual-device mesh (the round-4 crash). 512 levels keeps the
+    result ~2M rows while still exercising remap + unseen levels;
+    device_merge now budget-checks and refuses quadratic blowups."""
     r = np.random.RandomState(8)
-    ldom = ["a", "b", "c", "d"]
-    rdom = ["b", "c", "d", "e"]          # e unseen on the left
-    lcode = r.randint(0, 4, N)
-    rcode = r.randint(0, 4, N // 4)
+    card = 512
+    ldom = ["L%03d" % i for i in range(card)]          # L000..L511
+    rdom = ["L%03d" % i for i in range(1, card + 1)]   # L512 unseen
+    lcode = r.randint(0, card, N)
+    rcode = r.randint(0, card, N // 4)
     lf = Frame.from_numpy(
         {"k": lcode.astype(np.int32), "lv": np.arange(N, dtype=float)},
         categorical=["k"], domains={"k": ldom})
@@ -113,6 +121,26 @@ def test_device_merge_categorical_key_domain_remap():
     assert len(g) == len(e)
     assert list(g["k"]) == list(e["k"])
     assert np.allclose(g["lv"], e["lv"]) and np.allclose(g["rv"], e["rv"])
+
+
+def test_device_merge_budget_guard_refuses_quadratic_join(monkeypatch):
+    """A low-cardinality key whose join result would dwarf the device
+    budget must fall back to the host path (return None), never abort
+    the process — the round-4 crash regression pin. The budget is
+    pinned via env so the assertion holds on any mesh platform."""
+    monkeypatch.setenv("H2O3TPU_MERGE_MAX_OUT_BYTES", str(1 << 30))
+    r = np.random.RandomState(9)
+    lcode = r.randint(0, 4, N)
+    rcode = r.randint(0, 4, N // 4)
+    dom = ["a", "b", "c", "d"]
+    lf = Frame.from_numpy(
+        {"k": lcode.astype(np.int32), "lv": np.arange(N, dtype=float)},
+        categorical=["k"], domains={"k": dom})
+    rf = Frame.from_numpy(
+        {"k": rcode.astype(np.int32), "rv": np.arange(N // 4, dtype=float)},
+        categorical=["k"], domains={"k": dom})
+    from h2o3_tpu.ops.merge import device_merge
+    assert device_merge(lf, rf, ["k"], "inner") is None
 
 
 def test_device_merge_int_keys_exact_above_f32():
