@@ -843,14 +843,21 @@ def _model_mojo(params, body, mid=None):
 @route("GET", r"/3/Models\.java/(?P<mid>[^/]+)")
 def _model_pojo(params, body, mid=None):
     """Generated-source scorer download (water/api Models.java POJO
-    endpoint shape; a stdlib-Python module here)."""
-    from h2o3_tpu.genmodel.pojo import pojo_source
+    endpoint). gbm/drf/glm return compilable Java implementing
+    hex.genmodel.GenModel.score0 (hex/genmodel/GenModel.java:363);
+    other algos ship the stdlib-Python scorer module."""
     m = DKV.get(mid)
     if not isinstance(m, Model):
         raise KeyError(f"model {mid} not found")
-    src = pojo_source(m, modname=str(mid))
-    return {"__bytes__": src.encode(),
-            "__ctype__": "text/plain; charset=utf-8"}
+    if getattr(m, "algo", None) in ("gbm", "drf", "glm"):
+        from h2o3_tpu.genmodel.pojo_java import java_pojo_source
+        src = java_pojo_source(m, class_name=str(mid))
+        ctype = "text/x-java; charset=utf-8"
+    else:
+        from h2o3_tpu.genmodel.pojo import pojo_source
+        src = pojo_source(m, modname=str(mid))
+        ctype = "text/plain; charset=utf-8"
+    return {"__bytes__": src.encode(), "__ctype__": ctype}
 
 
 @route("POST", r"/3/ModelMetrics/models/(?P<mid>[^/]+)/frames/(?P<fid>[^/]+)")

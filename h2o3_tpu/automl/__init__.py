@@ -134,6 +134,55 @@ class H2OAutoML:
         return train_capped(get_builder("gbm")(**params),
                             training_frame, y, x, budget)
 
+    def _run_step(self, step: Step, budget: Budget, training_frame: Frame,
+                  y: str, x) -> List:
+        """Execute one modeling step; returns the trained models.
+        Runs on a worker thread — a budget SLOT is reserved up front
+        (try_start) so parallel siblings cannot all pass the exhausted
+        check and overshoot max_models; only the caller touches the
+        leaderboard."""
+        if not budget.try_start():
+            return []
+        trained_count = 0
+        try:
+            if step.kind == "exploitation":
+                m = self._lr_annealing_step(budget, training_frame, y, x)
+                if m is None:
+                    return []
+                m.output["automl_step"] = step.id
+                trained_count = 1
+                return [m]
+            cls = get_builder(step.algo)
+            if step.kind == "grid":
+                remaining = budget.remaining_models()
+                rem_s = budget.remaining_secs()
+                gs = GridSearch(
+                    cls, step.hyper,
+                    search_criteria={
+                        "strategy": "RandomDiscrete",
+                        "max_models": min(remaining, step.grid_models),
+                        "max_runtime_secs": rem_s or 0,
+                        "seed": self.seed},
+                    **{**step.params, "nfolds": self.nfolds})
+                grid = gs.train(training_frame, y=y, x=x)
+                for m in grid.models:
+                    m.output["automl_step"] = step.id
+                trained_count = len(grid.models)
+                return list(grid.models)
+            params = {**step.params, "nfolds": self.nfolds}
+            if "stopping_rounds" in getattr(cls, "DEFAULTS", {}):
+                params.setdefault("stopping_rounds", self.stopping_rounds)
+                params.setdefault("stopping_tolerance",
+                                  self.stopping_tolerance)
+            params = {k: v for k, v in params.items()
+                      if k in cls.accepted_params()}
+            m = train_capped(cls(**params), training_frame, y, x, budget)
+            m.output["automl_step"] = step.id
+            trained_count = 1
+            return [m]
+        finally:
+            budget.finish(trained_count)
+
     def train(self, y: str, training_frame: Frame,
               x: Optional[Sequence[str]] = None,
               validation_frame: Optional[Frame] = None,
@@ -153,60 +202,46 @@ class H2OAutoML:
                            if c.endswith("_te")]
         trained: List = []
 
-        for step in plan:
+        # candidates run as PARALLEL jobs within each priority group
+        # (hex/ParallelModelBuilder.java; AutoML.java:760 learn walks
+        # groups in order). Groups are barriers: exploitation steps
+        # read the leaderboard that earlier groups produced. On one
+        # chip parallelism overlaps host-side prep + compiles with
+        # device execution; on a pod each job gets its own dispatch.
+        import os as _os
+        par = int(_os.environ.get("H2O3TPU_AUTOML_PARALLEL", "0") or 0)
+        if par <= 0:
+            par = 3
+        from concurrent.futures import ThreadPoolExecutor, as_completed
+        groups = sorted({s.group for s in plan if s.kind != "ensemble"})
+        for g in groups:
             if budget.exhausted():
                 self._log_event("budget", "budget exhausted; stopping plan")
                 break
-            if step.kind == "ensemble":
-                continue        # ensembles run after the loop
-            try:
-                if step.kind == "exploitation":
-                    m = self._lr_annealing_step(budget, training_frame, y, x)
-                    if m is not None:
-                        m.output["automl_step"] = step.id
-                        trained.append(m)
-                        self.leaderboard_obj.add(m)
-                        self._log_event("exploitation", f"{step.id} done")
-                    continue
-                cls = get_builder(step.algo)
-                if step.kind == "grid":
-                    remaining = budget.remaining_models()
-                    rem_s = budget.remaining_secs()
-                    gs = GridSearch(
-                        cls, step.hyper,
-                        search_criteria={
-                            "strategy": "RandomDiscrete",
-                            "max_models": min(remaining, step.grid_models),
-                            "max_runtime_secs": rem_s or 0,
-                            "seed": self.seed},
-                        **{**step.params, "nfolds": self.nfolds})
-                    grid = gs.train(training_frame, y=y, x=x)
-                    for m in grid.models:
-                        m.output["automl_step"] = step.id
-                    budget.trained += len(grid.models)
-                    trained.extend(grid.models)
-                    self.leaderboard_obj.add(*grid.models)
-                else:
-                    params = {**step.params, "nfolds": self.nfolds}
-                    if "stopping_rounds" in getattr(cls, "DEFAULTS", {}):
-                        params.setdefault("stopping_rounds",
-                                          self.stopping_rounds)
-                        params.setdefault("stopping_tolerance",
-                                          self.stopping_tolerance)
-                    params = {k: v for k, v in params.items()
-                              if k in cls.accepted_params()}
-                    m = train_capped(cls(**params), training_frame, y, x,
-                                     budget)
-                    m.output["automl_step"] = step.id
-                    trained.append(m)
-                    self.leaderboard_obj.add(m)
-                self._log_event("model",
-                                f"{step.id} done ({budget.trained} models, "
-                                f"{time.time() - t0:.0f}s)")
-            except TimeoutError as e:
-                self._log_event("timeout", f"{step.id}: {e}")
-            except Exception as e:
-                self._log_event("error", f"{step.id} failed: {e}")
+            steps_g = [s for s in plan
+                       if s.group == g and s.kind != "ensemble"]
+            with ThreadPoolExecutor(max_workers=par) as ex:
+                futs = {ex.submit(self._run_step, s, budget,
+                                  training_frame, y, x): s
+                        for s in steps_g}
+                for fut in as_completed(futs):
+                    step = futs[fut]
+                    try:
+                        models = fut.result()
+                    except TimeoutError as e:
+                        self._log_event("timeout", f"{step.id}: {e}")
+                        continue
+                    except Exception as e:
+                        self._log_event("error", f"{step.id} failed: {e}")
+                        continue
+                    if not models:
+                        continue
+                    trained.extend(models)
+                    self.leaderboard_obj.add(*models)
+                    self._log_event(
+                        "model",
+                        f"{step.id} done ({budget.trained} models, "
+                        f"{time.time() - t0:.0f}s)")
 
         # stacked ensembles last (StackedEnsembleStepsProvider):
         # best-of-family + all-models

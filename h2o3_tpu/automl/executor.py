@@ -31,6 +31,30 @@ class Budget:
                          if max_runtime_secs else None)
         self.per_model_secs = per_model_secs
         self.trained = 0
+        self.inflight = 0
+        self._lock = threading.Lock()   # candidates train in parallel
+
+    def add_trained(self, k: int = 1) -> None:
+        with self._lock:
+            self.trained += k
+
+    def try_start(self) -> bool:
+        """Reserve one model slot before training starts — parallel
+        workers otherwise all pass exhausted() in the read-then-train
+        window and overshoot max_models."""
+        with self._lock:
+            if self.trained + self.inflight >= self.max_models:
+                return False
+            if self.deadline is not None and time.time() > self.deadline:
+                return False
+            self.inflight += 1
+            return True
+
+    def finish(self, trained_count: int) -> None:
+        """Release the reserved slot; count what actually trained."""
+        with self._lock:
+            self.inflight = max(0, self.inflight - 1)
+            self.trained += trained_count
 
     def exhausted(self) -> bool:
         if self.trained >= self.max_models:
@@ -79,5 +103,4 @@ def train_capped(builder, frame, y, x, budget: Budget):
             f"max_runtime_secs_per_model ({cap:.0f}s) exceeded")
     if job.status != "DONE":
         raise RuntimeError(job.exception or f"job {job.status}")
-    budget.trained += 1
     return job.result

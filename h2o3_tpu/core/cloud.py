@@ -68,8 +68,8 @@ def init(backend: Optional[str] = None,
         os.makedirs(cache_dir, exist_ok=True)
         jax.config.update("jax_compilation_cache_dir", cache_dir)
         jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
-    except Exception:
-        pass
+    except Exception as e:           # noqa: BLE001 — cache is optional
+        log.warning("persistent XLA cache unavailable: %s", e)
 
     if coordinator_address is not None and not _STARTED:
         jax.distributed.initialize(coordinator_address=coordinator_address,
@@ -85,7 +85,6 @@ def init(backend: Optional[str] = None,
     # Cleaner thread (water/Cleaner.java): opt-in — spilling mid-test
     # would make timings nondeterministic, so default off like the
     # reference's -cleaner flag family
-    import os
     if os.environ.get("H2O3_TPU_SPILL") == "1":
         from h2o3_tpu.core.cleaner import cleaner
         cleaner.start()

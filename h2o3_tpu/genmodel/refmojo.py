@@ -819,3 +819,339 @@ def score_reference_dl_mojo(path: str, rows: Dict[str, np.ndarray]):
             else:
                 h = np.maximum(h, 0.0)
     return h, info
+
+
+# ----------------------------------------- IsolationForest writer/reader
+
+
+def _leaf_split_counts(is_split: np.ndarray) -> np.ndarray:
+    """Per-leaf count of split nodes along its root path. Depth-major
+    trees: leaf l at full depth D passes node l >> (D - d) at depth d."""
+    D = is_split.shape[0]
+    counts = np.zeros(2 ** D, np.float64)
+    for l in range(2 ** D):
+        for d in range(D):
+            counts[l] += float(is_split[d][l >> (D - d)])
+    return counts
+
+
+def write_reference_isofor_mojo(model, path: str) -> str:
+    """Reference-layout IsolationForest MOJO
+    (IsolationForestMojoReader v1.40: SharedTree blobs + the
+    min/max_path_length kv pair,
+    hex/tree/isofor/IsolationForestMojoWriter.java:31).
+
+    IsolationForestMojoModel.unifyPreds sums LEAF values over trees, so
+    each exported leaf bakes in its full path length: the count of
+    split nodes on the root path plus our stored c(n) tail correction —
+    the walk then reproduces _tree_path_length exactly."""
+    bm = model.bm
+    f = model.forest
+    feat = np.asarray(f.feat)
+    thresh = np.asarray(f.thresh)
+    na_left = np.asarray(f.na_left)
+    is_split = np.asarray(f.is_split)
+    cat_split = np.asarray(f.cat_split)
+    left_words = np.asarray(f.left_words)
+    leaf = np.asarray(f.leaf, np.float64)
+    T, D, _ = feat.shape
+
+    host_edges = np.asarray(bm.edges)
+    edges = [e[np.isfinite(e)] for e in host_edges]
+    cards = [len(d) if d else 1 for d in bm.domains]
+    nb = np.asarray(bm.nbins)
+    divs = [max(1, -(-cards[i] // max(int(nb[i]), 1)))
+            if bm.is_cat[i] and cards[i] > int(nb[i]) else 1
+            for i in range(len(cards))]
+
+    names = list(bm.names)
+    domains: List[Optional[List[str]]] = list(bm.domains)
+    info = _base_info(model, category="AnomalyDetection",
+                      n_features=len(names), n_classes=1,
+                      n_columns=len(names),
+                      n_domains=sum(1 for d in domains if d is not None))
+    info.update({
+        "mojo_version": "1.40",
+        "algo": "isolationforest",
+        "algorithm": "Isolation Forest",
+        "supervised": "false",
+        "n_trees": T,
+        "n_trees_per_class": 1,
+        "min_path_length": int(model.output.get("min_path_length", 0)),
+        "max_path_length": int(model.output.get("max_path_length", 0)),
+        "output_anomaly_flag": "false",
+    })
+
+    def _blobs():
+        for t in range(T):
+            full_leaf = leaf[t] + _leaf_split_counts(is_split[t])
+            yield (f"trees/t00_{t:03d}.bin", _root_blob(
+                feat[t], thresh[t], na_left[t], is_split[t],
+                cat_split[t], left_words[t], full_leaf,
+                edges, cards, divs, D))
+    return _emit_mojo_zip(path, info, names, domains, _blobs())
+
+
+def score_reference_isofor_mojo(path: str, rows: Dict[str, np.ndarray]):
+    """Anomaly score + mean path length from a reference isofor MOJO —
+    the ported IsolationForestMojoModel.unifyPreds."""
+    tot, info = score_reference_mojo(path, rows)
+    tot = tot[:, 0]
+    T = int(info["n_trees"])
+    mn = float(info["min_path_length"])
+    mx = float(info["max_path_length"])
+    score = ((mx - tot) / (mx - mn)) if mx > mn else np.ones_like(tot)
+    return {"predict": score, "mean_length": tot / T}, info
+
+
+# --------------------------------------------- Word2Vec writer/reader
+
+
+def write_reference_word2vec_mojo(model, path: str) -> str:
+    """Reference-layout Word2Vec MOJO (Word2VecMojoReader v1.00
+    contract): kv vocab_size/vec_size, binary big-endian float32
+    ``vectors`` blob, and a ``vocabulary`` text entry — read back via
+    ByteBuffer.getFloat (big-endian) in reader order."""
+    vecs = np.asarray(model.vectors, np.float32)
+    vocab = list(model.vocab)
+    V, Dv = vecs.shape
+    info = _base_info(model, category="WordEmbedding",
+                      n_features=1, n_classes=1, n_columns=1,
+                      n_domains=0)
+    info.update({
+        "mojo_version": "1.00",
+        "algo": "word2vec",
+        "algorithm": "Word2Vec",
+        "supervised": "false",
+        "vocab_size": V,
+        "vec_size": Dv,
+    })
+    blobs = [("vectors", vecs.astype(">f4").tobytes()),
+             ("vocabulary", ("\n".join(vocab) + "\n").encode())]
+    return _emit_mojo_zip(path, info, ["Word"], [None], blobs)
+
+
+def read_reference_word2vec_mojo(path: str):
+    """Independent decode: {word: float32[vec_size]} exactly as
+    Word2VecMojoReader.readModelData builds its embeddings map."""
+    info, _, _ = _read_ini(path)
+    V = int(info["vocab_size"])
+    Dv = int(info["vec_size"])
+    with zipfile.ZipFile(path) as z:
+        raw = z.read("vectors")
+        vocab = z.read("vocabulary").decode().splitlines()
+    if len(raw) != V * Dv * 4:
+        raise IOError(f"corrupted vectors blob: {len(raw)} bytes")
+    mat = np.frombuffer(raw, dtype=">f4").reshape(V, Dv)
+    if len(vocab) != V:
+        raise IOError(f"corrupted vocabulary: {len(vocab)} words")
+    return {w: mat[i] for i, w in enumerate(vocab)}, info
+
+
+def _cats_first_perm(domains_by_feat, keep_all_levels: bool):
+    """Cats-first reorder shared by the CoxPH/GLRM writers: per-feature
+    design-column blocks (in frame order), the categorical/numeric
+    feature indices, and the design-column permutation that moves
+    categorical blocks first (the MojoModel data[] layout)."""
+    blocks, j = [], 0
+    for d in domains_by_feat:
+        if d is not None:
+            w = max(len(d), 1) - (0 if keep_all_levels else 1)
+        else:
+            w = 1
+        blocks.append(list(range(j, j + w)))
+        j += w
+    cats_i = [i for i, d in enumerate(domains_by_feat) if d is not None]
+    nums_i = [i for i, d in enumerate(domains_by_feat) if d is None]
+    perm = [c for i in cats_i for c in blocks[i]] + \
+        [c for i in nums_i for c in blocks[i]]
+    return blocks, cats_i, nums_i, perm, j
+
+
+# ------------------------------------------------- CoxPH writer/reader
+
+
+def write_reference_coxph_mojo(model, path: str) -> str:
+    """Reference-layout CoxPH MOJO (CoxPHMojoReader v1.00 contract):
+    coef over [cat one-hot blocks..., nums...] with cat_offsets,
+    big-endian x_mean_cat/x_mean_num rectangular blobs per stratum, and
+    lpBase derived BY THE READER as coef . x_mean
+    (CoxPHMojoModel.computeLpBase) — so score0 returns
+    lp - coef . x_mean, our centered linear predictor.
+
+    Our design expands features in frame order; the reference wants
+    categoricals first. Coefficients and the training design-column
+    means (output["x_mean_design"], recorded at fit) are permuted
+    accordingly. Strata/interactions are not exported (raises)."""
+    if model.params.get("stratify_by"):
+        raise ValueError("reference-format CoxPH MOJO export does not "
+                         "cover stratified models yet")
+    feats = list(model.features)
+    domains_by_feat = model.di_stats["domains"]
+    coef = np.asarray(model.coef, np.float64)
+    xmean = np.asarray(model.output["x_mean_design"], np.float64)
+    if len(xmean) != len(coef):
+        raise ValueError("x_mean_design missing/stale — retrain to export")
+
+    # our design column index blocks per feature, in feature order
+    # (use_all_factor_levels=False drops the base level per block)
+    blocks, cats_i, nums_i, perm, _ = _cats_first_perm(
+        domains_by_feat, keep_all_levels=False)
+    coef_ref = coef[perm]
+    xmean_ref = xmean[perm]
+
+    cat_offsets = [0]
+    for i in cats_i:
+        cat_offsets.append(cat_offsets[-1] + len(blocks[i]))
+    n_cat_coef = cat_offsets[-1]
+
+    names = [feats[i] for i in cats_i] + [feats[i] for i in nums_i]
+    domains: List[Optional[List[str]]] = \
+        [list(domains_by_feat[i]) for i in cats_i] + [None] * len(nums_i)
+    info = _base_info(model, category="CoxPH", n_features=len(names),
+                      n_classes=1, n_columns=len(names),
+                      n_domains=len(cats_i))
+    info.update({
+        "mojo_version": "1.00",
+        "algo": "coxph",
+        "algorithm": "CoxPH",
+        "coef": _jarr([float(v) for v in coef_ref]),
+        "cats": len(cats_i),
+        "cat_offsets": _jarr(cat_offsets),
+        "use_all_factor_levels": "false",
+        "strata_count": 0,
+        "x_mean_cat_size1": 1,
+        "x_mean_cat_size2": n_cat_coef,
+        "x_mean_num_size1": 1,
+        "x_mean_num_size2": len(coef_ref) - n_cat_coef,
+        "interactions_1": "null",
+        "interactions_2": "null",
+        "interaction_targets": "null",
+    })
+    blobs = [("x_mean_cat", xmean_ref[:n_cat_coef].astype(">f8").tobytes()),
+             ("x_mean_num", xmean_ref[n_cat_coef:].astype(">f8").tobytes())]
+    return _emit_mojo_zip(path, info, names, domains, blobs)
+
+
+def score_reference_coxph_mojo(path: str, rows: Dict[str, np.ndarray]):
+    """lp from a reference CoxPH MOJO — the ported
+    CoxPHMojoModel.score0 (cats-first data[], one-hot coef lookup,
+    lpBase = coef . x_mean subtracted)."""
+    info, columns, domain_spec = _read_ini(path)
+    coef = np.asarray(_parse_jarr(info["coef"]))
+    cat_offsets = [int(v) for v in _parse_jarr(info["cat_offsets"])]
+    n_cats = int(info["cats"])
+    with zipfile.ZipFile(path) as z:
+        xm_cat = np.frombuffer(z.read("x_mean_cat"), dtype=">f8")
+        xm_num = np.frombuffer(z.read("x_mean_num"), dtype=">f8")
+    lp_base = float(coef[:len(xm_cat)] @ xm_cat
+                    + coef[len(xm_cat):] @ xm_num)
+    n = len(next(iter(rows.values())))
+    lp = np.zeros(n)
+    # categoricals: data[] carries the domain code; skip first level
+    for ci in range(n_cats):
+        dom = domain_spec[ci]
+        lut = {s: j for j, s in enumerate(dom)}
+        codes = np.asarray([lut.get(str(v), -1) for v in rows[columns[ci]]])
+        for r in range(n):
+            val = codes[r] - 1            # use_all_factor_levels=false
+            x = val + cat_offsets[ci]
+            if 0 <= val and x < cat_offsets[ci + 1]:
+                lp[r] += coef[x]
+    # numerics follow the categorical coefficient block
+    for ni, cn in enumerate(columns[n_cats:]):
+        v = np.asarray(rows[cn], np.float64)
+        lp += coef[cat_offsets[-1] + ni] * v
+    return lp - lp_base, info
+
+
+# -------------------------------------------------- GLRM writer/reader
+
+
+def write_reference_glrm_mojo(model, path: str) -> str:
+    """Reference-layout GLRM MOJO (GlrmMojoReader v1.00+ contract):
+    kv dims (ncolA/ncolY/nrowY/ncolX), regularizationX/gammaX/
+    initialization, norm_sub/norm_mul, cols_permutation (cats first),
+    num_levels_per_category, a ``losses`` text entry, and the
+    big-endian double ``archetypes`` blob [nrowY, ncolY] read via
+    ByteBuffer.getDouble; transposed=false so archetypes_raw is the
+    matrix as written."""
+    feats = list(model.features)
+    domains_by_feat = model.di_stats["domains"]
+    Y = np.asarray(model.Y, np.float64)              # [k, P_design]
+    k = Y.shape[0]
+
+    blocks, cats_i, nums_i, perm, width = _cats_first_perm(
+        domains_by_feat, keep_all_levels=True)   # GLRM keeps all levels
+    if width != Y.shape[1]:
+        raise ValueError(
+            f"GLRM archetype width {Y.shape[1]} != design width {width} "
+            "(use_all_factor_levels mismatch)")
+    Yref = Y[:, perm]
+
+    num_means = [float(m) for m in model.di_stats["num_means"]]
+    num_sigmas = [float(s) if s > 0 else 1.0
+                  for s in model.di_stats["num_sigmas"]]
+    stdize = model.transform == "standardize"
+    norm_sub = num_means if stdize else [0.0] * len(nums_i)
+    norm_mul = [1.0 / s for s in num_sigmas] if stdize \
+        else [1.0] * len(nums_i)
+
+    losses = ["Categorical"] * len(cats_i) + ["Quadratic"] * len(nums_i)
+    names = [feats[i] for i in cats_i] + [feats[i] for i in nums_i]
+    domains: List[Optional[List[str]]] = \
+        [list(domains_by_feat[i]) for i in cats_i] + [None] * len(nums_i)
+    info = _base_info(model, category="DimReduction",
+                      n_features=len(names), n_classes=1,
+                      n_columns=len(names), n_domains=len(cats_i))
+    info.update({
+        "mojo_version": "1.10",
+        "algo": "glrm",
+        "algorithm": "Generalized Low Rank Modeling",
+        "supervised": "false",
+        "ncolA": len(feats),
+        "ncolY": Yref.shape[1],
+        "nrowY": k,
+        "ncolX": k,
+        "regularizationX": str(model.params.get("regularization_x",
+                                                "None")),
+        "gammaX": float(model.params.get("gamma_x", 0.0)),
+        "initialization": "PlusPlus",
+        "num_categories": len(cats_i),
+        "num_numeric": len(nums_i),
+        "norm_sub": _jarr(norm_sub),
+        "norm_mul": _jarr(norm_mul),
+        "cols_permutation": _jarr(cats_i + nums_i),
+        "num_levels_per_category": _jarr(
+            [max(len(domains_by_feat[i]), 1) for i in cats_i]),
+        "seed": int(model.params.get("seed", 0) or 0),
+        "reverse_transform": "true" if stdize else "false",
+        "transposed": "false",
+        "catOffsets": _jarr(np.concatenate(
+            [[0], np.cumsum([max(len(domains_by_feat[i]), 1)
+                             for i in cats_i])]).astype(int)
+            if cats_i else [0]),
+    })
+    blobs = [("archetypes", Yref.astype(">f8").tobytes()),
+             ("losses", ("\n".join(losses) + "\n").encode())]
+    return _emit_mojo_zip(path, info, names, domains, blobs)
+
+
+def read_reference_glrm_mojo(path: str):
+    """Independent decode of archetypes/norms/losses exactly as
+    GlrmMojoReader.readModelData walks them."""
+    info, columns, domain_spec = _read_ini(path)
+    nrowY = int(info["nrowY"])
+    ncolY = int(info["ncolY"])
+    with zipfile.ZipFile(path) as z:
+        arch = np.frombuffer(z.read("archetypes"),
+                             dtype=">f8").reshape(nrowY, ncolY)
+        losses = z.read("losses").decode().splitlines()
+    return {"archetypes": arch,
+            "losses": losses,
+            "norm_sub": np.asarray(_parse_jarr(info["norm_sub"])),
+            "norm_mul": np.asarray(_parse_jarr(info["norm_mul"])),
+            "permutation": [int(v) for v in
+                            _parse_jarr(info["cols_permutation"])],
+            "num_levels": [int(v) for v in _parse_jarr(
+                info["num_levels_per_category"])]}, info

@@ -31,7 +31,14 @@ from h2o3_tpu.utils.log import get_logger
 
 log = get_logger("h2o3_tpu.stream")
 
-DEFAULT_CHUNK_BYTES = 256 << 20          # one parse window
+# 64MB windows: small enough that narrowed per-window blocks transfer
+# WHILE the host tokenizes the next window (the wire through the axon
+# tunnel sustains only ~15-20 MB/s, so hiding tokenize time behind it
+# is the difference between adding and maxing the two costs)
+DEFAULT_CHUNK_BYTES = 64 << 20
+
+
+from functools import partial as _partial
 
 
 def _open(path: str) -> IO[bytes]:
@@ -64,16 +71,69 @@ def _iter_line_chunks(paths: List[str], chunk_bytes: int):
                 first_of_file
 
 
+def _block_int_dtype(lo: float, hi: float):
+    if -128 <= lo and hi <= 127:
+        return np.int8
+    if -32768 <= lo and hi <= 32767:
+        return np.int16
+    return np.int32
+
+
+@_partial(jax.jit, static_argnames=("npad", "dtype", "sizes"))
+def _assemble_col(parts, bit_parts, *, npad: int, dtype: str,
+                  sizes: tuple):
+    """Concatenate the per-window device blocks, upcast to the column's
+    final dtype, pad, and build the NA mask from per-block packed bits
+    (None = block had no NAs) — all on device. One program per
+    (file-window-shape, dtype) signature; the persistent XLA cache
+    amortizes it across runs."""
+    segs = [p.astype(dtype) for p in parts]
+    x = segs[0] if len(segs) == 1 else jnp.concatenate(segs)
+    x = jnp.pad(x, (0, npad - x.shape[0]))
+    x = jax.lax.with_sharding_constraint(x, mesh_mod.row_sharding())
+    msegs = []
+    for bits, sz in zip(bit_parts, sizes):
+        if bits is None:
+            msegs.append(jnp.zeros(sz, bool))
+        else:
+            idx = jnp.arange(sz, dtype=jnp.int32)
+            b = bits[idx >> 3]
+            msegs.append((
+                (b >> (7 - (idx & 7)).astype(jnp.uint8)) & 1).astype(bool))
+    m = msegs[0] if len(msegs) == 1 else jnp.concatenate(msegs)
+    m = jnp.pad(m, (0, npad - m.shape[0]), constant_values=True)
+    m = jax.lax.with_sharding_constraint(m, mesh_mod.row_sharding())
+    return x, m
+
+
 class _ColAcc:
-    """Per-column accumulator: device chunk list + global domain."""
+    """Per-column accumulator: per-window NARROWED device blocks + the
+    global categorical domain.
+
+    Each window's slice ships immediately as an async device_put at the
+    window-local narrow dtype (int8/int16 when the block's values fit —
+    the NewChunk.compress codec role, applied per chunk like the
+    reference), and NA masks ship as packed BITS only for blocks that
+    have NAs. The wire through the tunneled chip is the ingest
+    bottleneck (~15-20 MB/s measured), so bytes-on-wire is the budget:
+    narrowing + bit-masks + transfer/tokenize overlap together turn
+    sum(tokenize, transfer-at-4B/cell) into ~max(tokenize,
+    transfer-at-1-2B/cell)."""
 
     def __init__(self, name: str):
         self.name = name
-        self.parts: List[jax.Array] = []     # device arrays (async put)
-        self.na_parts: List[jax.Array] = []
+        self.parts: List[jax.Array] = []     # device blocks (async put)
+        self.bit_parts: List[Optional[jax.Array]] = []
+        self.sizes: List[int] = []
         self.levels: Dict[str, int] = {}     # global categorical domain
         self.order: List[str] = []
         self.is_cat = False
+
+    def _push(self, clean: np.ndarray, na: np.ndarray, dtype):
+        self.parts.append(jax.device_put(clean.astype(dtype, copy=False)))
+        self.bit_parts.append(
+            jax.device_put(np.packbits(na)) if na.any() else None)
+        self.sizes.append(len(clean))
 
     def add_numeric(self, arr: np.ndarray):
         if self.is_cat:
@@ -85,33 +145,41 @@ class _ColAcc:
             return
         na = ~np.isfinite(arr)
         clean = np.where(na, 0.0, arr)
-        # per-chunk integrality/range tracking for dtype narrowing at
-        # finish (the NewChunk.compress codec-selection role)
+        # per-chunk integrality/range tracking for the FINAL dtype
         if not hasattr(self, "_all_int"):
             self._all_int, self._lo, self._hi = True, np.inf, -np.inf
-        if self._all_int and np.all(clean == np.round(clean)) and \
-                np.all(np.abs(clean) < 2**31):
+        blk_int = np.all(clean == np.round(clean)) and \
+            np.all(np.abs(clean) < 2**31)
+        if self._all_int and blk_int:
             if clean.size:
                 self._lo = min(self._lo, float(clean.min()))
                 self._hi = max(self._hi, float(clean.max()))
         else:
             self._all_int = False
-        self.parts.append(clean.astype(np.float32))
-        self.na_parts.append(na)
+        if blk_int and clean.size:
+            bd = _block_int_dtype(float(clean.min()), float(clean.max()))
+        elif blk_int:
+            bd = np.int8
+        else:
+            bd = np.float32
+        self._push(clean, na, bd)
 
     def add_categorical(self, codes: np.ndarray, domain: List[str],
                         raw_numeric: Optional[np.ndarray] = None):
         if not self.is_cat and self.parts:
             # column promoted to categorical mid-stream: earlier numeric
-            # windows are fetched back and re-expressed as levels (rare
+            # blocks are fetched back and re-expressed as levels (rare
             # type-drift path; one host round trip per prior window —
             # the reference re-parses the column in the same situation)
-            old_parts, old_nas = self.parts, self.na_parts
-            self.parts, self.na_parts = [], []
+            old = list(zip(self.parts, self.bit_parts, self.sizes))
+            self.parts, self.bit_parts, self.sizes = [], [], []
             self.is_cat = True
-            for part, na in zip(old_parts, old_nas):
+            for part, bits, sz in old:
                 vals = np.asarray(part, np.float64)
-                vals[np.asarray(na)] = np.nan
+                if bits is not None:
+                    na_old = np.unpackbits(
+                        np.asarray(bits), count=sz).astype(bool)
+                    vals[na_old] = np.nan
                 self.add_categorical(np.zeros(0, np.int32), [],
                                      raw_numeric=vals)
         self.is_cat = True
@@ -139,39 +207,22 @@ class _ColAcc:
                 lut[j] = k
             remapped = np.where(codes >= 0, lut[np.maximum(codes, 0)], -1)
         na = remapped < 0
-        self.parts.append(np.where(na, 0, remapped).astype(np.int32))
-        self.na_parts.append(na)
+        clean = np.where(na, 0, remapped)
+        # interning is append-only, so block codes are final; narrow by
+        # the block's max level index (upcast to int32 at assembly)
+        self._push(clean, na,
+                   _block_int_dtype(0, float(clean.max(initial=0))))
 
-    def finish(self, n: int, npad: int, shard) -> Column:
-        """Assemble the padded column on HOST and ship it in ONE
-        device_put. Device-side concatenate/pad/astype compiled a fresh
-        XLA program per (window-shape, dtype) combination — ~6s of
-        compiles on the first ingest of every new file size, which is
-        what made measured ingest 5 MB/s while the steady state runs at
-        ~80 MB/s. device_put has no compile and stays async."""
+    def finish(self, n: int, npad: int) -> Column:
         dtype = np.float32
         if self.is_cat:
             dtype = np.int32
         elif getattr(self, "_all_int", False):
-            # dtype-codec role of NewChunk.compress
-            lo, hi = self._lo, self._hi
-            if -128 <= lo and hi <= 127:
-                dtype = np.int8
-            elif -32768 <= lo and hi <= 32767:
-                dtype = np.int16
-            else:
-                dtype = np.int32
-        data_h = np.zeros(npad, dtype)
-        na_h = np.ones(npad, bool)       # padding rows are NA-masked
-        pos = 0
-        for part, napart in zip(self.parts, self.na_parts):
-            k = len(part)
-            data_h[pos: pos + k] = part.astype(dtype, copy=False)
-            na_h[pos: pos + k] = napart
-            pos += k
-        self.parts, self.na_parts = [], []
-        data = jax.device_put(data_h, shard)
-        na = jax.device_put(na_h, shard)
+            dtype = _block_int_dtype(self._lo, self._hi)
+        data, na = _assemble_col(tuple(self.parts), tuple(self.bit_parts),
+                                 npad=npad, dtype=np.dtype(dtype).name,
+                                 sizes=tuple(self.sizes))
+        self.parts, self.bit_parts, self.sizes = [], [], []
         if self.is_cat:
             return Column(name=self.name, type=T_CAT, data=data,
                           na_mask=na, nrows=n, domain=list(self.order))
@@ -230,8 +281,7 @@ def stream_import_csv(path, destination_frame: Optional[str] = None,
             else:
                 accs[nm].add_numeric(np.asarray(arr, np.float64))
     npad = mesh_mod.padded_rows(total)
-    shard = mesh_mod.row_sharding()
-    columns = [accs[nm].finish(total, npad, shard) for nm in names]
+    columns = [accs[nm].finish(total, npad) for nm in names]
     fr = Frame(columns, total, key=destination_frame)
     log.info("stream-parsed %s -> %s (%d x %d)", paths[0], fr.key,
              fr.nrows, fr.ncols)

@@ -370,7 +370,14 @@ class CoxPHEstimator(ModelBuilder):
              "z_coef": float(b / s) if s > 0 else float("nan")}
             for nm, b, s in zip(di.coef_names, beta_np, se)]
 
+        # weighted design-column means: the reference MOJO derives
+        # lpBase as coef . x_mean (CoxPHMojoModel.computeLpBase), and
+        # by linearity coef . x_mean == eta_mean — recorded here so
+        # export can emit x_mean_cat/x_mean_num without training data
+        xmean = np.asarray(jnp.asarray(w) @ di.X, np.float64) / \
+            max(float(np.sum(w)), 1e-12)
         output = {"category": "CoxPH", "response": y, "names": list(x),
+                  "x_mean_design": [float(v) for v in xmean],
                   "coef_names": di.coef_names, "domain": None,
                   "loglik": loglik, "null_loglik": loglik0,
                   "lre": float(abs(loglik - loglik0)),

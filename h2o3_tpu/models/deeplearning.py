@@ -155,11 +155,17 @@ _STEP_STATICS = ("act", "category", "input_dropout", "hidden_dropout",
                  "l1", "l2", "nclasses", "adaptive", "rho", "epsilon",
                  "nesterov")
 
+# jitted full-dataset loss for the early-stopping boundary — the eager
+# _loss layer loop would re-dispatch per op through the chip tunnel
+_loss_eval = partial(jax.jit, static_argnames=(
+    "act", "category", "input_dropout", "hidden_dropout", "l1", "l2",
+    "nclasses"))(_loss)
+
 
 @partial(jax.jit, static_argnames=_STEP_STATICS + (
     "nsteps", "batch", "n", "rate", "rate_annealing",
     "momentum_start", "momentum_stable", "momentum_ramp"))
-def _train_steps_fused(params, opt_state, X, y, w, key, step0, *,
+def _train_steps_fused(params, opt_state, X, y, w, key, step0, limit, *,
                        nsteps, batch, n, rate, rate_annealing,
                        momentum_start, momentum_stable, momentum_ramp,
                        **step_kwargs):
@@ -167,7 +173,15 @@ def _train_steps_fused(params, opt_state, X, y, w, key, step0, *,
     drawn on device, lr/momentum schedules computed per step. Removes
     the per-step host round trip (the dominant cost on a remote chip),
     the HOGWILD-free analogue of the reference's per-node inner loop
-    (hex/deeplearning/DeepLearningTask.java)."""
+    (hex/deeplearning/DeepLearningTask.java).
+
+    ``nsteps`` is the STATIC chunk size and ``limit`` the TRACED count
+    of effective steps: iterations past the limit keep params frozen
+    (masked update). One compiled program therefore serves every chunk
+    of every epoch count at a given shape — the DL analogue of the tree
+    DEPTH_BUCKETS; the remainder chunk (e.g. 153 of a 200-chunk) no
+    longer compiles its own program (round-4 bench lost ~7 minutes of
+    its warmup budget to exactly that)."""
 
     from h2o3_tpu.parallel.mesh import row_sharding
 
@@ -187,15 +201,23 @@ def _train_steps_fused(params, opt_state, X, y, w, key, step0, *,
         ramp = jnp.minimum(1.0, step * batch / max(momentum_ramp, 1.0))
         mu_now = jnp.float32(momentum_start
                              + (momentum_stable - momentum_start) * ramp)
-        params, opt_state = _train_step_impl(
+        new_p, new_s = _train_step_impl(
             params, opt_state, lr, Xb, yb, wb, kstep,
             mu_now=mu_now, **step_kwargs)
+        eff = i < limit
+        params = jax.tree_util.tree_map(
+            lambda a, b: jnp.where(eff, a, b), new_p, params)
+        opt_state = jax.tree_util.tree_map(
+            lambda a, b: jnp.where(eff, a, b), new_s, opt_state)
         return (params, opt_state, key), None
 
     (params, opt_state, key), _ = jax.lax.scan(
         body, (params, opt_state, key),
         jnp.arange(nsteps, dtype=jnp.float32))
     return params, opt_state, key
+
+
+_DESIGN_MEMO = None      # (model, frame, key, DataInfo) — one slot
 
 
 class DeepLearningModel(Model):
@@ -212,11 +234,26 @@ class DeepLearningModel(Model):
         self.resp_stats = resp_stats   # (mean, sigma) for regression target
 
     def _design(self, frame: Frame):
-        return build_datainfo(frame, self.features,
-                              standardize=self.standardize,
-                              use_all_factor_levels=bool(
-                                  self.params.get("use_all_factor_levels")),
-                              stats_override=self.di_stats)
+        # single-slot memo (module-level, NOT per model): _fit scores
+        # training_metrics on the frame it just expanded, and
+        # bench/AutoML score the training frame again right after —
+        # rebuilding a 784-column design costs seconds on a remote
+        # chip. One global slot bounds pinned device memory to one
+        # design no matter how many models the leaderboard holds.
+        # Keyed by (model, frame) object identity + frame key; rapids
+        # mutations always produce NEW Frame objects.
+        global _DESIGN_MEMO
+        memo = _DESIGN_MEMO
+        if memo is not None and memo[0] is self and memo[1] is frame \
+                and memo[2] == frame.key:
+            return memo[3]
+        di = build_datainfo(frame, self.features,
+                            standardize=self.standardize,
+                            use_all_factor_levels=bool(
+                                self.params.get("use_all_factor_levels")),
+                            stats_override=self.di_stats)
+        _DESIGN_MEMO = (self, frame, frame.key, di)
+        return di
 
     def _raw_out(self, frame: Frame):
         di = self._design(frame)
@@ -401,14 +438,17 @@ class DeepLearningEstimator(ModelBuilder):
 
         batch = int(p["mini_batch_size"])
         if batch <= 1:
-            batch = min(1024, max(256, n // 64))   # TPU minibatch default
+            # TPU minibatch default: scale with data up to 4096 — the
+            # fused step is overhead-bound below that (measured
+            # 0.08ms/step at 1024 vs 0.36ms at 8192 on v5e), and
+            # ADADELTA's per-parameter rates keep convergence stable
+            batch = min(4096, max(256, n // 64))
         ndata = mesh.shape["data"]
         batch = ((batch + ndata - 1) // ndata) * ndata
         epochs = float(p["epochs"])
         total_steps = max(1, int(epochs * n / batch))
         stopper = EarlyStopper(int(p["stopping_rounds"]),
                                float(p["stopping_tolerance"]) or 1e-5)
-        score_every = max(1, total_steps // 10)
 
         Xh = di.X   # already device, row-sharded
         step_kwargs = dict(act=act, category=cat_mode, input_dropout=in_drop,
@@ -424,23 +464,38 @@ class DeepLearningEstimator(ModelBuilder):
                      momentum_start=float(p["momentum_start"]),
                      momentum_stable=float(p["momentum_stable"]),
                      momentum_ramp=float(p["momentum_ramp"]))
-        # fused multi-step chunks: score/cancel boundaries between chunks
-        chunk = score_every if stopper.enabled else min(total_steps, 200)
+        # fused multi-step chunks: score/cancel boundaries between
+        # chunks. The chunk size is the STATIC program; short final
+        # chunks ride the same program with a traced ``limit``, and the
+        # size is FIXED (200, or 25 for tiny fits) so epoch-count
+        # variants — AutoML candidates, a bench warmup vs its timed
+        # run — share one compile. Early stopping therefore scores at
+        # chunk boundaries (the reference's ScoreKeeper likewise scores
+        # on an interval, not per iteration).
+        chunk = 200 if total_steps >= 25 else 25
+        sched["nsteps"] = chunk
+        # full-dataset loss evals keep the OLD total//10 cadence (a
+        # long fit must not pay a full-data pass every 200 steps); the
+        # eval itself is the jitted program, never the eager layer loop
+        score_stride = max(chunk, -(-total_steps // 10))
+        next_score = score_stride
         done = 0
         while done < total_steps:
             k = min(chunk, total_steps - done)
-            sched["nsteps"] = k
             params_net, opt_state, key = _train_steps_fused(
                 params_net, opt_state, Xh, y_dev, w, key,
-                jnp.float32(done), **sched, **step_kwargs)
+                jnp.float32(done), jnp.float32(k), **sched, **step_kwargs)
             done += k
             job.update(k / total_steps, f"step {done}/{total_steps}")
-            if stopper.enabled:
+            if stopper.enabled and (done >= next_score
+                                    or done >= total_steps):
+                next_score += score_stride
                 key, sub = jax.random.split(key)
-                lv = float(_loss(params_net, Xh, y_dev, w, sub, act=act,
-                                 category=cat_mode, input_dropout=0.0,
-                                 hidden_dropout=tuple([0.0] * len(hidden)),
-                                 l1=0.0, l2=0.0, nclasses=out_dim))
+                lv = float(_loss_eval(
+                    params_net, Xh, y_dev, w, sub, act=act,
+                    category=cat_mode, input_dropout=0.0,
+                    hidden_dropout=tuple([0.0] * len(hidden)),
+                    l1=0.0, l2=0.0, nclasses=out_dim))
                 scoring_history.append({"step": done, "loss": lv})
                 if stopper.should_stop(lv):
                     break
@@ -456,6 +511,10 @@ class DeepLearningEstimator(ModelBuilder):
         model = DeepLearningModel(p, output, params_net, stats_of(di),
                                   list(x), act, bool(p["standardize"]),
                                   resp_stats)
+        # training_metrics below re-scores `frame`: hand it the design
+        # we already expanded instead of rebuilding it
+        global _DESIGN_MEMO
+        _DESIGN_MEMO = (model, frame, frame.key, di)
         if p.get("export_weights_and_biases"):
             # per-layer weight/bias frames in the DKV
             # (DeepLearningModelInfo export; client model.weights(i) /

@@ -123,7 +123,16 @@ class IsolationForestModel(Model):
         ml = _forest_mean_length(self.forest, bm.bins, self.bm.nbins_total)
         n = frame.nrows
         ml = np.asarray(ml)[:n]
-        score = 2.0 ** (-ml / max(self.c_norm, 1e-12))
+        mn = self.output.get("min_path_length")
+        mx = self.output.get("max_path_length")
+        if mn is not None and mx is not None and mx > mn:
+            # reference normalization (IsolationForestModel
+            # .normalizePathLength): (max - total) / (max - min)
+            T = self.forest.feat.shape[0]
+            score = (mx - ml * T) / (mx - mn)
+        else:
+            # 2^(-l/c) original-paper score: pre-stats fallback
+            score = 2.0 ** (-ml / max(self.c_norm, 1e-12))
         return {"predict": score, "mean_length": ml}
 
     def model_performance(self, frame: Frame):
@@ -178,8 +187,24 @@ class IsolationForestEstimator(ModelBuilder):
             job.update(1.0 / ntrees, f"tree {t + 1}/{ntrees}")
         forest = stack_trees(trees)
         c_norm = float(_avg_path_correction(jnp.asarray(float(psi))))
+        # training min/max TOTAL path length (sum over trees): the
+        # reference normalizes scores as (max - len) / (max - min)
+        # (hex/tree/isofor/IsolationForest.java:238 stats,
+        # IsolationForestModel.normalizePathLength)
+        tot = np.asarray(_forest_mean_length(
+            forest, bm.bins, bm.nbins_total))[:n] * ntrees
         output = {"category": ModelCategory.ANOMALY, "response": None,
-                  "names": list(x), "domain": None}
+                  "names": list(x), "domain": None,
+                  "min_path_length": int(np.floor(tot.min())) if n else 0,
+                  "max_path_length": int(np.ceil(tot.max())) if n else 0}
         model = IsolationForestModel(p, output, forest, bm, c_norm)
-        model.training_metrics = model.model_performance(frame)
+        # training metrics straight from the path lengths already
+        # computed for the min/max stats — no second forest scan
+        ml = tot / max(ntrees, 1)
+        mn, mx = output["min_path_length"], output["max_path_length"]
+        score = ((mx - tot) / (mx - mn)) if mx > mn \
+            else 2.0 ** (-ml / max(c_norm, 1e-12))
+        model.training_metrics = {
+            "mean_score": float(np.mean(score)) if n else 0.0,
+            "mean_length": float(np.mean(ml)) if n else 0.0}
         return model

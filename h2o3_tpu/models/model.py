@@ -147,16 +147,24 @@ class Model:
         genmodel runtime can score the model — GBM/DRF/GLM.
         """
         if format == "reference":
-            if self.algo == "glm":
-                from h2o3_tpu.genmodel.refmojo import \
-                    write_reference_glm_mojo
-                return write_reference_glm_mojo(self, path)
-            from h2o3_tpu.genmodel.refmojo import write_reference_mojo
-            if self.algo not in ("gbm", "drf"):
+            from h2o3_tpu.genmodel import refmojo
+            writers = {
+                "glm": refmojo.write_reference_glm_mojo,
+                "kmeans": refmojo.write_reference_kmeans_mojo,
+                "deeplearning": refmojo.write_reference_dl_mojo,
+                "isolationforest": refmojo.write_reference_isofor_mojo,
+                "word2vec": refmojo.write_reference_word2vec_mojo,
+                "coxph": refmojo.write_reference_coxph_mojo,
+                "glrm": refmojo.write_reference_glrm_mojo,
+                "gbm": refmojo.write_reference_mojo,
+                "drf": refmojo.write_reference_mojo,
+            }
+            w = writers.get(self.algo)
+            if w is None:
                 raise ValueError(
-                    "reference-format MOJO export supports GBM/DRF/GLM "
-                    f"only (got {self.algo})")
-            return write_reference_mojo(self, path)
+                    "reference-format MOJO export supports "
+                    f"{sorted(writers)} (got {self.algo})")
+            return w(self, path)
         from h2o3_tpu.genmodel.export import mojo_artifacts
         from h2o3_tpu.genmodel.mojo import write_mojo
         meta, arrays = mojo_artifacts(self)

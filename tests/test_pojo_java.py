@@ -1,0 +1,155 @@
+"""Compilable-Java POJO round trips (VERDICT r4 demand #7).
+
+The contract is hex/Model.java toJava(): a .java class extending
+hex.genmodel.GenModel with score0(double[] data, double[] preds)
+(hex/genmodel/GenModel.java:363). No JVM ships in this image, so each
+emitted source is (a) structurally checked for javac shape, (b)
+re-read by an INDEPENDENT parser (JavaPojoScorer extracts the Java
+constants from the source text) whose own numpy walk must reproduce
+the in-cluster predictions — the same two-sided validation
+tests/test_reference_mojo.py applies to reference-MOJO bytes.
+"""
+
+import numpy as np
+import pytest
+
+from h2o3_tpu.frame.frame import Frame
+from h2o3_tpu.genmodel.pojo_java import (JavaPojoScorer, check_java_shape,
+                                         java_pojo_source)
+
+N = 400
+
+
+def _frame(seed=0, multiclass=False):
+    r = np.random.RandomState(seed)
+    g = r.choice(["lo", "mid", "hi"], N)
+    a = r.randn(N)
+    b = r.randn(N) * 2 + 1
+    a[::17] = np.nan
+    eta = 1.2 * a - 0.7 * b + (g == "hi") * 1.5
+    if multiclass:
+        y = np.array(["u", "v", "w"], object)[
+            np.clip((eta + r.randn(N)).astype(int) % 3, 0, 2)]
+    else:
+        y = np.where(eta + r.randn(N) > 0, "yes", "no")
+    return Frame.from_numpy(
+        {"a": a, "b": b, "g": g, "y": y}, categorical=["g", "y"])
+
+
+def _data_rows(fr, names):
+    """double[] rows the way GenModel.score0 receives them: categorical
+    cells as level-index doubles, NaN for NA."""
+    cols = []
+    for n in names:
+        c = fr.col(n)
+        if c.is_categorical:
+            codes = np.asarray(c.to_numpy_codes(), float) \
+                if hasattr(c, "to_numpy_codes") else None
+            if codes is None:
+                from h2o3_tpu.rapids import _cat_codes
+                codes = _cat_codes(fr, n).astype(float)
+                codes[codes < 0] = np.nan
+            cols.append(codes)
+        else:
+            cols.append(np.asarray(c.to_numpy(), float))
+    return np.stack(cols, axis=1)
+
+
+def _check(src, cls=None):
+    probs = check_java_shape(src, cls)
+    assert not probs, probs
+
+
+def test_gbm_binomial_java_pojo_round_trip():
+    from h2o3_tpu.models.gbm import GBMEstimator
+    fr = _frame(1)
+    m = GBMEstimator(ntrees=12, max_depth=4, seed=3,
+                     distribution="bernoulli").train(fr, y="y")
+    src = java_pojo_source(m, class_name="gbm_pojo")
+    _check(src, "gbm_pojo")
+    sc = JavaPojoScorer(src)
+    data = _data_rows(fr, m.output['names'])
+    f0 = float(np.asarray(m.f0))
+    p1_java = np.array([
+        1.0 / (1.0 + np.exp(-(f0 + sum(sc.margins(row)))))
+        for row in data[:80]])
+    pred = m.predict(fr).col("p1").to_numpy()[:80]
+    assert np.allclose(p1_java, pred, atol=1e-5), \
+        np.abs(p1_java - pred).max()
+
+
+def test_gbm_multinomial_java_pojo_round_trip():
+    from h2o3_tpu.models.gbm import GBMEstimator
+    fr = _frame(2, multiclass=True)
+    m = GBMEstimator(ntrees=9, max_depth=3, seed=5,
+                     distribution="multinomial").train(fr, y="y")
+    src = java_pojo_source(m, class_name="gbm_multi")
+    _check(src, "gbm_multi")
+    sc = JavaPojoScorer(src)
+    data = _data_rows(fr, m.output['names'])
+    K = 3
+    f0 = np.asarray(m.f0, float)
+    pf = m.predict(fr)
+    got_cols = [pf.col(n).to_numpy()[:60] for n in pf.names[1:]]
+    for i, row in enumerate(data[:60]):
+        marg = np.asarray(sc.margins(row))
+        z = f0 + np.array([marg[k::K].sum() for k in range(K)])
+        p = np.exp(z - z.max())
+        p = p / p.sum()
+        for k in range(K):
+            assert abs(p[k] - got_cols[k][i]) < 1e-5
+
+
+def test_drf_regression_java_pojo_round_trip():
+    from h2o3_tpu.models.drf import DRFEstimator
+    r = np.random.RandomState(4)
+    a, b = r.randn(N), r.randn(N)
+    fr = Frame.from_numpy({"a": a, "b": b,
+                           "y": 2 * a - b + r.randn(N) * 0.1})
+    m = DRFEstimator(ntrees=10, max_depth=5, seed=7).train(fr, y="y")
+    src = java_pojo_source(m, class_name="drf_pojo")
+    _check(src, "drf_pojo")
+    sc = JavaPojoScorer(src)
+    data = _data_rows(fr, m.output['names'])
+    pred = m.predict(fr).col("predict").to_numpy()[:80]
+    got = np.array([np.mean(sc.margins(row)) for row in data[:80]])
+    assert np.allclose(got, pred, atol=1e-5)
+
+
+def test_glm_binomial_java_pojo_round_trip():
+    from h2o3_tpu.models.glm import GLMEstimator
+    fr = _frame(6)
+    m = GLMEstimator(family="binomial", lambda_=1e-4).train(fr, y="y")
+    src = java_pojo_source(m, class_name="glm_pojo")
+    _check(src, "glm_pojo")
+    sc = JavaPojoScorer(src)
+    data = _data_rows(fr, m.output['names'])
+    p1 = np.array([1.0 / (1.0 + np.exp(-sc.glm_eta(row)))
+                   for row in data[:100]])
+    pred = m.predict(fr).col("p1").to_numpy()[:100]
+    assert np.allclose(p1, pred, atol=1e-4), np.abs(p1 - pred).max()
+
+
+def test_java_pojo_rejects_unsupported_algo():
+    from h2o3_tpu.models.kmeans import KMeansEstimator
+    r = np.random.RandomState(8)
+    fr = Frame.from_numpy({"a": r.randn(N), "b": r.randn(N)})
+    m = KMeansEstimator(k=3, seed=1).train(fr)
+    with pytest.raises(ValueError, match="gbm/drf/glm"):
+        java_pojo_source(m)
+
+
+def test_rest_models_java_serves_java_source():
+    """GET /3/Models.java/{m} returns javac-shaped source for tree
+    algos (the reference endpoint contract)."""
+    from h2o3_tpu.api.server import _model_pojo
+    from h2o3_tpu.core.kv import DKV
+    from h2o3_tpu.models.gbm import GBMEstimator
+    fr = _frame(9)
+    m = GBMEstimator(ntrees=5, max_depth=3, seed=1,
+                     distribution="bernoulli").train(fr, y="y")
+    DKV.put(m.key, m)
+    out = _model_pojo({}, None, mid=m.key)
+    assert out["__ctype__"].startswith("text/x-java")
+    src = out["__bytes__"].decode()
+    assert not check_java_shape(src), check_java_shape(src)
