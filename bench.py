@@ -97,38 +97,66 @@ def _airlines_csv(n_rows: int) -> str:
     carriers = np.array(["UA", "AA", "DL", "WN", "US", "NW", "CO", "MQ"])
     origins = np.array([f"{a}{b}{c}" for a in "ABCDE" for b in "AEIOU"
                         for c in "KLMNP"])
-    import pandas as pd
+    # pyarrow csv writer over dictionary-encoded string columns: the
+    # strings are never materialized host-side (~80 MB/s vs ~6 for
+    # object arrays) — the 50M-row (2.4GB) file must not eat the bench
+    # budget in generation (round-4 gbm-full skip)
+    import pyarrow as pa
+    import pyarrow.csv as pacsv
+
+    def _dict(idx, values):
+        return pa.DictionaryArray.from_arrays(
+            pa.array(idx, type=pa.int32()), pa.array(list(values)))
+
     chunk = 2_000_000
-    first = True
     t0 = time.time()
+    sink = open(path + ".tmp", "wb")
+    writer = None
     for lo in range(0, n_rows, chunk):
         n = min(chunk, n_rows - lo)
         dep = r.randint(0, 2400, n)
         crs = np.maximum(dep - r.randint(-10, 60, n), 0)
-        df = pd.DataFrame({
-            "Year": r.randint(1987, 2009, n),
-            "Month": r.randint(1, 13, n),
-            "DayofMonth": r.randint(1, 29, n),
-            "DayOfWeek": r.randint(1, 8, n),
-            "DepTime": dep,
-            "CRSDepTime": crs,
-            "UniqueCarrier": carriers[r.randint(0, len(carriers), n)],
-            "Origin": origins[r.randint(0, len(origins), n)],
-            "Dest": origins[r.randint(0, len(origins), n)],
-            "Distance": r.randint(50, 2600, n),
-        })
+        month = r.randint(1, 13, n)
+        car_i = r.randint(0, len(carriers), n)
         # learnable signal: late-day departures + carrier/origin effects
-        delay = (0.03 * (df["DepTime"] - 1000)
-                 + (df["UniqueCarrier"].isin(["UA", "NW"])) * 15
-                 + (df["Month"].isin([12, 1, 6])) * 8
+        delay = (0.03 * (dep - 1000)
+                 + np.isin(car_i, [0, 5]) * 15          # UA, NW
+                 + np.isin(month, [12, 1, 6]) * 8
                  + r.randn(n) * 25)
-        df["IsDepDelayed"] = np.where(delay > 15, "YES", "NO")
-        df.to_csv(path, index=False, mode="w" if first else "a",
-                  header=first)
-        first = False
+        cols = {
+            "Year": pa.array(r.randint(1987, 2009, n)),
+            "Month": pa.array(month),
+            "DayofMonth": pa.array(r.randint(1, 29, n)),
+            "DayOfWeek": pa.array(r.randint(1, 8, n)),
+            "DepTime": pa.array(dep),
+            "CRSDepTime": pa.array(crs),
+            "UniqueCarrier": _dict(car_i, carriers),
+            "Origin": _dict(r.randint(0, len(origins), n), origins),
+            "Dest": _dict(r.randint(0, len(origins), n), origins),
+            "Distance": pa.array(r.randint(50, 2600, n)),
+            "IsDepDelayed": _dict((delay > 15).astype(np.int32),
+                                  ["NO", "YES"]),
+        }
+        tbl = pa.table(cols)
+        if writer is None:
+            writer = pacsv.CSVWriter(sink, tbl.schema)
+        writer.write_table(tbl)
+    writer.close()
+    sink.close()
+    os.rename(path + ".tmp", path)
     print(f"# wrote {path} ({os.path.getsize(path)/1e9:.2f} GB) "
           f"in {time.time()-t0:.0f}s", file=sys.stderr)
     return path
+
+
+def _tree_mfu_pct(rows_per_sec_tree: float, depth: int, n_features: int,
+                  n_bins: int = 65) -> float:
+    """MFU of the histogram matmuls (the tree FLOPs that touch the MXU):
+    per row per tree, levels 0..depth-1 contract [3L,C]x[C,F*B] with
+    L=2^level nodes -> 2 * 3*(2^depth - 1) * F*B flops (ops/histogram.py
+    _block_hist), against the v5e bf16 peak 197 TFLOP/s."""
+    flops_per_row_tree = 2 * 3 * (2 ** depth - 1) * n_features * n_bins
+    return 100 * rows_per_sec_tree * flops_per_row_tree / 197e12
 
 
 def _hbm_peak():
@@ -186,6 +214,7 @@ def _gbm_at(n_rows: int, ntrees: int, depth: int, tag: str):
         train_seconds=round(t_train, 1),
         total_seconds=round(t_ingest + t_train, 1),
         auc=round(float(model.training_metrics["AUC"]), 4),
+        mfu_pct=round(_tree_mfu_pct(rows_per_sec, depth, 10), 2),
         peak_hbm_gb=round(_hbm_peak() / 1e9, 2))
 
 
@@ -225,11 +254,16 @@ def bench_glm():
                          standardize=True).train(fr, y="y")
         dt = time.time() - t0
         row_iters = n * max_it / dt
+        # MFU: IRLSM is Gram-dominated (2*n*p^2 per iter, ops/gram.py);
+        # L-BFGS is two matvec passes (4*n*p per iter). Both shapes are
+        # HBM-bandwidth-bound at p=28, so these run low by design.
+        flops_per_row_iter = 2 * p * p if solver == "irlsm" else 4 * p
         _emit(
             f"GLM binomial {solver.upper()} HIGGS-shape {n/1e6:.0f}Mx{p}",
             row_iters, "row-iters/sec/chip",
             row_iters / 1.0e7, "estimated JVM 1.0e7 row-iters/sec",
             train_seconds=round(dt, 2),
+            mfu_pct=round(100 * row_iters * flops_per_row_iter / 197e12, 3),
             auc=round(float(m.training_metrics["AUC"]), 4))
 
 
@@ -298,6 +332,7 @@ def bench_xgb():
         rps, "rows/sec/chip",
         rps / 2.0e6, "estimated JVM xgboost-hist 2.0e6 rows/sec-tree",
         train_seconds=round(dt, 2),
+        mfu_pct=round(_tree_mfu_pct(rps, 6, 10), 2),
         auc=round(float(m.training_metrics["AUC"]), 4))
 
 

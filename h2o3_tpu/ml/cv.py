@@ -185,6 +185,7 @@ def train_with_cv(builder, frame: Frame, x: Sequence[str], y: str,
     cv_pred_keys = []
     fold_metric_dicts = []
     path_devs = []      # per-fold per-lambda holdout deviance (GLM search)
+    dev_scores = []     # (holdout idx, device score) — light-mode async sweep
 
     # CV fast path (tree builders): fold models train on the PARENT
     # frame with held-out rows weight-masked and the main model's bin
@@ -248,6 +249,21 @@ def train_with_cv(builder, frame: Frame, x: Sequence[str], y: str,
             sub._cv_shared_bm = shared_bm
             sub._cv_light = light
             m = sub._fit(frame, list(x), y, job)
+            if light and not keep_preds and hasattr(m, "_score_dev"):
+                # near-LOO async pipeline: keep every fold's holdout
+                # score ON DEVICE and fetch the whole sweep in one
+                # batched transfer after the loop — the per-fold
+                # blocking fetch was a ~100ms tunnel round trip × nfolds
+                # (pyunit_cv_carsRF's 583s). Periodic block bounds the
+                # number of in-flight fold forests in HBM.
+                dev_scores.append((idx, m._score_dev(frame)))
+                if len(dev_scores) % 64 == 0:
+                    dev_scores[-1][1].block_until_ready()
+                from h2o3_tpu.core.kv import DKV as _DKV
+                _DKV.remove(m.key)
+                del m
+                fold_metric_dicts.append({})
+                continue
             full_preds = m._score_raw(frame)
             preds = {k: np.asarray(v)[idx] for k, v in full_preds.items()}
             if light:
@@ -316,6 +332,13 @@ def train_with_cv(builder, frame: Frame, x: Sequence[str], y: str,
                 cols[name] = fullcol
             pf = Frame.from_numpy(cols)
             cv_pred_keys.append(pf.key)
+
+    if dev_scores:
+        # ONE batched device→host transfer merges the whole light sweep
+        fetched = _fetch_np([a for _, a in dev_scores])
+        for (idx2, _), arr in zip(dev_scores, fetched):
+            holdout[idx2] = np.asarray(arr)[idx2]
+        dev_scores.clear()
 
     # final model on all data (ModelBuilder.java "main model") — the
     # fast path trained it up front to share its binning with the folds

@@ -165,6 +165,18 @@ class DRFModel(Model):
             out[f"p{k}"] = p[:, k]
         return out
 
+    def _score_dev(self, frame: Frame):
+        """Device-resident holdout scoring for ml/cv.py light mode —
+        see GBMModel._score_dev (one batched fetch per CV sweep instead
+        of a blocking ~100ms tunnel sync per fold)."""
+        bm = rebin_for_scoring(self.bm, frame)
+        cat = self.output["category"]
+        if cat == ModelCategory.REGRESSION:
+            return self._mean_votes(bm)[:, 0]
+        p = self._probs(bm)
+        if cat == ModelCategory.BINOMIAL:
+            return p[:, 1]
+        return p
 
     def predict_leaf_node_assignment(self, frame: Frame) -> Frame:
         """Per-tree terminal node ids (h2o-py predict_leaf_node_assignment

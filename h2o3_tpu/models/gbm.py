@@ -375,6 +375,21 @@ class GBMModel(Model):
         dist = get_distribution(self.dist_name, **self.params)
         return {"predict": _fetch_np(dist.link_inv(marg))[:n]}
 
+    def _score_dev(self, frame: Frame):
+        """Device-resident holdout scoring for the near-LOO CV sweep
+        (ml/cv.py light mode): the padded device array the CV merge
+        needs (p1 / [N,K] probs / prediction) with NO host sync, so
+        hundreds of fold scores pipeline through the async dispatch
+        queue and the sweep pays one batched fetch at the end."""
+        bm = rebin_for_scoring(self.bm, frame)
+        marg = self._margins(bm, self._frame_offset(frame,
+                                                    bm.bins.shape[0]))
+        cat = self.output["category"]
+        if cat == ModelCategory.BINOMIAL:
+            return get_distribution("bernoulli").link_inv(marg)
+        if cat == ModelCategory.MULTINOMIAL:
+            return jax.nn.softmax(marg, axis=1)
+        return get_distribution(self.dist_name, **self.params).link_inv(marg)
 
     def predict_leaf_node_assignment(self, frame: Frame) -> Frame:
         """Per-tree terminal node ids (h2o-py predict_leaf_node_assignment

@@ -126,11 +126,17 @@ def put_sharded(host_array, sharding):
         host_array.shape, sharding, lambda idx: host_array[idx])
 
 
+FETCH_CALLS = 0      # observability: device→host fetches (tests assert
+#                      device pipelines never materialize on controller)
+
+
 def fetch_replicated(x):
     """Device→host fetch that works on cross-process sharded arrays.
 
     Single process: device_get. Multi-process: allgather the shards so
     every host sees the full array (water/MRTask postGlobal view)."""
+    global FETCH_CALLS
+    FETCH_CALLS += 1
     leaves = jax.tree_util.tree_leaves(x)
     if all(getattr(getattr(v, "sharding", None), "is_fully_addressable",
                    True) for v in leaves):

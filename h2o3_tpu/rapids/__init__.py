@@ -20,6 +20,7 @@ Grammar (Rapids.java:27-52):
 from __future__ import annotations
 
 import math
+import os as _os
 import re as _re
 from typing import Any, Callable, Dict, List, Optional
 
@@ -28,6 +29,7 @@ import numpy as np
 from h2o3_tpu.parallel.mesh import fetch_replicated as _fetch_np
 
 from h2o3_tpu.core.kv import DKV
+from h2o3_tpu.frame.column import Column, T_CAT, T_NUM, T_STR, T_UUID
 from h2o3_tpu.frame.frame import Frame
 from h2o3_tpu.parallel import mesh as mesh_mod
 
@@ -200,6 +202,159 @@ def _broadcast2(l, r):
     return {"C1": (l, r)}
 
 
+# ------------------------------------------------- device elementwise
+#
+# Elementwise prims on frames at or above this row count run on the
+# device mesh instead of fetching to the controller (the reference runs
+# every prim as an MRTask — water/rapids/ast/prims/mungers/AstGroup.java
+# pattern; at 116M rows a controller fetch per op is the difference
+# between an in-HBM pipeline and shipping the frame over the wire).
+# Below the threshold the exact host-float64 path runs: reference
+# pyunits assert f64-exact results that f32 device math can miss.
+_DEV_MIN_ROWS = int(_os.environ.get("H2O3TPU_RAPIDS_DEVICE_ROWS", "1000000"))
+
+DEV_OPS = 0      # observability: prims served by the device path (tests
+#                  assert scale ops don't silently fall back to host)
+
+
+def _dev_hit():
+    global DEV_OPS
+    DEV_OPS += 1
+
+# dtypes safe in the f32 device path: values exact in a 24-bit mantissa.
+# int32/time columns can exceed 2^24 (epoch millis certainly do) and
+# stay on the host f64 path; cat codes are always < 2^24.
+_DEV_SAFE_DTYPES = ("int8", "int16", "float32", "bfloat16", "uint8")
+
+
+def _dev_col_ok(c: Column) -> bool:
+    if c.type == T_CAT:
+        return True
+    if c.type != T_NUM or c.data is None:
+        return False
+    return str(c.data.dtype) in _DEV_SAFE_DTYPES
+
+
+def _dev_eligible(*vals) -> bool:
+    """True when every Frame operand is large, same-shape, and device-safe."""
+    frames = [v for v in vals if isinstance(v, Frame)]
+    if not frames or any(f.nrows < _DEV_MIN_ROWS for f in frames):
+        return False
+    if len({f.nrows for f in frames}) > 1:
+        return False
+    shapes = set()
+    for f in frames:
+        for n in f.names:
+            c = f.col(n)
+            if not _dev_col_ok(c):
+                return False
+            shapes.add(int(c.data.shape[0]))
+    return len(shapes) == 1
+
+
+def _dev_view(c: Column):
+    """NaN-injected f32 view — same NA encoding as the host f64 path, so
+    every ufunc reproduces host semantics (NaN propagation in arithmetic,
+    False comparisons on NA) on device."""
+    import jax.numpy as jnp
+    return jnp.where(c.na_mask, jnp.nan, c.data.astype(jnp.float32))
+
+
+def _dev_frame(nrows: int, outs: Dict[str, Any]) -> Frame:
+    """Frame from device result arrays. NA mask = NaN positions PLUS the
+    padding tail: comparisons map the NaN-injected padding back to 0.0
+    (NaN < x is False), which would otherwise read as valid rows."""
+    import jax.numpy as jnp
+    _dev_hit()
+    cols = []
+    for n, d in outs.items():
+        pad_na = jnp.arange(d.shape[0], dtype=jnp.int32) >= nrows
+        cols.append(Column(name=n, type=T_NUM, data=d,
+                           na_mask=jnp.isnan(d) | pad_na, nrows=nrows))
+    return Frame(cols, nrows)
+
+
+def _jnp_binops():
+    """name → jnp callable. Built lazily (jax import cost) and cached.
+    numpy ufuncs applied to jax arrays materialize to HOST numpy (no
+    __array_ufunc__ dispatch), so the device path needs its own table."""
+    global _JNP_BINOPS, _JNP_UNOPS
+    if _JNP_BINOPS is not None:
+        return _JNP_BINOPS, _JNP_UNOPS
+    import jax.numpy as jnp
+    from jax import lax
+
+    def _f32(x):
+        return x.astype(jnp.float32)
+
+    _JNP_BINOPS = {
+        "+": jnp.add, "-": jnp.subtract, "*": jnp.multiply,
+        "/": jnp.divide, "^": jnp.power, "%": jnp.mod, "%%": jnp.mod,
+        "intDiv": jnp.floor_divide, "%/%": jnp.floor_divide,
+        "==": lambda a, b: _f32(jnp.equal(a, b)),
+        "!=": lambda a, b: _f32(jnp.not_equal(a, b)),
+        "<": lambda a, b: _f32(jnp.less(a, b)),
+        "<=": lambda a, b: _f32(jnp.less_equal(a, b)),
+        ">": lambda a, b: _f32(jnp.greater(a, b)),
+        ">=": lambda a, b: _f32(jnp.greater_equal(a, b)),
+        "&": lambda a, b: _f32((a != 0) & (b != 0)),
+        "|": lambda a, b: _f32((a != 0) | (b != 0)),
+    }
+    _JNP_UNOPS = {
+        "abs": jnp.abs, "ceiling": jnp.ceil, "floor": jnp.floor,
+        "trunc": jnp.trunc, "exp": jnp.exp, "log": jnp.log,
+        "log10": jnp.log10, "log1p": jnp.log1p, "log2": jnp.log2,
+        "sqrt": jnp.sqrt, "sin": jnp.sin, "cos": jnp.cos, "tan": jnp.tan,
+        "asin": jnp.arcsin, "acos": jnp.arccos, "atan": jnp.arctan,
+        "sinh": jnp.sinh, "cosh": jnp.cosh, "tanh": jnp.tanh,
+        "sign": jnp.sign,
+        "not": lambda a: _f32(a == 0), "!": lambda a: _f32(a == 0),
+        "cumsum": jnp.cumsum, "cumprod": jnp.cumprod,
+        "cummax": lax.cummax, "cummin": lax.cummin,
+    }
+    return _JNP_BINOPS, _JNP_UNOPS
+
+
+_JNP_BINOPS = None
+_JNP_UNOPS = None
+
+
+def _dev_binop(op, l, r):
+    """Device path for frame⊗frame / frame⊗scalar elementwise binops.
+    Returns None when ineligible (caller falls back to host f64)."""
+    if op is None or not _dev_eligible(l, r):
+        return None
+    if isinstance(l, Frame) and isinstance(r, Frame):
+        if l.ncols == 1 and r.ncols > 1:
+            a = _dev_view(l.col(l.names[0]))
+            pairs = {n: (a, _dev_view(r.col(n))) for n in r.names}
+        elif r.ncols == 1 and l.ncols > 1:
+            b = _dev_view(r.col(r.names[0]))
+            pairs = {n: (_dev_view(l.col(n)), b) for n in l.names}
+        elif l.ncols == r.ncols:
+            pairs = {n: (_dev_view(l.col(n)), _dev_view(r.col(m)))
+                     for n, m in zip(l.names, r.names)}
+        else:
+            return None
+    elif isinstance(l, Frame):
+        pairs = {n: (_dev_view(l.col(n)), r) for n in l.names}
+    else:
+        pairs = {n: (l, _dev_view(r.col(n))) for n in r.names}
+    import jax.numpy as jnp
+    outs = {n: jnp.asarray(op(a, b), jnp.float32) for n, (a, b) in pairs.items()}
+    base = l if isinstance(l, Frame) else r
+    return _dev_frame(base.nrows, outs)
+
+
+def _dev_unop(op, v: Frame):
+    if op is None or not _dev_eligible(v):
+        return None
+    import jax.numpy as jnp
+    outs = {n: jnp.asarray(op(_dev_view(v.col(n))), jnp.float32)
+            for n in v.names}
+    return _dev_frame(v.nrows, outs)
+
+
 # ---------------------------------------------------------------- prims
 
 PRIMS: Dict[str, Callable] = {}
@@ -247,6 +402,9 @@ def _binop(op, name: str = ""):
             return float((l == r) if name == "==" else (l != r))
         if not isinstance(l, Frame) and not isinstance(r, Frame):
             return float(op(l, r))
+        dv = _dev_binop(_jnp_binops()[0].get(name), l, r)
+        if dv is not None:
+            return dv
         pairs = _broadcast2(l, r)
         out = {}
         for n, (a, b) in pairs.items():
@@ -277,11 +435,14 @@ for _name, _op in [("+", np.add), ("-", np.subtract), ("*", np.multiply),
     PRIMS[_name] = _binop(_op, _name)
 
 
-def _unop(op):
+def _unop(op, name: str = ""):
     def fn(env, x):
         v = env.ev(x)
         if not isinstance(v, Frame):
             return float(op(v))
+        dv = _dev_unop(_jnp_binops()[1].get(name), v)
+        if dv is not None:
+            return dv
         with np.errstate(all="ignore"):
             out = {n: np.asarray(op(_col_np(v, n).astype(np.float64)))
                    for n in v.names}
@@ -301,7 +462,7 @@ for _name, _op in [("abs", np.abs), ("ceiling", np.ceil), ("floor", np.floor),
                    ("lgamma", np.vectorize(math.lgamma)),
                    ("gamma", np.vectorize(math.gamma)),
                    ]:
-    PRIMS[_name] = _unop(_op)
+    PRIMS[_name] = _unop(_op, _name)
 
 
 @prim("is.na")
@@ -316,6 +477,19 @@ def _is_na(env, x):
             return float(np.isnan(float(v)))
         except (TypeError, ValueError):
             return 1.0 if v is None else 0.0
+    if _dev_eligible(v):
+        # the NA answer is the mask itself — no values ever leave HBM
+        import jax.numpy as jnp
+        _dev_hit()
+        cols = []
+        for n in v.names:
+            c = v.col(n)
+            pad_na = jnp.arange(c.data.shape[0],
+                                dtype=jnp.int32) >= v.nrows
+            cols.append(Column(name=f"isNA({n})", type=T_NUM,
+                               data=c.na_mask.astype(jnp.float32),
+                               na_mask=pad_na, nrows=v.nrows))
+        return Frame(cols, v.nrows)
     out = {}
     for n in v.names:
         c = v.col(n)
@@ -358,13 +532,50 @@ def _signif(env, x, digits=("num", 6)):
 # ---- reducers (ast/prims/reducers) ----------------------------------
 
 
-def _reducer(np_fn, na_fn):
+def _dev_reduce(name: str, v: Frame, na_rm: bool):
+    """Device-resident sum/min/max/mean over all columns: per-column
+    scalar partials leave the device, never the rows (AstSumAxis-at-scale
+    role). None → host fallback. f32 accumulation (XLA tree-reduces, so
+    error ~log n · eps) — only taken above _DEV_MIN_ROWS where the exact
+    client oracles of the small pyunits never go."""
+    if name not in ("sum", "min", "max", "mean") or not _dev_eligible(v):
+        return None
+    import jax.numpy as jnp
+    _dev_hit()
+    parts, counts, n_na = [], 0.0, 0.0
+    for n in v.names:
+        c = v.col(n)
+        logical = jnp.arange(c.data.shape[0], dtype=jnp.int32) < v.nrows
+        valid = logical & ~c.na_mask
+        x = c.data.astype(jnp.float32)
+        n_na += float(jnp.sum(c.na_mask & logical))
+        counts += float(jnp.sum(valid))
+        if name in ("sum", "mean"):
+            parts.append(float(jnp.sum(jnp.where(valid, x, 0.0))))
+        elif name == "min":
+            parts.append(float(jnp.min(jnp.where(valid, x, jnp.inf))))
+        else:
+            parts.append(float(jnp.max(jnp.where(valid, x, -jnp.inf))))
+    if not na_rm and n_na > 0:
+        return float("nan")
+    if name == "sum":
+        return float(np.sum(parts))
+    if name == "mean":
+        return float(np.sum(parts) / max(counts, 1.0))
+    return float(np.min(parts) if name == "min" else np.max(parts))
+
+
+def _reducer(np_fn, na_fn, name: str = ""):
     def fn(env, *args):
         vals = [env.ev(a) for a in args]
         na_rm = False
         if len(vals) > 1 and isinstance(vals[-1], (bool, float, int)):
             na_rm = bool(vals[-1])
             vals = vals[:-1]
+        if len(vals) == 1 and isinstance(vals[0], Frame):
+            dv = _dev_reduce(name, vals[0], na_rm)
+            if dv is not None:
+                return dv
         acc = []
         for v in vals:
             if isinstance(v, Frame):
@@ -391,7 +602,7 @@ for _name, _f, _fna in [
          lambda a: float(np.any(a[~np.isnan(a)] != 0))),
         ("all", lambda a: float(np.all(a != 0)),
          lambda a: float(np.all(a[~np.isnan(a)] != 0)))]:
-    PRIMS[_name] = _reducer(_f, _fna)
+    PRIMS[_name] = _reducer(_f, _fna, _name)
 
 
 # NA-skipping scalar rollups (AstNaRollupOp subclasses: sumNA/minNA/
@@ -418,12 +629,17 @@ def _flatten_prim(env, x):
     return float(val)
 
 
-def _cumop(op, axis1_op):
+def _cumop(op, axis1_op, name: str = ""):
     def fn(env, x, axis=0):
         v = env.ev(x)
         ax = int(env.ev(axis)) if not isinstance(axis, (int, float)) \
             else int(axis)
         if ax == 0:
+            # padding rows sit AFTER the logical rows, so a prefix scan
+            # over the padded array is exact on the logical prefix
+            dv = _dev_unop(_jnp_binops()[1].get(name), v)
+            if dv is not None:
+                return dv
             return _rebuild(v, {n: op(_col_np(v, n)) for n in v.names},
                             False)
         # axis=1: accumulate across columns within each row (AstCumu)
@@ -441,7 +657,7 @@ for _name, _op, _op1 in [
          lambda m: np.maximum.accumulate(m, axis=1)),
         ("cummin", np.minimum.accumulate,
          lambda m: np.minimum.accumulate(m, axis=1))]:
-    PRIMS[_name] = _cumop(_op, _op1)
+    PRIMS[_name] = _cumop(_op, _op1, _name)
 
 
 # ---- structural (ast/prims/mungers) ---------------------------------
@@ -784,6 +1000,14 @@ def _rm(env, name):
 @prim("ifelse")
 def _ifelse(env, test, yes, no):
     t, y, n = env.ev(test), env.ev(yes), env.ev(no)
+    if isinstance(t, Frame) and _dev_eligible(t, y, n):
+        import jax.numpy as jnp
+        tv_d = _dev_view(t.col(t.names[0]))
+        yv_d = _dev_view(y.col(y.names[0])) if isinstance(y, Frame) else y
+        nv_d = _dev_view(n.col(n.names[0])) if isinstance(n, Frame) else n
+        o = jnp.where(jnp.nan_to_num(tv_d) != 0, yv_d, nv_d)
+        o = jnp.where(jnp.isnan(tv_d), jnp.nan, o).astype(jnp.float32)
+        return _dev_frame(t.nrows, {"C1": o})
     tv = _col_np(t, t.names[0]) if isinstance(t, Frame) else t
     if not isinstance(tv, np.ndarray):
         return y if tv else n
@@ -1266,6 +1490,29 @@ def _strop(fn):
     def wrapper(env, x, *args):
         f = _as_frame(env.ev(x))
         extra = [a[1] if isinstance(a, tuple) else env.ev(a) for a in args]
+        if f.nrows >= _DEV_MIN_ROWS and all(
+                f.col(n).is_categorical and f.col(n).domain
+                for n in f.names):
+            # scale path: transform the DOMAIN on host (O(cardinality))
+            # and remap codes on device via a LUT gather — the rows
+            # never leave HBM (AstStrOp over CStrChunk becomes a
+            # dictionary rewrite at TPU scale)
+            import jax.numpy as jnp
+            _dev_hit()
+            cols = []
+            for n in f.names:
+                c = f.col(n)
+                dom = [fn(s, *extra) for s in (c.domain or [])]
+                uniq = sorted(set(dom))
+                remap = {s: i for i, s in enumerate(uniq)}
+                lut = np.array([remap[s] for s in dom], np.int32)
+                codes = jnp.take(jnp.asarray(lut),
+                                 c.data.astype(jnp.int32),
+                                 mode="clip")
+                cols.append(Column(name=n, type=T_CAT, data=codes,
+                                   na_mask=c.na_mask, nrows=f.nrows,
+                                   domain=uniq))
+            return Frame(cols, f.nrows)
         out, cats, strs = {}, [], []
         for n in f.names:
             c = f.col(n)

@@ -1,0 +1,134 @@
+"""Device-resident rapids elementwise/reducer paths (VERDICT r4 #9).
+
+Reference: every rapids prim is an MRTask over chunks
+(water/rapids/ast/prims/mungers/, AstGroup.java pattern) — nothing
+materializes on the driver. Here: frames >= _DEV_MIN_ROWS run
+elementwise prims / sum-min-max-mean / cat string-ops on the device
+mesh; below the threshold the exact host-float64 path keeps the small
+reference pyunits bit-stable.
+
+Two contracts:
+  1. parity — the device path reproduces the host path (f32 tolerance);
+  2. scale — at 10M rows none of these prims fetches a column to the
+     controller (mesh.FETCH_CALLS stays flat; scalar syncs are allowed).
+"""
+
+import numpy as np
+import pytest
+
+import h2o3_tpu
+import h2o3_tpu.rapids as R
+from h2o3_tpu.parallel import mesh as mesh_mod
+from h2o3_tpu.rapids import Session, rapids
+
+
+def _mk(sess, n, key, seed=1):
+    r = np.random.RandomState(seed)
+    a = r.randn(n) * 4.0
+    a[r.rand(n) < 0.05] = np.nan
+    b = r.rand(n) * 5.0 + 0.5
+    c = r.uniform(0.97, 1.03, n)          # cumprod-safe magnitudes
+    g = np.array(["lvl%02d" % i for i in r.randint(0, 12, n)], object)
+    fr = h2o3_tpu.Frame.from_numpy({"a": a, "b": b, "c": c, "g": g},
+                                   categorical=["g"], key=key)
+    sess.assign(key, fr)
+    return fr
+
+
+BINOPS = ["+", "-", "*", "/", "^", "<", "<=", ">", ">=", "==", "!=",
+          "&", "|", "intDiv"]
+UNOPS_A = ["abs", "floor", "ceiling", "trunc", "sign", "not", "sin",
+           "cos", "tanh"]
+UNOPS_B = ["exp", "log", "sqrt", "log1p"]     # positive domain
+CUMOPS = ["cumsum", "cummax", "cummin"]
+
+
+def _exprs(key):
+    es = [f'({op} (cols_py {key} ["a"]) (cols_py {key} ["b"]))'
+          for op in BINOPS]
+    es += [f'({op} (cols_py {key} ["a"]) 2.5)' for op in ("+", "*", "<")]
+    es += [f'({op} (cols_py {key} ["a"]))' for op in UNOPS_A]
+    es += [f'({op} (cols_py {key} ["b"]))' for op in UNOPS_B]
+    es += [f'({op} (cols_py {key} ["c"]) 0)' for op in CUMOPS]
+    es += ['(cumprod (cols_py %s ["c"]) 0)' % key,
+           f'(is.na (cols_py {key} ["a"]))',
+           f'(ifelse (> (cols_py {key} ["a"]) 0) '
+           f'(cols_py {key} ["b"]) (cols_py {key} ["c"]))']
+    return es
+
+
+REDUCES = ['(sum (cols_py KEY ["b"]))', '(mean (cols_py KEY ["a"]) 1)',
+           '(min (cols_py KEY ["b"]))', '(max (cols_py KEY ["a"]) 1)']
+
+
+@pytest.fixture()
+def small(monkeypatch):
+    sess = Session()
+    _mk(sess, 4096, "sd")
+    return sess
+
+
+@pytest.mark.parametrize("expr", _exprs("sd"))
+def test_device_host_parity(small, expr, monkeypatch):
+    host = rapids(expr, small)
+    monkeypatch.setattr(R, "_DEV_MIN_ROWS", 1)
+    dev = rapids(expr, small)
+    assert isinstance(dev, type(host))
+    hv = {n: host.col(n).to_numpy() for n in host.names}
+    dvv = {n: dev.col(n).to_numpy() for n in dev.names}
+    assert list(hv) == list(dvv)
+    loose = any(k in expr for k in ("cumsum", "cumprod"))
+    for n in hv:
+        np.testing.assert_allclose(
+            dvv[n], hv[n], rtol=2e-3 if loose else 2e-5,
+            atol=2e-3 if loose else 1e-5, equal_nan=True, err_msg=expr)
+
+
+@pytest.mark.parametrize("expr", REDUCES)
+def test_reduce_parity(small, expr, monkeypatch):
+    e = expr.replace("KEY", "sd")
+    host = rapids(e, small)
+    monkeypatch.setattr(R, "_DEV_MIN_ROWS", 1)
+    dev = rapids(e, small)
+    if np.isnan(host):
+        assert np.isnan(dev)
+    else:
+        assert abs(dev - host) <= 2e-4 * max(1.0, abs(host)), e
+
+
+def test_strop_cat_parity(small, monkeypatch):
+    e = '(toupper (cols_py sd ["g"]))'
+    host = rapids(e, small)
+    monkeypatch.setattr(R, "_DEV_MIN_ROWS", 1)
+    dev = rapids(e, small)
+    assert dev.col(dev.names[0]).domain == host.col(host.names[0]).domain
+    np.testing.assert_array_equal(dev.col(dev.names[0]).to_numpy(),
+                                  host.col(host.names[0]).to_numpy())
+
+
+def test_scale_no_controller_materialization():
+    """10M rows: elementwise + string-cat + reducers never fetch a
+    column to the controller (VERDICT r4 #9 'Done' criterion)."""
+    n = 10_000_000
+    sess = Session()
+    fr = _mk(sess, n, "big")
+    assert fr.nrows >= R._DEV_MIN_ROWS
+    # warm any lazy jax-op tables before counting
+    rapids('(+ (cols_py big ["a"]) 1)', sess)
+    base = mesh_mod.FETCH_CALLS
+    base_dev = R.DEV_OPS
+    exprs = (_exprs("big")
+             + [x.replace("KEY", "big") for x in REDUCES]
+             + ['(toupper (cols_py big ["g"]))'])
+    outs = [rapids(e, sess) for e in exprs]
+    # force execution of every produced frame before asserting
+    for o in outs:
+        if isinstance(o, h2o3_tpu.Frame):
+            o.col(o.names[0]).data.block_until_ready()
+    # every prim took the device path (f32 host caches are pre-seeded,
+    # so a flat fetch counter alone can't prove it)...
+    assert R.DEV_OPS - base_dev >= len(exprs), \
+        f"only {R.DEV_OPS - base_dev}/{len(exprs)} prims ran on device"
+    # ...and none of them materialized a column on the controller
+    assert mesh_mod.FETCH_CALLS == base, \
+        f"{mesh_mod.FETCH_CALLS - base} controller fetches at 10M rows"
