@@ -1155,3 +1155,243 @@ def read_reference_glrm_mojo(path: str):
                             _parse_jarr(info["cols_permutation"])],
             "num_levels": [int(v) for v in _parse_jarr(
                 info["num_levels_per_category"])]}, info
+
+
+# ------------------------------------------------- PCA writer/reader
+
+
+def write_reference_pca_mojo(model, path: str) -> str:
+    """Reference-layout PCA MOJO (PCAMojoReader v1.00 contract —
+    hex/genmodel/algos/pca/PCAMojoReader.java:17): kv entries for
+    ncats/nnums/catOffsets/permutation/normSub/normMul plus ONE
+    big-endian double blob ``eigenvectors_raw`` of shape
+    [eigenvector_size][k] in cats-first design order
+    (PCAMojoModel.score0 walks cat one-hot blocks first, then
+    (x-normSub)*normMul nums).
+
+    Our design expands features in frame order; rows of the eigenvector
+    matrix are permuted cats-first to match."""
+    feats = list(model.features)
+    domains_by_feat = model.di_stats["domains"]
+    keep_all = bool(model.use_all_levels)
+    blocks, cats_i, nums_i, perm, P = _cats_first_perm(
+        domains_by_feat, keep_all_levels=keep_all)
+    V = np.asarray(model.eigvecs, np.float64)          # [P, k], our order
+    if V.shape[0] != P:
+        raise ValueError("eigenvector rows do not match the design "
+                         f"({V.shape[0]} vs {P}) — retrain to export")
+    k = V.shape[1]
+    V_ref = V[perm]                                    # cats-first rows
+
+    cat_offsets = [0]
+    for i in cats_i:
+        cat_offsets.append(cat_offsets[-1] + len(blocks[i]))
+    nnums = len(nums_i)
+    if str(model.transform).lower() == "standardize":
+        norm_sub = [float(m) for m in model.di_stats["num_means"]]
+        norm_mul = [1.0 / float(s) for s in model.di_stats["num_sigmas"]]
+    else:
+        norm_sub = [0.0] * nnums
+        norm_mul = [1.0] * nnums
+
+    names = [feats[i] for i in cats_i] + [feats[i] for i in nums_i]
+    domains: List[Optional[List[str]]] = \
+        [list(domains_by_feat[i]) for i in cats_i] + [None] * nnums
+    info = _base_info(model, category="DimReduction", n_features=len(names),
+                      n_classes=k, n_columns=len(names),
+                      n_domains=len(cats_i))
+    info.update({
+        "mojo_version": "1.00",
+        "algo": "pca",
+        "algorithm": "Principal Component Analysis",
+        "use_all_factor_levels": "true" if keep_all else "false",
+        "pca_methods": str(model.params.get("pca_method", "GramSVD")),
+        "pca_impl": str(model.params.get("pca_impl", "mtj_evd_symmmatrix")),
+        "k": k,
+        # names are emitted cats-first, so the row→cats-first map is id
+        "permutation": _jarr(list(range(len(names)))),
+        "ncats": len(cats_i),
+        "nnums": nnums,
+        "normSub": _jarr(norm_sub),
+        "normMul": _jarr(norm_mul),
+        "catOffsets": _jarr(cat_offsets),
+        "eigenvector_size": P,
+    })
+    blobs = [("eigenvectors_raw", V_ref.astype(">f8").tobytes())]
+    return _emit_mojo_zip(path, info, names, domains, blobs)
+
+
+def score_reference_pca_mojo(path: str, rows: Dict[str, np.ndarray]):
+    """Independent PCA scorer following PCAMojoModel.score0 exactly:
+    cat levels index one-hot eigenvector rows (NaN/unseen skipped),
+    nums project as (x - normSub) * normMul * eigenrow."""
+    info, columns, domain_spec = _read_ini(path)
+    k = int(info["k"])
+    ncats = int(info["ncats"])
+    nnums = int(info["nnums"])
+    cat_offsets = [int(v) for v in _parse_jarr(info["catOffsets"])]
+    permutation = [int(v) for v in _parse_jarr(info["permutation"])]
+    norm_sub = np.asarray(_parse_jarr(info["normSub"]))
+    norm_mul = np.asarray(_parse_jarr(info["normMul"]))
+    use_all = str(info["use_all_factor_levels"]).lower() == "true"
+    P = int(info["eigenvector_size"])
+    with zipfile.ZipFile(path) as z:
+        ev = np.frombuffer(z.read("eigenvectors_raw"),
+                           dtype=">f8").reshape(P, k)
+    domains = {columns[ci]: lv for ci, lv in domain_spec.items()}
+    luts = {c: {s: j for j, s in enumerate(lv)}
+            for c, lv in domains.items()}
+    n = len(next(iter(rows.values())))
+    out = np.zeros((n, k))
+    for r in range(n):
+        row = []
+        for c in columns:
+            v = rows[c][r]
+            if c in luts and not (isinstance(v, float) and np.isnan(v)):
+                row.append(float(luts[c].get(str(v), np.nan)))
+            else:
+                row.append(float(v) if v is not None else np.nan)
+        acc = np.zeros(k)
+        for j in range(ncats):
+            tmp = row[permutation[j]]
+            if np.isnan(tmp):
+                continue
+            last_cat = cat_offsets[j + 1] - cat_offsets[j] - 1
+            level = int(tmp) - (0 if use_all else 1)
+            if level < 0 or level > last_cat:
+                continue
+            acc += ev[cat_offsets[j] + level]
+        vcol = cat_offsets[ncats]
+        for j in range(nnums):
+            acc += (row[permutation[ncats + j]] - norm_sub[j]) \
+                * norm_mul[j] * ev[vcol + j]
+        out[r] = acc
+    return out
+
+
+# ----------------------------------------- TargetEncoder writer/reader
+
+
+def write_reference_te_mojo(model, path: str) -> str:
+    """Reference-layout TargetEncoder MOJO
+    (hex/genmodel/algos/targetencoder/TargetEncoderMojoReader.java:13):
+    per-column ``[col]`` sections of ``level = num den`` lines in
+    feature_engineering/target_encoding/encoding_map.ini (last index =
+    the reserved NA level), the three columns-mapping ini files, and
+    with_blending/inflection_point/smoothing kv. The reader recomputes
+    priors as sum(num)/sum(den) per column (EncodingMap.getPriorMean),
+    so the NA row is emitted as ``0 0`` — our fit excludes NA rows from
+    the level stats and encodes NA with the prior, which the reader
+    reproduces via te_column_name_to_missing_values_presence = 0."""
+    p = model.params
+    enc_cols = list(model.enc_maps.keys())
+    emap_lines = []
+    hasna_lines = []
+    inenc_lines = []
+    inout_lines = []
+    for col in enc_cols:
+        m = model.enc_maps[col]
+        num = np.asarray(m["sum"], np.float64).sum(axis=0)
+        den = np.asarray(m["cnt"], np.float64).sum(axis=0)
+        emap_lines.append(f"[{col}]")
+        for lv in range(len(num)):
+            nv, dv = float(num[lv]), float(den[lv])
+            nr = repr(int(nv)) if nv == int(nv) else repr(nv)
+            dr = repr(int(dv)) if dv == int(dv) else repr(dv)
+            emap_lines.append(f"{lv} = {nr} {dr}")
+        emap_lines.append(f"{len(num)} = 0 0")      # reserved NA level
+        hasna_lines.append(f"{col} = 0")
+        inenc_lines += ["[from]", col, "[to]", col]
+        inout_lines += ["[from]", col, "[to]", f"{col}_te"]
+
+    names = list(enc_cols)
+    domains: List[Optional[List[str]]] = \
+        [list(model.enc_maps[c]["domain"]) for c in enc_cols]
+    info = _base_info(model, category="TargetEncoder",
+                      n_features=len(names), n_classes=1,
+                      n_columns=len(names), n_domains=len(names))
+    blending = bool(p.get("blending", False))
+    info.update({
+        "mojo_version": "1.00",
+        "algo": "targetencoder",
+        "algorithm": "TargetEncoder",
+        "with_blending": "true" if blending else "false",
+        "keep_original_categorical_columns": "true",
+        "non_predictors": model.output.get("response") or "",
+    })
+    if blending:
+        info["inflection_point"] = float(p.get("inflection_point", 10.0))
+        info["smoothing"] = float(p.get("smoothing", 20.0))
+    base = "feature_engineering/target_encoding"
+    blobs = [
+        (f"{base}/encoding_map.ini", "\n".join(emap_lines) + "\n"),
+        (f"{base}/te_column_name_to_missing_values_presence.ini",
+         "\n".join(hasna_lines) + "\n"),
+        (f"{base}/input_encoding_columns_map.ini",
+         "\n".join(inenc_lines) + "\n"),
+        (f"{base}/input_output_columns_map.ini",
+         "\n".join(inout_lines) + "\n"),
+    ]
+    return _emit_mojo_zip(path, info, names, domains,
+                          [(n, b.encode()) for n, b in blobs])
+
+
+def score_reference_te_mojo(path: str, rows: Dict[str, np.ndarray]):
+    """Independent TE scorer following TargetEncoderMojoModel.score0:
+    posterior num/den per level, optional lambda-blended toward the
+    per-column prior sum(num)/sum(den); NA/unseen → prior (our export
+    flags no NA level). Returns {col_te: [n]}."""
+    info, columns, domain_spec = _read_ini(path)
+    blending = str(info.get("with_blending", "false")).lower() == "true"
+    ip = float(info.get("inflection_point", 10.0))
+    sm = float(info.get("smoothing", 20.0))
+    with zipfile.ZipFile(path) as z:
+        emap_txt = z.read("feature_engineering/target_encoding/"
+                          "encoding_map.ini").decode().splitlines()
+        hasna_txt = z.read(
+            "feature_engineering/target_encoding/"
+            "te_column_name_to_missing_values_presence.ini"
+        ).decode().splitlines()
+    has_na = {}
+    for ln in hasna_txt:
+        if "=" in ln:
+            k, _, v = ln.partition("=")
+            has_na[k.strip()] = v.strip() == "1"
+    emaps: Dict[str, Dict[int, tuple]] = {}
+    sec = None
+    for ln in emap_txt:
+        ln = ln.strip()
+        if not ln:
+            continue
+        if ln.startswith("[") and ln.endswith("]"):
+            sec = ln[1:-1]
+            emaps[sec] = {}
+        else:
+            k, _, v = ln.partition("=")
+            num, den = v.strip().split(" ")
+            emaps[sec][int(k)] = (float(num), float(den))
+    domains = {columns[ci]: lv for ci, lv in domain_spec.items()}
+    out = {}
+    for col, emap in emaps.items():
+        prior_num = sum(nd[0] for nd in emap.values())
+        prior_den = sum(nd[1] for nd in emap.values())
+        prior = prior_num / prior_den
+        lv = {s: j for j, s in enumerate(domains[col])}
+        vals = rows[col]
+        enc = np.empty(len(vals))
+        for r, v in enumerate(vals):
+            isna = v is None or (isinstance(v, float) and np.isnan(v))
+            cat = lv.get(str(v)) if not isna else None
+            if cat is None and has_na.get(col):
+                cat = max(emap)                     # reserved NA level
+            if cat is None or cat not in emap or emap[cat][1] == 0:
+                enc[r] = prior
+                continue
+            num, den = emap[cat]
+            post = num / den
+            if blending:
+                lam = 1.0 / (1.0 + np.exp((ip - den) / sm))
+                post = lam * post + (1.0 - lam) * prior
+            enc[r] = post
+        out[f"{col}_te"] = enc
+    return out

@@ -156,6 +156,8 @@ class Model:
                 "word2vec": refmojo.write_reference_word2vec_mojo,
                 "coxph": refmojo.write_reference_coxph_mojo,
                 "glrm": refmojo.write_reference_glrm_mojo,
+                "pca": refmojo.write_reference_pca_mojo,
+                "targetencoder": refmojo.write_reference_te_mojo,
                 "gbm": refmojo.write_reference_mojo,
                 "drf": refmojo.write_reference_mojo,
             }

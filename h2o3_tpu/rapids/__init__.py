@@ -542,26 +542,30 @@ def _dev_reduce(name: str, v: Frame, na_rm: bool):
         return None
     import jax.numpy as jnp
     _dev_hit()
-    parts, counts, n_na = [], 0.0, 0.0
+    # per-column 0-d partials accumulate ON DEVICE; ONE batched scalar
+    # fetch ends the reduce (three float() syncs per column would pay
+    # ~100ms tunnel RTT each — the cost this path exists to avoid)
+    parts, counts, n_nas = [], [], []
     for n in v.names:
         c = v.col(n)
         logical = jnp.arange(c.data.shape[0], dtype=jnp.int32) < v.nrows
         valid = logical & ~c.na_mask
         x = c.data.astype(jnp.float32)
-        n_na += float(jnp.sum(c.na_mask & logical))
-        counts += float(jnp.sum(valid))
+        n_nas.append(jnp.sum(c.na_mask & logical))
+        counts.append(jnp.sum(valid))
         if name in ("sum", "mean"):
-            parts.append(float(jnp.sum(jnp.where(valid, x, 0.0))))
+            parts.append(jnp.sum(jnp.where(valid, x, 0.0)))
         elif name == "min":
-            parts.append(float(jnp.min(jnp.where(valid, x, jnp.inf))))
+            parts.append(jnp.min(jnp.where(valid, x, jnp.inf)))
         else:
-            parts.append(float(jnp.max(jnp.where(valid, x, -jnp.inf))))
-    if not na_rm and n_na > 0:
+            parts.append(jnp.max(jnp.where(valid, x, -jnp.inf)))
+    parts, counts, n_nas = _fetch_np((parts, counts, n_nas))
+    if not na_rm and np.sum(n_nas) > 0:
         return float("nan")
     if name == "sum":
         return float(np.sum(parts))
     if name == "mean":
-        return float(np.sum(parts) / max(counts, 1.0))
+        return float(np.sum(parts) / max(float(np.sum(counts)), 1.0))
     return float(np.min(parts) if name == "min" else np.max(parts))
 
 
@@ -1000,7 +1004,9 @@ def _rm(env, name):
 @prim("ifelse")
 def _ifelse(env, test, yes, no):
     t, y, n = env.ev(test), env.ev(yes), env.ev(no)
-    if isinstance(t, Frame) and _dev_eligible(t, y, n):
+    if isinstance(t, Frame) and _dev_eligible(t, y, n) \
+            and not isinstance(y, str) and not isinstance(n, str):
+        # string yes/no branches intern as categoricals — host path only
         import jax.numpy as jnp
         tv_d = _dev_view(t.col(t.names[0]))
         yv_d = _dev_view(y.col(y.names[0])) if isinstance(y, Frame) else y

@@ -129,6 +129,7 @@ def test_scale_no_controller_materialization():
     # so a flat fetch counter alone can't prove it)...
     assert R.DEV_OPS - base_dev >= len(exprs), \
         f"only {R.DEV_OPS - base_dev}/{len(exprs)} prims ran on device"
-    # ...and none of them materialized a column on the controller
-    assert mesh_mod.FETCH_CALLS == base, \
+    # ...and none materialized a column on the controller: the only
+    # fetches allowed are the reducers' single scalar-pytree fetch each
+    assert mesh_mod.FETCH_CALLS - base <= len(REDUCES), \
         f"{mesh_mod.FETCH_CALLS - base} controller fetches at 10M rows"

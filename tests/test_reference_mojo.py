@@ -368,3 +368,48 @@ def test_glrm_roundtrip(tmp_path):
                        np.asarray(m.Y, np.float64)[:, perm], atol=1e-6)
     assert len(dec["losses"]) == len(m.features)
     assert dec["permutation"] == cats_i + nums_i
+
+
+def test_pca_roundtrip(tmp_path):
+    from h2o3_tpu.genmodel.refmojo import score_reference_pca_mojo
+    from h2o3_tpu.models.pca import PCAEstimator
+    r = np.random.RandomState(11)
+    n = 600
+    x1 = r.randn(n) * 3 + 1
+    x2 = x1 * 0.5 + r.randn(n)
+    g = np.array(["p", "q", "s"], object)[r.randint(0, 3, n)]
+    fr = Frame.from_numpy({"x1": x1, "g": g, "x2": x2}, categorical=["g"])
+    m = PCAEstimator(k=2, transform="standardize", seed=3).train(fr)
+    p = str(tmp_path / "pca.zip")
+    m.download_mojo(p, format="reference")
+    got = score_reference_pca_mojo(p, {"x1": x1, "g": g, "x2": x2})
+    raw = m._score_raw(fr)
+    want = np.stack([raw["PC1"], raw["PC2"]], axis=1)
+    assert np.allclose(got, want, atol=2e-3), np.abs(got - want).max()
+
+
+def test_targetencoder_roundtrip(tmp_path):
+    from h2o3_tpu.genmodel.refmojo import score_reference_te_mojo
+    from h2o3_tpu.models.targetencoder import TargetEncoderEstimator
+    r = np.random.RandomState(13)
+    n = 1200
+    g1 = np.array(["a", "b", "c", "d"], object)[r.randint(0, 4, n)]
+    g2 = np.array(["u", "v"], object)[r.randint(0, 2, n)]
+    yv = ((g1 == "a") * 0.5 + (g2 == "v") * 0.2
+          + r.rand(n) < 0.55).astype(int)
+    fr = Frame.from_numpy(
+        {"g1": g1, "g2": g2,
+         "y": np.array(["no", "yes"], object)[yv]},
+        categorical=["g1", "g2", "y"])
+    for blending in (False, True):
+        m = TargetEncoderEstimator(
+            blending=blending, inflection_point=15.0, smoothing=25.0,
+            noise=0.0).train(fr, x=["g1", "g2"], y="y")
+        p = str(tmp_path / f"te_{blending}.zip")
+        m.download_mojo(p, format="reference")
+        got = score_reference_te_mojo(p, {"g1": g1, "g2": g2})
+        want = m.transform(fr, as_training=False, noise=0.0)
+        for col in ("g1_te", "g2_te"):
+            np.testing.assert_allclose(
+                got[col], want.col(col).to_numpy(), atol=1e-6,
+                err_msg=f"{col} blending={blending}")
