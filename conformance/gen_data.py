@@ -109,6 +109,8 @@ def gen_airlines(sd: str) -> None:
     dow = r.randint(1, 8, n)
     crsdep = r.randint(0, 2400, n)
     deptime = crsdep + r.randint(-10, 60, n)
+    crsarr = (crsdep + r.randint(30, 360, n)) % 2400   # pyunit_ifelse
+    arrtime = (crsarr + r.randint(-20, 90, n)) % 2400
     origin = r.choice(["SFO", "JFK", "ORD", "ATL", "DEN"], n)
     dest = r.choice(["LAX", "BOS", "SEA", "MIA", "PHX"], n)
     dist = r.randint(100, 2500, n)
@@ -121,12 +123,13 @@ def gen_airlines(sd: str) -> None:
         import io
         buf = io.StringIO()
         hdr = ["Year", "Month", "DayofMonth", "DayOfWeek", "DepTime",
-               "CRSDepTime", "UniqueCarrier", "Origin", "Dest",
-               "Distance", "DepDelay", "IsDepDelayed"]
+               "CRSDepTime", "ArrTime", "CRSArrTime", "UniqueCarrier",
+               "Origin", "Dest", "Distance", "DepDelay", "IsDepDelayed"]
         buf.write(",".join(hdr) + "\n")
         for i in range(n):
             buf.write(f"{year[i]},{month[i]},{dom[i]},{dow[i]},"
-                      f"{deptime[i]},{crsdep[i]},{carrier[i]},{origin[i]},"
+                      f"{deptime[i]},{crsdep[i]},{arrtime[i]},{crsarr[i]},"
+                      f"{carrier[i]},{origin[i]},"
                       f"{dest[i]},{dist[i]},{depdelay[i]},{isdelayed[i]}\n")
         with zipfile.ZipFile(path, "w") as z:
             z.writestr("allyears2k_headers.csv", buf.getvalue())
@@ -289,7 +292,10 @@ def gen_munging_files(sd: str) -> None:
         rng = np.random.RandomState(21)
         sel = rng.rand(len(irows)) < 0.8
         with open(p, "w") as f:
-            f.write(ih)
+            # the reference's iris_train.csv names the target "species"
+            # (pyunit_PUBDEV_6062 trains y="species"), unlike
+            # iris_wheader's "class"
+            f.write(ih.replace("class", "species"))
             f.writelines(ln for i, ln in enumerate(irows) if sel[i])
 
 

@@ -99,19 +99,19 @@ def _col_json(fr: Frame, name: str, row_offset: int, rows: int,
     wire_type = _WIRE_TYPES.get(c.type, c.type)
     data, string_data, domain = None, None, None
     if c.type in ("string", "uuid"):
-        vals = c.to_numpy()[lo:hi]
+        vals = c.host_view()[lo:hi]
         string_data = [None if v is None else str(v) for v in vals]
         data = []
     elif c.is_categorical:
         domain = list(c.domain or [])
-        codes = _fetch_np(c.data)[lo:hi].astype(np.int64)
-        na = _fetch_np(c.na_mask)[lo:hi]
-        # NA cells ride as JSON NaN (json.dumps allow_nan): the client
-        # probes math.isnan(cell) before indexing the domain
-        # (h2o-py/h2o/expr.py:416 _tabulate) — None breaks it
-        data = [float("nan") if m else int(v) for v, m in zip(codes, na)]
+        # cached host view (prefetch_host batched the fetch): f64 codes
+        # with NaN at NA. NA cells ride as JSON NaN (json.dumps
+        # allow_nan): the client probes math.isnan(cell) before
+        # indexing the domain (h2o-py/h2o/expr.py:416 _tabulate)
+        codes = c.host_view()[lo:hi]
+        data = [float("nan") if np.isnan(v) else int(v) for v in codes]
     else:
-        vals = np.asarray(c.to_numpy()[lo:hi], np.float64)
+        vals = np.asarray(c.host_view()[lo:hi], np.float64)
         if wire_type == "real" and vals.size and \
                 np.all(np.isnan(vals) | (vals == np.round(vals))) and \
                 np.nanmax(np.abs(vals), initial=0) < 2**53:
@@ -154,6 +154,11 @@ def _col_json(fr: Frame, name: str, row_offset: int, rows: int,
 def _frame_json(fr: Frame, rows: int = 10, row_offset: int = 0) -> dict:
     """FrameV3 wire shape (water/api/schemas3/FrameV3.java)."""
     rows = min(rows, fr.nrows)
+    # one batched host fetch for every column's preview data — a
+    # 1000-column frame (pyunit_create_frame) otherwise pays a blocking
+    # tunnel round trip per column
+    from h2o3_tpu.frame.column import prefetch_host
+    prefetch_host([fr.col(n) for n in fr.names])
     try:
         summ = fr.summary()
     except Exception:

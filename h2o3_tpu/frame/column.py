@@ -66,13 +66,14 @@ class Column:
         x = self.data.astype(jnp.float32)
         return jnp.where(self.na_mask, jnp.nan, x)
 
-    def to_numpy(self) -> np.ndarray:
-        """Host copy, logical rows only, NaN/None for NAs.
+    def host_view(self) -> np.ndarray:
+        """READ-ONLY cached host view, logical rows only, NaN/None NAs.
 
         Cached: columns are immutable (mutation makes new columns), and
         on a remote-attached chip every device→host fetch costs a full
         tunnel round trip (~100 ms) regardless of size — one batched
-        fetch of (data, mask), then reuse.
+        fetch of (data, mask), then reuse. Callers must not mutate;
+        use to_numpy() for an owned copy.
         """
         if self.type in (T_STR, T_UUID):
             return self.strings[: self.nrows]
@@ -84,7 +85,13 @@ class Column:
             x[mask[: self.nrows]] = np.nan
             host = x
             object.__setattr__(self, "_host_cache", host)
-        return host.copy()   # callers may mutate their view
+        return host
+
+    def to_numpy(self) -> np.ndarray:
+        """Host copy of host_view() — callers may mutate their copy."""
+        if self.type in (T_STR, T_UUID):
+            return self.strings[: self.nrows]
+        return self.host_view().copy()
 
 
 def prefetch_host(cols: List["Column"]) -> None:

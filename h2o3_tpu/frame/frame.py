@@ -163,6 +163,8 @@ class Frame:
 
     # ---- stats (RollupStats surface on the frame) --------------------
     def summary(self) -> Dict[str, dict]:
+        from h2o3_tpu.frame.rollups import prefetch_rollups
+        prefetch_rollups([self.col(n) for n in self._order])
         out = {}
         for n in self._order:
             c = self.col(n)

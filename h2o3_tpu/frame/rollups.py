@@ -38,19 +38,34 @@ def _rollup_kernel(x: jax.Array, na: jax.Array) -> dict:
     }
 
 
+def prefetch_rollups(cols) -> None:
+    """Fill many columns' rollup caches with ONE device→host fetch.
+
+    N sequential rollups() calls block on N tunnel round trips (~10-100ms
+    each on a remote-attached chip); a 1000-column frame summary
+    (pyunit_create_frame shape) pays ~100s that way. Dispatch every
+    column's kernel asynchronously, then device_get the whole list."""
+    todo = [c for c in cols
+            if c._rollups is None and c.type != T_STR and c.data is not None]
+    if not todo:
+        return
+    fetched = jax.device_get([_rollup_kernel(c.data, c.na_mask)
+                              for c in todo])
+    for c, stats in zip(todo, fetched):
+        out = {k: float(v) for k, v in stats.items()}
+        out["rows"] = int(out["rows"])
+        n_padding = c.data.shape[0] - c.nrows
+        out["na_count"] = int(out["na_count"]) - n_padding
+        out["zero_count"] = int(out["zero_count"])
+        c._rollups = out
+
+
 def rollups(col: Column) -> dict:
     """Compute-once stats (RollupStats.get semantics)."""
     if col._rollups is not None:
         return col._rollups
-    if col.type == T_STR:
+    if col.type == T_STR or col.data is None:
         col._rollups = {"rows": col.nrows, "na_count": 0}
         return col._rollups
-    stats = jax.device_get(_rollup_kernel(col.data, col.na_mask))
-    out = {k: float(v) for k, v in stats.items()}
-    out["rows"] = int(out["rows"])
-    # padding rows are flagged NA so reductions skip them; uncount them here
-    n_padding = (col.data.shape[0] - col.nrows) if col.data is not None else 0
-    out["na_count"] = int(out["na_count"]) - n_padding
-    out["zero_count"] = int(out["zero_count"])
-    col._rollups = out
-    return out
+    prefetch_rollups([col])
+    return col._rollups
