@@ -508,6 +508,48 @@ def model_to_v3(model: Model) -> dict:
                 [[nm, float(m), float(m / mx), float(m / tot)]
                  for nm, m in mags])
 
+    # multinomial GLM coefficient tables: indexed-class headers plus the
+    # class-named twin (GLMModel output coefficients_table and
+    # coefficients_table_multinomials_with_class_names — PUBDEV-6062)
+    if model.algo in ("glm", "gam") and \
+            getattr(model, "coef_multinomial", None) is not None and \
+            out_src.get("coef_names") is not None and \
+            out_src.get("family") != "ordinal" and \
+            output.get("coefficients_table") is None:
+        B = np.asarray(model.coef_multinomial, np.float64)   # [P+1, K]
+        names_m = list(out_src["coef_names"]) + ["Intercept"]
+        K = B.shape[1]
+        mus = np.asarray(out_src.get("coef_means") or
+                         [0.0] * (len(names_m) - 1), np.float64)
+        sds = np.asarray(out_src.get("coef_sds") or
+                         [1.0] * (len(names_m) - 1), np.float64)
+        if out_src.get("standardized"):
+            from h2o3_tpu.models.glm import destandardize_coefs
+            std_B = B
+            raw_B = np.stack([destandardize_coefs(B[:, k], mus, sds)
+                              for k in range(K)], axis=1)
+        else:
+            raw_B = B
+            std_B = np.empty_like(B)
+            std_B[:-1] = raw_B[:-1] * sds[:, None]
+            std_B[-1] = raw_B[-1] + raw_B[:-1].T @ mus
+        rows = [[nm] + [float(v) for v in raw_B[i]]
+                + [float(v) for v in std_B[i]]
+                for i, nm in enumerate(names_m)]
+        rows = [rows[-1]] + rows[:-1]    # Intercept first
+        dom = list(out_src.get("domain") or [str(k) for k in range(K)])
+        types_m = ["string"] + ["float64"] * (2 * K)
+        output["coefficients_table"] = twodim(
+            "Coefficients",
+            ["names"] + [f"coefs_class_{k}" for k in range(K)]
+            + [f"std_coefs_class_{k}" for k in range(K)],
+            types_m, rows, "glm multinomial coefficients")
+        output["coefficients_table_multinomials_with_class_names"] = twodim(
+            "Coefficients",
+            ["names"] + [f"coefs_class_{d}" for d in dom]
+            + [f"std_coefs_class_{d}" for d in dom],
+            types_m, rows, "glm multinomial coefficients")
+
     # multinomial GLM varimp: mean |standardized coef| across classes
     if model.algo in ("glm", "gam") and \
             getattr(model, "coef_multinomial", None) is not None and \

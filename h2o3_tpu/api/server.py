@@ -132,7 +132,7 @@ def _col_json(fr: Frame, name: str, row_offset: int, rows: int,
                    "schema_type": "Vec"},
         "label": name, "type": wire_type,
         "missing_count": int(s.get("na_count", 0) or 0),
-        "zero_count": int(s.get("zeros", 0) or 0),
+        "zero_count": int(s.get("zero_count", 0) or 0),
         "positive_infinity_count": 0, "negative_infinity_count": 0,
         "mins": [None if (isinstance(v, float) and np.isnan(v)) else v
                  for v in mins],
@@ -566,7 +566,7 @@ def _frame_summary(params, body, fid=None):
         s = summ.get(c["label"], {})
         c.update({k: (None if v is None or (isinstance(v, float) and np.isnan(v)) else v)
                   for k, v in s.items() if k in
-                  ("min", "max", "mean", "sigma", "na_count", "zeros",
+                  ("min", "max", "mean", "sigma", "na_count", "zero_count",
                    "cardinality", "type")})
     return {"frames": [j]}
 

@@ -90,7 +90,7 @@ class Column:
     def to_numpy(self) -> np.ndarray:
         """Host copy of host_view() — callers may mutate their copy."""
         if self.type in (T_STR, T_UUID):
-            return self.strings[: self.nrows]
+            return self.strings[: self.nrows].copy()
         return self.host_view().copy()
 
 
