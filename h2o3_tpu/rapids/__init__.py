@@ -252,25 +252,122 @@ def _dev_eligible(*vals) -> bool:
     return len(shapes) == 1
 
 
-def _dev_view(c: Column):
+import functools as _functools
+
+
+def _kernel_view(d, m):
     """NaN-injected f32 view — same NA encoding as the host f64 path, so
     every ufunc reproduces host semantics (NaN propagation in arithmetic,
-    False comparisons on NA) on device."""
+    False comparisons on NA) on device. Trace-time helper: only ever
+    called inside the jitted kernels below."""
     import jax.numpy as jnp
-    return jnp.where(c.na_mask, jnp.nan, c.data.astype(jnp.float32))
+    return jnp.where(m, jnp.nan, d.astype(jnp.float32))
+
+
+def _kernel_seal(out, nrows):
+    """(data, mask) result pair: NA where NaN, plus the padding tail —
+    comparisons map NaN-injected padding back to 0.0 (NaN < x is False),
+    which would otherwise read as valid rows."""
+    import jax.numpy as jnp
+    out = jnp.asarray(out, jnp.float32)
+    pad = jnp.arange(out.shape[0], dtype=jnp.int32) >= nrows
+    return out, jnp.isnan(out) | pad
+
+
+@_functools.lru_cache(maxsize=None)
+def _binop_kernel(name: str, kind: str):
+    """ONE jitted program per (op, operand-kind): the whole
+    view→op→seal chain fuses, so each prim costs one compile per shape
+    instead of ~5 eager sub-op compiles (the 10M-row scale test was
+    compile-bound, not compute-bound)."""
+    import jax
+    op = _jnp_binops()[0][name]
+    if kind == "ff":
+        def k(da, ma, db, mb, nrows):
+            return _kernel_seal(op(_kernel_view(da, ma),
+                                   _kernel_view(db, mb)), nrows)
+    elif kind == "fs":
+        def k(da, ma, s, nrows):
+            return _kernel_seal(op(_kernel_view(da, ma), s), nrows)
+    else:
+        def k(s, db, mb, nrows):
+            return _kernel_seal(op(s, _kernel_view(db, mb)), nrows)
+    return jax.jit(k)
+
+
+@_functools.lru_cache(maxsize=None)
+def _unop_kernel(name: str):
+    import jax
+    op = _jnp_binops()[1][name]
+
+    def k(d, m, nrows):
+        return _kernel_seal(op(_kernel_view(d, m)), nrows)
+    return jax.jit(k)
+
+
+@_functools.lru_cache(maxsize=None)
+def _isna_kernel():
+    import jax
+    import jax.numpy as jnp
+
+    def k(m, nrows):
+        pad = jnp.arange(m.shape[0], dtype=jnp.int32) >= nrows
+        return m.astype(jnp.float32), pad
+    return jax.jit(k)
+
+
+@_functools.lru_cache(maxsize=None)
+def _ifelse_kernel(ykind: str, nkind: str):
+    """kinds: 'f' frame (data+mask args) or 's' numeric scalar."""
+    import jax
+    import jax.numpy as jnp
+
+    def k(td, tm, *rest):
+        i = 0
+        tv = _kernel_view(td, tm)
+        if ykind == "f":
+            yv = _kernel_view(rest[0], rest[1]); i = 2
+        else:
+            yv = rest[0]; i = 1
+        if nkind == "f":
+            nv = _kernel_view(rest[i], rest[i + 1]); i += 2
+        else:
+            nv = rest[i]; i += 1
+        nrows = rest[i]
+        o = jnp.where(jnp.nan_to_num(tv) != 0, yv, nv)
+        o = jnp.where(jnp.isnan(tv), jnp.nan, o)
+        return _kernel_seal(o, nrows)
+    return jax.jit(k)
+
+
+@_functools.lru_cache(maxsize=None)
+def _reduce_kernel(name: str):
+    import jax
+    import jax.numpy as jnp
+
+    def k(d, m, nrows):
+        logical = jnp.arange(d.shape[0], dtype=jnp.int32) < nrows
+        valid = logical & ~m
+        x = d.astype(jnp.float32)
+        # counts stay int32: an f32 ones-sum saturates at 2^24 rows,
+        # understating the mean denominator on 100M-row frames
+        n_na = jnp.sum((m & logical).astype(jnp.int32))
+        cnt = jnp.sum(valid.astype(jnp.int32))
+        if name in ("sum", "mean"):
+            part = jnp.sum(jnp.where(valid, x, 0.0))
+        elif name == "min":
+            part = jnp.min(jnp.where(valid, x, jnp.inf))
+        else:
+            part = jnp.max(jnp.where(valid, x, -jnp.inf))
+        return part, cnt, n_na
+    return jax.jit(k)
 
 
 def _dev_frame(nrows: int, outs: Dict[str, Any]) -> Frame:
-    """Frame from device result arrays. NA mask = NaN positions PLUS the
-    padding tail: comparisons map the NaN-injected padding back to 0.0
-    (NaN < x is False), which would otherwise read as valid rows."""
-    import jax.numpy as jnp
+    """Frame from (data, mask) device result pairs."""
     _dev_hit()
-    cols = []
-    for n, d in outs.items():
-        pad_na = jnp.arange(d.shape[0], dtype=jnp.int32) >= nrows
-        cols.append(Column(name=n, type=T_NUM, data=d,
-                           na_mask=jnp.isnan(d) | pad_na, nrows=nrows))
+    cols = [Column(name=n, type=T_NUM, data=d, na_mask=m, nrows=nrows)
+            for n, (d, m) in outs.items()]
     return Frame(cols, nrows)
 
 
@@ -319,39 +416,56 @@ _JNP_BINOPS = None
 _JNP_UNOPS = None
 
 
-def _dev_binop(op, l, r):
+def _dev_binop(name, l, r):
     """Device path for frame⊗frame / frame⊗scalar elementwise binops.
     Returns None when ineligible (caller falls back to host f64)."""
-    if op is None or not _dev_eligible(l, r):
+    if name not in _jnp_binops()[0] or not _dev_eligible(l, r):
         return None
+    outs = {}
     if isinstance(l, Frame) and isinstance(r, Frame):
+        k = _binop_kernel(name, "ff")
         if l.ncols == 1 and r.ncols > 1:
-            a = _dev_view(l.col(l.names[0]))
-            pairs = {n: (a, _dev_view(r.col(n))) for n in r.names}
+            cl = l.col(l.names[0])
+            for n in r.names:
+                cr = r.col(n)
+                outs[n] = k(cl.data, cl.na_mask, cr.data, cr.na_mask,
+                            l.nrows)
         elif r.ncols == 1 and l.ncols > 1:
-            b = _dev_view(r.col(r.names[0]))
-            pairs = {n: (_dev_view(l.col(n)), b) for n in l.names}
+            cr = r.col(r.names[0])
+            for n in l.names:
+                cl = l.col(n)
+                outs[n] = k(cl.data, cl.na_mask, cr.data, cr.na_mask,
+                            l.nrows)
         elif l.ncols == r.ncols:
-            pairs = {n: (_dev_view(l.col(n)), _dev_view(r.col(m)))
-                     for n, m in zip(l.names, r.names)}
+            for n, m in zip(l.names, r.names):
+                cl, cr = l.col(n), r.col(m)
+                outs[n] = k(cl.data, cl.na_mask, cr.data, cr.na_mask,
+                            l.nrows)
         else:
             return None
     elif isinstance(l, Frame):
-        pairs = {n: (_dev_view(l.col(n)), r) for n in l.names}
+        k = _binop_kernel(name, "fs")
+        for n in l.names:
+            cl = l.col(n)
+            outs[n] = k(cl.data, cl.na_mask, float(r), l.nrows)
     else:
-        pairs = {n: (l, _dev_view(r.col(n))) for n in r.names}
-    import jax.numpy as jnp
-    outs = {n: jnp.asarray(op(a, b), jnp.float32) for n, (a, b) in pairs.items()}
+        k = _binop_kernel(name, "sf")
+        for n in r.names:
+            cr = r.col(n)
+            outs[n] = k(float(l), cr.data, cr.na_mask, r.nrows)
     base = l if isinstance(l, Frame) else r
     return _dev_frame(base.nrows, outs)
 
 
-def _dev_unop(op, v: Frame):
-    if op is None or not _dev_eligible(v):
+def _dev_unop(name, v: Frame):
+    if name not in _jnp_binops()[1] or not isinstance(v, Frame) \
+            or not _dev_eligible(v):
         return None
-    import jax.numpy as jnp
-    outs = {n: jnp.asarray(op(_dev_view(v.col(n))), jnp.float32)
-            for n in v.names}
+    k = _unop_kernel(name)
+    outs = {}
+    for n in v.names:
+        c = v.col(n)
+        outs[n] = k(c.data, c.na_mask, v.nrows)
     return _dev_frame(v.nrows, outs)
 
 
@@ -402,7 +516,7 @@ def _binop(op, name: str = ""):
             return float((l == r) if name == "==" else (l != r))
         if not isinstance(l, Frame) and not isinstance(r, Frame):
             return float(op(l, r))
-        dv = _dev_binop(_jnp_binops()[0].get(name), l, r)
+        dv = _dev_binop(name, l, r)
         if dv is not None:
             return dv
         pairs = _broadcast2(l, r)
@@ -440,7 +554,7 @@ def _unop(op, name: str = ""):
         v = env.ev(x)
         if not isinstance(v, Frame):
             return float(op(v))
-        dv = _dev_unop(_jnp_binops()[1].get(name), v)
+        dv = _dev_unop(name, v)
         if dv is not None:
             return dv
         with np.errstate(all="ignore"):
@@ -479,16 +593,14 @@ def _is_na(env, x):
             return 1.0 if v is None else 0.0
     if _dev_eligible(v):
         # the NA answer is the mask itself — no values ever leave HBM
-        import jax.numpy as jnp
         _dev_hit()
+        k = _isna_kernel()
         cols = []
         for n in v.names:
             c = v.col(n)
-            pad_na = jnp.arange(c.data.shape[0],
-                                dtype=jnp.int32) >= v.nrows
+            d, m = k(c.na_mask, v.nrows)
             cols.append(Column(name=f"isNA({n})", type=T_NUM,
-                               data=c.na_mask.astype(jnp.float32),
-                               na_mask=pad_na, nrows=v.nrows))
+                               data=d, na_mask=m, nrows=v.nrows))
         return Frame(cols, v.nrows)
     out = {}
     for n in v.names:
@@ -540,25 +652,19 @@ def _dev_reduce(name: str, v: Frame, na_rm: bool):
     client oracles of the small pyunits never go."""
     if name not in ("sum", "min", "max", "mean") or not _dev_eligible(v):
         return None
-    import jax.numpy as jnp
     _dev_hit()
-    # per-column 0-d partials accumulate ON DEVICE; ONE batched scalar
-    # fetch ends the reduce (three float() syncs per column would pay
-    # ~100ms tunnel RTT each — the cost this path exists to avoid)
+    # per-column 0-d partials accumulate ON DEVICE (one jitted kernel
+    # per reduce); ONE batched scalar fetch ends the reduce (three
+    # float() syncs per column would pay ~100ms tunnel RTT each — the
+    # cost this path exists to avoid)
+    k = _reduce_kernel(name)
     parts, counts, n_nas = [], [], []
     for n in v.names:
         c = v.col(n)
-        logical = jnp.arange(c.data.shape[0], dtype=jnp.int32) < v.nrows
-        valid = logical & ~c.na_mask
-        x = c.data.astype(jnp.float32)
-        n_nas.append(jnp.sum(c.na_mask & logical))
-        counts.append(jnp.sum(valid))
-        if name in ("sum", "mean"):
-            parts.append(jnp.sum(jnp.where(valid, x, 0.0)))
-        elif name == "min":
-            parts.append(jnp.min(jnp.where(valid, x, jnp.inf)))
-        else:
-            parts.append(jnp.max(jnp.where(valid, x, -jnp.inf)))
+        part, cnt, n_na = k(c.data, c.na_mask, v.nrows)
+        parts.append(part)
+        counts.append(cnt)
+        n_nas.append(n_na)
     parts, counts, n_nas = _fetch_np((parts, counts, n_nas))
     if not na_rm and np.sum(n_nas) > 0:
         return float("nan")
@@ -641,7 +747,7 @@ def _cumop(op, axis1_op, name: str = ""):
         if ax == 0:
             # padding rows sit AFTER the logical rows, so a prefix scan
             # over the padded array is exact on the logical prefix
-            dv = _dev_unop(_jnp_binops()[1].get(name), v)
+            dv = _dev_unop(name, v)
             if dv is not None:
                 return dv
             return _rebuild(v, {n: op(_col_np(v, n)) for n in v.names},
@@ -1007,13 +1113,23 @@ def _ifelse(env, test, yes, no):
     if isinstance(t, Frame) and _dev_eligible(t, y, n) \
             and not isinstance(y, str) and not isinstance(n, str):
         # string yes/no branches intern as categoricals — host path only
-        import jax.numpy as jnp
-        tv_d = _dev_view(t.col(t.names[0]))
-        yv_d = _dev_view(y.col(y.names[0])) if isinstance(y, Frame) else y
-        nv_d = _dev_view(n.col(n.names[0])) if isinstance(n, Frame) else n
-        o = jnp.where(jnp.nan_to_num(tv_d) != 0, yv_d, nv_d)
-        o = jnp.where(jnp.isnan(tv_d), jnp.nan, o).astype(jnp.float32)
-        return _dev_frame(t.nrows, {"C1": o})
+        tc = t.col(t.names[0])
+        args = [tc.data, tc.na_mask]
+        ykind = "f" if isinstance(y, Frame) else "s"
+        nkind = "f" if isinstance(n, Frame) else "s"
+        if ykind == "f":
+            yc = y.col(y.names[0])
+            args += [yc.data, yc.na_mask]
+        else:
+            args.append(float(y))
+        if nkind == "f":
+            nc = n.col(n.names[0])
+            args += [nc.data, nc.na_mask]
+        else:
+            args.append(float(n))
+        args.append(t.nrows)
+        out = _ifelse_kernel(ykind, nkind)(*args)
+        return _dev_frame(t.nrows, {"C1": out})
     tv = _col_np(t, t.names[0]) if isinstance(t, Frame) else t
     if not isinstance(tv, np.ndarray):
         return y if tv else n
