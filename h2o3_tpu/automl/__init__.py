@@ -211,7 +211,14 @@ class H2OAutoML:
         import os as _os
         par = int(_os.environ.get("H2O3TPU_AUTOML_PARALLEL", "0") or 0)
         if par <= 0:
-            par = 3
+            # ONE chip: sequential by default. Parallel workers each pay
+            # their own first-shape compile (~2-3 min through the tunnel
+            # compile service) and contend for it — measured: 3 parallel
+            # candidates ALL hit a 240s per-model cap that each clears
+            # in ~15s warm sequential (0/20 models vs 3+/20). The async
+            # dispatch queue already overlaps host prep with device
+            # execution inside one thread; on a pod, raise via env.
+            par = 1
         from concurrent.futures import ThreadPoolExecutor, as_completed
         groups = sorted({s.group for s in plan if s.kind != "ensemble"})
         for g in groups:

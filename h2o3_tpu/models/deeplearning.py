@@ -62,7 +62,7 @@ def _init_params(key, sizes: List[int], maxout: bool):
 
 
 def _forward(params, X, act: str, *, key=None, input_dropout=0.0,
-             hidden_dropout=None, train=False):
+             hidden_dropout=None, train=False, bf16=False):
     """fprop (Neurons.java fprop); returns final-layer linear output."""
     h = X
     if train and input_dropout > 0:
@@ -70,8 +70,20 @@ def _forward(params, X, act: str, *, key=None, input_dropout=0.0,
         keep = jax.random.bernoulli(sub, 1 - input_dropout, h.shape)
         h = h * keep / (1 - input_dropout)
     L = len(params)
+    # bf16 (explicit flag, set only by the fused TRAINING step at
+    # batch >= 16K): matmuls run at the v5e MXU's native bf16 rate with
+    # f32 accumulation (f32 dots pay the bf16x3 triple pass). Scoring,
+    # small fits, and the early-stopping loss evals stay f32 — metric
+    # oracles and stopping_tolerance (1e-5 default) are asserted on the
+    # f32 path.
     for i, layer in enumerate(params):
-        z = h @ layer["W"] + layer["b"]
+        if bf16:
+            z = jax.lax.dot(h.astype(jnp.bfloat16),
+                            layer["W"].astype(jnp.bfloat16),
+                            preferred_element_type=jnp.float32) \
+                + layer["b"]
+        else:
+            z = h @ layer["W"] + layer["b"]
         if i == L - 1:
             return z
         if act == "maxout":
@@ -97,9 +109,9 @@ def _forward_scoring(params, X, act: str):
 
 
 def _loss(params, X, y, w, key, *, act, category, input_dropout,
-          hidden_dropout, l1, l2, nclasses):
+          hidden_dropout, l1, l2, nclasses, bf16=False):
     out = _forward(params, X, act, key=key, input_dropout=input_dropout,
-                   hidden_dropout=hidden_dropout, train=True)
+                   hidden_dropout=hidden_dropout, train=True, bf16=bf16)
     if category == "softmax":
         logp = jax.nn.log_softmax(out, axis=1)
         nll = -jnp.take_along_axis(logp, y[:, None].astype(jnp.int32),
@@ -116,7 +128,8 @@ def _loss(params, X, y, w, key, *, act, category, input_dropout,
 
 def _train_step_impl(params, opt_state, lr, X, y, w, key, *, act, category,
                      input_dropout, hidden_dropout, l1, l2, nclasses,
-                     adaptive, rho, epsilon, nesterov, mu_now=None):
+                     adaptive, rho, epsilon, nesterov, mu_now=None,
+                     bf16=False):
     """One minibatch step. XLA's gradient psum over the sharded batch is
     the cross-replica model averaging (DeepLearningTask.java:164-176).
     ``mu_now`` overrides the momentum carried in opt_state (the fused
@@ -124,7 +137,7 @@ def _train_step_impl(params, opt_state, lr, X, y, w, key, *, act, category,
     grads = jax.grad(_loss)(params, X, y, w, key, act=act, category=category,
                             input_dropout=input_dropout,
                             hidden_dropout=hidden_dropout, l1=l1, l2=l2,
-                            nclasses=nclasses)
+                            nclasses=nclasses, bf16=bf16)
     def upd(p, g, s):
         # ADADELTA (reference adaptive_rate=True, rho/epsilon params)
         eg2 = rho * s["eg2"] + (1 - rho) * g * g
@@ -153,7 +166,7 @@ def _train_step_impl(params, opt_state, lr, X, y, w, key, *, act, category,
 
 _STEP_STATICS = ("act", "category", "input_dropout", "hidden_dropout",
                  "l1", "l2", "nclasses", "adaptive", "rho", "epsilon",
-                 "nesterov")
+                 "nesterov", "bf16")
 
 # jitted full-dataset loss for the early-stopping boundary — the eager
 # _loss layer loop would re-dispatch per op through the chip tunnel
@@ -165,7 +178,8 @@ _loss_eval = partial(jax.jit, static_argnames=(
 @partial(jax.jit, static_argnames=_STEP_STATICS + (
     "nsteps", "batch", "n", "rate", "rate_annealing",
     "momentum_start", "momentum_stable", "momentum_ramp"))
-def _train_steps_fused(params, opt_state, X, y, w, key, step0, limit, *,
+def _train_steps_fused(params, opt_state, X, y, w, key, step0, start0,
+                       limit, *,
                        nsteps, batch, n, rate, rate_annealing,
                        momentum_start, momentum_stable, momentum_ramp,
                        **step_kwargs):
@@ -187,16 +201,26 @@ def _train_steps_fused(params, opt_state, X, y, w, key, step0, limit, *,
 
     def body(carry, i):
         params, opt_state, key = carry
-        key, kidx, kstep = jax.random.split(key, 3)
-        idx = jax.random.randint(kidx, (batch,), 0, n)
-        # the gathered batch must stay row-sharded: without the
-        # constraint GSPMD may replicate the full sharded dataset to
-        # serve the random gather, and the gradient psum over the
-        # 'data' axis would average a replicated batch
-        Xb = jax.lax.with_sharding_constraint(X[idx], row_sharding())
-        yb = jax.lax.with_sharding_constraint(y[idx], row_sharding())
-        wb = jax.lax.with_sharding_constraint(w[idx], row_sharding())
+        key, kstep = jax.random.split(key)
         step = step0 + i
+        # CONTIGUOUS cyclic slice, not a random gather: random row
+        # gathers from a GB-scale HBM array run at ~3GB/s on v5e (the
+        # measured 1M-samples/s ceiling); sequential slices stream at
+        # full bandwidth. Matches the reference's default pass order
+        # (shuffle_training_data=false, DeepLearningTask row walk).
+        # start0 is host-computed (exact int; step0*batch would overflow
+        # int32 on long fits); modulo n, with dynamic_slice clamping the
+        # epoch-boundary start so tail rows still train.
+        start = (start0 + i.astype(jnp.int32) * batch) % max(n, 1)
+        Xb = jax.lax.dynamic_slice_in_dim(X, start, batch, axis=0)
+        yb = jax.lax.dynamic_slice_in_dim(y, start, batch, axis=0)
+        wb = jax.lax.dynamic_slice_in_dim(w, start, batch, axis=0)
+        # the sliced batch must stay row-sharded: without the constraint
+        # GSPMD may replicate it and the gradient psum over the 'data'
+        # axis would average a replicated batch
+        Xb = jax.lax.with_sharding_constraint(Xb, row_sharding())
+        yb = jax.lax.with_sharding_constraint(yb, row_sharding())
+        wb = jax.lax.with_sharding_constraint(wb, row_sharding())
         lr = jnp.float32(rate) / (1.0 + rate_annealing * step * batch)
         ramp = jnp.minimum(1.0, step * batch / max(momentum_ramp, 1.0))
         mu_now = jnp.float32(momentum_start
@@ -287,9 +311,15 @@ class DeepLearningModel(Model):
         assert self.params.get("autoencoder")
         return Frame.from_numpy(self._score_raw(frame))
 
-    def model_performance(self, frame: Frame):
+    def model_performance(self, frame: Frame, mask_weights=None):
+        """``mask_weights``: optional row mask multiplied into the
+        weights — the score_training_samples subsample path (the
+        reference scores training metrics on a 10K sample by default,
+        DeepLearningModel._score_training_samples=10000)."""
         y = self.output["response"]
         w = frame.valid_weights()
+        if mask_weights is not None:
+            w = w * jnp.asarray(np.asarray(mask_weights, np.float32))
         cat = self.output["category"]
         if self.params.get("autoencoder"):
             di = self._design(frame)
@@ -337,6 +367,7 @@ class DeepLearningEstimator(ModelBuilder):
         fold_column=None, fold_assignment="auto", ignored_columns=None,
         stopping_rounds=5, stopping_metric="auto", stopping_tolerance=0.0,
         score_interval=5.0, train_samples_per_iteration=-2,
+        score_training_samples=10000, score_validation_samples=0,
         use_all_factor_levels=False, max_w2=3.4e38, reproducible=False,
         checkpoint=None,
     )
@@ -438,11 +469,14 @@ class DeepLearningEstimator(ModelBuilder):
 
         batch = int(p["mini_batch_size"])
         if batch <= 1:
-            # TPU minibatch default: scale with data up to 4096 — the
+            # TPU minibatch default: scale with data up to 16K — the
             # fused step is overhead-bound below that (measured
-            # 0.08ms/step at 1024 vs 0.36ms at 8192 on v5e), and
-            # ADADELTA's per-parameter rates keep convergence stable
-            batch = min(4096, max(256, n // 64))
+            # 0.08ms/step at 1024 vs 0.36ms at 8192 on v5e; per-step
+            # dispatch ~6ms dominates at 4096 on 1M-row fits), and
+            # ADADELTA's per-parameter rates keep convergence stable.
+            # Power-of-two so the MXU tiles cleanly.
+            batch = min(16384, max(256, n // 64))
+            batch = 1 << (batch.bit_length() - 1)
         ndata = mesh.shape["data"]
         batch = ((batch + ndata - 1) // ndata) * ndata
         epochs = float(p["epochs"])
@@ -451,7 +485,8 @@ class DeepLearningEstimator(ModelBuilder):
                                float(p["stopping_tolerance"]) or 1e-5)
 
         Xh = di.X   # already device, row-sharded
-        step_kwargs = dict(act=act, category=cat_mode, input_dropout=in_drop,
+        step_kwargs = dict(bf16=batch >= 16384,
+                           act=act, category=cat_mode, input_dropout=in_drop,
                            hidden_dropout=hd, l1=float(p["l1"]),
                            l2=float(p["l2"]), nclasses=out_dim,
                            adaptive=adaptive, rho=float(p["rho"]),
@@ -484,7 +519,8 @@ class DeepLearningEstimator(ModelBuilder):
             k = min(chunk, total_steps - done)
             params_net, opt_state, key = _train_steps_fused(
                 params_net, opt_state, Xh, y_dev, w, key,
-                jnp.float32(done), jnp.float32(k), **sched, **step_kwargs)
+                jnp.float32(done), jnp.int32((done * batch) % max(n, 1)),
+                jnp.float32(k), **sched, **step_kwargs)
             done += k
             job.update(k / total_steps, f"step {done}/{total_steps}")
             if stopper.enabled and (done >= next_score
@@ -532,10 +568,33 @@ class DeepLearningEstimator(ModelBuilder):
                 bkeys.append(bf.key)
             model.output["weights_keys"] = wkeys
             model.output["biases_keys"] = bkeys
-        model.training_metrics = model.model_performance(frame)
+        nscore = int(p.get("score_training_samples") or 0)
+        score_mask = None
+        if nscore and frame.nrows > nscore:
+            # reference default: training metrics on a 10K sample
+            rs = np.random.RandomState(
+                (int(p["seed"]) if int(p["seed"]) >= 0 else 0xD1) & 0xFFFF)
+            mw = np.zeros(frame.nrows_padded, np.float32)
+            # randint draw, not choice(replace=False): the latter
+            # materializes an O(n) permutation on the controller
+            idx = np.unique(rs.randint(0, frame.nrows, 2 * nscore))[:nscore]
+            mw[idx] = 1.0
+            score_mask = mw
+        model.training_metrics = model.model_performance(
+            frame, mask_weights=score_mask)
         if category == ModelCategory.BINOMIAL:
             model.output["default_threshold"] = \
                 model.training_metrics["max_f1_threshold"]
         if validation_frame is not None:
-            model.validation_metrics = model.model_performance(validation_frame)
+            nv = int(p.get("score_validation_samples") or 0)
+            vmask = None
+            if nv and validation_frame.nrows > nv:
+                rs = np.random.RandomState(0xD2)
+                vm = np.zeros(validation_frame.nrows_padded, np.float32)
+                vidx = np.unique(rs.randint(0, validation_frame.nrows,
+                                            2 * nv))[:nv]
+                vm[vidx] = 1.0
+                vmask = vm
+            model.validation_metrics = model.model_performance(
+                validation_frame, mask_weights=vmask)
         return model
