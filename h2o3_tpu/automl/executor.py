@@ -71,12 +71,18 @@ class Budget:
 
     def model_cap(self) -> Optional[float]:
         """Per-model wallclock cap: the explicit cap, bounded by what is
-        left of the global budget."""
+        left of the global budget AND time-sliced so one expensive
+        candidate cannot eat the whole plan (AutoML.java planWork time
+        allocation role — one 361s XGBoost left 15 steps untrained)."""
         caps = []
         if self.per_model_secs:
             caps.append(self.per_model_secs)
         rem = self.remaining_secs()
         if rem is not None:
+            with self._lock:
+                left = max(1, self.max_models - self.trained
+                           - self.inflight + 1)
+            caps.append(max(60.0, rem / min(left, 8)))
             caps.append(rem)
         return min(caps) if caps else None
 
@@ -89,10 +95,15 @@ def train_capped(builder, frame, y, x, budget: Budget):
     job.update checkpoint — every training loop calls update at least
     once per scan chunk / IRLS lambda / DL epoch)."""
     cap = budget.model_cap()
+    if cap and "max_runtime_secs" in getattr(builder, "DEFAULTS", {}):
+        # builders that honor max_runtime_secs stop GRACEFULLY at a
+        # chunk boundary and return the partial model (the reference
+        # semantic) — the watchdog below becomes a backstop only
+        builder.params["max_runtime_secs"] = cap
     job = builder.train(frame, y=y, x=x, background=True)
     timer = None
     if cap:
-        timer = threading.Timer(cap, job.cancel)
+        timer = threading.Timer(cap * 1.5 + 30.0, job.cancel)
         timer.daemon = True
         timer.start()
     job.join()

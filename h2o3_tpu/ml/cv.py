@@ -135,6 +135,12 @@ def train_with_cv(builder, frame: Frame, x: Sequence[str], y: str,
         folds = fold_assignment(frame.nrows, nfolds, scheme, seed, yv)
 
     sub_params = {**p, "nfolds": 0, "fold_column": None}
+    cap_total = float(p.get("max_runtime_secs") or 0.0)
+    if cap_total > 0:
+        # the cap covers the WHOLE train incl. CV: each of the
+        # nfolds+1 fits gets its share (ModelBuilder
+        # cv_computeAndSetOptimalParameters time allocation)
+        sub_params["max_runtime_secs"] = cap_total / (nfolds + 1.0)
     job._work = nfolds + 1.0  # nfolds CV fits + the final model
 
     if y is None:

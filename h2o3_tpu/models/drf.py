@@ -20,6 +20,8 @@ throughout, and one model costs one dispatch.
 
 from __future__ import annotations
 
+import time
+
 from functools import partial
 from typing import Dict, List, Optional, Sequence
 
@@ -237,6 +239,7 @@ class DRFEstimator(ModelBuilder):
     algo = "drf"
 
     DEFAULTS = dict(
+        max_runtime_secs=0.0,
         ntrees=50, max_depth=20, min_rows=1.0, nbins=20, nbins_cats=1024,
         mtries=-1, sample_rate=0.632, col_sample_rate_per_tree=1.0,
         min_split_improvement=1e-5, seed=-1, nfolds=0,
@@ -359,11 +362,49 @@ class DRFEstimator(ModelBuilder):
         output = {"category": category, "response": y, "names": list(x),
                   "nclasses": rc.cardinality if rc.is_categorical else 1,
                   "domain": rc.domain}
-        forest, oob_sum, oob_cnt, gains_dev = _bag_scan(
-            bm.bins, bm.nbins, ys, w, key, jnp.int32(depth), tp=tp,
-            sample_rate=float(p["sample_rate"]), mtries=mtries,
-            n_class=K, ntrees=ntrees)
-        job.update(1.0, f"{ntrees} trees")
+        # max_runtime_secs (Model.Parameters): graceful stop at a
+        # 25-tree chunk boundary, keeping the forest built so far —
+        # without a cap the forest trains as ONE fused scan (the LOO-CV
+        # fast path needs exactly one dispatch per fold model)
+        _cap = float(p.get("max_runtime_secs") or 0.0)
+        if _cap > 0:
+            _deadline = time.time() + _cap
+            # chunk shrinks with per-tree cost so the deadline can bind
+            # (see GBM: a 25-tree chunk at depth bucket >=10 outruns an
+            # AutoML slice before the first boundary check)
+            _cost = (2.0 ** tp.max_depth / 64.0) * (bm.nbins_total / 65.0)
+            _chunk = max(1, min(25, int(round(25.0 / max(_cost, 1.0)))))
+            chunks, osum_acc, ocnt_acc, gains_acc = [], None, None, None
+            done = 0
+            while done < ntrees:
+                kk = min(_chunk, ntrees - done)
+                key, sub = jax.random.split(key)
+                tr_c, osum, ocnt, g_c = _bag_scan(
+                    bm.bins, bm.nbins, ys, w, sub, jnp.int32(depth),
+                    tp=tp, sample_rate=float(p["sample_rate"]),
+                    mtries=mtries, n_class=K, ntrees=kk)
+                chunks.append(tr_c)
+                osum_acc = osum if osum_acc is None else osum_acc + osum
+                ocnt_acc = ocnt if ocnt_acc is None else ocnt_acc + ocnt
+                gains_acc = g_c if gains_acc is None else gains_acc + g_c
+                done += kk
+                job.update(kk / ntrees, f"tree {done}/{ntrees}")
+                if time.time() > _deadline and done < ntrees:
+                    log.info("max_runtime_secs: DRF stopping at %d/%d "
+                             "trees", done, ntrees)
+                    break
+            forest = (chunks[0] if len(chunks) == 1 else
+                      Tree(*(jnp.concatenate([getattr(c, f)
+                                              for c in chunks])
+                             for f in Tree._fields)))
+            oob_sum, oob_cnt, gains_dev = osum_acc, ocnt_acc, gains_acc
+            ntrees = done
+        else:
+            forest, oob_sum, oob_cnt, gains_dev = _bag_scan(
+                bm.bins, bm.nbins, ys, w, key, jnp.int32(depth), tp=tp,
+                sample_rate=float(p["sample_rate"]), mtries=mtries,
+                n_class=K, ntrees=ntrees)
+            job.update(1.0, f"{ntrees} trees")
         model = DRFModel(p, output, forest, bm, ntrees)
         if getattr(self, "_cv_light", False):
             # near-LOO CV fold fit (ml/cv.py): skip OOB metrics / varimp

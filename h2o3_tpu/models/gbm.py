@@ -17,6 +17,8 @@ the reference's per-class tree loop (GBM.java buildNextKTrees "ktrees").
 
 from __future__ import annotations
 
+import time
+
 from functools import partial
 from typing import Dict, List, Optional, Sequence
 
@@ -37,6 +39,9 @@ from h2o3_tpu.models.tree import (Tree, TreeParams, TreeScalars,
                                   predict_forest, predict_tree, stack_trees)
 from h2o3_tpu.parallel.mesh import (get_mesh, put_sharded,
                                     row_sharding)
+from h2o3_tpu.utils.log import get_logger
+
+log = get_logger("h2o3_tpu.gbm")
 
 
 def _sample_columns(k1, k2, F: int, rate):
@@ -514,6 +519,7 @@ class GBMEstimator(ModelBuilder):
     cv_fold_masking = True   # ml/cv.py fast path: folds = masked weights
 
     DEFAULTS = dict(
+        max_runtime_secs=0.0,
         ntrees=50, max_depth=5, min_rows=10.0, learn_rate=0.1,
         sample_rate=1.0, col_sample_rate_per_tree=1.0,
         nbins=64, nbins_cats=1024, distribution="auto",
@@ -688,6 +694,23 @@ class GBMEstimator(ModelBuilder):
         seed = int(p["seed"]) if int(p["seed"]) >= 0 else 0xDEC0DE
         key = jax.random.PRNGKey(seed)
         ntrees = int(p["ntrees"])
+        # max_runtime_secs (Model.Parameters._max_runtime_secs): a
+        # GRACEFUL stop at the next chunk boundary keeping the trees
+        # built so far — the reference returns the partial model, it
+        # does not discard it
+        _cap = float(p.get("max_runtime_secs") or 0.0)
+        _deadline = (time.time() + _cap) if _cap > 0 else None
+        # deadline granularity: the stop can only fire at a chunk
+        # boundary, so capped fits shrink the chunk as per-tree cost
+        # grows (complete-tree layout: ~2^depth * nbins per tree) —
+        # a 25-deep-tree chunk at depth bucket 10 runs ~20-80s, far
+        # past a ~30s AutoML slice. Uncapped fits keep 25 (no extra
+        # program shapes on the pyunit paths).
+        if _deadline is not None:
+            _cost = (2.0 ** tp.max_depth / 64.0) * (bm.nbins_total / 65.0)
+            _chunk = max(1, min(25, int(round(25.0 / max(_cost, 1.0)))))
+        else:
+            _chunk = 25
         prior_T = 0
         if ckpt is not None:
             K_ck = (ckpt.output.get("nclasses", 1)
@@ -778,7 +801,7 @@ class GBMEstimator(ModelBuilder):
             chunks_m: List[Tree] = []
             done = 0
             while done < ntrees:
-                kk = min(25, ntrees - done)
+                kk = min(_chunk, ntrees - done)
                 key, sub = jax.random.split(key)
                 tr_k, margins, vm_, gains, devs = _boost_scan_multi(
                     bm.bins, bm.nbins, y_dev, w, margins, sub,
@@ -798,6 +821,10 @@ class GBMEstimator(ModelBuilder):
                 done += keep
                 job.update(kk / ntrees, f"tree {done}/{ntrees}")
                 if keep < kk:
+                    break
+                if _deadline and time.time() > _deadline:
+                    log.info("max_runtime_secs: GBM stopping at %d/%d "
+                             "trees", done, ntrees)
                     break
             forest = (chunks_m[0] if len(chunks_m) == 1 else
                       Tree(*(jnp.concatenate([getattr(c, f)
@@ -876,11 +903,10 @@ class GBMEstimator(ModelBuilder):
                 # per-tree host round trip (dominant on a remote chip)
                 # amortizes over CHUNK trees, while the inter-chunk
                 # job.update keeps progress reporting + cancellation live
-                CHUNK = 25
                 chunks = []
                 done = 0
                 while done < ntrees:
-                    k = min(CHUNK, ntrees - done)
+                    k = min(_chunk, ntrees - done)
                     key, sub = jax.random.split(key)
                     tr_k, margin, gains = _boost_scan(
                         bm.bins, bm.nbins, y_dev, w, margin, sub,
@@ -892,6 +918,10 @@ class GBMEstimator(ModelBuilder):
                         gains_total += np.asarray(gains)
                     done += k
                     job.update(k / ntrees, f"tree {done}/{ntrees}")
+                    if _deadline and time.time() > _deadline:
+                        log.info("max_runtime_secs: GBM stopping at "
+                                 "%d/%d trees", done, ntrees)
+                        break
                 forest = (chunks[0] if len(chunks) == 1 else
                           Tree(*(jnp.concatenate([getattr(c, f)
                                                   for c in chunks])
@@ -912,7 +942,7 @@ class GBMEstimator(ModelBuilder):
                 chunks = []
                 done = 0
                 while done < ntrees:
-                    k = min(25, ntrees - done)
+                    k = min(_chunk, ntrees - done)
                     key, sub = jax.random.split(key)
                     tr_k, margin, vm_, gains, devs = _boost_scan_scored(
                         bm.bins, bm.nbins, y_dev, w, margin, sub,
@@ -928,6 +958,10 @@ class GBMEstimator(ModelBuilder):
                     done += keep
                     job.update(k / ntrees, f"tree {done}/{ntrees}")
                     if keep < k:
+                        break
+                    if _deadline and time.time() > _deadline:
+                        log.info("max_runtime_secs: GBM stopping at "
+                                 "%d/%d trees", done, ntrees)
                         break
                 forest = (chunks[0] if len(chunks) == 1 else
                           Tree(*(jnp.concatenate([getattr(c, f)

@@ -34,6 +34,7 @@ from h2o3_tpu.utils.log import get_logger
 log = get_logger("h2o3_tpu.xgboost")
 
 _DIRECT = {"ntrees", "max_depth", "seed", "nfolds", "weights_column",
+           "max_runtime_secs",
            "fold_column", "fold_assignment", "ignored_columns",
            "stopping_rounds", "stopping_metric", "stopping_tolerance",
            "distribution", "min_rows", "learn_rate", "sample_rate",

@@ -54,20 +54,18 @@ def test_automl_step_plan_breadth():
 
 
 def test_automl_per_model_cap_enforced(classif_frame):
-    """max_runtime_secs_per_model must actually cancel slow models
+    """max_runtime_secs_per_model must actually bound slow models
     (VERDICT r1 weak #5: silently-ignored params are worse than
-    rejections)."""
-    import time as _t
+    rejections). Builders that honor max_runtime_secs stop GRACEFULLY
+    at a chunk boundary and return the partial model — the reference
+    Model.Parameters._max_runtime_secs semantic — so the cap manifests
+    as a truncated forest, not a cancelled job."""
     from h2o3_tpu.automl.executor import Budget, train_capped
     from h2o3_tpu.models.gbm import GBMEstimator
     budget = Budget(max_models=10, max_runtime_secs=0,
                     per_model_secs=0.02)       # impossibly small cap
-    t0 = _t.time()
-    try:
-        train_capped(GBMEstimator(ntrees=400, max_depth=6, seed=1),
+    m = train_capped(GBMEstimator(ntrees=400, max_depth=6, seed=1),
                      classif_frame, "y", None, budget)
-        raised = False
-    except TimeoutError:
-        raised = True
-    assert raised, "per-model cap did not cancel the job"
-    assert budget.trained == 0
+    n_trees = int(m.forest.feat.shape[0])
+    assert n_trees < 400, \
+        f"cap ignored: trained the full {n_trees}-tree forest"
