@@ -454,9 +454,16 @@ def main():
         err = _run_once(name, fn)
         if err is not None and any(s in repr(err) for s in _INFRA_SIGNS) \
                 and _remaining() > _MIN_NEED.get(name, 60):
-            print(f"# retrying {name} after infra error: {err!r}"[:300],
-                  file=sys.stderr)
-            err = _run_once(name, fn)
+            if "UNAVAILABLE" in repr(err):
+                # "TPU worker process crashed": the tunnel worker needs
+                # time to restart — an immediate retry hits the corpse
+                print("# waiting 60s for TPU worker recovery",
+                      file=sys.stderr)
+                time.sleep(60)
+            if _remaining() > _MIN_NEED.get(name, 60):
+                print(f"# retrying {name} after infra error: "
+                      f"{err!r}"[:300], file=sys.stderr)
+                err = _run_once(name, fn)
         if err is not None:
             import traceback
             traceback.print_exception(type(err), err, err.__traceback__,

@@ -95,15 +95,19 @@ def train_capped(builder, frame, y, x, budget: Budget):
     job.update checkpoint — every training loop calls update at least
     once per scan chunk / IRLS lambda / DL epoch)."""
     cap = budget.model_cap()
-    if cap and "max_runtime_secs" in getattr(builder, "DEFAULTS", {}):
+    graceful = bool(cap) and "max_runtime_secs" in builder.accepted_params()
+    if graceful:
         # builders that honor max_runtime_secs stop GRACEFULLY at a
         # chunk boundary and return the partial model (the reference
         # semantic) — the watchdog below becomes a backstop only
-        builder.params["max_runtime_secs"] = cap
+        builder.set_max_runtime(cap)
     job = builder.train(frame, y=y, x=x, background=True)
     timer = None
     if cap:
-        timer = threading.Timer(cap * 1.5 + 30.0, job.cancel)
+        # graceful builders get slack to reach their chunk boundary;
+        # others are cancelled AT the cap like before
+        timer = threading.Timer(cap * 1.5 + 30.0 if graceful else cap,
+                                job.cancel)
         timer.daemon = True
         timer.start()
     job.join()

@@ -137,10 +137,13 @@ def train_with_cv(builder, frame: Frame, x: Sequence[str], y: str,
     sub_params = {**p, "nfolds": 0, "fold_column": None}
     cap_total = float(p.get("max_runtime_secs") or 0.0)
     if cap_total > 0:
-        # the cap covers the WHOLE train incl. CV: each of the
-        # nfolds+1 fits gets its share (ModelBuilder
-        # cv_computeAndSetOptimalParameters time allocation)
-        sub_params["max_runtime_secs"] = cap_total / (nfolds + 1.0)
+        # the cap covers the WHOLE train incl. CV (ModelBuilder
+        # cv_computeAndSetOptimalParameters role): the MAIN model keeps
+        # half the budget, folds share the other half — an even
+        # (nfolds+1)-way split strangled the main model whenever the
+        # masked-weight fold fits were cheap
+        sub_params["max_runtime_secs"] = \
+            cap_total / 2.0 / max(nfolds, 1)
     job._work = nfolds + 1.0  # nfolds CV fits + the final model
 
     if y is None:
@@ -211,11 +214,14 @@ def train_with_cv(builder, frame: Frame, x: Sequence[str], y: str,
         fast = False
     final = None
     shared_bm = None
+    main_params = dict(sub_params)
+    if cap_total > 0:
+        main_params["max_runtime_secs"] = cap_total / 2.0
     if fast:
         # main model FIRST: folds reuse its full-data binning (GLM has
         # no binned matrix — folds share the design implicitly, since
         # the masked rows ride the same parent frame)
-        final = builder.__class__(**sub_params)._fit(
+        final = builder.__class__(**main_params)._fit(
             frame, list(x), y, job, validation_frame=validation_frame)
         shared_bm = getattr(final, "bm", None)
 
@@ -349,7 +355,7 @@ def train_with_cv(builder, frame: Frame, x: Sequence[str], y: str,
     # final model on all data (ModelBuilder.java "main model") — the
     # fast path trained it up front to share its binning with the folds
     if final is None:
-        fb = builder.__class__(**sub_params)
+        fb = builder.__class__(**main_params)
         if path_devs:
             # GLM lambda search under CV selects the lambda minimizing
             # the SUMMED holdout deviance over the folds' SHARED path

@@ -68,7 +68,7 @@ def _bag_scan(bins, nb, ys, w, key, depth_limit, *, tp: TreeParams,
         k, s = jax.random.split(carry)
         return k, s
 
-    _, subs = jax.lax.scan(gen, key, None, length=ntrees)
+    key_out, subs = jax.lax.scan(gen, key, None, length=ntrees)
 
     def step(carry, sub):
         osum, ocnt = carry
@@ -81,7 +81,10 @@ def _bag_scan(bins, nb, ys, w, key, depth_limit, *, tp: TreeParams,
         step, (oob_sum, oob_cnt), subs)
     # [T, K, ...] per-scan-step stacked class trees → flat [T*K, ...]
     forest = Tree(*(a.reshape((-1,) + a.shape[2:]) for a in trees))
-    return forest, oob_sum, oob_cnt, jnp.sum(gains, axis=0)
+    # key_out: the evolved key chain — the chunked capped path threads
+    # it so chunked and single-scan forests are bit-identical for the
+    # same seed (a NON-binding max_runtime_secs must not change results)
+    return forest, oob_sum, oob_cnt, jnp.sum(gains, axis=0), key_out
 
 
 def _bag_body(bins, nb, ys, w, oob_sum, oob_cnt, key, depth_limit, *,
@@ -372,15 +375,15 @@ class DRFEstimator(ModelBuilder):
             # chunk shrinks with per-tree cost so the deadline can bind
             # (see GBM: a 25-tree chunk at depth bucket >=10 outruns an
             # AutoML slice before the first boundary check)
-            _cost = (2.0 ** tp.max_depth / 64.0) * (bm.nbins_total / 65.0)
+            _cost = (2.0 ** tp.max_depth / 64.0) * (bm.nbins_total / 65.0) \
+                * max(1.0, bm.bins.shape[0] / 5_242_880.0)
             _chunk = max(1, min(25, int(round(25.0 / max(_cost, 1.0)))))
             chunks, osum_acc, ocnt_acc, gains_acc = [], None, None, None
             done = 0
             while done < ntrees:
                 kk = min(_chunk, ntrees - done)
-                key, sub = jax.random.split(key)
-                tr_c, osum, ocnt, g_c = _bag_scan(
-                    bm.bins, bm.nbins, ys, w, sub, jnp.int32(depth),
+                tr_c, osum, ocnt, g_c, key = _bag_scan(
+                    bm.bins, bm.nbins, ys, w, key, jnp.int32(depth),
                     tp=tp, sample_rate=float(p["sample_rate"]),
                     mtries=mtries, n_class=K, ntrees=kk)
                 chunks.append(tr_c)
@@ -400,7 +403,7 @@ class DRFEstimator(ModelBuilder):
             oob_sum, oob_cnt, gains_dev = osum_acc, ocnt_acc, gains_acc
             ntrees = done
         else:
-            forest, oob_sum, oob_cnt, gains_dev = _bag_scan(
+            forest, oob_sum, oob_cnt, gains_dev, _ = _bag_scan(
                 bm.bins, bm.nbins, ys, w, key, jnp.int32(depth), tp=tp,
                 sample_rate=float(p["sample_rate"]), mtries=mtries,
                 n_class=K, ntrees=ntrees)

@@ -645,6 +645,10 @@ class GBMEstimator(ModelBuilder):
             col_sample_rate=float(p["col_sample_rate_per_tree"]),
             nbins_total=bm.nbins_total,
             cat_feats=tuple(bool(v) for v in bm.is_cat),
+            # 10M+ rows: bigger histogram row blocks — 4096-row blocks
+            # put a 12K-iteration inner scan in every tree at 50M and
+            # underfeed the MXU contraction
+            block_rows=16384 if bm.bins.shape[0] > 8_388_608 else 4096,
             exact_f32=exact_f32_for(bm))
 
         # monotone constraints (GBM.java monotone_constraints; numeric
@@ -706,11 +710,19 @@ class GBMEstimator(ModelBuilder):
         # a 25-deep-tree chunk at depth bucket 10 runs ~20-80s, far
         # past a ~30s AutoML slice. Uncapped fits keep 25 (no extra
         # program shapes on the pyunit paths).
+        # row scale bounds single-program runtime: a 25-tree fused scan
+        # at 50M rows runs minutes inside ONE XLA program and trips the
+        # tunnel worker's execution watchdog ("TPU worker process
+        # crashed") — chunks shrink past ~5M padded rows so each
+        # program stays ~tens of seconds. <=5M keeps 25 (pyunits and
+        # the flagship bench shapes are untouched).
+        _rows_scale = max(1.0, bm.bins.shape[0] / 5_242_880.0)
         if _deadline is not None:
-            _cost = (2.0 ** tp.max_depth / 64.0) * (bm.nbins_total / 65.0)
+            _cost = (2.0 ** tp.max_depth / 64.0) * (bm.nbins_total / 65.0) \
+                * _rows_scale
             _chunk = max(1, min(25, int(round(25.0 / max(_cost, 1.0)))))
         else:
-            _chunk = 25
+            _chunk = max(1, min(25, int(round(25.0 / _rows_scale))))
         prior_T = 0
         if ckpt is not None:
             K_ck = (ckpt.output.get("nclasses", 1)

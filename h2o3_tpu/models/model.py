@@ -222,6 +222,13 @@ class ModelBuilder:
         DEFAULTS-based by convention, overridable by facades."""
         return set(getattr(cls, "DEFAULTS", {}))
 
+    def set_max_runtime(self, secs: float) -> None:
+        """Install a wallclock cap when the builder accepts one (the
+        AutoML executor's time slicing; facades forward to their inner
+        builder, which __init__ constructed before the cap existed)."""
+        if "max_runtime_secs" in self.accepted_params():
+            self.params["max_runtime_secs"] = float(secs)
+
     # -- subclass contract --------------------------------------------
     def _fit(self, frame: Frame, x: Sequence[str], y: Optional[str],
              job: Job, validation_frame: Optional[Frame] = None) -> Model:

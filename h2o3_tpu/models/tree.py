@@ -287,11 +287,15 @@ def _level_goleft(feat_d, thresh_d, nal_d, isp_d, cat_d, lw_d, nid, bins,
     go_num = b_r <= t_r
     W = lw_d.shape[1]
     cs_r = cat_d[nid]
-    lw_r = lw_d[nid]                                        # [N, W]
     widx = (b_r >> 5).astype(jnp.uint32)
-    word = jnp.sum(jnp.where(
-        widx[:, None] == jnp.arange(W, dtype=jnp.uint32)[None, :],
-        lw_r, jnp.uint32(0)), axis=1)
+    # select the row's bitset word WITHOUT an [N, W] u32 intermediate:
+    # TPU tiling pads the minor dim to 128, so [50M, 4] u32 becomes a
+    # 25.7GB allocation (observed gbm-full compile OOM). A static loop
+    # of per-word [N] gathers fuses into selects instead.
+    word = jnp.zeros_like(b_r, dtype=jnp.uint32)
+    for k in range(W):
+        word = word | jnp.where(widx == jnp.uint32(k), lw_d[nid, k],
+                                jnp.uint32(0))
     inset = ((word >> (b_r & 31).astype(jnp.uint32)) & 1) == 1
     go_split = jnp.where(cs_r, inset, go_num)
     goleft = jnp.where(isp_r, jnp.where(isna, nal_r, go_split), True)
@@ -542,6 +546,15 @@ def leaf_assignment_frame(model, frame):
     bm = rebin_for_scoring(model.bm, frame)
     ids = np.asarray(leaf_assignments(model.forest, bm.bins,
                                       model.bm.nbins_total))[: frame.nrows]
+    # forests compile at the DEPTH BUCKET (tree.py DEPTH_BUCKETS) with a
+    # traced limit masking deeper splits; the walk therefore returns ids
+    # at the bucket depth D — shift back to the REQUESTED depth's id
+    # space (rows route left through masked levels, so the shift is an
+    # exact inverse)
+    D = int(model.forest.feat.shape[1])
+    d_req = min(int(model.params.get("max_depth") or D), D)
+    if d_req < D:
+        ids = ids >> (D - d_req)
     category = model.output.get("category")
     K = (model.output.get("nclasses", 1)
          if category == "Multinomial" else 1)

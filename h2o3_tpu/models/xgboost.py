@@ -98,6 +98,10 @@ class XGBoostEstimator(ModelBuilder):
         self._gbm = GBMEstimator(**gbm_params)
         super().__init__(**params)
 
+    def set_max_runtime(self, secs: float) -> None:
+        self.params["max_runtime_secs"] = float(secs)
+        self._gbm.params["max_runtime_secs"] = float(secs)
+
     def train(self, training_frame: Frame, y: Optional[str] = None,
               x: Optional[Sequence[str]] = None,
               validation_frame: Optional[Frame] = None,
