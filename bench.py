@@ -168,6 +168,17 @@ def _hbm_peak():
         return 0
 
 
+def _compile_count() -> int:
+    """Process-wide XLA backend compiles so far (telemetry registry).
+
+    Emitted per config as a DELTA over the timed run: a warmed run
+    should report compiles_timed=0 — anything else means the timed
+    number includes compiler wall time, the exact failure mode the
+    telemetry subsystem exists to expose."""
+    from h2o3_tpu import telemetry
+    return int(telemetry.REGISTRY.value("xla_compile_total"))
+
+
 # ---------------------------------------------------------------- configs
 
 
@@ -199,6 +210,7 @@ def _gbm_at(n_rows: int, ntrees: int, depth: int, tag: str):
                       seed=1).train(fr, y="IsDepDelayed")
     DKV.remove(wm.key)
     del wm
+    c0 = _compile_count()
     t1 = time.time()
     model = GBMEstimator(ntrees=ntrees, max_depth=depth, seed=1).train(
         fr, y="IsDepDelayed")
@@ -215,7 +227,9 @@ def _gbm_at(n_rows: int, ntrees: int, depth: int, tag: str):
         total_seconds=round(t_ingest + t_train, 1),
         auc=round(float(model.training_metrics["AUC"]), 4),
         mfu_pct=round(_tree_mfu_pct(rows_per_sec, depth, 10), 2),
-        peak_hbm_gb=round(_hbm_peak() / 1e9, 2))
+        peak_hbm_gb=round(_hbm_peak() / 1e9, 2),
+        compiles_timed=_compile_count() - c0,
+        compiles_total=_compile_count())
 
 
 def bench_gbm():
@@ -248,6 +262,7 @@ def bench_glm():
         est = GLMEstimator(family="binomial", solver=solver, lambda_=0.0,
                            max_iterations=max_it, standardize=True)
         est.train(fr, y="y")          # warmup/compile
+        c0 = _compile_count()
         t0 = time.time()
         m = GLMEstimator(family="binomial", solver=solver, lambda_=0.0,
                          max_iterations=max_it,
@@ -264,7 +279,9 @@ def bench_glm():
             row_iters / 1.0e7, "estimated JVM 1.0e7 row-iters/sec",
             train_seconds=round(dt, 2),
             mfu_pct=round(100 * row_iters * flops_per_row_iter / 197e12, 3),
-            auc=round(float(m.training_metrics["AUC"]), 4))
+            auc=round(float(m.training_metrics["AUC"]), 4),
+            compiles_timed=_compile_count() - c0,
+            peak_hbm_gb=round(_hbm_peak() / 1e9, 2))
 
 
 def bench_dl():
@@ -287,6 +304,7 @@ def bench_dl():
     # epoch count shares it)
     DeepLearningEstimator(hidden=[200, 200], activation="rectifier",
                           epochs=0.1, seed=1).train(fr, y="label")
+    c0 = _compile_count()
     t0 = time.time()
     m = DeepLearningEstimator(hidden=[200, 200], activation="rectifier",
                               epochs=epochs, seed=1).train(fr, y="label")
@@ -311,7 +329,9 @@ def bench_dl():
         sps / 80_000.0, "PUBLISHED 80K samples/sec 1-node "
         "(hex/deeplearning/README.md:26)",
         train_seconds=round(dt, 2), mfu_pct=round(100 * mfu, 2),
-        train_err=err)
+        train_err=err,
+        compiles_timed=_compile_count() - c0,
+        peak_hbm_gb=round(_hbm_peak() / 1e9, 2))
 
 
 def bench_xgb():
@@ -322,6 +342,7 @@ def bench_xgb():
     fr = stream_import_csv(_airlines_csv(n_rows))
     XGBoostEstimator(ntrees=5, max_depth=6, seed=1).train(
         fr, y="IsDepDelayed")
+    c0 = _compile_count()
     t0 = time.time()
     m = XGBoostEstimator(ntrees=ntrees, max_depth=6, seed=1).train(
         fr, y="IsDepDelayed")
@@ -333,7 +354,9 @@ def bench_xgb():
         rps / 2.0e6, "estimated JVM xgboost-hist 2.0e6 rows/sec-tree",
         train_seconds=round(dt, 2),
         mfu_pct=round(_tree_mfu_pct(rps, 6, 10), 2),
-        auc=round(float(m.training_metrics["AUC"]), 4))
+        auc=round(float(m.training_metrics["AUC"]), 4),
+        compiles_timed=_compile_count() - c0,
+        peak_hbm_gb=round(_hbm_peak() / 1e9, 2))
 
 
 def bench_sort():

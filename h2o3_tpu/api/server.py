@@ -1427,9 +1427,30 @@ healthy: {info["cloud_healthy"]}</p>
 <a href="/3/ModelBuilders">/3/ModelBuilders</a> ·
 <a href="/3/Jobs">/3/Jobs</a> ·
 <a href="/3/Timeline">/3/Timeline</a> ·
+<a href="/3/Metrics">/3/Metrics</a> ·
 <a href="/3/SelfBench">/3/SelfBench</a></p>
 </body></html>"""
     return {"__html__": html}
+
+
+@route("GET", "/3/Metrics")
+def _metrics(params, body):
+    """Runtime telemetry snapshot (h2o3_tpu/telemetry): registry
+    counters/gauges/histograms + recent spans. ``?format=prometheus``
+    returns text exposition 0.0.4 for a scraping agent; the JSON shape
+    additionally carries the span ring and per-span-name aggregate."""
+    from h2o3_tpu import telemetry
+    fmt = str(params.get("format") or "").lower()
+    if fmt in ("prometheus", "prom", "text"):
+        return {"__bytes__": telemetry.to_prometheus().encode(),
+                "__ctype__": "text/plain; version=0.0.4; charset=utf-8"}
+    try:
+        nspans = int(float(params.get("spans") or 50))
+    except (TypeError, ValueError):
+        nspans = 50
+    return {"metrics": telemetry.snapshot(),
+            "spans": telemetry.spans_snapshot(nspans),
+            "span_aggregate": telemetry.spans_aggregate()}
 
 
 @route("GET", "/3/WaterMeterCpuTicks")
@@ -1447,6 +1468,15 @@ def _water_meter(params, body):
                                   int(p[5]), int(p[4])])
     except OSError:
         pass
+    if not ticks:
+        # no /proc (macOS, sandboxes): synthesize one pseudo-core from
+        # the process's own rusage so the endpoint still reports REAL
+        # collected data instead of an empty stub
+        import os as _os
+        t = _os.times()
+        hz = 100.0
+        ticks = [[int(t.user * hz), int(t.system * hz), 0,
+                  int(max(t.elapsed - t.user - t.system, 0) * hz)]]
     return {"cpu_ticks": ticks}
 
 
@@ -1490,7 +1520,13 @@ def _profiler(params, body):
         {"stacktrace": sig, "count": cnt}
         for sig, cnt in sorted(counts.items(), key=lambda kv: -kv[1])[:30]
     ]}]
-    return {"nodes": nodes, "depth": depth}
+    # span-level profile rides along: where the RUNTIME's structured
+    # phases (jobs, fits, chunks, parses) actually spent wall time —
+    # complements the raw stack samples the same way the reference's
+    # Timeline complements its Profiler
+    from h2o3_tpu import telemetry
+    return {"nodes": nodes, "depth": depth,
+            "spans": telemetry.spans_aggregate()}
 
 
 @route("GET", "/3/SelfBench")
@@ -1565,15 +1601,25 @@ class _Handler(BaseHTTPRequestHandler):
         elif body:
             params.update({k: v[0]
                            for k, v in urllib.parse.parse_qs(body).items()})
+        from h2o3_tpu import telemetry
         from h2o3_tpu.utils.timeline import record as _tl_record
-        _tl_record("rest", f"{method} {path}")
         for m, rx, fn in ROUTES:
             if m != method:
                 continue
             match = rx.match(path)
             if match:
+                # endpoint label = the route PATTERN (bounded
+                # cardinality), not the raw path with its keys
+                endpoint = rx.pattern.strip("^$")
+                telemetry.counter("rest_requests_total", method=method,
+                                  endpoint=endpoint).inc()
                 try:
-                    out = fn(params, body, **match.groupdict())
+                    with telemetry.span("rest", method=method,
+                                        endpoint=endpoint):
+                        # recorded INSIDE the span so the Timeline event
+                        # carries this request's span id
+                        _tl_record("rest", f"{method} {path}")
+                        out = fn(params, body, **match.groupdict())
                     code = 200
                 except KeyError as e:
                     out = _error_json(path, e, 404)
@@ -1615,6 +1661,7 @@ class _Handler(BaseHTTPRequestHandler):
                 self.end_headers()
                 self.wfile.write(payload)
                 return
+        _tl_record("rest", f"{method} {path}", status=404)
         self.send_response(404)
         payload = json.dumps({"msg": f"no route {method} {path}"}).encode()
         self.send_header("Content-Type", "application/json")

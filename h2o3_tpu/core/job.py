@@ -85,10 +85,12 @@ class Job:
     def start(self, fn: Callable[["Job"], Any], background: bool = False) -> "Job":
         self.status = RUNNING
         self.start_time = time.time()
+        from h2o3_tpu import telemetry
         from h2o3_tpu.utils.timeline import record as _tl
         _tl("job", f"start {self.description}", key=self.key)
+        telemetry.counter("jobs_started_total").inc()
 
-        def _run():
+        def _body():
             try:
                 try:
                     self.result = fn(self)
@@ -129,6 +131,21 @@ class Job:
                     raise
             finally:
                 self.end_time = time.time()
+
+        def _run():
+            # the job is the ROOT telemetry span: everything the work
+            # does (fit spans, boost chunks, compiles) nests under it —
+            # background jobs run on their own thread, whose fresh
+            # contextvar context makes this a root span automatically
+            try:
+                with telemetry.span("job", key=self.key,
+                                    desc=self.description):
+                    _body()
+            finally:
+                telemetry.counter("jobs_completed_total",
+                                  status=self.status).inc()
+                telemetry.histogram("job_duration_seconds").observe(
+                    (self.end_time or time.time()) - self.start_time)
 
         if background:
             self._thread = threading.Thread(target=_run, daemon=True, name=self.key)

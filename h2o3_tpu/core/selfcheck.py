@@ -73,7 +73,7 @@ def run_self_bench(sizes: Dict[str, int] | None = None) -> Dict[str, float]:
         mesh = get_mesh()
         if mesh.shape[DATA_AXIS] > 1:
             import functools
-            from jax import shard_map
+            from h2o3_tpu.parallel.mesh import shard_map
 
             @jax.jit
             @functools.partial(shard_map, mesh=mesh, in_specs=P(DATA_AXIS),

@@ -24,8 +24,10 @@ import jax.numpy as jnp
 import numpy as np
 
 from h2o3_tpu.frame.frame import Frame
+from h2o3_tpu.telemetry import observed_jit
 
 
+@observed_jit("frame.bin_device")
 @partial(jax.jit, static_argnames=("B", "is_cat_t", "has_remap_t",
                                    "div_t"))
 def _bin_device(datas, nas, remaps, edges, *, B: int, is_cat_t: tuple,

@@ -1189,7 +1189,10 @@ def write_reference_pca_mojo(model, path: str) -> str:
     nnums = len(nums_i)
     if str(model.transform).lower() == "standardize":
         norm_sub = [float(m) for m in model.di_stats["num_means"]]
-        norm_mul = [1.0 / float(s) for s in model.di_stats["num_sigmas"]]
+        # constant columns have sigma 0: emit 1.0 like the reference
+        # (DataInfo.java:620 `sigma != 0 ? 1/sigma : 1`)
+        norm_mul = [1.0 / float(s) if float(s) != 0.0 else 1.0
+                    for s in model.di_stats["num_sigmas"]]
     else:
         norm_sub = [0.0] * nnums
         norm_mul = [1.0] * nnums

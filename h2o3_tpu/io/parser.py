@@ -160,8 +160,13 @@ def import_file(path: str, destination_frame: Optional[str] = None,
         log.info("registered lazy frame %s -> %s (unparsed, %.1f MB on "
                  "disk)", key, path, (nbytes or 0) / 1e6)
         return stub
-    fr = _import_file_eager(path, destination_frame, col_types, header,
-                            na_strings)
+    import time as _time
+    from h2o3_tpu import telemetry
+    t0 = _time.time()
+    with telemetry.span("parse.import", path=str(path)):
+        fr = _import_file_eager(path, destination_frame, col_types, header,
+                                na_strings)
+    telemetry.histogram("parse_seconds").observe(_time.time() - t0)
     # provenance for the Cleaner's cheap eviction path: an unmutated
     # file-backed frame can drop straight back to its stub —
     # na_strings included, or rehydrate reparses without NA mapping
@@ -184,6 +189,13 @@ def _import_file_eager(path: str, destination_frame: Optional[str] = None,
         paths = [path]
     if not paths:
         raise FileNotFoundError(path)
+    from h2o3_tpu import telemetry
+    telemetry.counter("parse_files_total").inc(len(paths))
+    try:
+        telemetry.counter("parse_bytes_total").inc(
+            sum(os.path.getsize(f) for f in paths))
+    except OSError:
+        pass
 
     # SVMLight / ARFF (water/parser/{SVMLightParser,ARFFParser} roles)
     if all(f.endswith((".svm", ".svmlight")) for f in paths):
@@ -232,9 +244,11 @@ def _import_file_eager(path: str, destination_frame: Optional[str] = None,
         # only plain text csv: zips/parquet sniff via their own readers
         header = guess_header(paths[0])
     if all(f.endswith((".csv", ".csv.gz")) for f in paths):
-        parsed = _parse_csv_native(paths, col_types,
-                                   header=True if header is None else header,
-                                   na_strings=na_strings)
+        with telemetry.span("parse.csv_native", files=len(paths)):
+            parsed = _parse_csv_native(
+                paths, col_types,
+                header=True if header is None else header,
+                na_strings=na_strings)
         if parsed is not None:
             cols, cats, domains = parsed
             # UUID detection (water/fvec C16Chunk / Vec.T_UUID): a

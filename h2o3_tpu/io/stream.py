@@ -236,6 +236,13 @@ def stream_import_csv(path, destination_frame: Optional[str] = None,
     """Chunked native parse with overlapped async H2D transfer."""
     from h2o3_tpu.native import parse_csv_bytes
     paths = [path] if isinstance(path, str) else list(path)
+    from h2o3_tpu import telemetry
+    telemetry.counter("parse_files_total").inc(len(paths))
+    try:
+        telemetry.counter("parse_bytes_total").inc(
+            sum(os.path.getsize(f) for f in paths))
+    except OSError:
+        pass
     accs: Dict[str, _ColAcc] = {}
     names: List[str] = []
     header_line = None

@@ -17,6 +17,8 @@ UniformAdaptive initializer match the reference's semantics.
 
 from __future__ import annotations
 
+import time
+
 from functools import partial
 from typing import Dict, List, Optional, Sequence
 
@@ -474,8 +476,12 @@ class DeepLearningEstimator(ModelBuilder):
             # 0.08ms/step at 1024 vs 0.36ms at 8192 on v5e; per-step
             # dispatch ~6ms dominates at 4096 on 1M-row fits), and
             # ADADELTA's per-parameter rates keep convergence stable.
-            # Power-of-two so the MXU tiles cleanly.
-            batch = min(16384, max(256, n // 64))
+            # Power-of-two so the MXU tiles cleanly. The 256 floor is
+            # clamped to the PADDED row count: the fused step slices
+            # `batch` rows with dynamic_slice_in_dim, which requires
+            # slice size <= array dim — without the clamp any fit on a
+            # frame below ~224 rows fails at trace time.
+            batch = min(16384, max(256, n // 64), N)
             batch = 1 << (batch.bit_length() - 1)
         ndata = mesh.shape["data"]
         batch = ((batch + ndata - 1) // ndata) * ndata
@@ -515,12 +521,21 @@ class DeepLearningEstimator(ModelBuilder):
         score_stride = max(chunk, -(-total_steps // 10))
         next_score = score_stride
         done = 0
+        from h2o3_tpu import telemetry
         while done < total_steps:
             k = min(chunk, total_steps - done)
-            params_net, opt_state, key = _train_steps_fused(
-                params_net, opt_state, Xh, y_dev, w, key,
-                jnp.float32(done), jnp.int32((done * batch) % max(n, 1)),
-                jnp.float32(k), **sched, **step_kwargs)
+            _ct0 = time.time()
+            with telemetry.span("deeplearning.chunk", steps=k):
+                params_net, opt_state, key = _train_steps_fused(
+                    params_net, opt_state, Xh, y_dev, w, key,
+                    jnp.float32(done),
+                    jnp.int32((done * batch) % max(n, 1)),
+                    jnp.float32(k), **sched, **step_kwargs)
+            telemetry.histogram("train_chunk_seconds",
+                                algo="deeplearning").observe(
+                time.time() - _ct0)
+            telemetry.counter("train_iterations_total",
+                              algo="deeplearning").inc(k)
             done += k
             job.update(k / total_steps, f"step {done}/{total_steps}")
             if stopper.enabled and (done >= next_score

@@ -39,9 +39,21 @@ from h2o3_tpu.models.tree import (Tree, TreeParams, TreeScalars,
                                   predict_forest, predict_tree, stack_trees)
 from h2o3_tpu.parallel.mesh import (get_mesh, put_sharded,
                                     row_sharding)
+from h2o3_tpu import telemetry
+from h2o3_tpu.telemetry import observed_jit
 from h2o3_tpu.utils.log import get_logger
 
 log = get_logger("h2o3_tpu.gbm")
+
+
+def _tree_keys(key, tree0, ntrees: int):
+    """Per-tree PRNG keys derived from the GLOBAL tree index
+    (fold_in(key, tree0+i)), not from a per-chunk split: chunk size is a
+    scheduling artifact (max_runtime_secs shrinks it, row scale shrinks
+    it) and must never change seeded sampling results. ``tree0`` rides
+    as a traced scalar so every chunk boundary shares one program."""
+    return jax.vmap(lambda i: jax.random.fold_in(key, i))(
+        tree0 + jnp.arange(ntrees, dtype=jnp.int32))
 
 
 def _sample_columns(k1, k2, F: int, rate):
@@ -78,16 +90,17 @@ def _boost_step_jit(bins, nb, y, w, margin, key, knobs, constraints=None,
 def _boost_scan(bins, nb, y, w, margin, key, constraints=None,
                 interaction_sets=None, *,
                 tp: TreeParams, dist: Distribution, sample_rate: float,
-                ntrees: int):
-    return _boost_scan_jit(bins, nb, y, w, margin, key,
+                ntrees: int, tree0: int = 0):
+    return _boost_scan_jit(bins, nb, y, w, margin, key, tree0,
                            _knobs_of(tp, sample_rate), constraints,
                            interaction_sets, tp=_neutral_tp(tp),
                            dist=dist, ntrees=ntrees)
 
 
+@observed_jit("gbm.boost_scan")
 @partial(jax.jit, static_argnames=("tp", "dist", "ntrees"))
-def _boost_scan_jit(bins, nb, y, w, margin, key, knobs, constraints=None,
-                    interaction_sets=None, *,
+def _boost_scan_jit(bins, nb, y, w, margin, key, tree0, knobs,
+                    constraints=None, interaction_sets=None, *,
                     tp: TreeParams, dist: Distribution, ntrees: int):
     """All ``ntrees`` boosting iterations as ONE compiled program.
 
@@ -96,7 +109,7 @@ def _boost_scan_jit(bins, nb, y, w, margin, key, knobs, constraints=None,
     a remote-attached chip); static tree shapes make the stacked Tree
     output exactly what predict_forest consumes.
     """
-    keys = jax.random.split(key, ntrees)
+    keys = _tree_keys(key, tree0, ntrees)
 
     def step(margin, k):
         tree, margin, gains = _boost_step_impl(
@@ -114,16 +127,17 @@ def _boost_scan_scored(bins, nb, y, w, margin, key,
                        constraints=None, interaction_sets=None, *,
                        tp: TreeParams, dist: Distribution,
                        sample_rate: float, ntrees: int, B: int,
-                       use_val: bool):
+                       use_val: bool, tree0: int = 0):
     return _boost_scan_scored_jit(
-        bins, nb, y, w, margin, key, vbins, vy, vw, vmargin,
+        bins, nb, y, w, margin, key, tree0, vbins, vy, vw, vmargin,
         _knobs_of(tp, sample_rate), constraints, interaction_sets,
         tp=_neutral_tp(tp), dist=dist, ntrees=ntrees, B=B,
         use_val=use_val)
 
 
+@observed_jit("gbm.boost_scan_scored")
 @partial(jax.jit, static_argnames=("tp", "dist", "ntrees", "B", "use_val"))
-def _boost_scan_scored_jit(bins, nb, y, w, margin, key,
+def _boost_scan_scored_jit(bins, nb, y, w, margin, key, tree0,
                            vbins, vy, vw, vmargin, knobs,
                            constraints=None, interaction_sets=None, *,
                            tp: TreeParams, dist: Distribution,
@@ -139,7 +153,7 @@ def _boost_scan_scored_jit(bins, nb, y, w, margin, key,
     hex/tree/SharedTree.java:481 — here the scores ride inside the
     compiled program). With ``use_val`` the validation margin is
     carried through the scan too."""
-    keys = jax.random.split(key, ntrees)
+    keys = _tree_keys(key, tree0, ntrees)
 
     def step(carry, k):
         margin, vmargin = carry
@@ -165,17 +179,18 @@ def _boost_scan_multi(bins, nb, y_int, w, margins, key,
                       vbins, vy_int, vw, vmargins,
                       interaction_sets=None, *, tp: TreeParams,
                       sample_rate: float, n_class: int, ntrees: int,
-                      B: int, use_val: bool):
+                      B: int, use_val: bool, tree0: int = 0):
     return _boost_scan_multi_jit(
-        bins, nb, y_int, w, margins, key, vbins, vy_int, vw, vmargins,
-        _knobs_of(tp, sample_rate), interaction_sets,
+        bins, nb, y_int, w, margins, key, tree0, vbins, vy_int, vw,
+        vmargins, _knobs_of(tp, sample_rate), interaction_sets,
         tp=_neutral_tp(tp), n_class=n_class, ntrees=ntrees, B=B,
         use_val=use_val)
 
 
+@observed_jit("gbm.boost_scan_multi")
 @partial(jax.jit, static_argnames=("tp", "n_class", "ntrees", "B",
                                    "use_val"))
-def _boost_scan_multi_jit(bins, nb, y_int, w, margins, key,
+def _boost_scan_multi_jit(bins, nb, y_int, w, margins, key, tree0,
                           vbins, vy_int, vw, vmargins, knobs,
                           interaction_sets=None, *, tp: TreeParams,
                           n_class: int, ntrees: int, B: int,
@@ -187,7 +202,7 @@ def _boost_scan_multi_jit(bins, nb, y_int, w, margins, key,
     (VERDICT weak #3); the scan removes all per-tree round trips, so
     multinomial boosting matches the binomial fused path's throughput
     profile."""
-    keys = jax.random.split(key, ntrees)
+    keys = _tree_keys(key, tree0, ntrees)
 
     def step(carry, kk):
         margins, vmargins = carry
@@ -814,12 +829,18 @@ class GBMEstimator(ModelBuilder):
             done = 0
             while done < ntrees:
                 kk = min(_chunk, ntrees - done)
-                key, sub = jax.random.split(key)
-                tr_k, margins, vm_, gains, devs = _boost_scan_multi(
-                    bm.bins, bm.nbins, y_dev, w, margins, sub,
-                    vb_, vy_, vw_, vm_, interaction_sets, tp=tp,
-                    sample_rate=float(p["sample_rate"]), n_class=K,
-                    ntrees=kk, B=bm.nbins_total, use_val=use_val)
+                _ct0 = time.time()
+                with telemetry.span("gbm.chunk", trees=kk):
+                    tr_k, margins, vm_, gains, devs = _boost_scan_multi(
+                        bm.bins, bm.nbins, y_dev, w, margins, key,
+                        vb_, vy_, vw_, vm_, interaction_sets, tp=tp,
+                        sample_rate=float(p["sample_rate"]), n_class=K,
+                        ntrees=kk, B=bm.nbins_total, use_val=use_val,
+                        tree0=prior_T + done)
+                telemetry.histogram("train_chunk_seconds",
+                                    algo="gbm").observe(time.time() - _ct0)
+                telemetry.counter("train_iterations_total",
+                                  algo="gbm").inc(kk)
                 keep = (_stop_point(np.asarray(devs), done, kk,
                                     score_interval, stopper,
                                     scoring_history)
@@ -919,12 +940,18 @@ class GBMEstimator(ModelBuilder):
                 done = 0
                 while done < ntrees:
                     k = min(_chunk, ntrees - done)
-                    key, sub = jax.random.split(key)
-                    tr_k, margin, gains = _boost_scan(
-                        bm.bins, bm.nbins, y_dev, w, margin, sub,
-                        constraints, interaction_sets, tp=tp,
-                        dist=dist, sample_rate=float(p["sample_rate"]),
-                        ntrees=k)
+                    _ct0 = time.time()
+                    with telemetry.span("gbm.chunk", trees=k):
+                        tr_k, margin, gains = _boost_scan(
+                            bm.bins, bm.nbins, y_dev, w, margin, key,
+                            constraints, interaction_sets, tp=tp,
+                            dist=dist, sample_rate=float(p["sample_rate"]),
+                            ntrees=k, tree0=prior_T + done)
+                    telemetry.histogram(
+                        "train_chunk_seconds",
+                        algo="gbm").observe(time.time() - _ct0)
+                    telemetry.counter("train_iterations_total",
+                                      algo="gbm").inc(k)
                     chunks.append(tr_k)
                     if not light:
                         gains_total += np.asarray(gains)
@@ -955,13 +982,22 @@ class GBMEstimator(ModelBuilder):
                 done = 0
                 while done < ntrees:
                     k = min(_chunk, ntrees - done)
-                    key, sub = jax.random.split(key)
-                    tr_k, margin, vm_, gains, devs = _boost_scan_scored(
-                        bm.bins, bm.nbins, y_dev, w, margin, sub,
-                        vb_, vy_, vw_, vm_,
-                        constraints, interaction_sets, tp=tp,
-                        dist=dist, sample_rate=float(p["sample_rate"]),
-                        ntrees=k, B=bm.nbins_total, use_val=use_val)
+                    _ct0 = time.time()
+                    with telemetry.span("gbm.chunk", trees=k):
+                        tr_k, margin, vm_, gains, devs = \
+                            _boost_scan_scored(
+                                bm.bins, bm.nbins, y_dev, w, margin, key,
+                                vb_, vy_, vw_, vm_,
+                                constraints, interaction_sets, tp=tp,
+                                dist=dist,
+                                sample_rate=float(p["sample_rate"]),
+                                ntrees=k, B=bm.nbins_total,
+                                use_val=use_val, tree0=prior_T + done)
+                    telemetry.histogram(
+                        "train_chunk_seconds",
+                        algo="gbm").observe(time.time() - _ct0)
+                    telemetry.counter("train_iterations_total",
+                                      algo="gbm").inc(k)
                     keep = _stop_point(np.asarray(devs), done, k,
                                        score_interval, stopper,
                                        scoring_history)

@@ -21,6 +21,8 @@ L-BFGS path) — see the Family class below and tests/test_glm_surface.py.
 
 from __future__ import annotations
 
+import time
+
 from functools import partial
 from typing import Dict, List, Optional, Sequence
 
@@ -1001,6 +1003,7 @@ class GLMEstimator(ModelBuilder):
                      and solver not in ("coordinate_descent",
                                         "coordinate_descent_naive",
                                         "l_bfgs", "lbfgs"))
+        from h2o3_tpu import telemetry
         if fuse_path:
             # whole regularization path in ONE compiled scan of IRLS
             # while_loops (pyunit_glm_seed: 30 lambdas x CV folds paid a
@@ -1008,33 +1011,50 @@ class GLMEstimator(ModelBuilder):
             l1s = jnp.asarray([lam * alpha for lam in lambdas], jnp.float32)
             l2s = jnp.asarray([lam * (1.0 - alpha) for lam in lambdas],
                               jnp.float32)
-            best, coef_path = _irls_solve_path(
-                X1, jnp.asarray(coef, jnp.float32), y_dev, w, off_or0,
-                l1s, l2s, jnp.float32(p["beta_epsilon"]),
-                jnp.int32(p["max_iterations"]), fam.name, fam.link,
-                jnp.float32(fam.p), jnp.float32(fam.theta),
-                jnp.float32(self._objective_eps()),
-                use_l1=alpha > 0)
+            _st0 = time.time()
+            with telemetry.span("glm.solve", solver=solver,
+                                lambdas=len(lambdas)):
+                best, coef_path = _irls_solve_path(
+                    X1, jnp.asarray(coef, jnp.float32), y_dev, w, off_or0,
+                    l1s, l2s, jnp.float32(p["beta_epsilon"]),
+                    jnp.int32(p["max_iterations"]), fam.name, fam.link,
+                    jnp.float32(fam.p), jnp.float32(fam.theta),
+                    jnp.float32(self._objective_eps()),
+                    use_l1=alpha > 0)
+            telemetry.histogram("train_chunk_seconds",
+                                algo="glm").observe(time.time() - _st0)
+            telemetry.counter("train_iterations_total", algo="glm").inc(
+                len(lambdas) * int(p["max_iterations"]))
             job.update(1.0, f"lambda path ({len(lambdas)})")
         else:
             for li, lam in enumerate(lambdas):
                 l1 = lam * alpha
                 l2 = lam * (1.0 - alpha)
-                if solver in ("coordinate_descent",
-                              "coordinate_descent_naive"):
-                    coef = self._fit_cod(X1, y_dev, w, fam, l1, l2, coef,
-                                         int(p["max_iterations"]),
-                                         float(p["beta_epsilon"]), bounds,
-                                         off=off_or0)
-                elif solver in ("l_bfgs", "lbfgs") and l1 == 0:
-                    coef = self._fit_lbfgs(X1, y_dev, w, fam, l2, coef,
-                                           nobs, int(p["max_iterations"]),
-                                           off=off_or0)
-                else:
-                    coef = self._fit_irlsm(X1, y_dev, w, fam, l1, l2, coef,
-                                           nobs, int(p["max_iterations"]),
-                                           float(p["beta_epsilon"]),
-                                           off=off_or0)
+                _st0 = time.time()
+                with telemetry.span("glm.solve", solver=solver,
+                                    lam=float(lam)):
+                    if solver in ("coordinate_descent",
+                                  "coordinate_descent_naive"):
+                        coef = self._fit_cod(X1, y_dev, w, fam, l1, l2,
+                                             coef,
+                                             int(p["max_iterations"]),
+                                             float(p["beta_epsilon"]),
+                                             bounds, off=off_or0)
+                    elif solver in ("l_bfgs", "lbfgs") and l1 == 0:
+                        coef = self._fit_lbfgs(X1, y_dev, w, fam, l2,
+                                               coef, nobs,
+                                               int(p["max_iterations"]),
+                                               off=off_or0)
+                    else:
+                        coef = self._fit_irlsm(X1, y_dev, w, fam, l1, l2,
+                                               coef, nobs,
+                                               int(p["max_iterations"]),
+                                               float(p["beta_epsilon"]),
+                                               off=off_or0)
+                telemetry.histogram("train_chunk_seconds",
+                                    algo="glm").observe(time.time() - _st0)
+                telemetry.counter("train_iterations_total",
+                                  algo="glm").inc(int(p["max_iterations"]))
                 job.update(1.0 / len(lambdas),
                            f"lambda {li + 1}/{len(lambdas)}")
                 best = coef

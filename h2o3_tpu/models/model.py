@@ -345,13 +345,19 @@ class ModelBuilder:
                 raise ValueError(
                     "fold_assignment is incompatible with fold_column "
                     "(hex/ModelBuilder fold-spec validation)")
-            if nfolds >= 2:
-                from h2o3_tpu.ml.cv import train_with_cv
-                model = train_with_cv(self, training_frame, x, y, nfolds, j,
+            from h2o3_tpu import telemetry
+            with telemetry.span(f"{self.algo}.fit", algo=self.algo,
+                                nfolds=nfolds):
+                if nfolds >= 2:
+                    from h2o3_tpu.ml.cv import train_with_cv
+                    model = train_with_cv(self, training_frame, x, y,
+                                          nfolds, j,
+                                          validation_frame=validation_frame)
+                else:
+                    model = self._fit(training_frame, x, y, j,
                                       validation_frame=validation_frame)
-            else:
-                model = self._fit(training_frame, x, y, j,
-                                  validation_frame=validation_frame)
+            telemetry.histogram("model_fit_seconds",
+                                algo=self.algo).observe(time.time() - t0)
             if custom_metric_func is not None and y is not None:
                 # "python:key" CFunc references (water/udf/CFuncRef)
                 from h2o3_tpu.core.udf import resolve_udf

@@ -11,10 +11,11 @@ import functools
 
 import jax
 import jax.numpy as jnp
-from jax import shard_map
+from h2o3_tpu.parallel.mesh import shard_map
 from jax.sharding import PartitionSpec as P
 
 from h2o3_tpu.parallel.mesh import DATA_AXIS, MODEL_AXIS
+from h2o3_tpu.telemetry import observed_jit
 
 
 def _local_segment_sum(nid, vals, n_nodes: int, block_rows: int,
@@ -71,6 +72,7 @@ def segment_sum(nid, vals, *, n_nodes: int, mesh, block_rows: int = 16384,
     return out if want == n_nodes else out[:want]
 
 
+@observed_jit("ops.segment_sum")
 @functools.partial(jax.jit, static_argnames=("n_nodes", "block_rows",
                                              "mesh", "precision"))
 def _segment_sum_jit(nid, vals, *, n_nodes, block_rows, mesh, precision):

@@ -21,10 +21,29 @@ import functools
 from typing import Any, Callable
 
 import jax
-from jax import shard_map
+from h2o3_tpu.parallel.mesh import shard_map
 from jax.sharding import PartitionSpec as P
 
+from h2o3_tpu import telemetry
 from h2o3_tpu.parallel.mesh import DATA_AXIS, MODEL_AXIS, get_mesh
+
+
+def _charge_reduce_payload(out, mesh) -> None:
+    """MRTask telemetry: the reduce payload is the pytree the psum tree
+    carries — the analogue of the reference's ack/ackack wire volume.
+    Sizes come from avals (no device sync). A psum ring moves
+    ~2·(n-1)/n of the payload per device, so the collective estimate is
+    2·(n-1)·payload across the mesh."""
+    try:
+        payload = sum(getattr(leaf, "nbytes", 0) or 0
+                      for leaf in jax.tree_util.tree_leaves(out))
+    except Exception:   # noqa: BLE001 - accounting must never fail the task
+        return
+    telemetry.histogram("frame_reduce_payload_bytes",
+                        buckets=telemetry.BYTES_BUCKETS).observe(payload)
+    est = 2.0 * max(mesh.shape[DATA_AXIS] - 1, 0) * payload
+    telemetry.counter("collective_bytes_total").inc(est)
+    telemetry.add_collective_bytes(est)
 
 
 def frame_reduce(map_fn: Callable[..., Any], *arrays, mesh=None) -> Any:
@@ -34,6 +53,7 @@ def frame_reduce(map_fn: Callable[..., Any], *arrays, mesh=None) -> Any:
     the data axis. Equivalent of MRTask.doAll + reduce (water/MRTask.java).
     """
     mesh = mesh or get_mesh()
+    telemetry.counter("frame_reduce_total").inc()
 
     @functools.partial(
         shard_map, mesh=mesh,
@@ -45,12 +65,16 @@ def frame_reduce(map_fn: Callable[..., Any], *arrays, mesh=None) -> Any:
         return jax.tree_util.tree_map(
             lambda s: jax.lax.psum(s, DATA_AXIS), stats)
 
-    return _task(*arrays)
+    with telemetry.span("mr.frame_reduce"):
+        out = _task(*arrays)
+    _charge_reduce_payload(out, mesh)
+    return out
 
 
 def frame_map(map_fn: Callable[..., Any], *arrays, mesh=None) -> Any:
     """Elementwise over rows; output stays row-sharded (map-only MRTask)."""
     mesh = mesh or get_mesh()
+    telemetry.counter("frame_map_total").inc()
 
     @functools.partial(
         shard_map, mesh=mesh,
@@ -60,4 +84,5 @@ def frame_map(map_fn: Callable[..., Any], *arrays, mesh=None) -> Any:
     def _task(*local):
         return map_fn(*local)
 
-    return _task(*arrays)
+    with telemetry.span("mr.frame_map"):
+        return _task(*arrays)

@@ -220,6 +220,8 @@ DEV_OPS = 0      # observability: prims served by the device path (tests
 def _dev_hit():
     global DEV_OPS
     DEV_OPS += 1
+    from h2o3_tpu import telemetry
+    telemetry.counter("rapids_device_ops_total").inc()
 
 # dtypes safe in the f32 device path: values exact in a 24-bit mantissa.
 # int32/time columns can exceed 2^24 (epoch millis certainly do) and
@@ -671,7 +673,10 @@ def _dev_reduce(name: str, v: Frame, na_rm: bool):
     if name == "sum":
         return float(np.sum(parts))
     if name == "mean":
-        return float(np.sum(parts) / max(float(np.sum(counts)), 1.0))
+        tot = float(np.sum(counts))
+        # all values NA with na.rm: the host path (np.nanmean) yields
+        # NaN — a clamped denominator would silently return 0.0 here
+        return float(np.sum(parts) / tot) if tot > 0 else float("nan")
     return float(np.min(parts) if name == "min" else np.max(parts))
 
 

@@ -1,0 +1,42 @@
+"""Runtime telemetry — metrics registry + hierarchical spans + compile
+observer, exposed via ``GET /3/Metrics`` (api/server.py).
+
+The reference ships observability as a design constraint (TimeLine,
+WaterMeter, Profiler — PAPER.md §Timeline/Logs); this package is the
+TPU runtime's equivalent for its OWN failure modes: XLA compile storms,
+shape-bucket misses, and device-memory pressure. Always on, cheap
+(registry op ≈ 1µs; see test_telemetry.py overhead bound).
+
+Surface (stable metric names — README §Observability):
+
+    from h2o3_tpu import telemetry
+    telemetry.counter("frame_reduce_total").inc()
+    with telemetry.span("gbm.fit", trees=100):
+        ...
+    telemetry.snapshot() / telemetry.to_prometheus()
+"""
+
+from h2o3_tpu.telemetry.registry import (BYTES_BUCKETS, REGISTRY,
+                                         SECONDS_BUCKETS, counter, gauge,
+                                         histogram)
+from h2o3_tpu.telemetry.spans import (add_collective_bytes, annotate,
+                                      current_span, current_span_id, span)
+from h2o3_tpu.telemetry.spans import snapshot as spans_snapshot
+from h2o3_tpu.telemetry.spans import aggregate as spans_aggregate
+from h2o3_tpu.telemetry.compile_observer import install, observed_jit
+
+snapshot = REGISTRY.snapshot
+to_prometheus = REGISTRY.to_prometheus
+
+# the compile listener is process-wide and costs nothing when idle;
+# importing telemetry anywhere arms it (core/job.py imports this, so
+# every entry path — REST, python API, bench — is covered)
+install()
+
+__all__ = [
+    "BYTES_BUCKETS", "SECONDS_BUCKETS", "REGISTRY",
+    "counter", "gauge", "histogram",
+    "span", "annotate", "current_span", "current_span_id",
+    "add_collective_bytes", "spans_snapshot", "spans_aggregate",
+    "install", "observed_jit", "snapshot", "to_prometheus",
+]

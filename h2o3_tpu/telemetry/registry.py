@@ -1,0 +1,269 @@
+"""Process-wide metrics registry — counters, gauges, bucketed histograms.
+
+Reference: the runtime-observability role of water/TimeLine.java plus
+water/util/WaterMeter* — always-on, cheap enough to never turn off.
+The reference exposes raw tick arrays per endpoint; here one registry
+holds every runtime counter and the REST tier renders it as JSON or
+Prometheus text exposition (GET /3/Metrics).
+
+Metric identity is (name, sorted label items). Names are auto-prefixed
+``h2o3tpu_`` so the exposition namespace never collides with a
+co-located exporter; the names listed in README §Observability are a
+stable surface.
+
+Cost model: one dict lookup + one lock'd float add per op (~1µs). Every
+op also bumps ``_OPS`` so tests can bound total telemetry overhead as
+ops x per-op cost (the TimeLine "cheap enough to leave on" constraint,
+asserted loosely in tests/test_telemetry.py).
+"""
+
+from __future__ import annotations
+
+import bisect
+import os
+import threading
+from typing import Dict, List, Optional, Sequence, Tuple
+
+PREFIX = "h2o3tpu_"
+
+ENABLED = os.environ.get("H2O3TPU_TELEMETRY", "1") != "0"
+
+# default duration buckets (seconds): sub-ms dispatches → multi-minute
+# trainings; Prometheus-style cumulative le= bounds
+SECONDS_BUCKETS = (0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25,
+                   0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0, 120.0, 300.0,
+                   900.0)
+# payload/collective sizes: 256B .. 16GB, x8 per step
+BYTES_BUCKETS = tuple(256.0 * 8 ** i for i in range(9))
+
+
+def _full_name(name: str) -> str:
+    return name if name.startswith(PREFIX) else PREFIX + name
+
+
+def _label_key(labels: Dict[str, str]) -> Tuple[Tuple[str, str], ...]:
+    return tuple(sorted((k, str(v)) for k, v in labels.items()))
+
+
+class Counter:
+    __slots__ = ("name", "labels", "_value", "_lock")
+
+    def __init__(self, name: str, labels: Dict[str, str]):
+        self.name = name
+        self.labels = labels
+        self._value = 0.0
+        self._lock = threading.Lock()
+
+    def inc(self, n: float = 1.0) -> None:
+        global _OPS
+        with self._lock:
+            self._value += n
+        _OPS += 1
+
+    @property
+    def value(self) -> float:
+        return self._value
+
+
+class Gauge:
+    __slots__ = ("name", "labels", "_value", "_lock")
+
+    def __init__(self, name: str, labels: Dict[str, str]):
+        self.name = name
+        self.labels = labels
+        self._value = 0.0
+        self._lock = threading.Lock()
+
+    def set(self, v: float) -> None:
+        global _OPS
+        with self._lock:
+            self._value = float(v)
+        _OPS += 1
+
+    def set_max(self, v: float) -> None:
+        """High-water update (device-memory peaks)."""
+        global _OPS
+        with self._lock:
+            if v > self._value:
+                self._value = float(v)
+        _OPS += 1
+
+    def add(self, v: float) -> None:
+        global _OPS
+        with self._lock:
+            self._value += v
+        _OPS += 1
+
+    @property
+    def value(self) -> float:
+        return self._value
+
+
+class Histogram:
+    """Cumulative-bucket histogram (Prometheus semantics: each bucket
+    counts observations <= its bound; +Inf is implicit via count)."""
+
+    __slots__ = ("name", "labels", "bounds", "_counts", "_sum", "_count",
+                 "_lock")
+
+    def __init__(self, name: str, labels: Dict[str, str],
+                 buckets: Sequence[float] = SECONDS_BUCKETS):
+        self.name = name
+        self.labels = labels
+        self.bounds = tuple(sorted(float(b) for b in buckets))
+        self._counts = [0] * len(self.bounds)
+        self._sum = 0.0
+        self._count = 0
+        self._lock = threading.Lock()
+
+    def observe(self, v: float) -> None:
+        global _OPS
+        i = bisect.bisect_left(self.bounds, v)
+        with self._lock:
+            if i < len(self._counts):
+                self._counts[i] += 1
+            self._sum += v
+            self._count += 1
+        _OPS += 1
+
+    @property
+    def count(self) -> int:
+        return self._count
+
+    @property
+    def sum(self) -> float:
+        return self._sum
+
+    def cumulative(self) -> List[int]:
+        acc, out = 0, []
+        with self._lock:
+            counts = list(self._counts)
+        for c in counts:
+            acc += c
+            out.append(acc)
+        return out
+
+
+_OPS = 0   # total registry ops since boot (overhead accounting)
+
+
+class MetricsRegistry:
+    def __init__(self):
+        self._metrics: Dict[Tuple[str, tuple], object] = {}
+        self._lock = threading.Lock()
+
+    def _get(self, cls, name: str, labels: Dict[str, str], **kw):
+        name = _full_name(name)
+        key = (name, _label_key(labels))
+        m = self._metrics.get(key)
+        if m is None:
+            with self._lock:
+                m = self._metrics.get(key)
+                if m is None:
+                    m = cls(name, dict(labels), **kw)
+                    self._metrics[key] = m
+        return m
+
+    def counter(self, name: str, /, **labels) -> Counter:
+        return self._get(Counter, name, labels)
+
+    def gauge(self, name: str, /, **labels) -> Gauge:
+        return self._get(Gauge, name, labels)
+
+    def histogram(self, name: str, /,
+                  buckets: Sequence[float] = SECONDS_BUCKETS,
+                  **labels) -> Histogram:
+        return self._get(Histogram, name, labels, buckets=buckets)
+
+    def value(self, name: str, /, **labels) -> float:
+        """Current value of a counter/gauge (0.0 if never touched);
+        for a histogram returns its observation count."""
+        name = _full_name(name)
+        m = self._metrics.get((name, _label_key(labels)))
+        if m is None:
+            return 0.0
+        return float(m.count if isinstance(m, Histogram) else m.value)
+
+    def total(self, name: str) -> float:
+        """Sum of a counter across all label sets."""
+        name = _full_name(name)
+        tot = 0.0
+        for (n, _), m in list(self._metrics.items()):
+            if n == name and isinstance(m, Counter):
+                tot += m.value
+        return tot
+
+    def ops(self) -> int:
+        return _OPS
+
+    def reset(self) -> None:
+        """Drop every metric (tests only — production metrics are
+        cumulative-since-boot like the reference's tick counters)."""
+        with self._lock:
+            self._metrics.clear()
+
+    # -- exposition ---------------------------------------------------
+    def snapshot(self) -> Dict[str, list]:
+        """JSON shape: {counters: [...], gauges: [...], histograms: [...]},
+        each entry {name, labels, value|...}."""
+        counters, gauges, hists = [], [], []
+        for (_, _), m in sorted(self._metrics.items(),
+                                key=lambda kv: kv[0]):
+            if isinstance(m, Counter):
+                counters.append({"name": m.name, "labels": m.labels,
+                                 "value": m.value})
+            elif isinstance(m, Gauge):
+                gauges.append({"name": m.name, "labels": m.labels,
+                               "value": m.value})
+            else:
+                hists.append({"name": m.name, "labels": m.labels,
+                              "count": m.count, "sum": m.sum,
+                              "buckets": list(zip(m.bounds,
+                                                  m.cumulative()))})
+        return {"counters": counters, "gauges": gauges,
+                "histograms": hists}
+
+    def to_prometheus(self) -> str:
+        """Prometheus text exposition format 0.0.4."""
+        def _lbl(labels: Dict[str, str], extra: str = "") -> str:
+            items = [f'{k}="{_esc(v)}"' for k, v in sorted(labels.items())]
+            if extra:
+                items.append(extra)
+            return "{" + ",".join(items) + "}" if items else ""
+
+        def _esc(v) -> str:
+            return str(v).replace("\\", r"\\").replace('"', r'\"') \
+                         .replace("\n", r"\n")
+
+        by_name: Dict[str, List[object]] = {}
+        for m in self._metrics.values():
+            by_name.setdefault(m.name, []).append(m)
+        lines: List[str] = []
+        for name in sorted(by_name):
+            ms = by_name[name]
+            kind = ("counter" if isinstance(ms[0], Counter) else
+                    "gauge" if isinstance(ms[0], Gauge) else "histogram")
+            lines.append(f"# TYPE {name} {kind}")
+            for m in ms:
+                if isinstance(m, (Counter, Gauge)):
+                    lines.append(f"{m.name}{_lbl(m.labels)} {m.value:g}")
+                else:
+                    cum = m.cumulative()
+                    for bound, c in zip(m.bounds, cum):
+                        le = 'le="%g"' % bound
+                        lines.append(f"{m.name}_bucket"
+                                     f"{_lbl(m.labels, le)} {c}")
+                    inf = 'le="+Inf"'
+                    lines.append(f"{m.name}_bucket"
+                                 f"{_lbl(m.labels, inf)} {m.count}")
+                    lines.append(f"{m.name}_sum{_lbl(m.labels)} {m.sum:g}")
+                    lines.append(f"{m.name}_count{_lbl(m.labels)} {m.count}")
+        return "\n".join(lines) + "\n"
+
+
+REGISTRY = MetricsRegistry()
+
+# module-level shorthands — the instrumentation call surface
+counter = REGISTRY.counter
+gauge = REGISTRY.gauge
+histogram = REGISTRY.histogram

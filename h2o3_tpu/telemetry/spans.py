@@ -1,0 +1,172 @@
+"""Hierarchical span tracer — where a distributed fit spends its time.
+
+Reference: the reference answers "where did the time go" with the
+TimeLine packet ring + /3/Profiler stack samples; the TPU runtime's
+time sinks are instead structured phases (job → algo.fit → boost chunk
+→ xla compile), so the primitive here is a nested span:
+
+    with span("gbm.fit"):
+        with span("gbm.chunk", trees=25):
+            ...
+
+Each span records wall time, the device-memory high-water mark at exit
+(``device.memory_stats()['peak_bytes_in_use']``, best-effort — some
+plugin backends report none), and any collective-byte estimates charged
+to it by the dispatch layer (parallel/map_reduce.py). Nesting is
+contextvar-based, so worker threads (background jobs) get their own
+root spans for free. Finished spans land in a fixed ring (the TimeLine
+capacity discipline) and feed ``span_seconds{name=}`` histograms in the
+registry; ``GET /3/Metrics`` serves both views.
+
+Timeline events recorded while a span is active carry its id
+(utils/timeline.py), tying the flat event ring to the span tree.
+"""
+
+from __future__ import annotations
+
+import contextvars
+import itertools
+import threading
+import time
+from collections import deque
+from contextlib import contextmanager
+from typing import Dict, List, Optional
+
+from h2o3_tpu.telemetry.registry import counter, histogram
+
+_CAPACITY = 1024
+_finished: deque = deque(maxlen=_CAPACITY)
+_finished_lock = threading.Lock()
+_ids = itertools.count(1)
+
+_current: contextvars.ContextVar[Optional["Span"]] = \
+    contextvars.ContextVar("h2o3tpu_span", default=None)
+
+
+class Span:
+    __slots__ = ("id", "name", "parent_id", "start", "end", "meta",
+                 "device_peak_bytes", "collective_bytes", "_token")
+
+    def __init__(self, name: str, parent_id: Optional[str], **meta):
+        self.id = f"sp-{next(_ids):08d}"
+        self.name = name
+        self.parent_id = parent_id
+        self.start = time.time()
+        self.end = 0.0
+        self.meta = meta
+        self.device_peak_bytes = 0
+        self.collective_bytes = 0.0
+        self._token = None
+
+    @property
+    def duration(self) -> float:
+        return (self.end or time.time()) - self.start
+
+    def annotate(self, **meta) -> None:
+        self.meta.update(meta)
+
+    def to_dict(self) -> Dict:
+        return {"id": self.id, "parent_id": self.parent_id,
+                "name": self.name,
+                "start_ms": int(self.start * 1000),
+                "duration_ms": round(self.duration * 1000, 3),
+                "device_peak_bytes": self.device_peak_bytes,
+                "collective_bytes": self.collective_bytes,
+                "meta": {k: v for k, v in self.meta.items()}}
+
+
+def _device_peak() -> int:
+    """Device HBM high-water, 0 when the backend reports no stats (the
+    axon plugin case — job.py documents that pressure then shows up as
+    RESOURCE_EXHAUSTED, not as this gauge)."""
+    try:
+        import jax
+        s = jax.devices()[0].memory_stats() or {}
+        return int(s.get("peak_bytes_in_use", 0) or 0)
+    except Exception:   # noqa: BLE001 - stats are strictly best-effort
+        return 0
+
+
+@contextmanager
+def span(name: str, **meta):
+    """Open a child of the current span (root if none) for the duration
+    of the with-block. Exceptions propagate; the span still closes."""
+    parent = _current.get()
+    sp = Span(name, parent.id if parent is not None else None, **meta)
+    sp._token = _current.set(sp)
+    try:
+        yield sp
+    finally:
+        _current.reset(sp._token)
+        sp.end = time.time()
+        sp.device_peak_bytes = _device_peak()
+        if parent is not None:
+            # charge child collective traffic up the tree so a root job
+            # span totals its whole subtree
+            parent.collective_bytes += sp.collective_bytes
+        with _finished_lock:
+            _finished.append(sp)
+        counter("spans_total", name=name).inc()
+        histogram("span_seconds", name=name).observe(sp.end - sp.start)
+        from h2o3_tpu.utils.timeline import record as _tl
+        _tl("span", f"{name} {sp.duration * 1000:.1f}ms",
+            span_id=sp.id, parent_id=sp.parent_id)
+
+
+def current_span() -> Optional[Span]:
+    return _current.get()
+
+
+def current_span_id() -> Optional[str]:
+    sp = _current.get()
+    return sp.id if sp is not None else None
+
+
+def add_collective_bytes(n: float) -> None:
+    """Charge an estimated collective payload to the active span."""
+    sp = _current.get()
+    if sp is not None:
+        sp.collective_bytes += n
+
+
+def annotate(**meta) -> None:
+    """Attach metadata to the active span (no-op without one)."""
+    sp = _current.get()
+    if sp is not None:
+        sp.meta.update(meta)
+
+
+def snapshot(last: int = 100) -> List[Dict]:
+    """Most recent finished spans, oldest first."""
+    with _finished_lock:
+        evs = list(_finished)
+    return [s.to_dict() for s in evs[-max(int(last), 0):]]
+
+
+def aggregate() -> List[Dict]:
+    """Per-name rollup of the finished ring (the /3/Profiler span view):
+    count, total/mean wall ms, max device peak."""
+    with _finished_lock:
+        evs = list(_finished)
+    agg: Dict[str, Dict] = {}
+    for s in evs:
+        a = agg.setdefault(s.name, {"name": s.name, "count": 0,
+                                    "total_ms": 0.0,
+                                    "device_peak_bytes": 0,
+                                    "collective_bytes": 0.0})
+        a["count"] += 1
+        a["total_ms"] += s.duration * 1000
+        a["device_peak_bytes"] = max(a["device_peak_bytes"],
+                                     s.device_peak_bytes)
+        a["collective_bytes"] += s.collective_bytes
+    out = sorted(agg.values(), key=lambda a: -a["total_ms"])
+    for a in out:
+        a["total_ms"] = round(a["total_ms"], 3)
+        a["mean_ms"] = round(a["total_ms"] / max(a["count"], 1), 3)
+    return out
+
+
+def clear() -> None:
+    """Tests only."""
+    with _finished_lock:
+        _finished.clear()

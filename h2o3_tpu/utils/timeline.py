@@ -23,8 +23,19 @@ _seq = 0
 
 
 def record(kind: str, what: str, **info) -> None:
-    """Append one event (TimeLine.record_IOclose-style cheap append)."""
+    """Append one event (TimeLine.record_IOclose-style cheap append).
+
+    Events recorded while a telemetry span is active carry its id, so
+    the flat ring can be joined against the span tree (/3/Metrics)."""
     global _seq
+    if "span_id" not in info:
+        try:
+            from h2o3_tpu.telemetry.spans import current_span_id
+            sid = current_span_id()
+            if sid is not None:
+                info["span_id"] = sid
+        except Exception:   # noqa: BLE001 - the ring must never fail
+            pass
     with _lock:
         _seq += 1
         _events.append({"seq": _seq, "ts_ms": int(time.time() * 1000),
