@@ -2,16 +2,26 @@
 
     python bench.py            # all five configs under the time budget
     python bench.py gbm        # one config by substring
+    python bench.py --one gbm  # run one config in-process (child mode)
+    python bench.py --probe    # backend liveness probe (child mode)
     H2O3TPU_BENCH_FAST=1       # scaled-down shapes (CI smoke)
     H2O3TPU_BENCH_BUDGET_S=N   # wallclock budget (default 1500s)
     H2O3TPU_BENCH_FULL=1       # force the 50M-row GBM escalation
+    H2O3TPU_BENCH_CONFIG_TIMEOUT_S=N  # per-config hard cap override
 
 Structure (round-3 contract): the flagship GBM line is emitted FIRST at
 a scale that finishes in minutes; every other config is bounded; the
 50M-row GBM escalation runs LAST and only if the remaining budget
-allows. One bounded retry per config on infra-class errors (transient
-remote_compile/INTERNAL failures of the tunneled chip must not zero the
-scoreboard — round-2 lesson, BENCH_r02 rc=124).
+allows.
+
+Fault tolerance (round-5 lesson — BENCH_r05 banked ZERO lines when the
+first device_put hit a wedged TPU worker and the in-place retry hit the
+corpse again until the budget went to -22s): the parent process never
+touches the backend. Each config runs in a FRESH CHILD process with a
+hard per-config timeout, preceded by a backend liveness probe
+(core/watchdog.py probe, itself a subprocess) under the shared
+bounded-backoff retry policy. A wedged worker therefore costs one
+config line, not the scoreboard, and the budget is clamped at zero.
 
 Configs (BASELINE.json):
   1. gbm      GBM binomial 100 trees depth 6, airlines schema 5M rows
@@ -39,17 +49,26 @@ import time
 import numpy as np
 
 FAST = os.environ.get("H2O3TPU_BENCH_FAST") == "1"
+# stub mode (tests): tiny stdlib-only configs exercise the parent
+# harness — subprocess isolation, timeouts, probes, budget clamping —
+# without booting a backend (tests/test_bench_harness.py)
+STUB = os.environ.get("H2O3TPU_BENCH_STUB") == "1"
 BUDGET_S = float(os.environ.get("H2O3TPU_BENCH_BUDGET_S", "1500"))
 _T0 = time.time()
 
 # infra-class error signatures: transient failures of the compile
-# service / tunneled chip, NOT user errors — retried once per config
+# service / tunneled chip, NOT user errors (superset of
+# watchdog.INFRA_SIGNS — kept inline so the parent can classify a
+# child's stderr without importing anything heavy)
 _INFRA_SIGNS = ("remote_compile", "INTERNAL", "UNAVAILABLE",
                 "DEADLINE_EXCEEDED", "RESOURCE_EXHAUSTED: Attempting")
 
 
 def _remaining() -> float:
-    return BUDGET_S - (time.time() - _T0)
+    """Wallclock budget left, clamped at zero: a config that overruns
+    its estimate must not drive the recorded budget negative (the
+    round-5 scoreboard showed -22s)."""
+    return max(0.0, BUDGET_S - (time.time() - _T0))
 
 
 # ---------------------------------------------------------------- helpers
@@ -439,23 +458,141 @@ CONFIGS = [("gbm", bench_gbm), ("glm", bench_glm), ("dl", bench_dl),
 _MIN_NEED = {"gbm": 60, "glm": 90, "dl": 60, "xgb": 60, "sort": 60,
              "automl": 180, "gbm-full": 600}
 
+# hard per-config wallclock cap (child process killed past it): a
+# wedged worker costs one line, never the scoreboard
+_HARD_CAP = {"gbm": 900, "glm": 600, "dl": 600, "xgb": 600, "sort": 400,
+             "automl": 900, "gbm-full": 1200}
 
-def _run_once(name, fn):
+
+def _stub_ok(name):
+    def _fn():
+        _emit(f"stub config {name}", 1.0, "units", 1.0, "stub")
+    return _fn
+
+
+def _stub_wedge():
+    # a wedged backend: the child accepts work and never finishes
+    time.sleep(3600)
+
+
+if STUB:
+    CONFIGS = [("stub_a", _stub_ok("stub_a")),
+               ("stub_wedge", _stub_wedge),
+               ("stub_b", _stub_ok("stub_b"))]
+    _MIN_NEED = {n: 1 for n, _ in CONFIGS}
+    _HARD_CAP = {n: 30 for n, _ in CONFIGS}
+
+
+def _hard_cap(name) -> float:
+    env = float(os.environ.get("H2O3TPU_BENCH_CONFIG_TIMEOUT_S", "0") or 0)
+    return env or float(_HARD_CAP.get(name, 600))
+
+
+# ---------------------------------------------------------- child modes
+
+
+def _child_one(name: str) -> int:
+    """Run exactly one config in THIS process (spawned by the parent).
+    Metric lines go to stdout; failures leave a classified traceback on
+    stderr for the parent and exit nonzero."""
+    fn = dict(CONFIGS)[name]
+    if not STUB:
+        import h2o3_tpu
+        h2o3_tpu.init()
     try:
         fn()
-        return None
-    except Exception as e:   # noqa: BLE001
-        return e
+        return 0
+    except Exception as e:   # noqa: BLE001 - child boundary
+        import traceback
+        traceback.print_exc(file=sys.stderr)
+        print(f"# child-error {name}: {e!r}"[:300], file=sys.stderr)
+        return 1
+
+
+def _child_probe() -> int:
+    """Backend liveness probe in a fresh process (core/watchdog.py):
+    jax.devices() + a tiny device_put round-trip. In stub mode only the
+    fault-injection hook runs — the harness under test, not the chip."""
+    from h2o3_tpu.core import watchdog
+    try:
+        if STUB:
+            watchdog.maybe_fail("probe")
+        else:
+            rt = watchdog.probe_backend()
+            print(f"# probe ok ({rt:.2f}s)", file=sys.stderr)
+        return 0
+    except Exception as e:   # noqa: BLE001 - child boundary
+        print(f"# probe failed: {e!r}"[:300], file=sys.stderr)
+        return 1
+
+
+# --------------------------------------------------------------- parent
+
+
+def _spawn(args, timeout_s, extra_env=None):
+    """Run a child; returns (rc, stdout, stderr_tail). rc=124 on
+    timeout (child and its process group killed)."""
+    import subprocess
+    env = dict(os.environ)
+    env.update(extra_env or {})
+    try:
+        p = subprocess.run([sys.executable, os.path.abspath(__file__)]
+                           + args, env=env, capture_output=True,
+                           text=True, timeout=timeout_s)
+        return p.returncode, p.stdout, p.stderr[-2000:]
+    except subprocess.TimeoutExpired as e:
+        out = e.stdout or b""
+        if isinstance(out, bytes):
+            out = out.decode(errors="replace")
+        return 124, out, f"timeout after {timeout_s:.0f}s (child killed)"
+
+
+def _passthrough(stdout: str) -> int:
+    """Re-emit the child's metric lines from the parent (the driver
+    tails PARENT stdout; the tail-proof summary needs them recorded
+    here). Returns how many metric lines came through."""
+    n = 0
+    for ln in stdout.splitlines():
+        ln = ln.strip()
+        if ln.startswith("{"):
+            try:
+                _emit_raw(json.loads(ln))
+                n += 1
+                continue
+            except ValueError:
+                pass
+        if ln:
+            print(ln, flush=True)
+    return n
+
+
+def _preflight(name: str, policy) -> bool:
+    """Probe the backend from a fresh process under the shared retry
+    policy. False = backend dead after bounded backoff — fail fast on
+    this config instead of feeding it to a corpse."""
+    for attempt in range(1, policy.max_attempts + 1):
+        budget = min(_hard_cap(name), max(_remaining(), 5.0)) + 30.0
+        rc, _, err = _spawn(["--probe"], timeout_s=budget)
+        if rc == 0:
+            return True
+        print(f"# preflight {name}: probe attempt {attempt}/"
+              f"{policy.max_attempts} failed: {err.strip()[-200:]}",
+              file=sys.stderr)
+        if attempt < policy.max_attempts and _remaining() > 0:
+            time.sleep(policy.delay(attempt))
+    return False
 
 
 def main():
     import atexit
     atexit.register(_print_summary)
-    import h2o3_tpu
-    h2o3_tpu.init()
+    # policy only — the parent must NEVER touch the backend itself (a
+    # wedged chip would take the whole scoreboard down with it)
+    from h2o3_tpu.core import watchdog
+    policy = watchdog.policy_from_config()
     filt = sys.argv[1] if len(sys.argv) > 1 else ""
     force_full = os.environ.get("H2O3TPU_BENCH_FULL") == "1"
-    for name, fn in CONFIGS:
+    for name, _fn in CONFIGS:
         if filt:
             # explicit selection: substring match, except the escalation
             # config which must be named exactly ("gbm" must not also
@@ -474,31 +611,55 @@ def main():
             _emit_raw({"metric": name,
                        "skipped": f"budget ({_remaining():.0f}s left)"})
             continue
-        err = _run_once(name, fn)
-        if err is not None and any(s in repr(err) for s in _INFRA_SIGNS) \
-                and _remaining() > _MIN_NEED.get(name, 60):
-            if "UNAVAILABLE" in repr(err):
-                # "TPU worker process crashed": the tunnel worker needs
-                # time to restart — an immediate retry hits the corpse
-                print("# waiting 60s for TPU worker recovery",
-                      file=sys.stderr)
-                time.sleep(60)
-            if _remaining() > _MIN_NEED.get(name, 60):
-                print(f"# retrying {name} after infra error: "
-                      f"{err!r}"[:300], file=sys.stderr)
-                err = _run_once(name, fn)
-        if err is not None:
-            import traceback
-            traceback.print_exception(type(err), err, err.__traceback__,
-                                      file=sys.stderr)
-            _emit_raw({"metric": name, "error": repr(err)[:300]})
-        # free HBM between configs — each one builds its own frames
-        import gc
-        from h2o3_tpu.core.kv import DKV
-        DKV.clear()
-        gc.collect()
+        for attempt in range(1, policy.max_attempts + 1):
+            if not _preflight(name, policy):
+                _emit_raw({"metric": name,
+                           "error": "backend dead (pre-flight probe "
+                                    "failed after bounded backoff)"})
+                break
+            cap = min(_hard_cap(name), max(_remaining(), 10.0))
+            rc, out, err = _spawn(
+                ["--one", name], timeout_s=cap,
+                # child budget = what is left HERE, so in-config caps
+                # (automl max_runtime_secs) see the parent's clock
+                extra_env={"H2O3TPU_BENCH_BUDGET_S":
+                           f"{max(_remaining(), 10.0):.0f}"})
+            emitted = _passthrough(out)
+            if rc == 0:
+                if err.strip():     # child progress notes (ingest etc.)
+                    sys.stderr.write(err if err.endswith("\n")
+                                     else err + "\n")
+                break
+            if rc == 124:
+                _emit_raw({"metric": name,
+                           "error": f"wedged: killed after {cap:.0f}s "
+                                    f"hard cap ({emitted} lines emitted)"})
+                break   # a kill is a wedge, not a blip: don't re-feed it
+            infra = any(s in err for s in _INFRA_SIGNS)
+            if (not infra or attempt >= policy.max_attempts
+                    or _remaining() < _MIN_NEED.get(name, 60)):
+                sys.stderr.write(err + "\n")
+                _emit_raw({"metric": name,
+                           "error": err.strip().splitlines()[-1][:300]
+                           if err.strip() else f"child rc={rc}"})
+                break
+            d = policy.delay(attempt)
+            print(f"# retrying {name} after infra error in {d:.0f}s "
+                  f"(attempt {attempt}/{policy.max_attempts})",
+                  file=sys.stderr)
+            time.sleep(d)
+    # left_s is clamped ≥ 0 (used_s stays honest about any overrun)
+    _emit_raw({"metric": "budget",
+               "budget_s": round(BUDGET_S, 1),
+               "used_s": round(time.time() - _T0, 1),
+               "left_s": round(_remaining(), 1)})
     _print_summary()
 
 
 if __name__ == "__main__":
-    main()
+    if len(sys.argv) > 1 and sys.argv[1] == "--one":
+        sys.exit(_child_one(sys.argv[2]))
+    elif len(sys.argv) > 1 and sys.argv[1] == "--probe":
+        sys.exit(_child_probe())
+    else:
+        main()

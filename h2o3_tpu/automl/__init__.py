@@ -16,14 +16,14 @@ enforcement in automl/executor.py.
 
 from __future__ import annotations
 
+import os
 import time
 from typing import List, Optional, Sequence
 
-from h2o3_tpu.automl.executor import Budget, train_capped
-from h2o3_tpu.automl.steps import Step, modeling_plan
+from h2o3_tpu.automl.executor import Budget, run_step, train_capped
+from h2o3_tpu.automl.steps import modeling_plan
 from h2o3_tpu.frame.frame import Frame
 from h2o3_tpu.ml.ensemble import StackedEnsembleEstimator
-from h2o3_tpu.ml.grid import GridSearch
 from h2o3_tpu.ml.leaderboard import Leaderboard
 from h2o3_tpu.models import get_builder
 from h2o3_tpu.utils.log import get_logger
@@ -49,7 +49,8 @@ class H2OAutoML:
                  keep_cross_validation_predictions: bool = True,
                  verbosity: str = "warn", balance_classes: bool = False,
                  max_runtime_secs_per_model: float = 0.0,
-                 preprocessing: Optional[Sequence[str]] = None):
+                 preprocessing: Optional[Sequence[str]] = None,
+                 recovery_dir: Optional[str] = None):
         self.max_models = int(max_models)
         self.max_runtime_secs = float(max_runtime_secs)
         self.seed = int(seed) if int(seed) >= 0 else 5723
@@ -68,6 +69,18 @@ class H2OAutoML:
         self.max_runtime_secs_per_model = float(max_runtime_secs_per_model)
         self.preprocessing = list(preprocessing or [])
         self.event_log: List[dict] = []
+        # hex/faulttolerance/Recovery.java role for AutoML: when set,
+        # every trained model + per-step walk state snapshot to this dir
+        # so resume_automl() can continue after a crash (core/recovery.py)
+        self.recovery_dir = recovery_dir
+        self._recovery = None
+        if recovery_dir:
+            from h2o3_tpu.core.recovery import Recovery
+            self._recovery = Recovery(recovery_dir,
+                                      state_name="automl_state")
+        self._skip_steps: set = set()       # step ids done pre-crash
+        self._prior_models: List = []       # models restored on resume
+        self._step_models: dict = {}        # step id -> snapshot files
         if balance_classes:
             log.warning("balance_classes is not implemented; ignoring")
 
@@ -134,54 +147,50 @@ class H2OAutoML:
         return train_capped(get_builder("gbm")(**params),
                             training_frame, y, x, budget)
 
-    def _run_step(self, step: Step, budget: Budget, training_frame: Frame,
-                  y: str, x) -> List:
-        """Execute one modeling step; returns the trained models.
-        Runs on a worker thread — a budget SLOT is reserved up front
-        (try_start) so parallel siblings cannot all pass the exhausted
-        check and overshoot max_models; only the caller touches the
-        leaderboard."""
-        if not budget.try_start():
-            return []
-        trained_count = 0
-        try:
-            if step.kind == "exploitation":
-                m = self._lr_annealing_step(budget, training_frame, y, x)
-                if m is None:
-                    return []
-                m.output["automl_step"] = step.id
-                trained_count = 1
-                return [m]
-            cls = get_builder(step.algo)
-            if step.kind == "grid":
-                remaining = budget.remaining_models()
-                rem_s = budget.remaining_secs()
-                gs = GridSearch(
-                    cls, step.hyper,
-                    search_criteria={
-                        "strategy": "RandomDiscrete",
-                        "max_models": min(remaining, step.grid_models),
-                        "max_runtime_secs": rem_s or 0,
-                        "seed": self.seed},
-                    **{**step.params, "nfolds": self.nfolds})
-                grid = gs.train(training_frame, y=y, x=x)
-                for m in grid.models:
-                    m.output["automl_step"] = step.id
-                trained_count = len(grid.models)
-                return list(grid.models)
-            params = {**step.params, "nfolds": self.nfolds}
-            if "stopping_rounds" in getattr(cls, "DEFAULTS", {}):
-                params.setdefault("stopping_rounds", self.stopping_rounds)
-                params.setdefault("stopping_tolerance",
-                                  self.stopping_tolerance)
-            params = {k: v for k, v in params.items()
-                      if k in cls.accepted_params()}
-            m = train_capped(cls(**params), training_frame, y, x, budget)
-            m.output["automl_step"] = step.id
-            trained_count = 1
-            return [m]
-        finally:
-            budget.finish(trained_count)
+    # -- fault tolerance (core/recovery.py; resume_automl below) -------
+    def _recovery_params(self) -> dict:
+        """Ctor kwargs, JSON-shaped, sufficient to rebuild this run."""
+        return {
+            "max_models": self.max_models,
+            "max_runtime_secs": self.max_runtime_secs,
+            "seed": self.seed,
+            "nfolds": self.nfolds,
+            "project_name": self.project_name,
+            "sort_metric": self.sort_metric,
+            "include_algos": sorted(self.include) if self.include else None,
+            "exclude_algos": sorted(self.exclude) or None,
+            "stopping_rounds": self.stopping_rounds,
+            "stopping_tolerance": self.stopping_tolerance,
+            "max_runtime_secs_per_model": self.max_runtime_secs_per_model,
+            "preprocessing": self.preprocessing or None,
+        }
+
+    def _snapshot_state(self, y: str, x) -> None:
+        self._recovery.write_state({
+            "params": self._recovery_params(),
+            "y": y, "x": list(x) if x else None,
+            "done_steps": sorted(self._skip_steps),
+            "models": self._step_models,
+        })
+
+    def _on_step_done(self, step_id: str, models: List, y: str, x) -> None:
+        """Persist leaderboard membership + step completion after every
+        trained model reaches the leaderboard (Recovery.onModel role).
+        Grid steps already snapshotted per-model into their nested dir;
+        everything else snapshots here."""
+        if self._recovery is None:
+            return
+        files = []
+        for m in models:
+            fname = f"{m.key}.bin"
+            if os.path.exists(os.path.join(self._recovery.dir,
+                                           step_id, fname)):
+                files.append(f"{step_id}/{fname}")   # grid snapshot
+            else:
+                files.append(self._recovery.save_model(m))
+        self._step_models[step_id] = files
+        self._skip_steps.add(step_id)
+        self._snapshot_state(y, x)
 
     def train(self, y: str, training_frame: Frame,
               x: Optional[Sequence[str]] = None,
@@ -190,9 +199,21 @@ class H2OAutoML:
         t0 = time.time()
         budget = Budget(self.max_models, self.max_runtime_secs,
                        self.max_runtime_secs_per_model)
+        if self._prior_models:
+            # resumed run: restored models count toward max_models —
+            # the budget must not re-spend what the dead process trained
+            budget.add_trained(len(self._prior_models))
         plan = modeling_plan(self.seed, include=self.include,
                              exclude=self.exclude)
         self._log_event("init", f"plan: {[st.id for st in plan]}")
+        if self._skip_steps:
+            self._log_event(
+                "resume", f"skipping {sorted(self._skip_steps)} "
+                f"({len(self._prior_models)} models restored)")
+        if self._recovery is not None:
+            # state exists from minute zero: a kill before the first
+            # model still leaves a resumable run
+            self._snapshot_state(y, x)
         training_frame, te_model = self._maybe_target_encode(
             training_frame, y, x)
         self._te_model = te_model
@@ -226,9 +247,10 @@ class H2OAutoML:
                 self._log_event("budget", "budget exhausted; stopping plan")
                 break
             steps_g = [s for s in plan
-                       if s.group == g and s.kind != "ensemble"]
+                       if s.group == g and s.kind != "ensemble"
+                       and s.id not in self._skip_steps]
             with ThreadPoolExecutor(max_workers=par) as ex:
-                futs = {ex.submit(self._run_step, s, budget,
+                futs = {ex.submit(run_step, self, s, budget,
                                   training_frame, y, x): s
                         for s in steps_g}
                 for fut in as_completed(futs):
@@ -245,37 +267,45 @@ class H2OAutoML:
                         continue
                     trained.extend(models)
                     self.leaderboard_obj.add(*models)
+                    self._on_step_done(step.id, models, y, x)
                     self._log_event(
                         "model",
                         f"{step.id} done ({budget.trained} models, "
                         f"{time.time() - t0:.0f}s)")
 
         # stacked ensembles last (StackedEnsembleStepsProvider):
-        # best-of-family + all-models
-        with_cv = [m for m in trained
+        # best-of-family + all-models. Resumed models participate — CV
+        # holdouts ride the binary snapshots (persist pickles them).
+        with_cv = [m for m in self._prior_models + trained
                    if getattr(m, "_cv_holdout", None) is not None]
         best_of_family = {}
         if self._allowed("stackedensemble") and len(with_cv) >= 2:
             for m in self.leaderboard_obj.sorted_models():
                 if m in with_cv and m.algo not in best_of_family:
                     best_of_family[m.algo] = m
-            if len(best_of_family) >= 2:
+            if (len(best_of_family) >= 2 and
+                    "StackedEnsemble_BestOfFamily" not in self._skip_steps):
                 try:
                     se = StackedEnsembleEstimator(
                         base_models=list(best_of_family.values())).train(
                         training_frame, y=y, x=x)
                     se.output["automl_step"] = "StackedEnsemble_BestOfFamily"
                     self.leaderboard_obj.add(se)
+                    self._on_step_done("StackedEnsemble_BestOfFamily",
+                                       [se], y, x)
                 except Exception as e:
                     self._log_event("error",
                                     f"best-of-family ensemble failed: {e}")
-            if len(with_cv) > max(2, len(best_of_family)):
+            if (len(with_cv) > max(2, len(best_of_family)) and
+                    "StackedEnsemble_AllModels" not in self._skip_steps):
                 try:
                     se2 = StackedEnsembleEstimator(
                         base_models=with_cv[:10]).train(
                         training_frame, y=y, x=x)
                     se2.output["automl_step"] = "StackedEnsemble_AllModels"
                     self.leaderboard_obj.add(se2)
+                    self._on_step_done("StackedEnsemble_AllModels",
+                                       [se2], y, x)
                 except Exception as e:
                     self._log_event("error",
                                     f"all-models ensemble failed: {e}")
@@ -285,3 +315,36 @@ class H2OAutoML:
                         f"{time.time() - t0:.0f}s; leader="
                         f"{self.leader.key if self.leader else None}")
         return self.leader
+
+
+def resume_automl(recovery_dir: str, training_frame: Frame,
+                  validation_frame: Optional[Frame] = None,
+                  leaderboard_frame: Optional[Frame] = None) -> H2OAutoML:
+    """Resume an AutoML run killed mid-plan from its recovery snapshots
+    (hex/faulttolerance/Recovery.onDone re-run path, AutoML flavor).
+
+    Rebuilds the leaderboard from the persisted model binaries, marks the
+    completed steps done so no step retrains twice, and continues the
+    modeling plan from the next step. The wallclock budget restarts (the
+    dead process's elapsed time is unknowable and usually irrelevant
+    after a restart); ``max_models`` counts restored models. Returns the
+    resumed :class:`H2OAutoML` with a complete leaderboard."""
+    from h2o3_tpu.core.recovery import Recovery
+    state = Recovery(recovery_dir, state_name="automl_state").read_state()
+    if state is None:
+        raise FileNotFoundError(
+            f"no automl_state.json under {recovery_dir}")
+    aml = H2OAutoML(recovery_dir=recovery_dir, **state["params"])
+    rec = aml._recovery
+    files = [f for fs in state["models"].values() for f in fs]
+    prior = rec.load_models(files)
+    aml._prior_models = prior
+    aml._skip_steps = set(state["done_steps"])
+    aml._step_models = dict(state["models"])
+    aml.leaderboard_obj.add(*prior)
+    aml._log_event("resume", f"restored {len(prior)} models, "
+                   f"{len(aml._skip_steps)} steps done")
+    aml.train(y=state["y"], training_frame=training_frame,
+              x=state["x"], validation_frame=validation_frame,
+              leaderboard_frame=leaderboard_frame)
+    return aml

@@ -1,4 +1,5 @@
-"""AutoML step executor — budget accounting + per-model runtime caps.
+"""AutoML step executor — budget accounting + per-model runtime caps +
+step execution (with crash recovery).
 
 Reference: ai/h2o/automl/ModelingStepsExecutor (driven from
 AutoML.java:760 learn) — runs each ModelingStep under the global
@@ -7,10 +8,16 @@ max_runtime_secs_per_model enforced by cancelling the model's Job when
 the cap expires (the reference passes the cap into
 Model.Parameters._max_runtime_secs; here a watchdog cancels the Job,
 which every builder honours at its next progress checkpoint).
+
+``run_step`` executes one modeling step; when the owning AutoML run has
+a ``recovery_dir``, grid steps snapshot per-model into a nested
+recovery dir (core/recovery.py) and resume their own partial walks, so
+a kill mid-grid costs at most the model in flight.
 """
 
 from __future__ import annotations
 
+import os
 import threading
 import time
 from typing import List, Optional
@@ -119,3 +126,66 @@ def train_capped(builder, frame, y, x, budget: Budget):
     if job.status != "DONE":
         raise RuntimeError(job.exception or f"job {job.status}")
     return job.result
+
+
+def run_step(aml, step, budget: Budget, training_frame, y, x) -> List:
+    """Execute one modeling step; returns the trained models
+    (ModelingStepsExecutor.submit role, moved from H2OAutoML._run_step).
+
+    Runs on a worker thread — a budget SLOT is reserved up front
+    (try_start) so parallel siblings cannot all pass the exhausted
+    check and overshoot max_models; only the caller touches the
+    leaderboard."""
+    from h2o3_tpu.ml.grid import GridSearch, resume_grid
+    from h2o3_tpu.models import get_builder
+    if not budget.try_start():
+        return []
+    trained_count = 0
+    try:
+        if step.kind == "exploitation":
+            m = aml._lr_annealing_step(budget, training_frame, y, x)
+            if m is None:
+                return []
+            m.output["automl_step"] = step.id
+            trained_count = 1
+            return [m]
+        cls = get_builder(step.algo)
+        if step.kind == "grid":
+            sub_dir = None
+            if aml._recovery is not None:
+                sub_dir = os.path.join(aml._recovery.dir, step.id)
+            if sub_dir and os.path.exists(
+                    os.path.join(sub_dir, "grid_state.json")):
+                # the previous process died inside this grid walk: its
+                # per-combo snapshots resume here — only the combo in
+                # flight at the kill retrains
+                grid = resume_grid(sub_dir, training_frame)
+            else:
+                remaining = budget.remaining_models()
+                rem_s = budget.remaining_secs()
+                gs = GridSearch(
+                    cls, step.hyper,
+                    search_criteria={
+                        "strategy": "RandomDiscrete",
+                        "max_models": min(remaining, step.grid_models),
+                        "max_runtime_secs": rem_s or 0,
+                        "seed": aml.seed},
+                    recovery_dir=sub_dir,
+                    **{**step.params, "nfolds": aml.nfolds})
+                grid = gs.train(training_frame, y=y, x=x)
+            for m in grid.models:
+                m.output["automl_step"] = step.id
+            trained_count = len(grid.models)
+            return list(grid.models)
+        params = {**step.params, "nfolds": aml.nfolds}
+        if "stopping_rounds" in getattr(cls, "DEFAULTS", {}):
+            params.setdefault("stopping_rounds", aml.stopping_rounds)
+            params.setdefault("stopping_tolerance", aml.stopping_tolerance)
+        params = {k: v for k, v in params.items()
+                  if k in cls.accepted_params()}
+        m = train_capped(cls(**params), training_frame, y, x, budget)
+        m.output["automl_step"] = step.id
+        trained_count = 1
+        return [m]
+    finally:
+        budget.finish(trained_count)

@@ -31,12 +31,22 @@ class Config:
     # default number of histogram bins (reference nbins, hex/tree/DHistogram.java)
     nbins: int = 64
     ice_root: str = "/tmp/h2o3_tpu"   # spill/checkpoint dir (-ice_root)
+    # -- fault tolerance (core/watchdog.py shared retry policy) --------
+    # total attempts for infra-class errors (1 = no retry); the analogue
+    # of the reference's sys.ai.h2o.* retry properties
+    infra_max_attempts: int = 3
+    infra_backoff_base_s: float = 0.5   # first retry delay (doubles)
+    infra_backoff_max_s: float = 30.0   # backoff ceiling
+    # backend liveness probe deadline; 0 = unbounded (probe_backend)
+    probe_timeout_s: float = 60.0
 
     # fields that parse as int from the environment (annotations are
     # strings under `from __future__ import annotations`, so resolve
     # by hand)
     _INT_FIELDS = frozenset({"port", "nthreads", "data_axis", "model_axis",
-                             "block_rows", "nbins"})
+                             "block_rows", "nbins", "infra_max_attempts"})
+    _FLOAT_FIELDS = frozenset({"infra_backoff_base_s", "infra_backoff_max_s",
+                               "probe_timeout_s"})
 
     @staticmethod
     def from_env(**overrides) -> "Config":
@@ -44,8 +54,13 @@ class Config:
         for f in dataclasses.fields(Config):
             env = os.environ.get("H2O3TPU_" + f.name.upper())
             if env is not None:
-                setattr(cfg, f.name,
-                        int(env) if f.name in Config._INT_FIELDS else env)
+                if f.name in Config._INT_FIELDS:
+                    val = int(env)
+                elif f.name in Config._FLOAT_FIELDS:
+                    val = float(env)
+                else:
+                    val = env
+                setattr(cfg, f.name, val)
         for k, v in overrides.items():
             if v is not None and hasattr(cfg, k):
                 setattr(cfg, k, v)

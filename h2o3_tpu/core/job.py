@@ -15,7 +15,9 @@ import time
 import traceback
 from typing import Any, Callable, Optional
 
+from h2o3_tpu.core import watchdog
 from h2o3_tpu.core.kv import DKV, make_key
+from h2o3_tpu.core.watchdog import is_infra_error  # noqa: F401 - re-export
 from h2o3_tpu.utils.log import get_logger
 
 log = get_logger("h2o3_tpu.job")
@@ -23,25 +25,9 @@ log = get_logger("h2o3_tpu.job")
 CREATED, RUNNING, DONE, FAILED, CANCELLED = (
     "CREATED", "RUNNING", "DONE", "FAILED", "CANCELLED")
 
-# transient infra failures of the tunneled chip / compile service —
-# distinct from user errors and worth exactly one in-place retry (a
-# remote_compile INTERNAL blip permanently failed an AutoML step in
-# round 2's bench run). RESOURCE_EXHAUSTED is retryable because the
-# retry is preceded by a jit-cache purge (see free_device_memory): the
-# executable cache pins HBM and the axon plugin reports no memory
-# stats, so pressure shows up as this error, not as a readable gauge.
-_INFRA_SIGNS = ("remote_compile", "INTERNAL:", "UNAVAILABLE:",
-                "DEADLINE_EXCEEDED", "RESOURCE_EXHAUSTED")
-
-
-def is_infra_error(e: BaseException) -> bool:
-    """True for retryable infra-class errors (XlaRuntimeError INTERNAL /
-    remote_compile / UNAVAILABLE), False for user/programming errors."""
-    if isinstance(e, (ValueError, TypeError, KeyError,
-                      JobCancelledException)):
-        return False
-    msg = f"{type(e).__name__}: {e}"
-    return any(s in msg for s in _INFRA_SIGNS)
+# classification + retry policy live in core/watchdog.py (shared with
+# bench.py and the probe); kept as an alias for existing importers
+_INFRA_SIGNS = watchdog.INFRA_SIGNS
 
 
 def free_device_memory(reason: str = "") -> None:
@@ -60,6 +46,10 @@ def free_device_memory(reason: str = "") -> None:
 
 class JobCancelledException(Exception):
     pass
+
+
+# cancellation is a user decision, never a retryable infra blip
+watchdog.NON_RETRYABLE.append(JobCancelledException)
 
 
 class Job:
@@ -92,25 +82,38 @@ class Job:
 
         def _body():
             try:
-                try:
-                    self.result = fn(self)
-                except Exception as e:  # noqa: BLE001
-                    # one bounded retry for infra-class errors only —
-                    # the work restarts from scratch (model builds are
-                    # idempotent; progress just re-accumulates)
-                    if not (is_infra_error(e)
-                            and not self._cancel_requested.is_set()):
-                        raise
-                    log.warning("job %s: retrying after infra error: %s",
-                                self.key, e)
-                    _tl("job", f"infra-retry {self.description}",
-                        key=self.key, error=str(e)[:200])
-                    if "RESOURCE_EXHAUSTED" in f"{e}":
-                        # HBM pressure: purge executable caches before
-                        # the retry or it just exhausts again
-                        free_device_memory("RESOURCE_EXHAUSTED retry")
-                    self._worked = 0.0
-                    self.result = fn(self)
+                # bounded retries for infra-class errors only, under the
+                # shared watchdog policy (backoff + jitter, attempts from
+                # core/config.py). The work restarts from scratch — model
+                # builds are idempotent; progress just re-accumulates.
+                policy = watchdog.policy_from_config()
+                attempt = 0
+                while True:
+                    attempt += 1
+                    try:
+                        watchdog.maybe_fail("job")
+                        self.result = fn(self)
+                        break
+                    except Exception as e:  # noqa: BLE001
+                        if (attempt >= policy.max_attempts
+                                or not is_infra_error(e)
+                                or self._cancel_requested.is_set()):
+                            raise
+                        delay = policy.delay(attempt)
+                        log.warning("job %s: retrying after infra error "
+                                    "in %.1fs (attempt %d/%d): %s",
+                                    self.key, delay, attempt,
+                                    policy.max_attempts, e)
+                        _tl("job", f"infra-retry {self.description}",
+                            key=self.key, error=str(e)[:200])
+                        telemetry.counter("infra_retries_total",
+                                          site="job").inc()
+                        if "RESOURCE_EXHAUSTED" in f"{e}":
+                            # HBM pressure: purge executable caches
+                            # before the retry or it just exhausts again
+                            free_device_memory("RESOURCE_EXHAUSTED retry")
+                        self._worked = 0.0
+                        policy.sleep(delay)
                 if self.dest and self.result is not None:
                     DKV.put(self.dest, self.result)
                 self.status = DONE

@@ -1,0 +1,102 @@
+"""Recovery — crash-survivable snapshot/resume state for long walks.
+
+Reference: hex/faulttolerance/Recovery.java:21-45 — when a Grid or
+AutoML run is started with a recovery directory, every trained model
+and the walk state are persisted there so a fresh cluster can pick the
+work up after a node dies. Here the same contract backs both
+ml/grid.py (per-combo snapshots, resume_grid) and automl
+(per-step snapshots, resume_automl in automl/__init__.py).
+
+On-disk layout under ``recovery_dir``::
+
+    <state name>.json      walk state (atomic: tmp + rename)
+    <model key>.bin        one binary snapshot per trained model
+    <step id>/             nested Recovery of a grid step (AutoML)
+
+State writes are atomic (write-to-tmp + ``os.rename``) so a SIGKILL
+mid-write leaves the previous consistent snapshot, never a torn file.
+Model snapshots go through io/persist.py (device-independent pickle),
+so a run killed on an 8-device mesh resumes fine on one device.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Dict, List, Optional
+
+from h2o3_tpu.utils.log import get_logger
+
+log = get_logger("h2o3_tpu.recovery")
+
+
+class Recovery:
+    """One recovery directory: model snapshots + an atomic state file."""
+
+    def __init__(self, recovery_dir: str, state_name: str = "state"):
+        self.dir = recovery_dir
+        self.state_name = state_name
+        os.makedirs(recovery_dir, exist_ok=True)
+
+    # ------------------------------------------------------------ state
+    @property
+    def state_path(self) -> str:
+        return os.path.join(self.dir, f"{self.state_name}.json")
+
+    def write_state(self, state: dict) -> None:
+        """Atomic state snapshot: a kill mid-write must leave the prior
+        consistent state, not a torn JSON (Recovery.java writes the
+        recovery state via the persist layer for the same reason)."""
+        tmp = self.state_path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(state, f)
+            f.flush()
+            os.fsync(f.fileno())
+        os.rename(tmp, self.state_path)
+
+    def read_state(self) -> Optional[dict]:
+        if not os.path.exists(self.state_path):
+            return None
+        with open(self.state_path) as f:
+            return json.load(f)
+
+    def has_state(self) -> bool:
+        return os.path.exists(self.state_path)
+
+    # ------------------------------------------------------------ models
+    def save_model(self, model) -> str:
+        """Snapshot one trained model; returns its file name."""
+        from h2o3_tpu.io.persist import save_model
+        fname = f"{model.key}.bin"
+        save_model(model, os.path.join(self.dir, fname))
+        return fname
+
+    def load_models(self, files: List[str]) -> List:
+        from h2o3_tpu.io.persist import load_model
+        out = []
+        for f in files:
+            path = os.path.join(self.dir, f)
+            try:
+                out.append(load_model(path))
+            except Exception as e:  # noqa: BLE001 - a torn tail snapshot
+                # (killed mid-save_model) costs one model, not the resume
+                log.warning("recovery: skipping unreadable snapshot %s: %s",
+                            path, e)
+        return out
+
+    def sub(self, name: str) -> "Recovery":
+        """Nested recovery dir (one per AutoML grid step)."""
+        return Recovery(os.path.join(self.dir, name),
+                        state_name=self.state_name)
+
+
+def ensure_json_safe(params: Dict, what: str) -> None:
+    """Fail fast (before any model trains) when walk params cannot be
+    serialized into the recovery state."""
+    for k, v in params.items():
+        try:
+            json.dumps(v)
+        except TypeError:
+            raise ValueError(
+                f"{what} requires JSON-serializable params; "
+                f"'{k}'={type(v).__name__} is not") from None

@@ -144,17 +144,13 @@ class GridSearch:
         self.grid_id = grid_id or make_key(f"grid_{builder_cls.algo}")
         # hex/faulttolerance/Recovery.java:21-45 — when set, every trained
         # model + the walk state snapshot to this dir so a fresh cluster
-        # can resume_grid() the remaining work
+        # can resume_grid() the remaining work (core/recovery.py)
         self.recovery_dir = recovery_dir
+        self._recovery = None
         if recovery_dir:   # fail fast, not after the first model trains
-            import json as _json
-            for k, v in fixed_params.items():
-                try:
-                    _json.dumps(v)
-                except TypeError:
-                    raise ValueError(
-                        "recovery_dir requires JSON-serializable fixed "
-                        f"params; '{k}'={type(v).__name__} is not") from None
+            from h2o3_tpu.core.recovery import Recovery, ensure_json_safe
+            ensure_json_safe(fixed_params, "recovery_dir fixed")
+            self._recovery = Recovery(recovery_dir, state_name="grid_state")
 
     def _combos(self) -> List[dict]:
         names = sorted(self.hyper_params)
@@ -230,15 +226,11 @@ class GridSearch:
     # -- fault tolerance (hex/faulttolerance/Recovery onModel snapshots) --
     def _snapshot(self, model, combo: dict, done: List[dict],
                   y, x) -> None:
-        import json
-        import os
-        from h2o3_tpu.io.persist import persist_manager, save_model
-        d = self.recovery_dir
-        save_model(model, os.path.join(d, f"{model.key}.bin"))
+        fname = self._recovery.save_model(model)
         done.append(combo)
         self._model_files = getattr(self, "_model_files", [])
-        self._model_files.append(f"{model.key}.bin")
-        state = {
+        self._model_files.append(fname)
+        self._recovery.write_state({
             "grid_id": self.grid_id,
             "algo": self.builder_cls.algo,
             "fixed": self.fixed,   # validated JSON-serializable in __init__
@@ -247,22 +239,20 @@ class GridSearch:
             "y": y, "x": list(x) if x else None,
             "done": done,
             "models": self._model_files,
-        }
-        persist_manager.write(os.path.join(d, "grid_state.json"),
-                              json.dumps(state).encode())
+        })
 
 
 def resume_grid(recovery_dir: str, training_frame, validation_frame=None) -> Grid:
     """Resume an interrupted grid from its recovery snapshots
     (hex/faulttolerance/Recovery.onDone re-run path + GridImportExport)."""
-    import json
-    import os
-    from h2o3_tpu.io.persist import load_model, persist_manager
+    from h2o3_tpu.core.recovery import Recovery
     from h2o3_tpu.models import get_builder
-    state = json.loads(persist_manager.read(
-        os.path.join(recovery_dir, "grid_state.json")).decode())
-    prior = [load_model(os.path.join(recovery_dir, f))
-             for f in state["models"]]
+    rec = Recovery(recovery_dir, state_name="grid_state")
+    state = rec.read_state()
+    if state is None:
+        raise FileNotFoundError(
+            f"no grid_state.json under {recovery_dir}")
+    prior = rec.load_models(state["models"])
     gs = GridSearch(get_builder(state["algo"]), state["hyper_params"],
                     search_criteria=state["criteria"],
                     grid_id=state["grid_id"], recovery_dir=recovery_dir,

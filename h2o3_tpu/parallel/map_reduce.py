@@ -25,6 +25,7 @@ from h2o3_tpu.parallel.mesh import shard_map
 from jax.sharding import PartitionSpec as P
 
 from h2o3_tpu import telemetry
+from h2o3_tpu.core import watchdog
 from h2o3_tpu.parallel.mesh import DATA_AXIS, MODEL_AXIS, get_mesh
 
 
@@ -53,6 +54,10 @@ def frame_reduce(map_fn: Callable[..., Any], *arrays, mesh=None) -> Any:
     the data axis. Equivalent of MRTask.doAll + reduce (water/MRTask.java).
     """
     mesh = mesh or get_mesh()
+    # fault-injection site: a dispatch onto a wedged/restarted worker
+    # dies here with INTERNAL/UNAVAILABLE — tier-1 tests plant that
+    # failure (watchdog.inject_fault) to exercise the job-level retries
+    watchdog.maybe_fail("frame_reduce")
     telemetry.counter("frame_reduce_total").inc()
 
     @functools.partial(
@@ -74,6 +79,7 @@ def frame_reduce(map_fn: Callable[..., Any], *arrays, mesh=None) -> Any:
 def frame_map(map_fn: Callable[..., Any], *arrays, mesh=None) -> Any:
     """Elementwise over rows; output stays row-sharded (map-only MRTask)."""
     mesh = mesh or get_mesh()
+    watchdog.maybe_fail("frame_map")
     telemetry.counter("frame_map_total").inc()
 
     @functools.partial(

@@ -38,6 +38,44 @@ def _cloud():
     h2o3_tpu.shutdown()
 
 
+@pytest.fixture(autouse=True)
+def _check_keys(request):
+    """Leak check — the water/runner/CheckKeysTask analogue: every key a
+    test (or its function-scoped fixtures) creates must be gone from the
+    DKV when the test ends, and the Scope stack must balance.
+
+    The fixture brackets the test in a Scope, so keys created on the
+    test's own thread are swept automatically; anything still present
+    afterwards (e.g. keys put by background threads, which thread-local
+    Scope tracking cannot see) fails the test. Tests that intentionally
+    leave keys — REST servers creating objects on handler threads,
+    cross-test module state — opt out with @pytest.mark.allow_key_leak
+    (which also skips the sweep)."""
+    if request.node.get_closest_marker("allow_key_leak"):
+        yield
+        return
+    from h2o3_tpu.core.kv import DKV
+    from h2o3_tpu.core.scope import Scope, _stack
+    baseline = set(DKV.keys())
+    depth = len(_stack())
+    Scope().__enter__()
+    try:
+        yield
+    finally:
+        # unwind this fixture's scope plus any scope the test entered
+        # and failed to exit (each exit sweeps its tracked keys)
+        unbalanced = len(_stack()) - depth - 1
+        while len(_stack()) > depth:
+            _stack()[-1].__exit__(None, None, None)
+        leaked = [k for k in DKV.keys() if k not in baseline]
+        for k in leaked:    # sweep so one leak cannot cascade
+            DKV.remove(k)
+    assert unbalanced <= 0, \
+        f"{unbalanced} Scope(s) entered but never exited"
+    assert not leaked, \
+        f"{len(leaked)} DKV key(s) leaked: {sorted(leaked)[:10]}"
+
+
 @pytest.fixture()
 def rng():
     return np.random.RandomState(42)

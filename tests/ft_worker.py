@@ -1,0 +1,46 @@
+"""Worker for the fault-tolerance resume test: runs an AutoML plan with
+a recovery_dir and gets SIGKILLed by the parent mid-plan
+(tests/test_fault_tolerance.py). The parent then resume_automl()s from
+the snapshots — the hex/faulttolerance/Recovery.java contract.
+
+Deterministic data: build_data() here and in the parent test must stay
+identical (the resume trains on "the same" frame a fresh cluster would
+re-import after a crash).
+"""
+
+import os
+import sys
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+recovery_dir = sys.argv[1]
+
+import numpy as np                            # noqa: E402
+
+import h2o3_tpu                               # noqa: E402
+
+h2o3_tpu.init(backend="cpu")
+
+
+def build_data():
+    r = np.random.RandomState(17)
+    n = 1200
+    X = r.randn(n, 5)
+    logits = X[:, 0] * 1.5 - X[:, 1] + 0.5 * X[:, 2]
+    y = (r.rand(n) < 1 / (1 + np.exp(-logits))).astype(int)
+    cols = {f"x{i}": X[:, i] for i in range(5)}
+    cols["y"] = np.array(["no", "yes"], dtype=object)[y]
+    return h2o3_tpu.Frame.from_numpy(cols, categorical=["y"])
+
+
+from h2o3_tpu.automl import H2OAutoML         # noqa: E402
+
+fr = build_data()
+aml = H2OAutoML(max_models=8, seed=11, nfolds=0,
+                include_algos=["glm", "gbm", "drf"],
+                max_runtime_secs=600, recovery_dir=recovery_dir)
+aml.train(y="y", training_frame=fr)
+print("FT-WORKER-DONE", flush=True)

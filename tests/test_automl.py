@@ -8,8 +8,10 @@ from h2o3_tpu.automl import H2OAutoML
 
 # ~520s single-threaded on this container (dozens of model fits); the
 # tier-1 gate runs `-m 'not slow'` under a hard wallclock — without the
-# marker this one file eats 60% of the budget
-pytestmark = pytest.mark.slow
+# marker this one file eats 60% of the budget. allow_key_leak: AutoML
+# trains through background job threads the thread-local Scope leak
+# check cannot track.
+pytestmark = [pytest.mark.slow, pytest.mark.allow_key_leak]
 
 
 def test_automl_runs_and_ranks(classif_frame):

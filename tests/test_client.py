@@ -10,6 +10,9 @@ from h2o3_tpu.api.server import start_server, stop_server
 from h2o3_tpu import client as h2o
 
 
+pytestmark = pytest.mark.allow_key_leak  # REST handler threads create keys the thread-local Scope cannot track
+
+
 @pytest.fixture(scope="module")
 def conn():
     port = start_server(port=0, background=True)

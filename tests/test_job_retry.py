@@ -2,11 +2,14 @@
 
 A transient XLA/remote_compile INTERNAL error must not permanently fail
 a job (in round 2 one such blip killed an AutoML step for good); user
-errors must still fail fast with no retry.
+errors must still fail fast with no retry. Since the fault-tolerance
+layer the retry policy is shared (core/watchdog.py): bounded attempts +
+exponential backoff from core/config.py.
 """
 
 import pytest
 
+from h2o3_tpu.core import config
 from h2o3_tpu.core.job import FAILED, DONE, Job, is_infra_error
 
 
@@ -14,7 +17,14 @@ class FakeXlaRuntimeError(Exception):
     pass
 
 
-def test_infra_error_retried_once():
+@pytest.fixture(autouse=True)
+def _fast_backoff(monkeypatch):
+    """Keep the watchdog backoff out of the test wallclock."""
+    monkeypatch.setattr(config.ARGS, "infra_backoff_base_s", 0.001)
+    monkeypatch.setattr(config.ARGS, "infra_backoff_max_s", 0.002)
+
+
+def test_infra_error_retried():
     calls = {"n": 0}
 
     def flaky(job):
@@ -31,7 +41,10 @@ def test_infra_error_retried_once():
     assert calls["n"] == 2
 
 
-def test_infra_error_not_retried_twice():
+def test_infra_retries_bounded_by_config(monkeypatch):
+    """A permanently-dead backend gets exactly infra_max_attempts tries
+    (the watchdog policy), then the job fails for good."""
+    monkeypatch.setattr(config.ARGS, "infra_max_attempts", 3)
     calls = {"n": 0}
 
     def always_down(job):
@@ -40,7 +53,7 @@ def test_infra_error_not_retried_twice():
 
     with pytest.raises(FakeXlaRuntimeError):
         Job("dead step").start(always_down)
-    assert calls["n"] == 2
+    assert calls["n"] == 3
 
 
 def test_user_error_fails_fast():
@@ -69,3 +82,21 @@ def test_is_infra_error_classification():
     assert is_infra_error(RuntimeError("UNAVAILABLE: socket closed"))
     assert not is_infra_error(ValueError("INTERNAL: looks alike"))
     assert not is_infra_error(RuntimeError("plain user-visible failure"))
+
+
+def test_retries_observable_in_telemetry():
+    """infra_retries_total{site=job} counts every retry the policy
+    grants (README §Fault tolerance metric surface)."""
+    from h2o3_tpu import telemetry
+    before = telemetry.REGISTRY.value("infra_retries_total", site="job")
+    calls = {"n": 0}
+
+    def flaky(job):
+        calls["n"] += 1
+        if calls["n"] == 1:
+            raise FakeXlaRuntimeError("UNAVAILABLE: worker restarting")
+        return "ok"
+
+    Job("flaky counted").start(flaky)
+    after = telemetry.REGISTRY.value("infra_retries_total", site="job")
+    assert after - before == 1

@@ -75,6 +75,7 @@ def test_arff_quoted_names_and_values(tmp_path):
     assert fr.col("note").to_numpy()[0] == "a, b"
 
 
+@pytest.mark.allow_key_leak   # REST handler thread creates the model key
 def test_xgboost_over_rest(classif_frame):
     """The facade must be drivable through POST /3/ModelBuilders/xgboost
     with XGBoost-style params actually applied."""

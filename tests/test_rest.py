@@ -13,6 +13,9 @@ import h2o3_tpu
 from h2o3_tpu.api.server import start_server, stop_server
 
 
+pytestmark = pytest.mark.allow_key_leak  # REST handler threads create keys the thread-local Scope cannot track
+
+
 @pytest.fixture(scope="module")
 def port():
     p = start_server(port=0, background=True)
