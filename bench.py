@@ -491,6 +491,26 @@ def _hard_cap(name) -> float:
 # ---------------------------------------------------------- child modes
 
 
+def _emit_hardening(name: str) -> None:
+    """Request-hardening counters for this config's process (ISSUE 3):
+    how many requests were rejected by the admission gate and how many
+    deadlines expired while the config ran. Non-zero numbers mean the
+    measured wall times include overload shedding — the scoreboard must
+    say so."""
+    try:
+        from h2o3_tpu import telemetry
+        _emit_raw({
+            "metric": f"request-hardening {name}",
+            "rest_rejected_total":
+                int(telemetry.REGISTRY.total("rest_rejected_total")),
+            "request_deadline_exceeded_total": int(
+                telemetry.REGISTRY.value("request_deadline_exceeded_total")),
+            "rest_client_disconnects_total": int(
+                telemetry.REGISTRY.value("rest_client_disconnects_total"))})
+    except Exception:   # noqa: BLE001 - accounting must never fail a config
+        pass
+
+
 def _child_one(name: str) -> int:
     """Run exactly one config in THIS process (spawned by the parent).
     Metric lines go to stdout; failures leave a classified traceback on
@@ -501,6 +521,7 @@ def _child_one(name: str) -> int:
         h2o3_tpu.init()
     try:
         fn()
+        _emit_hardening(name)
         return 0
     except Exception as e:   # noqa: BLE001 - child boundary
         import traceback

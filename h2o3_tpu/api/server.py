@@ -18,6 +18,7 @@ from __future__ import annotations
 import json
 import re
 import threading
+import time
 import urllib.parse
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Callable, Dict, List, Optional, Tuple
@@ -27,6 +28,7 @@ import numpy as np
 from h2o3_tpu.parallel.mesh import fetch_replicated as _fetch_np
 
 from h2o3_tpu.core import cloud as cloud_mod
+from h2o3_tpu.core import request_ctx
 from h2o3_tpu.core.job import Job, list_jobs
 from h2o3_tpu.core.kv import DKV
 from h2o3_tpu.frame.frame import Frame
@@ -1552,6 +1554,148 @@ def _shutdown(params, body):
 # ------------------------------------------------------------- plumbing
 
 
+class AdmissionGate:
+    """Bounded in-flight request gate (the reference's bounded Jetty
+    pool role, water/api/RequestServer): at most ``max_inflight``
+    requests execute handlers concurrently; up to ``queue_depth`` more
+    wait for a slot (bounded by ``queue_wait_s`` or their own request
+    deadline, whichever is sooner); everything past that fails fast
+    with 503 + Retry-After so overload degrades into clean client
+    retries instead of an unbounded handler-thread pile-up."""
+
+    def __init__(self, max_inflight: int, queue_depth: int,
+                 queue_wait_s: float):
+        self.max_inflight = max(1, int(max_inflight))
+        self.queue_depth = max(0, int(queue_depth))
+        self.queue_wait_s = float(queue_wait_s)
+        self._inflight = 0
+        self._waiting = 0
+        self._cond = threading.Condition()
+
+    def enter(self, deadline: Optional[float] = None) -> bool:
+        """True = admitted (caller MUST pair with leave()); False =
+        saturated, answer 503."""
+        from h2o3_tpu import telemetry
+        gauge = telemetry.gauge("rest_inflight_requests")
+        with self._cond:
+            if self._inflight < self.max_inflight:
+                self._inflight += 1
+                gauge.set(self._inflight)
+                return True
+            if self._waiting >= self.queue_depth:
+                return False
+            limit = time.monotonic() + self.queue_wait_s
+            if deadline is not None:
+                limit = min(limit, deadline)
+            self._waiting += 1
+            try:
+                while self._inflight >= self.max_inflight:
+                    left = limit - time.monotonic()
+                    if left <= 0:
+                        return False
+                    self._cond.wait(left)
+                self._inflight += 1
+                gauge.set(self._inflight)
+                return True
+            finally:
+                self._waiting -= 1
+
+    def leave(self) -> None:
+        from h2o3_tpu import telemetry
+        with self._cond:
+            self._inflight -= 1
+            telemetry.gauge("rest_inflight_requests").set(self._inflight)
+            self._cond.notify()
+
+
+def _gate_from_config() -> AdmissionGate:
+    """Build the gate from config.ARGS with H2O3TPU_REST_* env overrides
+    on top (same pattern as watchdog.policy_from_config: servers booted
+    without init() still honor the knobs)."""
+    import os
+    from h2o3_tpu.core import config as _cfg
+    env = os.environ.get
+    a = _cfg.ARGS
+    return AdmissionGate(
+        max_inflight=int(env("H2O3TPU_REST_MAX_INFLIGHT",
+                             a.rest_max_inflight)),
+        queue_depth=int(env("H2O3TPU_REST_QUEUE_DEPTH",
+                            a.rest_queue_depth)),
+        queue_wait_s=float(env("H2O3TPU_REST_QUEUE_WAIT_S",
+                               a.rest_queue_wait_s)))
+
+
+def _max_body_bytes() -> int:
+    import os
+    from h2o3_tpu.core import config as _cfg
+    mb = int(os.environ.get("H2O3TPU_REST_MAX_BODY_MB",
+                            _cfg.ARGS.rest_max_body_mb))
+    return mb << 20
+
+
+# health checks, the metrics scrape, and job polling/cancel must keep
+# answering while the gate rejects work — an overloaded node that stops
+# ping/poll responses looks dead to every client and orchestrator
+_EXEMPT_PREFIXES = ("/3/Ping", "/3/Metrics", "/3/Jobs")
+
+
+def _admission_exempt(path: str) -> bool:
+    return any(path == p or path.startswith(p + "/")
+               for p in _EXEMPT_PREFIXES)
+
+
+_UPLOAD_CHUNK = 1 << 20      # /3/PostFile disk-streaming block
+
+
+def _job_key_of(out) -> Optional[str]:
+    """Job key inside a handler response: ModelBuilderSchema-style
+    {"job": JobV3} or a bare JobV3 at the root."""
+    if not isinstance(out, dict):
+        return None
+    jd = out.get("job")
+    if isinstance(jd, dict) and isinstance(jd.get("key"), dict):
+        return jd["key"].get("name")
+    meta = out.get("__meta")
+    if isinstance(meta, dict) and meta.get("schema_name") == "JobV3" \
+            and isinstance(out.get("key"), dict):
+        return out["key"].get("name")
+    return None
+
+
+def _await_job_deadline(out, deadline: float, path: str):
+    """A deadlined request that spawned a background job blocks until
+    the job finishes or the deadline passes. Expiry cancels the job —
+    the cooperative checks (Job.update / map_reduce cancel_point) stop
+    it at the next chunk boundary, the job ends CANCELLED, and the
+    client gets 408 instead of a leaked RUNNING job."""
+    jk = _job_key_of(out)
+    if not jk:
+        return out, 200
+    from h2o3_tpu import telemetry
+    j = DKV.get(jk)
+    while isinstance(j, Job) and j.status in ("CREATED", "RUNNING"):
+        if time.monotonic() >= deadline:
+            j.cancel()
+            j.join(5.0)      # grace: one chunk boundary away
+            telemetry.counter("request_deadline_exceeded_total").inc()
+            err = _error_json(path, request_ctx.DeadlineExceeded(
+                f"request deadline exceeded; job {jk} cancelled"), 408)
+            err["values"] = {"job": jk,
+                            "job_status": getattr(j, "status", "?")}
+            return err, 408
+        time.sleep(0.02)
+        j = DKV.get(jk)
+    if isinstance(j, Job):
+        # finished inside the deadline: refresh the snapshot the client
+        # sees (it was RUNNING when the handler returned)
+        if isinstance(out.get("job"), dict):
+            out["job"] = j.to_dict()
+        elif _job_key_of(out) == jk and out.get("__meta", {}).get(
+                "schema_name") == "JobV3":
+            out = j.to_dict()
+    return out, 200
+
+
 class _Handler(BaseHTTPRequestHandler):
     protocol_version = "HTTP/1.1"
 
@@ -1559,49 +1703,188 @@ class _Handler(BaseHTTPRequestHandler):
         log.debug("http: " + fmt, *args)
 
     def _dispatch(self, method: str):
+        try:
+            self._dispatch_inner(method)
+        except (BrokenPipeError, ConnectionResetError) as e:
+            # the client hung up mid-request/mid-response — a normal
+            # event under load, not a handler crash worth a traceback
+            from h2o3_tpu import telemetry
+            telemetry.counter("rest_client_disconnects_total").inc()
+            log.info("client disconnected on %s %s: %r",
+                     method, self.path, e)
+            self.close_connection = True
+
+    _DRAIN_CAP = 8 << 20
+
+    def _drain(self, length: int) -> bool:
+        """Consume a modest unread request body so an early error
+        response can be read reliably and the connection stays usable;
+        oversized bodies are left unread (the caller then closes the
+        connection instead of swallowing gigabytes)."""
+        if length > self._DRAIN_CAP:
+            return False
+        left = length
+        while left > 0:
+            chunk = self.rfile.read(min(_UPLOAD_CHUNK, left))
+            if not chunk:
+                break
+            left -= len(chunk)
+        return True
+
+    def _respond(self, code: int, out, extra_headers: Optional[dict] = None,
+                 close: bool = False):
+        if isinstance(out, dict) and "__bytes__" in out:
+            payload = out["__bytes__"]
+            ctype = out.get("__ctype__", "application/octet-stream")
+            extra_headers = {**(out.get("__headers__") or {}),
+                             **(extra_headers or {})}
+        elif isinstance(out, dict) and "__html__" in out:
+            payload = out["__html__"].encode()
+            ctype = "text/html; charset=utf-8"
+        else:
+            payload = json.dumps(_json_sanitize(out),
+                                 default=_json_default).encode()
+            ctype = "application/json"
+        self.send_response(code)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(payload)))
+        for hk, hv in (extra_headers or {}).items():
+            self.send_header(hk, hv)
+        if close:
+            # the body was not (fully) read: the connection cannot be
+            # reused — the leftover bytes would parse as a new request
+            self.send_header("Connection", "close")
+            self.close_connection = True
+        self.end_headers()
+        self.wfile.write(payload)
+
+    def _dispatch_inner(self, method: str):
+        from h2o3_tpu import telemetry
         parsed = urllib.parse.urlparse(self.path)
         path = parsed.path
         params: Dict[str, str] = {
             k: v[0] for k, v in urllib.parse.parse_qs(parsed.query).items()}
-        length = int(self.headers.get("Content-Length") or 0)
-        raw = self.rfile.read(length) if length else b""
+
+        # -- request deadline (?_timeout_ms= / X-H2O-Deadline-Ms) ------
+        deadline = None
+        tmo = params.pop("_timeout_ms", None)
+        if tmo is None:
+            tmo = self.headers.get("X-H2O-Deadline-Ms")
+        if tmo is not None:
+            try:
+                tmo_ms = float(tmo)
+            except (TypeError, ValueError):
+                return self._respond(400, _error_json(path, ValueError(
+                    f"malformed deadline {tmo!r} "
+                    f"(expected milliseconds)"), 400))
+            if tmo_ms > 0:
+                deadline = time.monotonic() + tmo_ms / 1000.0
+
+        # -- Content-Length must be a clean non-negative integer -------
+        raw_len = self.headers.get("Content-Length")
+        try:
+            length = int(raw_len) if raw_len else 0
+            if length < 0:
+                raise ValueError(raw_len)
+        except (TypeError, ValueError):
+            telemetry.counter("rest_rejected_total",
+                              reason="bad_content_length").inc()
+            return self._respond(400, _error_json(path, ValueError(
+                f"malformed Content-Length: {raw_len!r}"), 400),
+                close=True)
+
+        # -- admission control (exempt: ping/metrics/job polling) ------
+        exempt = _admission_exempt(path)
+        if not exempt and not _GATE.enter(deadline=deadline):
+            telemetry.counter("rest_rejected_total",
+                              reason="saturated").inc()
+            drained = self._drain(length)
+            return self._respond(503, _error_json(path, RuntimeError(
+                f"server saturated ({_GATE.max_inflight} in flight, "
+                f"{_GATE.queue_depth} queued); retry later"), 503),
+                extra_headers={"Retry-After": "1"}, close=not drained)
+        try:
+            self._handle(method, path, params, length, deadline)
+        finally:
+            if not exempt:
+                _GATE.leave()
+
+    def _post_file(self, path: str, length: int):
+        """Raw file-body upload (h2o-py sends the file bytes as the
+        request body, h2o-py/h2o/backend/connection.py:473) — streamed
+        to disk in 1 MiB blocks so a multi-GB upload never buffers in
+        handler memory."""
+        import tempfile
+        first = self.rfile.read(min(length, _UPLOAD_CHUNK)) \
+            if length else b""
+        # the client sends no filename: sniff the container format so
+        # the extension-dispatching parser picks the right reader
+        if first[:4] == b"PK\x03\x04":
+            suffix = ".zip"
+        elif first[:2] == b"\x1f\x8b":
+            suffix = ".csv.gz"
+        elif first[:4] == b"PAR1":
+            suffix = ".parquet"
+        else:
+            suffix = ".csv"
+        fd, tmp = tempfile.mkstemp(prefix="h2o3tpu_upload_",
+                                   suffix=suffix)
+        total = len(first)
+        with open(fd, "wb") as f:
+            f.write(first)
+            while total < length:
+                chunk = self.rfile.read(min(_UPLOAD_CHUNK,
+                                            length - total))
+                if not chunk:
+                    break
+                f.write(chunk)
+                total += len(chunk)
+        self._respond(200, {"destination_frame": tmp,
+                            "total_bytes": total})
+
+    def _handle(self, method: str, path: str, params: Dict[str, str],
+                length: int, deadline: Optional[float]):
+        from h2o3_tpu import telemetry
         if path.startswith("/3/PostFile"):
-            # raw file-body upload (h2o-py sends the file bytes as the
-            # request body, h2o-py/h2o/backend/connection.py:473)
-            import tempfile
-            # the client sends no filename: sniff the container format so
-            # the extension-dispatching parser picks the right reader
-            if raw[:4] == b"PK\x03\x04":
-                suffix = ".zip"
-            elif raw[:2] == b"\x1f\x8b":
-                suffix = ".csv.gz"
-            elif raw[:4] == b"PAR1":
-                suffix = ".parquet"
-            else:
-                suffix = ".csv"
-            fd, tmp = tempfile.mkstemp(prefix="h2o3tpu_upload_",
-                                       suffix=suffix)
-            with open(fd, "wb") as f:
-                f.write(raw)
-            payload = json.dumps({"destination_frame": tmp,
-                                  "total_bytes": len(raw)}).encode()
-            self.send_response(200)
-            self.send_header("Content-Type", "application/json")
-            self.send_header("Content-Length", str(len(payload)))
-            self.end_headers()
-            self.wfile.write(payload)
-            return
+            return self._post_file(path, length)
+        max_body = _max_body_bytes()
+        if length > max_body:
+            telemetry.counter("rest_rejected_total",
+                              reason="body_too_large").inc()
+            drained = self._drain(length)
+            return self._respond(413, _error_json(path, ValueError(
+                f"request body of {length} bytes exceeds the "
+                f"{max_body >> 20} MB cap (H2O3TPU_REST_MAX_BODY_MB); "
+                f"use /3/PostFile for large uploads"), 413),
+                close=not drained)
+        raw = self.rfile.read(length) if length else b""
         body = raw.decode("utf-8", "replace")
         ctype = self.headers.get("Content-Type", "")
         if "json" in ctype and body:
             try:
                 params.update(json.loads(body))
-            except json.JSONDecodeError:
-                pass
+            except json.JSONDecodeError as e:
+                # a body the client MARKED as JSON but that does not
+                # parse must fail loudly — silently ignoring it ran
+                # handlers with half the intended parameters
+                return self._respond(400, _error_json(path, ValueError(
+                    f"malformed JSON body: {e}"), 400))
         elif body:
             params.update({k: v[0]
                            for k, v in urllib.parse.parse_qs(body).items()})
-        from h2o3_tpu import telemetry
+        # h2o-py style clients ship every parameter form-encoded in the
+        # body: honor a _timeout_ms that arrived there too (query-string
+        # and header deadlines were already parsed pre-admission)
+        tmo = params.pop("_timeout_ms", None)
+        if tmo is not None and deadline is None:
+            try:
+                tmo_ms = float(tmo)
+            except (TypeError, ValueError):
+                return self._respond(400, _error_json(path, ValueError(
+                    f"malformed deadline {tmo!r} "
+                    f"(expected milliseconds)"), 400))
+            if tmo_ms > 0:
+                deadline = time.monotonic() + tmo_ms / 1000.0
         from h2o3_tpu.utils.timeline import record as _tl_record
         for m, rx, fn in ROUTES:
             if m != method:
@@ -1614,13 +1897,20 @@ class _Handler(BaseHTTPRequestHandler):
                 telemetry.counter("rest_requests_total", method=method,
                                   endpoint=endpoint).inc()
                 try:
-                    with telemetry.span("rest", method=method,
-                                        endpoint=endpoint):
+                    # the deadline rides a contextvar: any Job the
+                    # handler creates captures it (core/job.py) and the
+                    # cooperative checks enforce it at chunk boundaries
+                    with request_ctx.deadline_scope(deadline), \
+                            telemetry.span("rest", method=method,
+                                           endpoint=endpoint):
                         # recorded INSIDE the span so the Timeline event
                         # carries this request's span id
                         _tl_record("rest", f"{method} {path}")
                         out = fn(params, body, **match.groupdict())
                     code = 200
+                except request_ctx.DeadlineExceeded as e:
+                    out = _error_json(path, e, 408)
+                    code = 408
                 except KeyError as e:
                     out = _error_json(path, e, 404)
                     code = 404
@@ -1641,33 +1931,11 @@ class _Handler(BaseHTTPRequestHandler):
                     log.exception("handler error on %s %s", method, path)
                     out = _error_json(path, e, 500)
                     code = 500
-                extra_headers = {}
-                if isinstance(out, dict) and "__bytes__" in out:
-                    payload = out["__bytes__"]
-                    ctype = out.get("__ctype__", "application/octet-stream")
-                    extra_headers = out.get("__headers__") or {}
-                elif isinstance(out, dict) and "__html__" in out:
-                    payload = out["__html__"].encode()
-                    ctype = "text/html; charset=utf-8"
-                else:
-                    payload = json.dumps(_json_sanitize(out),
-                                         default=_json_default).encode()
-                    ctype = "application/json"
-                self.send_response(code)
-                self.send_header("Content-Type", ctype)
-                self.send_header("Content-Length", str(len(payload)))
-                for hk, hv in extra_headers.items():
-                    self.send_header(hk, hv)
-                self.end_headers()
-                self.wfile.write(payload)
-                return
+                if code == 200 and deadline is not None:
+                    out, code = _await_job_deadline(out, deadline, path)
+                return self._respond(code, out)
         _tl_record("rest", f"{method} {path}", status=404)
-        self.send_response(404)
-        payload = json.dumps({"msg": f"no route {method} {path}"}).encode()
-        self.send_header("Content-Type", "application/json")
-        self.send_header("Content-Length", str(len(payload)))
-        self.end_headers()
-        self.wfile.write(payload)
+        self._respond(404, {"msg": f"no route {method} {path}"})
 
     def do_GET(self):
         self._dispatch("GET")
@@ -1677,6 +1945,9 @@ class _Handler(BaseHTTPRequestHandler):
 
     def do_DELETE(self):
         self._dispatch("DELETE")
+
+
+_GATE = _gate_from_config()
 
 
 def _error_json(path: str, e: Exception, status: int) -> dict:
@@ -1751,7 +2022,10 @@ def start_server(port: int = 54321, background: bool = True) -> int:
     """Start the REST server (water.api.RequestServer.start).
 
     Returns the bound port (0 picks an ephemeral port)."""
-    global _SERVER, _THREAD
+    global _SERVER, _THREAD, _GATE
+    # rebuild the admission gate at boot: init() rebinds config.ARGS and
+    # H2O3TPU_REST_* env knobs set after import must take effect
+    _GATE = _gate_from_config()
     _SERVER = ThreadingHTTPServer(("127.0.0.1", port), _Handler)
     actual = _SERVER.server_address[1]
     log.info("REST server on http://127.0.0.1:%d (/3, /99)", actual)

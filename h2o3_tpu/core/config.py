@@ -39,14 +39,28 @@ class Config:
     infra_backoff_max_s: float = 30.0   # backoff ceiling
     # backend liveness probe deadline; 0 = unbounded (probe_backend)
     probe_timeout_s: float = 60.0
+    # -- request hardening (api/server.py admission gate + bounds) -----
+    # max requests executing handlers concurrently; the analogue of the
+    # reference's bounded Jetty thread pool (water/api/RequestServer)
+    rest_max_inflight: int = 64
+    # requests allowed to WAIT for a slot once saturated; anything past
+    # inflight+queue fails fast with 503 + Retry-After
+    rest_queue_depth: int = 16
+    # longest a queued request waits for a slot before 503
+    rest_queue_wait_s: float = 10.0
+    # Content-Length cap for buffered bodies (MB); /3/PostFile streams
+    # to disk in chunks and is exempt
+    rest_max_body_mb: int = 256
 
     # fields that parse as int from the environment (annotations are
     # strings under `from __future__ import annotations`, so resolve
     # by hand)
     _INT_FIELDS = frozenset({"port", "nthreads", "data_axis", "model_axis",
-                             "block_rows", "nbins", "infra_max_attempts"})
+                             "block_rows", "nbins", "infra_max_attempts",
+                             "rest_max_inflight", "rest_queue_depth",
+                             "rest_max_body_mb"})
     _FLOAT_FIELDS = frozenset({"infra_backoff_base_s", "infra_backoff_max_s",
-                               "probe_timeout_s"})
+                               "probe_timeout_s", "rest_queue_wait_s"})
 
     @staticmethod
     def from_env(**overrides) -> "Config":

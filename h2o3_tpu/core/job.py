@@ -15,8 +15,9 @@ import time
 import traceback
 from typing import Any, Callable, Optional
 
-from h2o3_tpu.core import watchdog
+from h2o3_tpu.core import request_ctx, watchdog
 from h2o3_tpu.core.kv import DKV, make_key
+from h2o3_tpu.core.scope import Scope
 from h2o3_tpu.core.watchdog import is_infra_error  # noqa: F401 - re-export
 from h2o3_tpu.utils.log import get_logger
 
@@ -69,6 +70,12 @@ class Job:
         self._cancel_requested = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self.result: Any = None
+        # request deadline (absolute monotonic) captured at SUBMISSION
+        # time from the request context (api/server.py installs it for
+        # ?_timeout_ms= / X-H2O-Deadline-Ms requests); background jobs
+        # run on a fresh thread whose context would not inherit it, so
+        # Job.start re-installs it via request_ctx.job_scope
+        self.deadline: Optional[float] = request_ctx.current_deadline()
         DKV.put(self.key, self)
 
     # -- lifecycle (Job.start / Job.update, water/Job.java:206-225) ------
@@ -81,6 +88,13 @@ class Job:
         telemetry.counter("jobs_started_total").inc()
 
         def _body():
+            # every key the work creates is tracked in a job-local Scope:
+            # a cancelled/expired job must release its partial keys
+            # (water/Scope.java exit-on-abort role) instead of leaking
+            # half-built models/frames into the DKV; DONE and FAILED
+            # jobs keep theirs (pollers read FAILED results' state)
+            sc = Scope()
+            sc.__enter__()
             try:
                 # bounded retries for infra-class errors only, under the
                 # shared watchdog policy (backoff + jitter, attempts from
@@ -121,6 +135,14 @@ class Job:
             except JobCancelledException:
                 self.status = CANCELLED
                 _tl("job", f"cancelled {self.description}", key=self.key)
+            except request_ctx.DeadlineExceeded as e:
+                # an expired request deadline is a cancellation, not a
+                # failure: the REST tier answers 408 and the job must
+                # end CANCELLED, never linger RUNNING (ISSUE 3 contract)
+                self.status = CANCELLED
+                self._msg = "deadline exceeded"
+                _tl("job", f"deadline-cancelled {self.description}",
+                    key=self.key, error=str(e)[:200])
             except Exception as e:  # noqa: BLE001 - job boundary
                 # exception BEFORE status: pollers react to FAILED by
                 # reading .exception, which must already be set
@@ -134,6 +156,9 @@ class Job:
                     raise
             finally:
                 self.end_time = time.time()
+                if self.status != CANCELLED:
+                    sc.keep(*sc._tracked)
+                sc.__exit__(None, None, None)
 
         def _run():
             # the job is the ROOT telemetry span: everything the work
@@ -141,8 +166,15 @@ class Job:
             # background jobs run on their own thread, whose fresh
             # contextvar context makes this a root span automatically
             try:
-                with telemetry.span("job", key=self.key,
-                                    desc=self.description):
+                # job_scope makes this job + its captured deadline
+                # visible to cancel_point() checks at chunk boundaries
+                # (parallel/map_reduce.py) no matter how deep the work
+                # nests — background threads start with a fresh
+                # contextvar context, so this re-install is what carries
+                # the request deadline across the thread hop
+                with request_ctx.job_scope(self, deadline=self.deadline), \
+                        telemetry.span("job", key=self.key,
+                                       desc=self.description):
                     _body()
             finally:
                 telemetry.counter("jobs_completed_total",
@@ -163,6 +195,12 @@ class Job:
             self._msg = msg
         if self._cancel_requested.is_set():
             raise JobCancelledException(self.key)
+        if self.deadline is not None and time.monotonic() >= self.deadline:
+            from h2o3_tpu import telemetry
+            telemetry.counter("request_deadline_exceeded_total").inc()
+            raise request_ctx.DeadlineExceeded(
+                f"job {self.key}: request deadline exceeded "
+                f"(observed at progress update)")
 
     @property
     def progress(self) -> float:
@@ -176,6 +214,11 @@ class Job:
 
     def cancel(self) -> None:
         self._cancel_requested.set()
+
+    def cancel_requested(self) -> bool:
+        """Polled at chunk boundaries (request_ctx.cancel_point — the
+        water/Job.java stop_requested() analogue)."""
+        return self._cancel_requested.is_set()
 
     def join(self, timeout: Optional[float] = None) -> "Job":
         if self._thread is not None:
@@ -218,4 +261,12 @@ class Job:
 
 
 def list_jobs() -> list:
-    return [DKV.get(k).to_dict() for k in DKV.keys("job_")]
+    out = []
+    for k in DKV.keys("job_"):
+        # the key can be removed between keys() and get() (remove_all
+        # from another handler thread) — skip dead keys instead of
+        # AttributeError'ing on None
+        j = DKV.get(k)
+        if isinstance(j, Job):
+            out.append(j.to_dict())
+    return out

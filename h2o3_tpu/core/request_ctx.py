@@ -1,0 +1,111 @@
+"""Request lifecycle context — deadlines and cooperative cancellation.
+
+Reference: water/api/RequestServer.java serves every request on a
+bounded Jetty pool and water/Job.java:stop_requested() is polled at
+chunk boundaries inside MRTask loops, so a cancelled or expired request
+frees its F/J workers within one chunk. Here the same contract rides on
+``contextvars``: the REST tier (api/server.py) installs a request
+deadline, ``Job.start`` captures it and re-installs it (plus the job
+itself) on the worker thread, and the map/reduce layer
+(parallel/map_reduce.py) calls :func:`cancel_point` at every dispatch —
+the chunk boundary of this runtime. A DrJAX-style scan only yields
+between dispatches, so this is exactly where an expired request can be
+observed without preempting compiled code.
+
+Deadlines are ABSOLUTE ``time.monotonic()`` instants (never wall clock:
+NTP steps must not expire requests).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import contextvars
+import time
+from typing import Iterator, Optional
+
+from h2o3_tpu.core import watchdog
+
+
+class DeadlineExceeded(Exception):
+    """The request's deadline expired; the work was cancelled
+    cooperatively. Maps to HTTP 408 at the REST boundary and to a
+    CANCELLED job in core/job.py."""
+
+
+# a deadline expiry is a client decision, never a retryable infra blip
+# (and the name must NOT contain the watchdog's "DEADLINE_EXCEEDED"
+# infra token, which marks the backend's own RPC timeouts)
+watchdog.NON_RETRYABLE.append(DeadlineExceeded)
+
+_DEADLINE: contextvars.ContextVar[Optional[float]] = contextvars.ContextVar(
+    "h2o3tpu_request_deadline", default=None)
+_JOB: contextvars.ContextVar[Optional[object]] = contextvars.ContextVar(
+    "h2o3tpu_current_job", default=None)
+
+
+def current_deadline() -> Optional[float]:
+    """The active absolute monotonic deadline, or None."""
+    return _DEADLINE.get()
+
+
+def current_job():
+    """The Job whose work is running on this thread, or None."""
+    return _JOB.get()
+
+
+def remaining_s() -> Optional[float]:
+    """Seconds until the active deadline (negative = expired); None when
+    no deadline is set."""
+    dl = _DEADLINE.get()
+    return None if dl is None else dl - time.monotonic()
+
+
+@contextlib.contextmanager
+def deadline_scope(deadline: Optional[float]) -> Iterator[None]:
+    """Install an absolute monotonic deadline for the duration of the
+    block (None = explicitly clear any inherited deadline)."""
+    tok = _DEADLINE.set(deadline)
+    try:
+        yield
+    finally:
+        _DEADLINE.reset(tok)
+
+
+@contextlib.contextmanager
+def job_scope(job, deadline: Optional[float] = None) -> Iterator[None]:
+    """Install ``job`` (and its captured deadline) as the thread's
+    current work unit — Job.start wraps the worker body in this so
+    cancel_point() deep inside map/reduce loops can observe both."""
+    tok_j = _JOB.set(job)
+    tok_d = _DEADLINE.set(deadline)
+    try:
+        yield
+    finally:
+        _DEADLINE.reset(tok_d)
+        _JOB.reset(tok_j)
+
+
+def check_deadline(site: str = "") -> None:
+    """Raise DeadlineExceeded if the active deadline has passed."""
+    dl = _DEADLINE.get()
+    if dl is not None and time.monotonic() >= dl:
+        from h2o3_tpu import telemetry
+        telemetry.counter("request_deadline_exceeded_total").inc()
+        raise DeadlineExceeded(
+            f"request deadline exceeded"
+            f"{f' at {site}' if site else ''} "
+            f"({time.monotonic() - dl:.3f}s past)")
+
+
+def cancel_point(site: str = "") -> None:
+    """Cooperative cancellation checkpoint — call at chunk boundaries.
+
+    Observes (1) a cancel() on the current job and (2) the request
+    deadline, raising JobCancelledException / DeadlineExceeded so the
+    job layer marks the work CANCELLED and frees the worker within one
+    chunk (water/Job.java stop_requested() polling contract)."""
+    job = _JOB.get()
+    if job is not None and job.cancel_requested():
+        from h2o3_tpu.core.job import JobCancelledException
+        raise JobCancelledException(getattr(job, "key", "job"))
+    check_deadline(site)

@@ -25,7 +25,7 @@ from h2o3_tpu.parallel.mesh import shard_map
 from jax.sharding import PartitionSpec as P
 
 from h2o3_tpu import telemetry
-from h2o3_tpu.core import watchdog
+from h2o3_tpu.core import request_ctx, watchdog
 from h2o3_tpu.parallel.mesh import DATA_AXIS, MODEL_AXIS, get_mesh
 
 
@@ -58,6 +58,11 @@ def frame_reduce(map_fn: Callable[..., Any], *arrays, mesh=None) -> Any:
     # dies here with INTERNAL/UNAVAILABLE — tier-1 tests plant that
     # failure (watchdog.inject_fault) to exercise the job-level retries
     watchdog.maybe_fail("frame_reduce")
+    # chunk boundary: the one place a cancelled/expired request can be
+    # observed without preempting compiled code (a scan only yields
+    # between dispatches) — a cancel or deadline frees this worker
+    # within one chunk instead of finishing the whole job
+    request_ctx.cancel_point("frame_reduce")
     telemetry.counter("frame_reduce_total").inc()
 
     @functools.partial(
@@ -80,6 +85,7 @@ def frame_map(map_fn: Callable[..., Any], *arrays, mesh=None) -> Any:
     """Elementwise over rows; output stays row-sharded (map-only MRTask)."""
     mesh = mesh or get_mesh()
     watchdog.maybe_fail("frame_map")
+    request_ctx.cancel_point("frame_map")
     telemetry.counter("frame_map_total").inc()
 
     @functools.partial(

@@ -7,6 +7,11 @@ TPU runtime's equivalent for its OWN failure modes: XLA compile storms,
 shape-bucket misses, and device-memory pressure. Always on, cheap
 (registry op ≈ 1µs; see test_telemetry.py overhead bound).
 
+Request hardening (api/server.py + core/request_ctx.py) reports
+through the same registry: ``rest_inflight_requests`` (gauge),
+``rest_rejected_total{reason=}``, ``request_deadline_exceeded_total``,
+``rest_client_disconnects_total``.
+
 Surface (stable metric names — README §Observability):
 
     from h2o3_tpu import telemetry

@@ -69,6 +69,18 @@ def _check_keys(request):
             _stack()[-1].__exit__(None, None, None)
         leaked = [k for k in DKV.keys() if k not in baseline]
         for k in leaked:    # sweep so one leak cannot cascade
+            # a leaked RUNNING job is a live worker thread that would
+            # keep writing keys after the sweep — cancel it (observed
+            # cooperatively at the next chunk boundary) and wait
+            # briefly before removing its key
+            v = DKV.get_raw(k)
+            if getattr(v, "status", None) == "RUNNING" \
+                    and hasattr(v, "cancel"):
+                v.cancel()
+                try:
+                    v.join(10.0)
+                except Exception:
+                    pass
             DKV.remove(k)
     assert unbalanced <= 0, \
         f"{unbalanced} Scope(s) entered but never exited"

@@ -1,0 +1,468 @@
+"""Request-path hardening tests (ISSUE 3) — admission control, request
+deadlines, cooperative cancellation, body bounds, and malformed-request
+errors, all driven over real HTTP against the in-process REST server.
+
+Unlike tests/test_rest.py these do NOT opt out of the conftest DKV/Scope
+leak check: every key created through the wire (jobs, models, frames put
+by handler threads) is cleaned up explicitly, so the leak check guards
+the new request paths too.
+
+Everything here is CPU-only and fast; the sustained overload soak is
+marked slow.
+"""
+
+import json
+import socket
+import struct
+import threading
+import time
+import urllib.error
+import urllib.parse
+import urllib.request
+from concurrent.futures import ThreadPoolExecutor
+
+import numpy as np
+import pytest
+
+import h2o3_tpu
+from h2o3_tpu import telemetry
+from h2o3_tpu.api import server as api_server
+from h2o3_tpu.core import request_ctx
+from h2o3_tpu.core.job import (CANCELLED, DONE, Job, JobCancelledException,
+                               list_jobs)
+from h2o3_tpu.core.kv import DKV
+
+# a deliberately blocking endpoint for overload tests: handlers park on
+# this event until the test releases them (registered into the global
+# route table like any other endpoint; unmatched by real clients)
+_RELEASE = threading.Event()
+
+
+@api_server.route("GET", "/3/TestBlock")
+def _test_block(params, body):
+    _RELEASE.wait(timeout=20)
+    return {"ok": True}
+
+
+@pytest.fixture(autouse=True)
+def _release_guard():
+    """Overload tests clear _RELEASE themselves; always leave it set so
+    a stray parked handler cannot outlive its test."""
+    _RELEASE.set()
+    yield
+    _RELEASE.set()
+
+
+@pytest.fixture(scope="module")
+def gated_port(tmp_path_factory):
+    """REST server with a tiny admission gate + 1 MB body cap so tier-1
+    tests can saturate it with a handful of threads."""
+    import os
+    env = {"H2O3TPU_REST_MAX_INFLIGHT": "3",
+           "H2O3TPU_REST_QUEUE_DEPTH": "2",
+           "H2O3TPU_REST_QUEUE_WAIT_S": "0.5",
+           "H2O3TPU_REST_MAX_BODY_MB": "1"}
+    old = {k: os.environ.get(k) for k in env}
+    os.environ.update(env)
+    try:
+        port = api_server.start_server(port=0, background=True)
+        yield port
+    finally:
+        api_server.stop_server()
+        for k, v in old.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
+
+def _req(port, method, path, headers=None, timeout=30, **params):
+    url = f"http://127.0.0.1:{port}{path}"
+    data = None
+    if method == "POST":
+        data = urllib.parse.urlencode(
+            {k: (json.dumps(v) if isinstance(v, (list, dict)) else v)
+             for k, v in params.items()}).encode()
+    elif params:
+        url += ("&" if "?" in url else "?") + urllib.parse.urlencode(params)
+    req = urllib.request.Request(url, data=data, method=method)
+    if data is not None:
+        req.add_header("Content-Type", "application/x-www-form-urlencoded")
+    for k, v in (headers or {}).items():
+        req.add_header(k, v)
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            return resp.status, json.loads(resp.read()), dict(resp.headers)
+    except urllib.error.HTTPError as e:
+        body = e.read()
+        return e.code, json.loads(body) if body else {}, dict(e.headers)
+
+
+def _train_frame(key):
+    r = np.random.RandomState(9)
+    n = 3000
+    X = r.randn(n, 4)
+    yv = (X[:, 0] + 0.5 * X[:, 1] > 0).astype(int)
+    return h2o3_tpu.Frame.from_numpy(
+        {**{f"x{i}": X[:, i] for i in range(4)},
+         "y": np.array(["n", "p"], dtype=object)[yv]},
+        categorical=["y"], key=key)
+
+
+def _poll_job(port, key, timeout=60):
+    t0 = time.time()
+    while time.time() - t0 < timeout:
+        st, j, _ = _req(port, "GET", f"/3/Jobs/{key}")
+        assert st == 200, j
+        jd = j["jobs"][0]
+        if jd["status"] in ("DONE", "FAILED", "CANCELLED"):
+            return jd
+        time.sleep(0.1)
+    raise TimeoutError(key)
+
+
+# --------------------------------------------------------- admission gate
+
+
+def test_overload_sheds_503_and_exempt_endpoints_survive(gated_port):
+    """Acceptance: a ≥4× max_inflight burst gets clean 503s with
+    Retry-After in H2OErrorV3 shape, while /3/Ping, /3/Metrics and
+    /3/Jobs keep answering with bounded latency."""
+    _RELEASE.clear()
+    rej0 = telemetry.REGISTRY.value("rest_rejected_total",
+                                    reason="saturated")
+    pool = ThreadPoolExecutor(max_workers=12)
+    try:
+        futs = [pool.submit(_req, gated_port, "GET", "/3/TestBlock",
+                            timeout=30) for _ in range(12)]
+        time.sleep(0.5)           # burst fully arrived; gate saturated
+        # exempt endpoints answer fast while the gate is saturated
+        for path in ("/3/Ping", "/3/Metrics", "/3/Jobs"):
+            t0 = time.time()
+            st, _, _ = _req(gated_port, "GET", path, timeout=10)
+            assert st == 200, path
+            assert time.time() - t0 < 2.0, \
+                f"{path} latency unbounded under overload"
+        _RELEASE.set()
+        results = [f.result(timeout=30) for f in futs]
+    finally:
+        _RELEASE.set()
+        pool.shutdown(wait=True)
+    codes = [st for st, _, _ in results]
+    n200, n503 = codes.count(200), codes.count(503)
+    assert n200 + n503 == 12, codes
+    assert n200 >= 3, codes                  # the in-flight slots finished
+    assert n503 >= 12 - 3 - 2, codes         # everything past the queue shed
+    for st, body, hdrs in results:
+        if st == 503:
+            assert hdrs.get("Retry-After"), "503 must carry Retry-After"
+            assert body["__meta"]["schema_name"] == "H2OErrorV3"
+            assert body["http_status"] == 503
+    assert telemetry.REGISTRY.value(
+        "rest_rejected_total", reason="saturated") - rej0 >= 7
+    # the gate drains: inflight gauge returns to zero
+    t0 = time.time()
+    while telemetry.REGISTRY.value("rest_inflight_requests") > 0:
+        assert time.time() - t0 < 10, "inflight gauge never drained"
+        time.sleep(0.05)
+
+
+@pytest.mark.slow
+def test_overload_soak_inflight_stays_bounded(gated_port):
+    """Sustained saturation: the inflight gauge never exceeds the gate
+    and ping latency stays bounded for the whole soak window."""
+    _RELEASE.clear()
+    pool = ThreadPoolExecutor(max_workers=24)
+    try:
+        futs = [pool.submit(_req, gated_port, "GET", "/3/TestBlock",
+                            timeout=40) for _ in range(24)]
+        t_end = time.time() + 8.0
+        while time.time() < t_end:
+            assert telemetry.REGISTRY.value("rest_inflight_requests") <= 3
+            t0 = time.time()
+            st, _, _ = _req(gated_port, "GET", "/3/Ping", timeout=10)
+            assert st == 200
+            assert time.time() - t0 < 2.0
+            time.sleep(0.2)
+        _RELEASE.set()
+        for f in futs:
+            f.result(timeout=40)
+    finally:
+        _RELEASE.set()
+        pool.shutdown(wait=True)
+
+
+# ------------------------------------------------------ request deadlines
+
+
+def test_build_completes_inside_generous_deadline(gated_port):
+    """A deadlined model build that finishes in time returns 200 with
+    the job snapshot refreshed to DONE (no client re-poll needed)."""
+    _train_frame("hardening_ok_train")
+    st, j, _ = _req(gated_port, "POST", "/3/ModelBuilders/gbm",
+                    **{"_timeout_ms": 120000,
+                       "training_frame": "hardening_ok_train",
+                       "response_column": "y", "ntrees": 5,
+                       "max_depth": 5, "seed": 1,
+                       "model_id": "hardening_ok_model"})
+    try:
+        assert st == 200, j
+        assert j["job"]["status"] == "DONE", j["job"]
+    finally:
+        for k in (j.get("job", {}).get("key", {}).get("name"),
+                  "hardening_ok_model"):
+            if k:
+                DKV.remove(k)
+
+
+def test_deadline_expired_build_408_job_cancelled_no_leak(gated_port):
+    """Acceptance: an expired model-build deadline answers 408, the job
+    ends CANCELLED (not RUNNING), and every key the build created is
+    released — only the job key remains, and the test removes it."""
+    _train_frame("hardening_dl_train")
+    before = set(DKV.keys())
+    dl0 = telemetry.REGISTRY.value("request_deadline_exceeded_total")
+    st, j, _ = _req(gated_port, "POST", "/3/ModelBuilders/gbm",
+                    timeout=120,
+                    **{"_timeout_ms": 300,
+                       "training_frame": "hardening_dl_train",
+                       "response_column": "y", "ntrees": 400,
+                       "max_depth": 5, "seed": 1,
+                       "model_id": "hardening_dl_model"})
+    assert st == 408, j
+    assert j["__meta"]["schema_name"] == "H2OErrorV3"
+    jk = j["values"]["job"]
+    try:
+        # cooperative cancellation lands within one chunk boundary
+        jd = _poll_job(gated_port, jk, timeout=90)
+        assert jd["status"] == "CANCELLED", jd
+        assert telemetry.REGISTRY.value(
+            "request_deadline_exceeded_total") > dl0
+        # no partial model, no stray keys: the cancelled job's Scope
+        # swept everything it created; only its own job key remains
+        assert DKV.get_raw("hardening_dl_model") is None
+        leaked = set(DKV.keys()) - before - {jk}
+        assert not leaked, f"cancelled build leaked keys: {sorted(leaked)}"
+        running = [d for d in list_jobs() if d["status"] == "RUNNING"]
+        assert not running, running
+    finally:
+        DKV.remove(jk)
+
+
+def test_deadline_header_and_malformed_deadline(gated_port):
+    st, j, _ = _req(gated_port, "GET", "/3/Cloud",
+                    headers={"X-H2O-Deadline-Ms": "30000"})
+    assert st == 200 and j["cloud_size"] == 8
+    st, j, _ = _req(gated_port, "GET", "/3/Cloud",
+                    **{"_timeout_ms": "soon"})
+    assert st == 400
+    assert j["__meta"]["schema_name"] == "H2OErrorV3"
+
+
+def test_cancel_mid_gbm_stops_within_chunk_and_releases_keys(gated_port):
+    """Satellite: POST /3/Jobs/{key}/cancel mid-fit → CANCELLED within
+    one chunk boundary, Scope keys released (only the job key stays)."""
+    _train_frame("hardening_cancel_train")
+    before = set(DKV.keys())
+    st, j, _ = _req(gated_port, "POST", "/3/ModelBuilders/gbm",
+                    **{"training_frame": "hardening_cancel_train",
+                       "response_column": "y", "ntrees": 400,
+                       "max_depth": 5, "seed": 1,
+                       "model_id": "hardening_cancel_model"})
+    assert st == 200, j
+    jk = j["job"]["key"]["name"]
+    try:
+        st, _, _ = _req(gated_port, "POST", f"/3/Jobs/{jk}/cancel")
+        assert st == 200
+        t0 = time.time()
+        jd = _poll_job(gated_port, jk, timeout=90)
+        assert jd["status"] == "CANCELLED", jd
+        # the fit observed the cancel at a chunk boundary, not at the end
+        assert jd["progress"] < 1.0, jd
+        assert time.time() - t0 < 60
+        assert DKV.get_raw("hardening_cancel_model") is None
+        leaked = set(DKV.keys()) - before - {jk}
+        assert not leaked, f"cancelled fit leaked keys: {sorted(leaked)}"
+    finally:
+        DKV.remove(jk)
+
+
+# ------------------------------------------- cooperative cancel plumbing
+
+
+def test_frame_reduce_observes_deadline():
+    from h2o3_tpu.parallel.map_reduce import frame_reduce
+    with request_ctx.deadline_scope(time.monotonic() - 0.001):
+        with pytest.raises(request_ctx.DeadlineExceeded):
+            frame_reduce(lambda a: a.sum(), np.arange(64.0))
+
+
+def test_frame_map_observes_job_cancel():
+    from h2o3_tpu.parallel.map_reduce import frame_map
+    job = Job("cancel-point probe")
+    job.cancel()
+    with request_ctx.job_scope(job):
+        with pytest.raises(JobCancelledException):
+            frame_map(lambda a: a * 2, np.arange(64.0))
+
+
+def test_job_captures_request_deadline_and_cancels():
+    """Job.start re-installs the submission-time deadline on the worker
+    thread; the progress-update checkpoint expires it → CANCELLED."""
+    with request_ctx.deadline_scope(time.monotonic() + 0.05):
+        j = Job("deadline capture probe")
+
+    def work(job):
+        t0 = time.time()
+        while time.time() - t0 < 20:
+            time.sleep(0.01)
+            job.update(0.001)
+        return "finished"
+
+    j.start(work, background=True).join(30)
+    assert j.status == CANCELLED
+    assert j.result is None
+    assert j.progress_msg == "deadline exceeded"
+
+
+def test_cancelled_job_releases_scope_keys():
+    """Keys a job creates are swept when it ends CANCELLED; a DONE job
+    keeps them (water/Scope exit-on-abort role)."""
+    started = threading.Event()
+
+    def work(job):
+        DKV.put("hardening_partial_key", {"partial": True})
+        started.set()
+        while True:
+            time.sleep(0.01)
+            job.update(0.0)
+
+    j = Job("scope release probe")
+    j.start(work, background=True)
+    assert started.wait(20)
+    assert DKV.get_raw("hardening_partial_key") is not None
+    j.cancel()
+    j.join(30)
+    assert j.status == CANCELLED
+    assert DKV.get_raw("hardening_partial_key") is None
+
+    def work_done(job):
+        DKV.put("hardening_kept_key", {"done": True})
+        return "ok"
+
+    j2 = Job("scope keep probe").start(work_done)
+    assert j2.status == DONE
+    assert DKV.get_raw("hardening_kept_key") is not None
+    DKV.remove("hardening_kept_key")
+
+
+def test_list_jobs_skips_dead_keys(monkeypatch):
+    """Satellite: a job key removed between keys() and get() must be
+    skipped, not AttributeError on None.to_dict()."""
+    real_keys = DKV.keys
+
+    def ghost_keys(prefix=""):
+        return iter(list(real_keys(prefix)) + ["job_ghost_removed"])
+
+    monkeypatch.setattr(DKV, "keys", ghost_keys)
+    jobs = list_jobs()          # must not raise
+    assert all(d["key"]["name"] != "job_ghost_removed" for d in jobs)
+
+
+# ------------------------------------------------- malformed requests
+
+
+def test_malformed_json_body_is_400(gated_port):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{gated_port}/3/LogAndEcho",
+        data=b'{"message": oops', method="POST")
+    req.add_header("Content-Type", "application/json")
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        urllib.request.urlopen(req, timeout=10)
+    assert ei.value.code == 400
+    body = json.loads(ei.value.read())
+    assert body["__meta"]["schema_name"] == "H2OErrorV3"
+    assert "JSON" in body["msg"]
+
+
+def test_malformed_content_length_is_400(gated_port):
+    """A non-integer Content-Length used to raise before the dispatch
+    try block and drop the connection; now it's a clean 400."""
+    import http.client
+    conn = http.client.HTTPConnection("127.0.0.1", gated_port, timeout=10)
+    try:
+        conn.putrequest("POST", "/3/LogAndEcho")
+        conn.putheader("Content-Length", "banana")
+        conn.endheaders()
+        resp = conn.getresponse()
+        assert resp.status == 400
+        body = json.loads(resp.read())
+        assert body["__meta"]["schema_name"] == "H2OErrorV3"
+        assert "Content-Length" in body["msg"]
+    finally:
+        conn.close()
+
+
+def test_body_over_cap_is_413(gated_port):
+    rej0 = telemetry.REGISTRY.value("rest_rejected_total",
+                                    reason="body_too_large")
+    big = urllib.parse.urlencode(
+        {"message": "x" * (2 << 20)}).encode()      # 2 MB > 1 MB cap
+    st, j, _ = _req_raw_post(gated_port, "/3/LogAndEcho", big)
+    assert st == 413, j
+    assert j["__meta"]["schema_name"] == "H2OErrorV3"
+    assert telemetry.REGISTRY.value(
+        "rest_rejected_total", reason="body_too_large") > rej0
+
+
+def _req_raw_post(port, path, data):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}{path}", data=data, method="POST")
+    req.add_header("Content-Type", "application/x-www-form-urlencoded")
+    try:
+        with urllib.request.urlopen(req, timeout=30) as resp:
+            return resp.status, json.loads(resp.read()), dict(resp.headers)
+    except urllib.error.HTTPError as e:
+        body = e.read()
+        return e.code, json.loads(body) if body else {}, dict(e.headers)
+
+
+def test_postfile_streams_to_disk(gated_port):
+    """/3/PostFile accepts a body LARGER than the buffered-body cap —
+    it streams to disk in chunks instead of buffering."""
+    import os
+    payload = b"a,b\n" + b"1,2\n" * (600 << 10)     # ~2.4 MB > 1 MB cap
+    st, j, _ = _req_raw_post(gated_port, "/3/PostFile", payload)
+    assert st == 200, j
+    assert j["total_bytes"] == len(payload)
+    assert os.path.exists(j["destination_frame"])
+    os.unlink(j["destination_frame"])
+
+
+# --------------------------------------------------- client disconnects
+
+
+def test_client_disconnect_counted_not_crashed(gated_port):
+    """A client that hangs up mid-request is counted, and the handler
+    thread survives to serve the next request."""
+    _RELEASE.clear()
+    c0 = telemetry.REGISTRY.value("rest_client_disconnects_total")
+    s = socket.create_connection(("127.0.0.1", gated_port), timeout=10)
+    try:
+        # SO_LINGER(0): close sends RST so the parked handler's write
+        # deterministically fails instead of landing in a dead buffer
+        s.setsockopt(socket.SOL_SOCKET, socket.SO_LINGER,
+                     struct.pack("ii", 1, 0))
+        s.sendall(b"GET /3/TestBlock HTTP/1.1\r\n"
+                  b"Host: 127.0.0.1\r\n\r\n")
+        time.sleep(0.3)          # handler is parked on _RELEASE
+    finally:
+        s.close()
+    _RELEASE.set()
+    t0 = time.time()
+    while telemetry.REGISTRY.value("rest_client_disconnects_total") <= c0:
+        assert time.time() - t0 < 15, "disconnect never counted"
+        time.sleep(0.05)
+    # the server is still healthy
+    st, _, _ = _req(gated_port, "GET", "/3/Ping")
+    assert st == 200
