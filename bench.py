@@ -449,19 +449,74 @@ def bench_automl():
         max_runtime_secs=round(cap, 0), **extra)
 
 
+def bench_grid():
+    """Model-batched grid search (parallel/model_batch.py): one
+    numeric-only GBM shape bucket trained as a single vmapped program
+    vs the sequential per-combo walk — models/sec, both paths."""
+    import h2o3_tpu
+    from h2o3_tpu.ml.grid import GridSearch
+    from h2o3_tpu.models.gbm import GBMEstimator
+    n = 100_000 if FAST else 500_000
+    r = np.random.RandomState(9)
+    X = r.randn(n, 6).astype(np.float32)
+    yv = (X[:, 0] + 0.5 * X[:, 1] + 0.5 * r.randn(n) > 0).astype(int)
+    cols = {f"x{i}": X[:, i] for i in range(6)}
+    cols["y"] = np.array(["N", "Y"], object)[yv]
+    fr = h2o3_tpu.Frame.from_numpy(cols, categorical=["y"])
+    hyper = {"learn_rate": [0.05, 0.08, 0.1, 0.15],
+             "sample_rate": [0.7, 1.0],
+             "min_rows": [5.0, 20.0]}            # 16 combos, ONE bucket
+    n_combos = 4 * 2 * 2
+    fixed = dict(ntrees=20, max_depth=6, seed=1)
+
+    def _run(batch_mode):
+        os.environ["H2O3TPU_BATCH_MODELS"] = batch_mode
+        try:
+            t0 = time.time()
+            g = GridSearch(GBMEstimator, hyper, **fixed).train(fr, y="y")
+            return time.time() - t0, g
+        finally:
+            os.environ.pop("H2O3TPU_BATCH_MODELS", None)
+
+    # warmup compiles both programs on a 2-combo slice
+    wf = dict(fixed)
+    whyper = {"learn_rate": [0.05, 0.1]}
+    for mode in ("auto", "off"):
+        os.environ["H2O3TPU_BATCH_MODELS"] = mode
+        GridSearch(GBMEstimator, whyper, **wf).train(fr, y="y")
+    os.environ.pop("H2O3TPU_BATCH_MODELS", None)
+    c0 = _compile_count()
+    t_bat, g_bat = _run("auto")
+    compiles_bat = _compile_count() - c0
+    t_seq, _ = _run("off")
+    mps_bat = n_combos / t_bat
+    mps_seq = n_combos / t_seq
+    _emit(
+        f"grid GBM {n_combos} combos {n/1e3:.0f}K rows "
+        f"(model-batched vmap vs sequential walk)",
+        mps_bat, "models/sec",
+        mps_bat / mps_seq, "sequential per-combo walk, same config",
+        batched_seconds=round(t_bat, 1),
+        sequential_seconds=round(t_seq, 1),
+        n_models=len(g_bat.models),
+        compiles_timed=compiles_bat,
+        peak_hbm_gb=round(_hbm_peak() / 1e9, 2))
+
+
 CONFIGS = [("gbm", bench_gbm), ("glm", bench_glm), ("dl", bench_dl),
            ("xgb", bench_xgb), ("sort", bench_sort),
+           ("grid", bench_grid),
            ("automl", bench_automl), ("gbm-full", bench_gbm_full)]
 
 # minimum seconds a config plausibly needs; skipped (with a JSON note)
 # rather than started when the remaining budget is below it
 _MIN_NEED = {"gbm": 60, "glm": 90, "dl": 60, "xgb": 60, "sort": 60,
-             "automl": 180, "gbm-full": 600}
+             "grid": 120, "automl": 180, "gbm-full": 600}
 
 # hard per-config wallclock cap (child process killed past it): a
 # wedged worker costs one line, never the scoreboard
 _HARD_CAP = {"gbm": 900, "glm": 600, "dl": 600, "xgb": 600, "sort": 400,
-             "automl": 900, "gbm-full": 1200}
+             "grid": 600, "automl": 900, "gbm-full": 1200}
 
 
 def _stub_ok(name):
@@ -475,9 +530,30 @@ def _stub_wedge():
     time.sleep(3600)
 
 
+def _stub_grid():
+    """`grid` models/sec line without a backend: drives the model-batch
+    PLANNER (shape buckets, canonical combo keys, the knob) over a
+    synthetic numeric-only GBM grid, so the harness exercises the
+    batched-path plumbing even where no accelerator exists."""
+    from h2o3_tpu.parallel import model_batch
+    combos = [{"learn_rate": lr, "sample_rate": sr, "max_depth": d}
+              for lr in (0.05, 0.1) for sr in (0.8, 1.0)
+              for d in (5, 12)]       # 8 combos, TWO depth buckets
+    t0 = time.time()
+    buckets = model_batch.plan_buckets("gbm", combos)
+    assert len({model_batch.combo_key(c) for c in combos}) == len(combos)
+    dt = max(time.time() - t0, 1e-6)
+    _emit("grid GBM 8 combos (stub; bucket planner, no backend)",
+          len(combos) / dt, "models/sec", 1.0, "stub",
+          buckets=len(buckets),
+          widths=sorted(b.width for b in buckets),
+          batched=model_batch.enabled())
+
+
 if STUB:
     CONFIGS = [("stub_a", _stub_ok("stub_a")),
                ("stub_wedge", _stub_wedge),
+               ("grid", _stub_grid),
                ("stub_b", _stub_ok("stub_b"))]
     _MIN_NEED = {n: 1 for n, _ in CONFIGS}
     _HARD_CAP = {n: 30 for n, _ in CONFIGS}

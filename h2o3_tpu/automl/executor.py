@@ -154,25 +154,35 @@ def run_step(aml, step, budget: Budget, training_frame, y, x) -> List:
             sub_dir = None
             if aml._recovery is not None:
                 sub_dir = os.path.join(aml._recovery.dir, step.id)
-            if sub_dir and os.path.exists(
-                    os.path.join(sub_dir, "grid_state.json")):
-                # the previous process died inside this grid walk: its
-                # per-combo snapshots resume here — only the combo in
-                # flight at the kill retrains
-                grid = resume_grid(sub_dir, training_frame)
-            else:
-                remaining = budget.remaining_models()
-                rem_s = budget.remaining_secs()
-                gs = GridSearch(
-                    cls, step.hyper,
-                    search_criteria={
-                        "strategy": "RandomDiscrete",
-                        "max_models": min(remaining, step.grid_models),
-                        "max_runtime_secs": rem_s or 0,
-                        "seed": aml.seed},
-                    recovery_dir=sub_dir,
-                    **{**step.params, "nfolds": aml.nfolds})
-                grid = gs.train(training_frame, y=y, x=x)
+            # grid combos route through the model-batched path when
+            # eligible (parallel/model_batch.py via GridSearch.train):
+            # shape buckets train as one vmapped program; CV folds,
+            # structural knob spreads and batch failures fall back
+            # per-combo inside the grid walk
+            from h2o3_tpu import telemetry
+            from h2o3_tpu.parallel import model_batch
+            with telemetry.span("automl.grid_step", step=step.id,
+                                batched=model_batch.enabled()):
+                if sub_dir and os.path.exists(
+                        os.path.join(sub_dir, "grid_state.json")):
+                    # the previous process died inside this grid walk:
+                    # its per-combo snapshots resume here — only the
+                    # combo in flight at the kill retrains (the resumed
+                    # walk re-plans batch buckets over what is LEFT)
+                    grid = resume_grid(sub_dir, training_frame)
+                else:
+                    remaining = budget.remaining_models()
+                    rem_s = budget.remaining_secs()
+                    gs = GridSearch(
+                        cls, step.hyper,
+                        search_criteria={
+                            "strategy": "RandomDiscrete",
+                            "max_models": min(remaining, step.grid_models),
+                            "max_runtime_secs": rem_s or 0,
+                            "seed": aml.seed},
+                        recovery_dir=sub_dir,
+                        **{**step.params, "nfolds": aml.nfolds})
+                    grid = gs.train(training_frame, y=y, x=x)
             for m in grid.models:
                 m.output["automl_step"] = step.id
             trained_count = len(grid.models)

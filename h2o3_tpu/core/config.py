@@ -51,6 +51,11 @@ class Config:
     # Content-Length cap for buffered bodies (MB); /3/PostFile streams
     # to disk in chunks and is exempt
     rest_max_body_mb: int = 256
+    # -- model batching (parallel/model_batch.py) ----------------------
+    # grid/AutoML combos sharing one compiled program train as a single
+    # vmapped batch: "auto" (default) batches eligible buckets of >= 2
+    # combos; "off"/"0" forces the sequential per-combo walk
+    batch_models: str = "auto"
 
     # fields that parse as int from the environment (annotations are
     # strings under `from __future__ import annotations`, so resolve

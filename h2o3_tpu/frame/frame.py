@@ -29,6 +29,9 @@ class Frame:
     def __init__(self, columns: List[Column], nrows: int, key: Optional[str] = None):
         self._cols: Dict[str, Column] = {c.name: c for c in columns}
         self._order: List[str] = [c.name for c in columns]
+        # device_matrix cache: column-name tuple -> stacked [Npad, F]
+        # device array (invalidated on column mutation)
+        self._matrix_cache: Dict[tuple, jax.Array] = {}
         self.nrows = nrows
         self.key = key or make_key("frame")
         DKV.put(self.key, self)
@@ -91,6 +94,8 @@ class Frame:
             new_cols[new] = c
         self._cols = new_cols
         self._order = list(new_names)
+        # name-keyed cache: stale after rename
+        getattr(self, "_matrix_cache", {}).clear()
         # a mutated frame no longer matches its source file — the
         # Cleaner must not evict it back to a FileBackedFrame stub
         self._source_paths = None
@@ -155,6 +160,7 @@ class Frame:
         self._cols[col.name] = col
         if col.name not in self._order:
             self._order.append(col.name)
+        getattr(self, "_matrix_cache", {}).clear()   # column set changed
         self._source_paths = None    # mutated: no source-stub eviction
 
     def drop(self, names: Sequence[str]) -> "Frame":
@@ -203,11 +209,29 @@ class Frame:
             data[n] = v
         return pd.DataFrame(data)
 
+    def device_matrix(self, names: Optional[Sequence[str]] = None) -> jax.Array:
+        """Stacked [Npad, F] float32 device matrix, CACHED per
+        column-name tuple: repeated grid/AutoML fits and predicts over
+        the same feature set previously re-ran ``jnp.stack`` over every
+        column on each call, re-materializing X in HBM each time. The
+        cache invalidates on column mutation (add_column /
+        rename_columns) — column data itself is an immutable device
+        array, so name identity is sufficient."""
+        import jax.numpy as jnp
+        key = tuple(names) if names is not None else tuple(self._order)
+        cache = getattr(self, "_matrix_cache", None)
+        if cache is None:            # deserialized pre-cache instances
+            cache = self._matrix_cache = {}
+        m = cache.get(key)
+        if m is None:
+            m = jnp.stack([self.col(n).numeric_view() for n in key],
+                          axis=1)
+            cache[key] = m
+        return m
+
     def matrix(self, names: Optional[Sequence[str]] = None) -> jax.Array:
         """Stack numeric views into a padded [Npad, F] float32 device matrix."""
-        import jax.numpy as jnp
-        names = list(names or self._order)
-        return jnp.stack([self.col(n).numeric_view() for n in names], axis=1)
+        return self.device_matrix(names)
 
     def valid_weights(self) -> jax.Array:
         """1.0 for logical rows, 0.0 for mesh-padding rows."""

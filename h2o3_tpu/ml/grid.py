@@ -4,8 +4,12 @@ Reference: hex/grid/GridSearch.java:70 (startGridSearch at :662) with
 HyperSpaceWalker strategies (Cartesian, RandomDiscrete with max_models /
 max_runtime_secs / seed budgets) and the Grid key'd model collection.
 Model-parallel training over spare mesh slices is reference parallelism
-#5 (SURVEY §2.4); here candidates run sequentially on the one mesh —
-each candidate itself uses the full mesh.
+#5 (SURVEY §2.4). Eligible combos batch through parallel/model_batch.py:
+shape buckets (same structural knobs) train as ONE vmapped program and
+unstack into ordinary Models, so an M-combo bucket costs one dispatch
+instead of M; everything else — and any batched-path failure — walks
+the sequential per-combo path, preserving grid semantics, early
+stopping, recovery snapshots and leaderboard order exactly.
 """
 
 from __future__ import annotations
@@ -16,8 +20,10 @@ from typing import Dict, List, Optional, Sequence
 
 import numpy as np
 
+from h2o3_tpu import telemetry
 from h2o3_tpu.core.job import Job
 from h2o3_tpu.core.kv import DKV, make_key
+from h2o3_tpu.parallel import model_batch
 from h2o3_tpu.utils.log import get_logger
 
 log = get_logger("h2o3_tpu.grid")
@@ -173,7 +179,12 @@ class GridSearch:
         combos = self._combos()
         done = _skip_done or []
         if done:
-            combos = [c for c in combos if c not in done]
+            # canonical-key set filter: the resume path previously ran
+            # an O(n·m) dict-equality scan (`c not in done`) — a 10K-
+            # combo grid resumed late paid ~10K·10K dict compares
+            done_keys = {model_batch.combo_key(c) for c in done}
+            combos = [c for c in combos
+                      if model_batch.combo_key(c) not in done_keys]
         budget_s = float(self.criteria.get("max_runtime_secs", 0) or 0)
         max_models = int(self.criteria.get("max_models", 0) or 0)
         stop_rounds = int(self.criteria.get("stopping_rounds", 0) or 0)
@@ -185,6 +196,17 @@ class GridSearch:
         failures: List[dict] = []
         job = Job(f"grid {self.builder_cls.algo}", work=float(len(combos)))
         job.status = "RUNNING"
+        # ---- model-batched pre-training (parallel/model_batch.py) ----
+        # eligible shape buckets train as ONE vmapped program up front;
+        # the walk below then consumes the pre-trained models in combo
+        # order, so budgets, max_models, asymptotic stopping, recovery
+        # snapshots and leaderboard order behave exactly as sequential
+        # (models trained past a stop/budget point are discarded).
+        pre = self._train_batched(combos, training_frame, y, x,
+                                  validation_frame, job,
+                                  budget_s=budget_s, t0=t0,
+                                  max_models=max_models,
+                                  prior=len(models))
         for i, combo in enumerate(combos):
             if budget_s and time.time() - t0 > budget_s:
                 log.info("grid budget exhausted after %d models", len(models))
@@ -193,9 +215,13 @@ class GridSearch:
                 break
             params = {**self.fixed, **combo}
             try:
-                b = self.builder_cls(**params)
-                m = b.train(training_frame, y=y, x=x,
-                            validation_frame=validation_frame)
+                m = pre.pop(i, None)
+                if m is None:
+                    b = self.builder_cls(**params)
+                    m = b.train(training_frame, y=y, x=x,
+                                validation_frame=validation_frame)
+                telemetry.counter("grid_models_total",
+                                  algo=self.builder_cls.algo).inc()
                 m.output["grid_params"] = combo
                 models.append(m)
                 if self.recovery_dir:
@@ -218,10 +244,51 @@ class GridSearch:
                 log.warning("grid combo %s failed: %s", combo, e)
                 failures.append({"params": combo, "error": str(e)})
             job.update(1.0, f"model {i + 1}/{len(combos)}")
+        # pre-trained models the walk never consumed (budget/max_models/
+        # stopping fired first) are discarded — sequential never trained
+        # them, so they must not linger in the store either
+        for m in pre.values():
+            DKV.remove(m.key)
         job.status = "DONE"
         sort_metric = (self.criteria.get("sort_metric")
                        or (default_sort_metric(models[0]) if models else "mse"))
         return Grid(self.grid_id, models, failures, sort_metric)
+
+    def _train_batched(self, combos: List[dict], training_frame, y, x,
+                       validation_frame, job, *, budget_s: float,
+                       t0: float, max_models: int, prior: int) -> Dict:
+        """Pre-train eligible shape buckets as vmapped programs; returns
+        {combo index -> Model}. Any failure or ineligibility leaves the
+        affected combos to the sequential walk — this method can only
+        ever ADD pre-trained models, never change grid semantics."""
+        pre: Dict[int, object] = {}
+        if not model_batch.enabled() or len(combos) < 2:
+            return pre
+        # successes cap: combos past max_models can never enter the grid
+        # (failures would shift the window — those walk sequentially)
+        planned = combos if not max_models \
+            else combos[: max(max_models - prior, 0)]
+        algo = self.builder_cls.algo
+        for bucket in model_batch.plan_buckets(algo, planned):
+            if bucket.width < 2:
+                continue            # one model gains nothing from vmap
+            if budget_s and time.time() - t0 > budget_s:
+                break
+            bcombos = [planned[i] for i in bucket.indices]
+            try:
+                bmodels = model_batch.train_bucket(
+                    self.builder_cls, self.fixed, bcombos,
+                    training_frame, y=y, x=x,
+                    validation_frame=validation_frame)
+                pre.update(zip(bucket.indices, bmodels))
+            except model_batch.BatchIneligible as e:
+                log.debug("grid bucket not batchable (%s): sequential "
+                          "fallback", e)
+            except Exception as e:   # noqa: BLE001 - fallback boundary
+                log.warning("batched %s bucket failed (%s); per-combo "
+                            "fallback", algo, e)
+            job.update(0.0, "batched buckets")   # cancellation checkpoint
+        return pre
 
     # -- fault tolerance (hex/faulttolerance/Recovery onModel snapshots) --
     def _snapshot(self, model, combo: dict, done: List[dict],

@@ -35,8 +35,10 @@ from h2o3_tpu.models.distribution import Distribution, get_distribution
 from h2o3_tpu.models.model import (Model, ModelBuilder, ModelCategory,
                                    infer_category)
 from h2o3_tpu.models.tree import (Tree, TreeParams, TreeScalars,
-                                  bucket_depth, exact_f32_for, grow_tree,
-                                  predict_forest, predict_tree, stack_trees)
+                                  bucket_depth, concat_forests,
+                                  exact_f32_for, grow_tree,
+                                  predict_forest, predict_tree,
+                                  stack_trees, unstack_model_trees)
 from h2o3_tpu.parallel.mesh import (get_mesh, put_sharded,
                                     row_sharding)
 from h2o3_tpu import telemetry
@@ -173,6 +175,52 @@ def _boost_scan_scored_jit(bins, nb, y, w, margin, key, tree0,
     (margin, vmargin), (trees, gains, devs) = jax.lax.scan(
         step, (margin, vmargin), keys)
     return trees, margin, vmargin, gains, devs
+
+
+def _boost_scan_batched(bins, nb, y, w, margins, keys, knobs_b,
+                        constraints=None, interaction_sets=None, *,
+                        tp: TreeParams, dist: Distribution, ntrees: int,
+                        tree0: int = 0):
+    return _boost_scan_batched_jit(bins, nb, y, w, margins, keys, tree0,
+                                   knobs_b, constraints, interaction_sets,
+                                   tp=_neutral_tp(tp), dist=dist,
+                                   ntrees=ntrees)
+
+
+@observed_jit("gbm.boost_scan_batched")
+@partial(jax.jit, static_argnames=("tp", "dist", "ntrees"))
+def _boost_scan_batched_jit(bins, nb, y, w, margins, keys, tree0, knobs_b,
+                            constraints=None, interaction_sets=None, *,
+                            tp: TreeParams, dist: Distribution,
+                            ntrees: int):
+    """Model-batched boosting: ``vmap`` over the MODEL axis of a whole
+    grid/AutoML shape bucket — ``knobs_b`` [M, 7] numeric knob vectors,
+    ``keys`` [M, 2] per-model PRNG keys, ``margins`` [M, Npad] — with
+    ``bins``/``y``/``w`` broadcast (shared, un-vmapped). One compiled
+    program trains M models where the sequential walk paid M dispatch/
+    readback round trips (the driver-bound outer loop of ml/grid.py).
+
+    Every step also emits the training deviance so the host can apply
+    per-model early-stop MASKS (truncate each model's stacked forest at
+    its stop point) instead of the sequential path's Python breaks.
+    Returns ([M, T, ...] stacked trees, [M, Npad] margins, [M, T, F]
+    gains, [M, T] deviances)."""
+    keys_t = jax.vmap(lambda k: _tree_keys(k, tree0, ntrees))(keys)
+
+    def one(margin, tkeys, knobs):
+        def step(margin, k):
+            tree, margin, gains = _boost_step_impl(
+                bins, nb, y, w, margin, k, knobs, tp=tp, dist=dist,
+                constraints=constraints,
+                interaction_sets=interaction_sets)
+            dev = jnp.sum(w * dist.deviance(y, margin)) \
+                / jnp.maximum(jnp.sum(w), 1e-12)
+            return margin, (tree, gains, dev)
+
+        margin, (trees, gains, devs) = jax.lax.scan(step, margin, tkeys)
+        return trees, margin, gains, devs
+
+    return jax.vmap(one)(margins, keys_t, knobs_b)
 
 
 def _boost_scan_multi(bins, nb, y_int, w, margins, key,
@@ -336,6 +384,54 @@ def _stop_point(devs, done, k, score_interval, stopper,
             if stopper.should_stop(devf):
                 return t_local + 1
     return k
+
+
+def _build_constraints(p, x, frame, category):
+    """Monotone constraints vector (GBM.java monotone_constraints;
+    numeric features only, like the reference's validation)."""
+    mc = p.get("monotone_constraints") or {}
+    if isinstance(mc, (list, tuple)):
+        # h2o-py serializes this as KeyValue pairs
+        # ([{'key': col, 'value': ±1}, ...], water/api/schemas3/KeyValueV3)
+        mc = {kv["key"]: kv["value"] for kv in mc}
+    if not mc:
+        return None
+    unknown_cols = set(mc) - set(x)
+    if unknown_cols:
+        raise ValueError(f"monotone_constraints columns not in "
+                         f"predictors: {sorted(unknown_cols)}")
+    bad = [c for c in mc if frame.col(c).is_categorical]
+    if bad:
+        raise ValueError("monotone_constraints require numeric "
+                         f"columns; categorical: {sorted(bad)}")
+    if category == ModelCategory.MULTINOMIAL:
+        raise ValueError("monotone_constraints are not supported "
+                         "for multinomial distributions")
+    arr = np.zeros(len(x), np.int8)
+    for c, d in mc.items():
+        arr[x.index(c)] = int(np.sign(d))
+    return jnp.asarray(arr)
+
+
+def _build_interaction_sets(p, x):
+    """Interaction-constraint set matrix (GBM interaction_constraints;
+    hex/tree/GlobalInteractionConstraints): listed groups may interact
+    internally; unlisted features become singleton sets."""
+    ic = p.get("interaction_constraints")
+    if not ic:
+        return None
+    unknown_cols = {c for grp in ic for c in grp} - set(x)
+    if unknown_cols:
+        raise ValueError("interaction_constraints columns not in "
+                         f"predictors: {sorted(unknown_cols)}")
+    listed = {c for grp in ic for c in grp}
+    groups = [list(grp) for grp in ic]
+    groups += [[c] for c in x if c not in listed]
+    S = np.zeros((len(groups), len(x)), bool)
+    for si, grp in enumerate(groups):
+        for c in grp:
+            S[si, x.index(c)] = True
+    return jnp.asarray(S)
 
 
 class GBMModel(Model):
@@ -666,49 +762,8 @@ class GBMEstimator(ModelBuilder):
             block_rows=16384 if bm.bins.shape[0] > 8_388_608 else 4096,
             exact_f32=exact_f32_for(bm))
 
-        # monotone constraints (GBM.java monotone_constraints; numeric
-        # features only, like the reference's validation)
-        constraints = None
-        mc = p.get("monotone_constraints") or {}
-        if isinstance(mc, (list, tuple)):
-            # h2o-py serializes this as KeyValue pairs
-            # ([{'key': col, 'value': ±1}, ...], water/api/schemas3/KeyValueV3)
-            mc = {kv["key"]: kv["value"] for kv in mc}
-        if mc:
-            unknown_cols = set(mc) - set(x)
-            if unknown_cols:
-                raise ValueError(f"monotone_constraints columns not in "
-                                 f"predictors: {sorted(unknown_cols)}")
-            bad = [c for c in mc if frame.col(c).is_categorical]
-            if bad:
-                raise ValueError("monotone_constraints require numeric "
-                                 f"columns; categorical: {sorted(bad)}")
-            if category == ModelCategory.MULTINOMIAL:
-                raise ValueError("monotone_constraints are not supported "
-                                 "for multinomial distributions")
-            arr = np.zeros(len(x), np.int8)
-            for c, d in mc.items():
-                arr[x.index(c)] = int(np.sign(d))
-            constraints = jnp.asarray(arr)
-
-        # interaction constraints (GBM interaction_constraints;
-        # hex/tree/GlobalInteractionConstraints): listed groups may
-        # interact internally; unlisted features become singleton sets
-        interaction_sets = None
-        ic = p.get("interaction_constraints")
-        if ic:
-            unknown_cols = {c for grp in ic for c in grp} - set(x)
-            if unknown_cols:
-                raise ValueError("interaction_constraints columns not in "
-                                 f"predictors: {sorted(unknown_cols)}")
-            listed = {c for grp in ic for c in grp}
-            groups = [list(grp) for grp in ic]
-            groups += [[c] for c in x if c not in listed]
-            S = np.zeros((len(groups), len(x)), bool)
-            for si, grp in enumerate(groups):
-                for c in grp:
-                    S[si, x.index(c)] = True
-            interaction_sets = jnp.asarray(S)
+        constraints = _build_constraints(p, x, frame, category)
+        interaction_sets = _build_interaction_sets(p, x)
 
         seed = int(p["seed"]) if int(p["seed"]) >= 0 else 0xDEC0DE
         key = jax.random.PRNGKey(seed)
@@ -859,10 +914,7 @@ class GBMEstimator(ModelBuilder):
                     log.info("max_runtime_secs: GBM stopping at %d/%d "
                              "trees", done, ntrees)
                     break
-            forest = (chunks_m[0] if len(chunks_m) == 1 else
-                      Tree(*(jnp.concatenate([getattr(c, f)
-                                              for c in chunks_m])
-                             for f in Tree._fields)))
+            forest = concat_forests(chunks_m)
             if ckpt is not None:
                 forest = Tree(*(jnp.concatenate([getattr(ckpt.forest, f),
                                                  getattr(forest, f)])
@@ -961,10 +1013,7 @@ class GBMEstimator(ModelBuilder):
                         log.info("max_runtime_secs: GBM stopping at "
                                  "%d/%d trees", done, ntrees)
                         break
-                forest = (chunks[0] if len(chunks) == 1 else
-                          Tree(*(jnp.concatenate([getattr(c, f)
-                                                  for c in chunks])
-                                 for f in Tree._fields)))
+                forest = concat_forests(chunks)
             else:
                 # early stopping WITHOUT leaving the fused path: chunks
                 # of score_interval trees, deviance computed inside the
@@ -1011,10 +1060,7 @@ class GBMEstimator(ModelBuilder):
                         log.info("max_runtime_secs: GBM stopping at "
                                  "%d/%d trees", done, ntrees)
                         break
-                forest = (chunks[0] if len(chunks) == 1 else
-                          Tree(*(jnp.concatenate([getattr(c, f)
-                                                  for c in chunks])
-                                 for f in Tree._fields)))
+                forest = concat_forests(chunks)
             if ckpt is not None:
                 forest = Tree(*(jnp.concatenate([getattr(ckpt.forest, f),
                                                  getattr(forest, f)])
@@ -1051,3 +1097,219 @@ class GBMEstimator(ModelBuilder):
         from h2o3_tpu.ml.calibration import maybe_calibrate
         maybe_calibrate(model, p, category)
         return model
+
+
+# ---- model-batched training (parallel/model_batch.py trainer) ----------
+
+
+def fit_gbm_batched(builder_cls, params_list: List[dict], frame: Frame,
+                    y: Optional[str] = None, x: Optional[Sequence[str]] = None,
+                    validation_frame: Optional[Frame] = None) -> List[Model]:
+    """Train a whole shape bucket of GBM hyperparameter combos as ONE
+    vmapped boosting program (_boost_scan_batched): the shared preamble
+    (binning, weights, init margin) runs once, per-model numeric knobs
+    stack into a [M, 7] matrix, and the host touches the device once per
+    tree CHUNK for the whole bucket instead of once per model per chunk.
+
+    Raises parallel.model_batch.BatchIneligible for anything the vmapped
+    program cannot express (CV, checkpoints, multinomial, runtime caps,
+    validation-frame early stopping) — the caller falls back to the
+    sequential per-combo path, so semantics are always preserved.
+    Models return in ``params_list`` order with the same outputs the
+    sequential path produces (metrics, varimp, scoring history,
+    threshold), matching it within float tolerance."""
+    from h2o3_tpu.parallel.model_batch import BATCHABLE_KNOBS, BatchIneligible
+
+    builders = [builder_cls(**p) for p in params_list]
+    M = len(builders)
+    b0 = builders[0]
+    p0 = b0.params
+    batchable = BATCHABLE_KNOBS["gbm"]
+    for b in builders[1:]:
+        for k, v in b.params.items():
+            if k not in batchable and v != p0.get(k):
+                raise BatchIneligible(f"structural param '{k}' varies")
+    for b in builders:
+        p = b.params
+        if int(p.get("nfolds") or 0) >= 2 or p.get("fold_column"):
+            raise BatchIneligible("cross-validation")
+        if p.get("checkpoint") is not None:
+            raise BatchIneligible("checkpoint restart")
+        if p.get("custom_distribution_func"):
+            raise BatchIneligible("custom distribution")
+        if float(p.get("max_runtime_secs") or 0.0) > 0:
+            raise BatchIneligible("per-model runtime cap")
+    depths = [int(b.params["max_depth"]) for b in builders]
+    if len({bucket_depth(d) for d in depths}) != 1:
+        raise BatchIneligible("max_depth spans compile depth buckets")
+
+    mesh = get_mesh()
+    x = b0.resolve_x(frame, x, y)
+    category = infer_category(frame, y)
+    if category == ModelCategory.MULTINOMIAL:
+        raise BatchIneligible("multinomial (per-class tree loop)")
+    dist_name = b0._resolve_distribution(category)
+    stopper_on = int(p0["stopping_rounds"]) > 0
+    if stopper_on and validation_frame is not None:
+        # validation-side stopping carries a second margin through the
+        # scan — sequential path handles it; not vmapped (yet)
+        raise BatchIneligible("validation-frame early stopping")
+
+    # ---- shared preamble (identical to the sequential _fit) ----------
+    w = frame.valid_weights()
+    if p0.get("weights_column"):
+        wc = frame.col(p0["weights_column"]).numeric_view()
+        w = w * jnp.where(jnp.isnan(wc), 0.0, wc)
+    rc = frame.col(y)
+    if p0.get("check_constant_response", True) and not rc.is_categorical:
+        yh = rc.to_numpy()
+        vals = yh[~np.isnan(yh)]
+        if vals.size and float(vals.min()) == float(vals.max()):
+            raise ValueError(
+                "Response cannot be constant - check your response "
+                "column, or set check_constant_response=False")
+    wh_host = b0._host_weights(frame, y)
+    resp_na_host = np.isnan(rc.to_numpy())
+    if resp_na_host.any():
+        w = w * jnp.asarray(np.pad(
+            (~resp_na_host).astype(np.float32),
+            (0, frame.nrows_padded - frame.nrows)))
+    bm = bin_frame(frame, x, nbins=p0["nbins"],
+                   nbins_cats=p0["nbins_cats"], weights=wh_host)
+    w, w_scale = b0._normalize_uniform_weights(w, wh_host)
+    if w_scale != 1.0:
+        wh_host = wh_host / np.float32(w_scale)
+
+    def _tp_of(p):
+        return TreeParams(
+            max_depth=int(p["max_depth"]),
+            min_rows=float(p["min_rows"]) / w_scale,
+            learn_rate=float(p["learn_rate"]),
+            reg_lambda=float(p["reg_lambda"]) / w_scale,
+            min_split_improvement=float(p["min_split_improvement"])
+            / w_scale,
+            col_sample_rate=float(p["col_sample_rate_per_tree"]),
+            nbins_total=bm.nbins_total,
+            cat_feats=tuple(bool(v) for v in bm.is_cat),
+            block_rows=16384 if bm.bins.shape[0] > 8_388_608 else 4096,
+            exact_f32=exact_f32_for(bm))
+
+    tps = [_tp_of(b.params) for b in builders]
+    tp0 = tps[0]                 # shared static program (depth buckets)
+    knobs_b = jnp.stack([_knobs_of(tps[m],
+                                   float(builders[m].params["sample_rate"]))
+                         for m in range(M)])
+    keys = jnp.stack([jax.random.PRNGKey(
+        int(b.params["seed"]) if int(b.params["seed"]) >= 0 else 0xDEC0DE)
+        for b in builders])
+    constraints = _build_constraints(p0, x, frame, category)
+    interaction_sets = _build_interaction_sets(p0, x)
+    ntrees = int(p0["ntrees"])
+    score_interval = int(p0["score_tree_interval"]) or 5
+    from h2o3_tpu.models.model import EarlyStopper
+    stoppers = [EarlyStopper(int(p0["stopping_rounds"]),
+                             float(p0["stopping_tolerance"]))
+                for _ in range(M)]
+    histories: List[List[dict]] = [[] for _ in range(M)]
+
+    if category == ModelCategory.BINOMIAL:
+        dist = get_distribution("bernoulli")
+    else:
+        dist = get_distribution(dist_name, **p0)
+    yv = np.nan_to_num(rc.to_numpy()).astype(np.float32)
+    mean_y = (float(np.sum(yv * wh_host))
+              / max(float(np.sum(wh_host)), 1e-12))
+    yv = np.pad(yv, (0, bm.bins.shape[0] - frame.nrows))
+    y_dev = put_sharded(yv, row_sharding(mesh))
+    off = None
+    if p0.get("offset_column") and p0["offset_column"] in frame:
+        onp = np.nan_to_num(
+            frame.col(p0["offset_column"]).to_numpy()).astype(np.float32)
+        onp = np.pad(onp, (0, bm.bins.shape[0] - frame.nrows))
+        off = put_sharded(jnp.asarray(onp), row_sharding(mesh))
+    if off is None:
+        f0 = np.float32(dist.init_margin(mean_y))
+        margin1 = jnp.full((bm.bins.shape[0],), f0, jnp.float32)
+    else:
+        c = jnp.float32(dist.init_margin(mean_y))
+        for _ in range(25):
+            gsum = jnp.sum(w * dist.grad(y_dev, off + c))
+            hsum = jnp.sum(w * dist.hess(y_dev, off + c))
+            c = c - gsum / jnp.maximum(hsum, 1e-12)
+        f0 = np.float32(c)
+        margin1 = off + f0
+    margins = jnp.zeros((M, bm.bins.shape[0]), jnp.float32) + margin1
+
+    # chunked batched scans: same chunk policy as the sequential path
+    # (no deadline — runtime-capped fits are ineligible above), so the
+    # global-tree-index PRNG keys and stop points line up exactly
+    _rows_scale = max(1.0, bm.bins.shape[0] / 5_242_880.0)
+    _chunk = max(1, min(25, int(round(25.0 / _rows_scale))))
+    chunk_trees: List[List[Tree]] = [[] for _ in range(M)]
+    gains_tot = np.zeros((M, len(x)), np.float32)
+    stopped = [False] * M
+    done = 0
+    while done < ntrees and not all(stopped):
+        k = min(_chunk, ntrees - done)
+        alive = M - sum(stopped)
+        _ct0 = time.time()
+        with telemetry.span("gbm.chunk", trees=k, batch=M):
+            tr_b, margins, gains_b, devs_b = _boost_scan_batched(
+                bm.bins, bm.nbins, y_dev, w, margins, keys, knobs_b,
+                constraints, interaction_sets, tp=tp0, dist=dist,
+                ntrees=k, tree0=done)
+        telemetry.histogram("train_chunk_seconds",
+                            algo="gbm").observe(time.time() - _ct0)
+        telemetry.counter("train_iterations_total",
+                          algo="gbm").inc(k * alive)
+        devs_h = np.asarray(devs_b) if stopper_on else None
+        gains_h = np.asarray(gains_b)
+        for m in range(M):
+            if stopped[m]:
+                continue           # masked out, not a Python break: the
+                #                    program still ran its lane; results
+                #                    past the stop point are discarded
+            keep = (_stop_point(devs_h[m], done, k, score_interval,
+                                stoppers[m], histories[m])
+                    if stopper_on else k)
+            chunk_trees[m].append(unstack_model_trees(tr_b, m, keep))
+            gains_tot[m] += gains_h[m, :keep].sum(axis=0)
+            if keep < k:
+                stopped[m] = True
+        done += k
+
+    # ---- per-model unstack into ordinary Model objects ---------------
+    output_base = {"category": category, "response": y, "names": list(x),
+                   "nclasses": rc.cardinality if rc.is_categorical else 1,
+                   "domain": rc.domain, "init_f": float(f0)}
+    from h2o3_tpu.ml.calibration import maybe_calibrate
+    models: List[Model] = []
+    t_done = time.time()
+    for m in range(M):
+        p = builders[m].params
+        forest = concat_forests(chunk_trees[m])
+        model = GBMModel(p, dict(output_base), forest, bm, f0, dist_name)
+        if category == ModelCategory.BINOMIAL:
+            pfin = dist.link_inv(model._margins(bm, off))
+            model.training_metrics = mm.binomial_metrics(pfin, y_dev, w)
+            model.output["default_threshold"] = \
+                model.training_metrics["max_f1_threshold"]
+        else:
+            mfin = model._margins(bm, off)
+            model.training_metrics = mm.regression_metrics(
+                dist.link_inv(mfin), y_dev, w,
+                deviance_fn=lambda yy, pp, _m=mfin: dist.deviance(yy, _m))
+        model.output["scoring_history"] = histories[m]
+        vi = gains_tot[m]
+        order = np.argsort(-vi)
+        tot = vi.sum() or 1.0
+        model.output["varimp"] = [
+            (x[i], float(vi[i]), float(vi[i] / max(vi.max(), 1e-12)),
+             float(vi[i] / tot)) for i in order]
+        if validation_frame is not None:
+            model.validation_metrics = \
+                model.model_performance(validation_frame)
+        maybe_calibrate(model, p, category)
+        model.output["run_time"] = time.time() - t_done
+        models.append(model)
+    return models

@@ -43,6 +43,7 @@ from h2o3_tpu.ops.optimize import (admm_l1_quadratic,
                                    cholesky_solve_regularized, lbfgs)
 from h2o3_tpu.parallel.mesh import (get_mesh, put_sharded,
                                     row_sharding)
+from h2o3_tpu.telemetry import observed_jit
 from h2o3_tpu.utils.log import get_logger
 
 log = get_logger("h2o3_tpu.glm")
@@ -276,6 +277,28 @@ def _irls_solve_path(X1, coef, y, w, off, l1s, l2s, beta_eps, max_iter,
 
     coef, path = jax.lax.scan(solve_one, coef, (l1s, l2s))
     return coef, path
+
+
+@observed_jit("glm.irls_solve_batched")
+@partial(jax.jit, static_argnames=("family", "link", "use_l1"))
+def _irls_solve_batched(X1, coef0, y, w, off, l1s, l2s, beta_eps,
+                        max_iter, family: str, link: str, tweedie_power,
+                        theta=1e-5, obj_epss=None, *, use_l1: bool):
+    """Model-batched IRLS: ``vmap`` over the (alpha, lambda) product of
+    a grid/AutoML shape bucket — each lane is an INDEPENDENT fit from
+    the zero start (exactly what the sequential grid walk solves per
+    combo; contrast _irls_solve_path, whose lambdas warm-start
+    sequentially within ONE model). l1s/l2s/obj_epss ride the vmapped
+    axis; X1/y/w/off broadcast. The vmapped while_loop runs until every
+    lane converges, freezing finished lanes, so an M-combo sweep costs
+    one dispatch instead of M."""
+
+    def one(l1, l2, oe):
+        return _irls_solve(X1, coef0, y, w, off, l1, l2, beta_eps,
+                           max_iter, family, link, tweedie_power, theta,
+                           oe, use_l1=use_l1)
+
+    return jax.vmap(one)(l1s, l2s, obj_epss)
 
 
 @partial(jax.jit, static_argnames=("family", "link", "sweeps"))
@@ -538,19 +561,29 @@ class GLMModel(Model):
         eta = X1 @ jnp.asarray(self.coef, jnp.float32)
         return eta if off is None else eta + off
 
+    def _ordinal_probs(self, frame: Frame) -> jax.Array:
+        """Device-resident ordinal class probabilities [Npad, K]
+        (proportional-odds P(y<=k) differences), like the other
+        families' device scoring paths."""
+        X1 = self._design(frame)
+        P = X1.shape[1] - 1
+        eta = X1[:, :P] @ jnp.asarray(self.coef[:P], jnp.float32)
+        alphas = jnp.asarray(self.output["ordinal_alphas"], jnp.float32)
+        cum = jax.nn.sigmoid(alphas[None, :] - eta[:, None])
+        cum = jnp.concatenate(
+            [jnp.zeros((eta.shape[0], 1), jnp.float32), cum,
+             jnp.ones((eta.shape[0], 1), jnp.float32)], axis=1)
+        return jnp.diff(cum, axis=1)
+
     def _score_raw(self, frame: Frame) -> Dict[str, np.ndarray]:
         n = frame.nrows
         cat = self.output["category"]
         if self.output.get("family") == "ordinal":
-            X1 = self._design(frame)
-            P = X1.shape[1] - 1
-            eta = _fetch_np(X1[:, :P] @ jnp.asarray(
-                self.coef[:P], jnp.float32))[:n]
-            alphas = np.asarray(self.output["ordinal_alphas"])
-            cum = 1 / (1 + np.exp(-(alphas[None, :] - eta[:, None])))
-            cum = np.concatenate([np.zeros((n, 1)), cum,
-                                  np.ones((n, 1))], axis=1)
-            probs = np.diff(cum, axis=1)
+            # whole cumulative-logit pipeline on device, ONE fetch at
+            # the end — the previous path round-tripped eta through the
+            # host and ran the sigmoid in NumPy mid-predict, a blocking
+            # device sync per scoring call (costly on a remote chip)
+            probs = _fetch_np(self._ordinal_probs(frame))[:n]
             out = {"predict": probs.argmax(axis=1).astype(np.int32)}
             for k in range(probs.shape[1]):
                 out[f"p{k}"] = probs[:, k]
@@ -1181,3 +1214,162 @@ def _p_values_table(X1, y, w, coef, fam: Family, names, nobs: float,
 def _finish(model: GLMModel, frame: Frame, validation_frame):
     if validation_frame is not None:
         model.validation_metrics = model.model_performance(validation_frame)
+
+
+# ---- model-batched training (parallel/model_batch.py trainer) ----------
+
+
+def fit_glm_batched(builder_cls, params_list: List[dict], frame: Frame,
+                    y: Optional[str] = None,
+                    x: Optional[Sequence[str]] = None,
+                    validation_frame: Optional[Frame] = None) -> List[Model]:
+    """Train a grid bucket's (alpha, lambda) product as ONE vmapped IRLS
+    program (_irls_solve_batched): the design matrix, weights and
+    response adapt once, per-combo l1/l2/objective-epsilon stack onto
+    the vmapped axis, and the sequential walk's per-combo dispatch+
+    readback round trips collapse into one per use_l1 partition (ADMM
+    vs Cholesky inner solves are distinct compiled programs, exactly
+    like the sequential path's use_l1 static flag).
+
+    Raises parallel.model_batch.BatchIneligible for anything the
+    vmapped solve cannot express — CV, lambda_search, constrained/
+    L-BFGS solvers, multinomial/ordinal, p-values, interactions — and
+    the caller falls back per-combo."""
+    from h2o3_tpu.parallel.model_batch import BATCHABLE_KNOBS, BatchIneligible
+
+    builders = [builder_cls(**p) for p in params_list]
+    M = len(builders)
+    b0 = builders[0]
+    p0 = b0.params
+    batchable = BATCHABLE_KNOBS["glm"] | {"lambda_"}
+    for b in builders[1:]:
+        for k, v in b.params.items():
+            if k not in batchable and v != p0.get(k):
+                raise BatchIneligible(f"structural param '{k}' varies")
+    lams, alphas = [], []
+    for b in builders:
+        p = b.params
+        if int(p.get("nfolds") or 0) >= 2 or p.get("fold_column"):
+            raise BatchIneligible("cross-validation")
+        if p.get("lambda_search"):
+            raise BatchIneligible("lambda_search (warm-started path)")
+        if p.get("compute_p_values"):
+            raise BatchIneligible("compute_p_values")
+        if p.get("beta_constraints") is not None or p.get("non_negative"):
+            raise BatchIneligible("constrained solve (projected COD)")
+        if p.get("interactions"):
+            raise BatchIneligible("interaction expansion")
+        if str(p.get("solver") or "auto").lower() not in ("auto", "irlsm"):
+            raise BatchIneligible(f"solver {p.get('solver')}")
+        if float(p.get("max_runtime_secs") or 0.0) > 0:
+            raise BatchIneligible("per-model runtime cap")
+        lam = p.get("lambda_")
+        if isinstance(lam, (list, tuple)):
+            if len(lam) > 1:
+                raise BatchIneligible("multi-lambda combo")
+            lam = lam[0] if lam else 0.0
+        lams.append(float(lam or 0.0))
+        alphas.append(float(p["alpha"] if p["alpha"] is not None else 0.5))
+
+    mesh = get_mesh()
+    x = b0.resolve_x(frame, x, y)
+    category = infer_category(frame, y)
+    if category == ModelCategory.MULTINOMIAL:
+        raise BatchIneligible("multinomial")
+    fam_name = b0._resolve_family(category)
+    if fam_name in ("multinomial", "ordinal"):
+        raise BatchIneligible(f"family {fam_name}")
+    fam = Family(fam_name, float(p0["tweedie_power"]), p0["link"],
+                 theta=float(p0.get("theta") or 1e-5))
+
+    # ---- shared preamble (identical to the sequential _fit) ----------
+    di = build_datainfo(frame, x, standardize=bool(p0["standardize"]),
+                        use_all_factor_levels=bool(
+                            p0["use_all_factor_levels"]),
+                        missing_values_handling=p0["missing_values_handling"])
+    ones = jnp.ones((di.X.shape[0], 1), jnp.float32)
+    X1 = jax.device_put(jnp.concatenate([di.X, ones], axis=1),
+                        row_sharding(mesh))
+    w = frame.valid_weights()
+    if p0.get("weights_column"):
+        wc = frame.col(p0["weights_column"]).numeric_view()
+        w = w * jnp.where(jnp.isnan(wc), 0.0, wc)
+    off = None
+    if p0.get("offset_column") and p0["offset_column"] in frame:
+        ov = frame.col(p0["offset_column"]).numeric_view()
+        off = jnp.where(jnp.isnan(ov), 0.0, ov).astype(jnp.float32)
+    off_or0 = off if off is not None else \
+        jnp.zeros((X1.shape[0],), jnp.float32)
+    rc = frame.col(y)
+    cmus, csds = coef_stats(di)
+    output_base = {"category": category, "response": y, "names": list(x),
+                   "coef_names": di.coef_names, "domain": rc.domain,
+                   "coef_means": cmus.tolist(), "coef_sds": csds.tolist(),
+                   "standardized": bool(p0["standardize"]),
+                   "nclasses": rc.cardinality if rc.is_categorical else 1}
+    if category == ModelCategory.BINOMIAL:
+        yraw = adapt_domain(rc, rc.domain)
+        yv = np.pad(np.maximum(yraw, 0).astype(np.float32),
+                    (0, X1.shape[0] - frame.nrows))
+        wna = np.pad((yraw >= 0).astype(np.float32),
+                     (0, X1.shape[0] - frame.nrows))
+        w = w * jnp.asarray(wna)
+    else:
+        yn = rc.to_numpy()
+        wna = np.pad((~np.isnan(yn)).astype(np.float32),
+                     (0, X1.shape[0] - frame.nrows))
+        w = w * jnp.asarray(wna)
+        yv = np.pad(np.nan_to_num(yn).astype(np.float32),
+                    (0, X1.shape[0] - frame.nrows))
+    y_dev = put_sharded(yv, row_sharding(mesh))
+
+    # ---- one vmapped solve per use_l1 partition ----------------------
+    l1_all = np.array([lams[m] * alphas[m] for m in range(M)], np.float32)
+    l2_all = np.array([lams[m] * (1.0 - alphas[m]) for m in range(M)],
+                      np.float32)
+    oe_all = np.array([b._objective_eps() for b in builders], np.float32)
+    coef0 = jnp.zeros((X1.shape[1],), jnp.float32)
+    coefs = np.zeros((M, X1.shape[1]), np.float32)
+    from h2o3_tpu import telemetry
+    for use_l1 in (False, True):
+        # sequential parity: _fit_irlsm picks ADMM iff l1 > 0
+        idx = np.where((l1_all > 0) == use_l1)[0]
+        if idx.size == 0:
+            continue
+        _st0 = time.time()
+        with telemetry.span("glm.solve_batched", solver="irlsm",
+                            width=int(idx.size)):
+            out = _irls_solve_batched(
+                X1, coef0, y_dev, w, off_or0,
+                jnp.asarray(l1_all[idx]), jnp.asarray(l2_all[idx]),
+                jnp.float32(p0["beta_epsilon"]),
+                jnp.int32(p0["max_iterations"]), fam.name, fam.link,
+                jnp.float32(fam.p), jnp.float32(fam.theta),
+                jnp.asarray(oe_all[idx]), use_l1=use_l1)
+        telemetry.histogram("train_chunk_seconds",
+                            algo="glm").observe(time.time() - _st0)
+        telemetry.counter("train_iterations_total", algo="glm").inc(
+            int(idx.size) * int(p0["max_iterations"]))
+        coefs[idx] = np.asarray(out)
+
+    # ---- per-model unstack into ordinary Model objects ---------------
+    models: List[Model] = []
+    t_done = time.time()
+    for m in range(M):
+        output = dict(output_base)
+        output["lambda_best"] = lams[m]
+        model = GLMModel(builders[m].params, output, coefs[m], fam,
+                         stats_of(di), list(x))
+        mu = fam.linkinv(X1 @ jnp.asarray(coefs[m], jnp.float32) + off_or0)
+        if category == ModelCategory.BINOMIAL:
+            model.training_metrics = mm.binomial_metrics(mu, y_dev, w)
+            model.output["default_threshold"] = \
+                model.training_metrics["max_f1_threshold"]
+        else:
+            model.training_metrics = mm.regression_metrics(
+                mu, y_dev, w,
+                deviance_fn=lambda a, b: fam.deviance(a, b))
+        _finish(model, frame, validation_frame)
+        model.output["run_time"] = time.time() - t_done
+        models.append(model)
+    return models

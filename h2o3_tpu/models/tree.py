@@ -472,6 +472,25 @@ def stack_trees(trees) -> Tree:
                   for f in Tree._fields))
 
 
+def concat_forests(chunks) -> Tree:
+    """Concatenate [T_i, ...] forest chunks along the tree axis — the
+    chunked-scan and model-batched training paths both assemble their
+    final forest through this."""
+    chunks = list(chunks)
+    if len(chunks) == 1:
+        return chunks[0]
+    return Tree(*(jnp.concatenate([getattr(c, f) for c in chunks])
+                  for f in Tree._fields))
+
+
+def unstack_model_trees(batched: Tree, m: int, keep=None) -> Tree:
+    """Slice model ``m``'s forest out of a model-batched [M, T, ...]
+    stacked Tree (parallel/model_batch vmap axis), optionally truncated
+    to its first ``keep`` trees (per-model early stop)."""
+    sl = slice(None) if keep is None else slice(int(keep))
+    return Tree(*(a[m, sl] for a in batched))
+
+
 def _route(tree: Tree, bins, B: int):
     """Terminal node id per row for one tree — the single routing
     implementation shared by scoring and leaf assignment."""

@@ -1,0 +1,176 @@
+"""Model batching — vmap hyperparameter combos into ONE compiled program.
+
+`hex.grid`'s analogue (ml/grid.py) and the AutoML executor train combos
+sequentially: a 50-combo grid pays 50 dispatch/readback round trips
+while the mesh idles between models — the "driver-bound outer loop"
+DrJAX (PAPERS.md) eliminates by expressing the whole sweep as one
+compiled MapReduce program, and the batched-learner layout GPU
+tree-boosting systems use. The per-model hot loops are already fused
+(GBM `_boost_scan_jit`, GLM `_irls_solve`) and already carry their
+numeric knobs as TRACED values (gbm `_knobs_of`), so the missing layer
+is exactly this module: group combos into SHAPE BUCKETS (same
+structural/static knobs → same compiled program), stack their numeric
+knobs and PRNG keys, and train the whole bucket as one jitted
+``vmap``-over-knobs program.
+
+Eligibility is knob-driven: ``BATCHABLE_KNOBS[algo]`` lists the hyper
+parameters that may vary WITHIN a bucket (they ride on the vmapped
+axis); any other varying knob is structural and splits buckets. A
+bucket the per-algo trainer cannot vmap raises ``BatchIneligible`` and
+the caller (ml/grid.py) falls back to the sequential per-combo path,
+so grid semantics, early stopping, recovery snapshots and leaderboard
+order are always preserved.
+
+Knob: ``H2O3TPU_BATCH_MODELS`` = ``auto`` (default, batch eligible
+buckets of >= 2 combos) | ``off``/``0`` (always sequential).
+
+Telemetry (stable names, README §Batched training):
+``batched_train_batches_total{algo}``, ``batched_train_width{algo}``
+(histogram), ``grid_models_total{algo,path}``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from h2o3_tpu.utils.log import get_logger
+
+log = get_logger("h2o3_tpu.model_batch")
+
+
+class BatchIneligible(Exception):
+    """The combo set cannot be trained as one vmapped program; the
+    caller must fall back to the sequential per-combo path."""
+
+
+# hyper parameters that may vary WITHIN one shape bucket — each rides as
+# a traced value (or a PRNG key) on the vmapped model axis of the
+# compiled program. Anything else that varies is structural: it would
+# change the compiled program (static jit key, tree shapes, solver
+# family) and therefore keys the bucket instead.
+BATCHABLE_KNOBS: Dict[str, frozenset] = {
+    # gbm: _knobs_of() already hoists these out of the static jit key;
+    # max_depth batches WITHIN a compile bucket (tree.py DEPTH_BUCKETS —
+    # the program compiles at the bucket depth with a traced limit)
+    "gbm": frozenset({"learn_rate", "sample_rate",
+                      "col_sample_rate_per_tree", "min_rows",
+                      "min_split_improvement", "reg_lambda", "seed",
+                      "max_depth"}),
+    # glm: the (alpha, lambda) product enters _irls_solve as traced
+    # l1/l2 scalars; every other knob changes the solve family/design
+    "glm": frozenset({"alpha", "lambda_", "Lambda", "lambda"}),
+}
+
+
+def mode() -> str:
+    """Resolved ``H2O3TPU_BATCH_MODELS`` value (env wins over config)."""
+    v = os.environ.get("H2O3TPU_BATCH_MODELS")
+    if v is None:
+        from h2o3_tpu.core.config import ARGS
+        v = getattr(ARGS, "batch_models", "auto")
+    return str(v).strip().lower() or "auto"
+
+
+def enabled() -> bool:
+    return mode() not in ("0", "off", "false", "no")
+
+
+def _canon(v):
+    """Hashable canonical form of a hyper value (JSON round trips lists)."""
+    if isinstance(v, (list, tuple)):
+        return tuple(_canon(x) for x in v)
+    return v
+
+
+def combo_key(combo: dict) -> tuple:
+    """Canonical identity of a combo — sorted items with list values
+    tupled, so resume filtering is one set lookup per combo instead of
+    the O(n·m) dict-equality scan (ml/grid.py recovery path)."""
+    return tuple(sorted((k, _canon(v)) for k, v in combo.items()))
+
+
+def bucket_key(algo: str, combo: dict) -> tuple:
+    """Structural signature of a combo: the non-batchable knob values
+    (plus, for gbm, the compile DEPTH BUCKET of max_depth). Combos with
+    equal bucket keys share one compiled program."""
+    batchable = BATCHABLE_KNOBS.get(algo, frozenset())
+    items: List[Tuple] = []
+    for k in sorted(combo):
+        if k in batchable:
+            if algo == "gbm" and k == "max_depth":
+                from h2o3_tpu.models.tree import bucket_depth
+                items.append(("max_depth#bucket",
+                              bucket_depth(int(combo[k]))))
+            continue
+        items.append((k, _canon(combo[k])))
+    return tuple(items)
+
+
+@dataclasses.dataclass
+class Bucket:
+    key: tuple
+    indices: List[int]           # positions in the walk-ordered combo list
+
+    @property
+    def width(self) -> int:
+        return len(self.indices)
+
+
+def plan_buckets(algo: str, combos: Sequence[dict]) -> List[Bucket]:
+    """Group walk-ordered combos into shape buckets (first-occurrence
+    order; indices stay ascending so the caller can restore walk order
+    after batch training)."""
+    by_key: Dict[tuple, Bucket] = {}
+    order: List[Bucket] = []
+    for i, c in enumerate(combos):
+        k = bucket_key(algo, c)
+        b = by_key.get(k)
+        if b is None:
+            b = Bucket(key=k, indices=[])
+            by_key[k] = b
+            order.append(b)
+        b.indices.append(i)
+    return order
+
+
+def _trainer_for(algo: str):
+    """Per-algo batched trainer (lazy import — no cycles, and the
+    planner above stays importable without a backend)."""
+    if algo == "gbm":
+        from h2o3_tpu.models.gbm import fit_gbm_batched
+        return fit_gbm_batched
+    if algo == "glm":
+        from h2o3_tpu.models.glm import fit_glm_batched
+        return fit_glm_batched
+    return None
+
+
+def train_bucket(builder_cls, fixed: dict, combos: Sequence[dict], frame,
+                 y: Optional[str] = None, x=None,
+                 validation_frame=None) -> List:
+    """Train one shape bucket as a single vmapped program; returns one
+    Model per combo, in combo order. Raises ``BatchIneligible`` when the
+    algo has no batched trainer or the shared params cannot be vmapped
+    (the caller falls back per-combo)."""
+    algo = builder_cls.algo
+    trainer = _trainer_for(algo)
+    if trainer is None:
+        raise BatchIneligible(f"no batched trainer for algo '{algo}'")
+    params_list = [{**fixed, **c} for c in combos]
+    import time as _time
+    from h2o3_tpu import telemetry
+    t0 = _time.time()
+    with telemetry.span("model_batch.train", algo=algo,
+                        width=len(params_list)):
+        models = trainer(builder_cls, params_list, frame, y=y, x=x,
+                         validation_frame=validation_frame)
+    telemetry.counter("batched_train_batches_total", algo=algo).inc()
+    telemetry.histogram(
+        "batched_train_width",
+        buckets=(1, 2, 4, 8, 16, 32, 64, 128, 256, 512),
+        algo=algo).observe(float(len(models)))
+    log.info("batched %s bucket: %d models in %.2fs", algo, len(models),
+             _time.time() - t0)
+    return models
