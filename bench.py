@@ -8,6 +8,8 @@
     H2O3TPU_BENCH_BUDGET_S=N   # wallclock budget (default 1500s)
     H2O3TPU_BENCH_FULL=1       # force the 50M-row GBM escalation
     H2O3TPU_BENCH_CONFIG_TIMEOUT_S=N  # per-config hard cap override
+    H2O3TPU_BENCH_TRACE_DIR=DIR       # Chrome-trace artifacts per config
+                                      # (default /tmp/h2o3tpu_bench_traces)
 
 Structure (round-3 contract): the flagship GBM line is emitted FIRST at
 a scale that finishes in minutes; every other config is bounded; the
@@ -587,6 +589,24 @@ def _emit_hardening(name: str) -> None:
         pass
 
 
+def _emit_trace(name: str) -> None:
+    """Write this config's process trace (spans + timeline + compiles)
+    as a Chrome trace-event artifact so a BENCH run is explorable in
+    Perfetto — where the wall time of a slow config actually went
+    (compile track vs chunk spans), not just its final number."""
+    try:
+        from h2o3_tpu.telemetry import trace_export
+        out_dir = os.environ.get("H2O3TPU_BENCH_TRACE_DIR",
+                                 "/tmp/h2o3tpu_bench_traces")
+        path = os.path.join(out_dir, f"trace_{name}.json")
+        trace = trace_export.process_trace()
+        trace_export.write_trace(path, trace)
+        _emit_raw({"metric": f"trace {name}", "trace_path": path,
+                   "trace_events": len(trace["traceEvents"])})
+    except Exception:   # noqa: BLE001 - artifacts must never fail a config
+        pass
+
+
 def _child_one(name: str) -> int:
     """Run exactly one config in THIS process (spawned by the parent).
     Metric lines go to stdout; failures leave a classified traceback on
@@ -598,6 +618,7 @@ def _child_one(name: str) -> int:
     try:
         fn()
         _emit_hardening(name)
+        _emit_trace(name)
         return 0
     except Exception as e:   # noqa: BLE001 - child boundary
         import traceback

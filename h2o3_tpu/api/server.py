@@ -1430,6 +1430,8 @@ healthy: {info["cloud_healthy"]}</p>
 <a href="/3/Jobs">/3/Jobs</a> ·
 <a href="/3/Timeline">/3/Timeline</a> ·
 <a href="/3/Metrics">/3/Metrics</a> ·
+<a href="/3/Trace">/3/Trace</a> ·
+<a href="/3/Logs">/3/Logs</a> ·
 <a href="/3/SelfBench">/3/SelfBench</a></p>
 </body></html>"""
     return {"__html__": html}
@@ -1539,9 +1541,123 @@ def _selfbench(params, body):
     return run_self_bench()
 
 
-@route("GET", "/3/Logs/download")
+@route("GET", "/3/Logs")
 def _logs(params, body):
-    return {"log": ""}
+    """Recent log lines (water/api/LogsHandler role) from the structured
+    pipeline's ring buffers: ``?level=ERROR`` selects a per-level ring,
+    ``?last=N`` bounds the tail."""
+    from h2o3_tpu.utils.log import level_counts, log_buffer, log_file_path
+    level = params.get("level")
+    try:
+        last = int(float(params.get("last") or 0)) or None
+    except (TypeError, ValueError):
+        last = None
+    lines = log_buffer(level=level, last=last)
+    return {"log": "\n".join(lines),
+            "lines": lines,
+            "level": (level or "ALL").upper(),
+            "level_counts": level_counts(),
+            "file": log_file_path() or ""}
+
+
+@route("GET", "/3/Logs/download")
+def _logs_download(params, body):
+    """The whole log as a text attachment (h2o.download_all_logs role).
+    Serves the rotating file sink when H2O3TPU_LOG_DIR is active,
+    otherwise the in-memory ring — never again the empty stub."""
+    from h2o3_tpu.utils.log import log_buffer, log_file_path
+    path = log_file_path()
+    data = None
+    if path:
+        try:
+            with open(path, "rb") as f:
+                data = f.read()
+        except OSError:
+            data = None
+    if data is None:
+        data = ("\n".join(log_buffer()) + "\n").encode()
+    return {"__bytes__": data, "__ctype__": "text/plain; charset=utf-8",
+            "__headers__": {
+                "Content-Disposition":
+                    'attachment; filename="h2o3tpu.log"'}}
+
+
+@route("GET", r"/3/Jobs/(?P<key>[^/]+)/trace")
+def _job_trace(params, body, key=None):
+    """One job's flight-recorder capsule as Chrome trace-event JSON —
+    load it in https://ui.perfetto.dev (telemetry/trace_export.py)."""
+    from h2o3_tpu.telemetry import flight_recorder, trace_export
+    cap = flight_recorder.get_capsule(key)
+    if cap is None:
+        raise KeyError(
+            f"no telemetry capsule for job {key} (cancelled capsules "
+            f"are swept; completed ones are retained for the last "
+            f"{flight_recorder.keep_count()} jobs — "
+            f"H2O3TPU_FLIGHT_RECORDER_KEEP)")
+    return trace_export.capsule_trace(cap)
+
+
+@route("GET", r"/3/Jobs/(?P<key>[^/]+)/telemetry")
+def _job_telemetry(params, body, key=None):
+    """The raw capsule (spans/events/compiles/logs/metric deltas)."""
+    from h2o3_tpu.telemetry import flight_recorder
+    cap = flight_recorder.get_capsule(key)
+    if cap is None:
+        raise KeyError(f"no telemetry capsule for job {key}")
+    return cap.to_dict()
+
+
+@route("GET", "/3/Trace")
+def _process_trace(params, body):
+    """The whole process ring (spans + timeline + compiles) as Chrome
+    trace JSON — the zoomed-out view when no single job is suspect."""
+    from h2o3_tpu.telemetry import trace_export
+    try:
+        nspans = int(float(params.get("spans") or 2048))
+        nevents = int(float(params.get("events") or 2048))
+    except (TypeError, ValueError):
+        nspans, nevents = 2048, 2048
+    return trace_export.process_trace(last_spans=nspans,
+                                      last_events=nevents)
+
+
+@route("POST", "/3/Profiler/capture")
+def _profiler_capture(params, body):
+    """Bounded jax.profiler window (the /3/JProfile analogue): captures
+    a TensorBoard-loadable device trace for ``duration_ms`` (capped at
+    10s) into ``log_dir``. Degrades gracefully — a backend that cannot
+    profile answers with supported=false, not a 500."""
+    import os
+    import tempfile
+    try:
+        dur_ms = float(params.get("duration_ms") or 1000.0)
+    except (TypeError, ValueError):
+        raise ValueError(
+            f"malformed duration_ms {params.get('duration_ms')!r}")
+    dur_s = min(max(dur_ms, 1.0), 10_000.0) / 1000.0
+    log_dir = _unquote(str(params.get("log_dir") or "")) or \
+        tempfile.mkdtemp(prefix="h2o3tpu_jprofile_")
+    started = False
+    try:
+        import jax
+        jax.profiler.start_trace(log_dir)
+        started = True
+        time.sleep(dur_s)
+    except Exception as e:   # noqa: BLE001 - degrade, don't 500
+        return {"supported": False, "error": str(e)[:500],
+                "log_dir": log_dir if started else None}
+    finally:
+        if started:
+            try:
+                import jax
+                jax.profiler.stop_trace()
+            except Exception:   # noqa: BLE001
+                pass
+    files = []
+    for root, _dirs, names in os.walk(log_dir):
+        files.extend(os.path.join(root, n) for n in names)
+    return {"supported": True, "log_dir": log_dir,
+            "duration_ms": dur_s * 1000.0, "files": sorted(files)[:100]}
 
 
 @route("POST", "/3/Shutdown")
@@ -1584,7 +1700,8 @@ class AdmissionGate:
                 return True
             if self._waiting >= self.queue_depth:
                 return False
-            limit = time.monotonic() + self.queue_wait_s
+            t_q = time.monotonic()
+            limit = t_q + self.queue_wait_s
             if deadline is not None:
                 limit = min(limit, deadline)
             self._waiting += 1
@@ -1599,6 +1716,10 @@ class AdmissionGate:
                 return True
             finally:
                 self._waiting -= 1
+                # queue-wait leg of the RED surface: how long admitted
+                # AND timed-out requests sat waiting for a slot
+                telemetry.histogram("rest_queue_wait_seconds").observe(
+                    time.monotonic() - t_q)
 
     def leave(self) -> None:
         from h2o3_tpu import telemetry
@@ -1896,6 +2017,7 @@ class _Handler(BaseHTTPRequestHandler):
                 endpoint = rx.pattern.strip("^$")
                 telemetry.counter("rest_requests_total", method=method,
                                   endpoint=endpoint).inc()
+                t_req = time.monotonic()
                 try:
                     # the deadline rides a contextvar: any Job the
                     # handler creates captures it (core/job.py) and the
@@ -1933,8 +2055,17 @@ class _Handler(BaseHTTPRequestHandler):
                     code = 500
                 if code == 200 and deadline is not None:
                     out, code = _await_job_deadline(out, deadline, path)
+                # RED per-route latency: the duration leg next to the
+                # rest_requests_total rate leg (route = bounded pattern,
+                # status = final HTTP code incl. the 408 deadline path)
+                telemetry.histogram("rest_request_seconds",
+                                    route=endpoint,
+                                    status=str(code)).observe(
+                    time.monotonic() - t_req)
                 return self._respond(code, out)
         _tl_record("rest", f"{method} {path}", status=404)
+        telemetry.counter("rest_requests_total", method=method,
+                          endpoint="(no_route)").inc()
         self._respond(404, {"msg": f"no route {method} {path}"})
 
     def do_GET(self):

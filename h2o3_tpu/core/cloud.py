@@ -58,6 +58,13 @@ def init(backend: Optional[str] = None,
                                   model_axis=model_axis, **kwargs)
     _config.ARGS = cfg
 
+    # rebuild the logging pipeline: level/dir/format knobs set between
+    # import and init() (H2O3TPU_LOG_*, init(log_level=...)) must take
+    # effect — utils/log.py configure() is idempotent
+    from h2o3_tpu.utils import log as _log
+    _log.configure(level=cfg.log_level,
+                   log_dir=cfg.log_dir or None)
+
     # persistent XLA compilation cache: repeated sessions (tests, bench,
     # conformance servers) skip recompiling identical programs — this
     # both cuts cold-start time and shrinks the exposure to the CPU

@@ -51,6 +51,12 @@ class Config:
     # Content-Length cap for buffered bodies (MB); /3/PostFile streams
     # to disk in chunks and is exempt
     rest_max_body_mb: int = 256
+    # -- observability (telemetry/flight_recorder.py + utils/log.py) ---
+    # rotating per-process log file directory; "" = stream+ring only
+    log_dir: str = ""
+    # completed-job telemetry capsules retained in the DKV (newest
+    # first); cancelled jobs' capsules are swept with their Scope
+    flight_recorder_keep: int = 32
     # -- model batching (parallel/model_batch.py) ----------------------
     # grid/AutoML combos sharing one compiled program train as a single
     # vmapped batch: "auto" (default) batches eligible buckets of >= 2
@@ -63,7 +69,7 @@ class Config:
     _INT_FIELDS = frozenset({"port", "nthreads", "data_axis", "model_axis",
                              "block_rows", "nbins", "infra_max_attempts",
                              "rest_max_inflight", "rest_queue_depth",
-                             "rest_max_body_mb"})
+                             "rest_max_body_mb", "flight_recorder_keep"})
     _FLOAT_FIELDS = frozenset({"infra_backoff_base_s", "infra_backoff_max_s",
                                "probe_timeout_s", "rest_queue_wait_s"})
 

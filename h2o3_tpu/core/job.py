@@ -83,9 +83,16 @@ class Job:
         self.status = RUNNING
         self.start_time = time.time()
         from h2o3_tpu import telemetry
+        from h2o3_tpu.telemetry import flight_recorder
         from h2o3_tpu.utils.timeline import record as _tl
         _tl("job", f"start {self.description}", key=self.key)
         telemetry.counter("jobs_started_total").inc()
+
+        # the flight-recorder handle crosses the _run → _body closure
+        # boundary via this cell (attach must run on the WORKER thread —
+        # a background thread's context is fresh, so the contextvar set
+        # in start()'s thread would never reach the work)
+        rec_cell = []
 
         def _body():
             # every key the work creates is tracked in a job-local Scope:
@@ -96,6 +103,11 @@ class Job:
             sc = Scope()
             sc.__enter__()
             try:
+                # the telemetry capsule key is DKV.put INSIDE this
+                # Scope: a cancelled job's capsule is swept with its
+                # partial keys (telemetry/flight_recorder.py)
+                if rec_cell:
+                    flight_recorder.publish(rec_cell[0])
                 # bounded retries for infra-class errors only, under the
                 # shared watchdog policy (backoff + jitter, attempts from
                 # core/config.py). The work restarts from scratch — model
@@ -164,7 +176,12 @@ class Job:
             # the job is the ROOT telemetry span: everything the work
             # does (fit spans, boost chunks, compiles) nests under it —
             # background jobs run on their own thread, whose fresh
-            # contextvar context makes this a root span automatically
+            # contextvar context makes this a root span automatically.
+            # The flight recorder attaches FIRST so the root job span
+            # itself lands in the capsule when it closes.
+            handle = flight_recorder.attach(self.key, self.description)
+            if handle is not None:
+                rec_cell.append(handle)
             try:
                 # job_scope makes this job + its captured deadline
                 # visible to cancel_point() checks at chunk boundaries
@@ -177,6 +194,7 @@ class Job:
                                        desc=self.description):
                     _body()
             finally:
+                flight_recorder.detach(handle, status=self.status)
                 telemetry.counter("jobs_completed_total",
                                   status=self.status).inc()
                 telemetry.histogram("job_duration_seconds").observe(

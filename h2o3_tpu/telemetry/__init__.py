@@ -10,7 +10,15 @@ shape-bucket misses, and device-memory pressure. Always on, cheap
 Request hardening (api/server.py + core/request_ctx.py) reports
 through the same registry: ``rest_inflight_requests`` (gauge),
 ``rest_rejected_total{reason=}``, ``request_deadline_exceeded_total``,
-``rest_client_disconnects_total``.
+``rest_client_disconnects_total``; the RED duration legs are
+``rest_request_seconds{route,status}`` and ``rest_queue_wait_seconds``.
+
+Post-hoc, per-job debuggability rides the same instrumentation:
+``flight_recorder`` captures each Job's span subtree, timeline events,
+compiles, and log records into a bounded DKV capsule
+(``<job_key>_telemetry``), and ``trace_export`` renders capsules or
+the whole process ring as Perfetto-loadable Chrome trace JSON
+(``GET /3/Jobs/{id}/trace``, ``GET /3/Trace``).
 
 Surface (stable metric names — README §Observability):
 
@@ -24,11 +32,14 @@ Surface (stable metric names — README §Observability):
 from h2o3_tpu.telemetry.registry import (BYTES_BUCKETS, REGISTRY,
                                          SECONDS_BUCKETS, counter, gauge,
                                          histogram)
+from h2o3_tpu.telemetry import flight_recorder
 from h2o3_tpu.telemetry.spans import (add_collective_bytes, annotate,
                                       current_span, current_span_id, span)
 from h2o3_tpu.telemetry.spans import snapshot as spans_snapshot
 from h2o3_tpu.telemetry.spans import aggregate as spans_aggregate
-from h2o3_tpu.telemetry.compile_observer import install, observed_jit
+from h2o3_tpu.telemetry.compile_observer import (compiles_snapshot, install,
+                                                 observed_jit)
+from h2o3_tpu.telemetry import trace_export
 
 snapshot = REGISTRY.snapshot
 to_prometheus = REGISTRY.to_prometheus
@@ -44,4 +55,5 @@ __all__ = [
     "span", "annotate", "current_span", "current_span_id",
     "add_collective_bytes", "spans_snapshot", "spans_aggregate",
     "install", "observed_jit", "snapshot", "to_prometheus",
+    "compiles_snapshot", "flight_recorder", "trace_export",
 ]

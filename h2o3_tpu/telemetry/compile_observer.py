@@ -23,13 +23,21 @@ time in the compiler. Two complementary probes:
 from __future__ import annotations
 
 import threading
-from typing import Callable, Dict
+import time
+from collections import deque
+from typing import Callable, Dict, List
 
 from h2o3_tpu.telemetry import spans
 from h2o3_tpu.telemetry.registry import counter, histogram
 
 _installed = False
 _install_lock = threading.Lock()
+
+# recent compile events (end timestamp + duration) — the dedicated
+# compile track in Chrome-trace exports (telemetry/trace_export.py)
+_COMPILE_RING_CAPACITY = 512
+_compile_ring: deque = deque(maxlen=_COMPILE_RING_CAPACITY)
+_compile_ring_lock = threading.Lock()
 
 # per observed fn: shape-signature interning with a cap, so label
 # cardinality stays bounded even under pathological shape churn
@@ -46,10 +54,27 @@ def _on_duration(name: str, secs: float, **kw) -> None:
     counter("xla_compile_total").inc()
     histogram("xla_compile_seconds").observe(secs)
     sp = spans.current_span()
+    ev = {"ts_ms": int(time.time() * 1000), "dur_s": round(secs, 6),
+          "event": "xla_compile",
+          "span_id": sp.id if sp is not None else None}
+    with _compile_ring_lock:
+        _compile_ring.append(ev)
+    try:
+        from h2o3_tpu.telemetry import flight_recorder
+        flight_recorder.record_compile(ev)
+    except Exception:   # noqa: BLE001 - capture is best-effort
+        pass
     if sp is not None:
         sp.meta["xla_compiles"] = sp.meta.get("xla_compiles", 0) + 1
         sp.meta["xla_compile_s"] = round(
             sp.meta.get("xla_compile_s", 0.0) + secs, 3)
+
+
+def compiles_snapshot(last: int = _COMPILE_RING_CAPACITY) -> List[Dict]:
+    """Most recent compile events, oldest first."""
+    with _compile_ring_lock:
+        evs = list(_compile_ring)
+    return evs[-max(int(last), 0):]
 
 
 def install() -> None:

@@ -1,0 +1,269 @@
+"""Flight recorder — a per-job capsule of everything telemetry saw.
+
+PR 1 left the runtime with always-on aggregates (registry counters,
+the span ring, the compile observer), but aggregates can't answer the
+post-hoc question an operator actually asks: *what did THIS job do?*
+The reference answers it with per-node log files plus the TimeLine
+ring; here a job-scoped recorder rides the existing instrumentation:
+
+- when a :class:`~h2o3_tpu.core.job.Job` starts, ``attach()`` installs
+  a :class:`JobRecorder` on the worker thread's context;
+- every span that closes on that context (telemetry/spans.py), every
+  timeline event (utils/timeline.py), every XLA compile
+  (telemetry/compile_observer.py) and every log record (utils/log.py)
+  is *also* appended to the job's bounded :class:`JobTelemetry`
+  capsule — the always-on ring/registry paths are untouched;
+- the capsule lives in the DKV under ``<job_key>_telemetry``. It is
+  DKV.put INSIDE the job's Scope, so a cancelled/expired job's capsule
+  is swept with the rest of its partial keys (the water/Scope.java
+  exit-on-abort contract); completed jobs keep theirs, bounded by a
+  process-wide retention ring (``H2O3TPU_FLIGHT_RECORDER_KEEP`` newest
+  capsules; older ones are evicted from the DKV).
+
+``GET /3/Jobs/{key}/trace`` (api/server.py) renders a capsule as
+Chrome trace-event JSON via telemetry/trace_export.py — the
+DrJAX-style dispatch/compile timeline, loadable in Perfetto.
+
+Capture is CONTEXT-scoped, not thread-scoped: nested foreground jobs
+(grid → model builds) stack their recorders, so an inner model build
+is captured by its own capsule AND its parent grid job's. Work a job
+hands to unmanaged helper threads is best-effort invisible (same
+limitation as thread-local Scope tracking).
+
+Cost model: with no recorder attached, every hook is one contextvar
+read of an empty tuple (~100ns) — the "cheap enough to leave on"
+TimeLine constraint holds (tests/test_telemetry.py overhead bound runs
+with the recorder enabled).
+"""
+
+from __future__ import annotations
+
+import contextvars
+import os
+import threading
+import time
+from collections import deque
+from typing import Dict, List, Optional, Tuple
+
+from h2o3_tpu.telemetry.registry import REGISTRY
+
+ENABLED = os.environ.get("H2O3TPU_FLIGHT_RECORDER", "1") != "0"
+
+# per-capsule bounds: a runaway job (million-chunk fit, log storm) must
+# yield a truncated capsule, never an unbounded one — drops are counted
+MAX_SPANS = 2048
+MAX_EVENTS = 2048
+MAX_COMPILES = 512
+MAX_LOGS = 1024
+
+TELEMETRY_SUFFIX = "_telemetry"
+
+
+def capsule_key(job_key: str) -> str:
+    return f"{job_key}{TELEMETRY_SUFFIX}"
+
+
+def keep_count() -> int:
+    """Completed-job capsules retained in the DKV (newest first) —
+    env ``H2O3TPU_FLIGHT_RECORDER_KEEP`` wins over config.ARGS, the
+    watchdog/gate knob pattern."""
+    env = os.environ.get("H2O3TPU_FLIGHT_RECORDER_KEEP")
+    if env is not None:
+        try:
+            return max(0, int(env))
+        except ValueError:
+            pass
+    try:
+        from h2o3_tpu.core import config as _cfg
+        return max(0, int(_cfg.ARGS.flight_recorder_keep))
+    except Exception:   # noqa: BLE001 - config not importable yet
+        return 32
+
+
+class JobTelemetry:
+    """One job's bounded telemetry capsule (DKV value)."""
+
+    __slots__ = ("job_key", "description", "start_ms", "end_ms", "status",
+                 "spans", "events", "compiles", "logs", "metric_deltas",
+                 "dropped", "_counters0", "_lock")
+
+    def __init__(self, job_key: str, description: str):
+        self.job_key = job_key
+        self.description = description
+        self.start_ms = int(time.time() * 1000)
+        self.end_ms = 0
+        self.status = "RUNNING"
+        self.spans: List[Dict] = []
+        self.events: List[Dict] = []
+        self.compiles: List[Dict] = []
+        self.logs: List[Dict] = []
+        self.metric_deltas: Dict[str, float] = {}
+        self.dropped: Dict[str, int] = {}
+        self._counters0 = _counter_totals()
+        self._lock = threading.Lock()
+
+    # -- capture (hot path: one lock'd append) -------------------------
+    def _add(self, bucket: List[Dict], cap: int, kind: str, item: Dict):
+        with self._lock:
+            if len(bucket) < cap:
+                bucket.append(item)
+            else:
+                self.dropped[kind] = self.dropped.get(kind, 0) + 1
+
+    def add_span(self, span_dict: Dict) -> None:
+        self._add(self.spans, MAX_SPANS, "spans", span_dict)
+
+    def add_event(self, event: Dict) -> None:
+        self._add(self.events, MAX_EVENTS, "events", event)
+
+    def add_compile(self, compile_event: Dict) -> None:
+        self._add(self.compiles, MAX_COMPILES, "compiles", compile_event)
+
+    def add_log(self, log_record: Dict) -> None:
+        self._add(self.logs, MAX_LOGS, "logs", log_record)
+
+    # -- lifecycle -----------------------------------------------------
+    def finalize(self, status: str) -> None:
+        self.end_ms = int(time.time() * 1000)
+        self.status = status
+        now = _counter_totals()
+        self.metric_deltas = {
+            name: round(now[name] - self._counters0.get(name, 0.0), 6)
+            for name in now
+            if now[name] != self._counters0.get(name, 0.0)}
+
+    def to_dict(self) -> Dict:
+        with self._lock:
+            return {
+                "job_key": self.job_key,
+                "description": self.description,
+                "status": self.status,
+                "start_ms": self.start_ms,
+                "end_ms": self.end_ms,
+                "duration_ms": (self.end_ms - self.start_ms)
+                if self.end_ms else None,
+                "spans": list(self.spans),
+                "events": list(self.events),
+                "compiles": list(self.compiles),
+                "logs": list(self.logs),
+                "metric_deltas": dict(self.metric_deltas),
+                "dropped": dict(self.dropped),
+            }
+
+
+def _counter_totals() -> Dict[str, float]:
+    """Counter totals by name (labels folded) — the start/end metric
+    delta a capsule reports ("this job cost 3 compiles, 412 reduces")."""
+    return REGISTRY.counter_totals()
+
+
+# active recorders on THIS context, innermost last. A tuple (immutable)
+# so readers never see a half-built list.
+_ACTIVE: contextvars.ContextVar[Tuple[JobTelemetry, ...]] = \
+    contextvars.ContextVar("h2o3tpu_flight_recorders", default=())
+
+# completed-capsule retention ring (keys, oldest first)
+_ring: deque = deque()
+_ring_lock = threading.Lock()
+
+
+class _Handle:
+    __slots__ = ("capsule", "token", "published")
+
+    def __init__(self, capsule: JobTelemetry, token):
+        self.capsule = capsule
+        self.token = token
+        self.published = False
+
+
+def attach(job_key: str, description: str = "") -> Optional[_Handle]:
+    """Start recording the current context into a fresh capsule.
+
+    Called by Job.start on the WORKER thread (a background thread's
+    context is fresh, so the job really is the recording root there).
+    Returns None when the recorder is disabled."""
+    if not ENABLED:
+        return None
+    cap = JobTelemetry(job_key, description)
+    token = _ACTIVE.set(_ACTIVE.get() + (cap,))
+    return _Handle(cap, token)
+
+
+def publish(handle: Optional[_Handle]) -> None:
+    """DKV.put the capsule under ``<job_key>_telemetry`` — called from
+    inside the job's Scope so the key is tracked and therefore swept
+    when a cancelled job's scope unwinds."""
+    if handle is None:
+        return
+    from h2o3_tpu.core.kv import DKV
+    DKV.put(capsule_key(handle.capsule.job_key), handle.capsule)
+    handle.published = True
+
+
+def detach(handle: Optional[_Handle], status: str) -> None:
+    """Stop recording, stamp the end state, and rotate retention: keep
+    the newest ``H2O3TPU_FLIGHT_RECORDER_KEEP`` completed capsules, evict
+    older ones from the DKV. A capsule whose key is already gone (the
+    cancel sweep) is finalized but not resurrected."""
+    if handle is None:
+        return
+    _ACTIVE.reset(handle.token)
+    handle.capsule.finalize(status)
+    if not handle.published:
+        return
+    from h2o3_tpu.core.kv import DKV
+    key = capsule_key(handle.capsule.job_key)
+    if key not in DKV:          # swept with the cancelled job's Scope
+        return
+    keep = keep_count()
+    if keep == 0:
+        DKV.remove(key)
+        return
+    with _ring_lock:
+        _ring.append(key)
+        while len(_ring) > keep:
+            DKV.remove(_ring.popleft())
+
+
+def get_capsule(job_key: str) -> Optional[JobTelemetry]:
+    from h2o3_tpu.core.kv import DKV
+    cap = DKV.get(capsule_key(job_key))
+    return cap if isinstance(cap, JobTelemetry) else None
+
+
+# ---------------------------------------------------------------- hooks
+# Called from spans.py / timeline.py / compile_observer.py / log.py.
+# With no recorder attached these cost one contextvar read.
+
+
+def record_span(span) -> None:
+    recs = _ACTIVE.get()
+    if recs:
+        d = span.to_dict()
+        for cap in recs:
+            cap.add_span(d)
+
+
+def record_event(event: Dict) -> None:
+    for cap in _ACTIVE.get():
+        cap.add_event(event)
+
+
+def record_compile(compile_event: Dict) -> None:
+    for cap in _ACTIVE.get():
+        cap.add_compile(compile_event)
+
+
+def record_log(log_record: Dict) -> None:
+    for cap in _ACTIVE.get():
+        cap.add_log(log_record)
+
+
+def is_recording() -> bool:
+    return bool(_ACTIVE.get())
+
+
+def clear() -> None:
+    """Tests only — drop the retention ring (not the DKV entries)."""
+    with _ring_lock:
+        _ring.clear()

@@ -179,7 +179,8 @@ class MetricsRegistry:
         """Current value of a counter/gauge (0.0 if never touched);
         for a histogram returns its observation count."""
         name = _full_name(name)
-        m = self._metrics.get((name, _label_key(labels)))
+        with self._lock:
+            m = self._metrics.get((name, _label_key(labels)))
         if m is None:
             return 0.0
         return float(m.count if isinstance(m, Histogram) else m.value)
@@ -188,10 +189,23 @@ class MetricsRegistry:
         """Sum of a counter across all label sets."""
         name = _full_name(name)
         tot = 0.0
-        for (n, _), m in list(self._metrics.items()):
+        with self._lock:
+            items = list(self._metrics.items())
+        for (n, _), m in items:
             if n == name and isinstance(m, Counter):
                 tot += m.value
         return tot
+
+    def counter_totals(self) -> Dict[str, float]:
+        """Counter totals folded over label sets — the cheap start/end
+        delta snapshot the flight recorder takes per job."""
+        with self._lock:
+            items = list(self._metrics.items())
+        out: Dict[str, float] = {}
+        for (name, _), m in items:
+            if isinstance(m, Counter):
+                out[name] = out.get(name, 0.0) + m.value
+        return out
 
     def ops(self) -> int:
         return _OPS
@@ -206,9 +220,13 @@ class MetricsRegistry:
     def snapshot(self) -> Dict[str, list]:
         """JSON shape: {counters: [...], gauges: [...], histograms: [...]},
         each entry {name, labels, value|...}."""
+        # copy under the lock: a scrape racing first-touch metric
+        # creation on another thread must never see the dict resize
+        # mid-iteration (RuntimeError → 500 on /3/Metrics)
+        with self._lock:
+            items = list(self._metrics.items())
         counters, gauges, hists = [], [], []
-        for (_, _), m in sorted(self._metrics.items(),
-                                key=lambda kv: kv[0]):
+        for (_, _), m in sorted(items, key=lambda kv: kv[0]):
             if isinstance(m, Counter):
                 counters.append({"name": m.name, "labels": m.labels,
                                  "value": m.value})
@@ -235,8 +253,12 @@ class MetricsRegistry:
             return str(v).replace("\\", r"\\").replace('"', r'\"') \
                          .replace("\n", r"\n")
 
+        # same copy-under-lock discipline as snapshot(): the exposition
+        # walk must not race first-touch creation
+        with self._lock:
+            metrics = list(self._metrics.values())
         by_name: Dict[str, List[object]] = {}
-        for m in self._metrics.values():
+        for m in metrics:
             by_name.setdefault(m.name, []).append(m)
         lines: List[str] = []
         for name in sorted(by_name):

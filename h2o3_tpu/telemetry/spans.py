@@ -45,7 +45,8 @@ _current: contextvars.ContextVar[Optional["Span"]] = \
 
 class Span:
     __slots__ = ("id", "name", "parent_id", "start", "end", "meta",
-                 "device_peak_bytes", "collective_bytes", "_token")
+                 "device_peak_bytes", "collective_bytes", "_token",
+                 "_peak_base")
 
     def __init__(self, name: str, parent_id: Optional[str], **meta):
         self.id = f"sp-{next(_ids):08d}"
@@ -57,6 +58,7 @@ class Span:
         self.device_peak_bytes = 0
         self.collective_bytes = 0.0
         self._token = None
+        self._peak_base = 0
 
     @property
     def duration(self) -> float:
@@ -90,16 +92,26 @@ def _device_peak() -> int:
 @contextmanager
 def span(name: str, **meta):
     """Open a child of the current span (root if none) for the duration
-    of the with-block. Exceptions propagate; the span still closes."""
+    of the with-block. Exceptions propagate; the span still closes.
+
+    ``device_peak_bytes`` is SPAN-RELATIVE: the process high-water mark
+    is read at entry as a baseline, and the span reports how far the
+    high-water ROSE while it was open. Best-effort semantics: the mark
+    is process-wide and monotonic, so concurrent spans each get charged
+    the shared rise, and a span that allocated under an earlier
+    high-water reports 0 (pre-fix every span after the global peak
+    reported the same global max). Backends without ``memory_stats``
+    report 0 throughout."""
     parent = _current.get()
     sp = Span(name, parent.id if parent is not None else None, **meta)
+    sp._peak_base = _device_peak()
     sp._token = _current.set(sp)
     try:
         yield sp
     finally:
         _current.reset(sp._token)
         sp.end = time.time()
-        sp.device_peak_bytes = _device_peak()
+        sp.device_peak_bytes = max(0, _device_peak() - sp._peak_base)
         if parent is not None:
             # charge child collective traffic up the tree so a root job
             # span totals its whole subtree
@@ -108,6 +120,13 @@ def span(name: str, **meta):
             _finished.append(sp)
         counter("spans_total", name=name).inc()
         histogram("span_seconds", name=name).observe(sp.end - sp.start)
+        # per-job flight recorder capture (one contextvar read when no
+        # recorder is attached — telemetry/flight_recorder.py)
+        try:
+            from h2o3_tpu.telemetry import flight_recorder
+            flight_recorder.record_span(sp)
+        except Exception:   # noqa: BLE001 - capture is best-effort
+            pass
         from h2o3_tpu.utils.timeline import record as _tl
         _tl("span", f"{name} {sp.duration * 1000:.1f}ms",
             span_id=sp.id, parent_id=sp.parent_id)

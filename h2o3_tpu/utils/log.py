@@ -1,40 +1,230 @@
-"""Leveled logging (analogue of water.util.Log, reference
+"""Structured logging pipeline (analogue of water.util.Log, reference
 h2o-core/src/main/java/water/util/Log.java:24).
 
-The reference keeps per-node rotating files via log4j; here a thin wrapper
-over the stdlib so every subsystem logs through one place and the REST
-``/3/Logs`` endpoint can replay the buffer.
+The reference keeps per-node rotating log4j files and replays them over
+``GET /3/Logs``. This is the same discipline for one controller
+process, rebuilt as a pipeline every subsystem shares:
+
+- **one root** — every logger is a child of ``h2o3_tpu`` (``get_logger``
+  normalizes bare names to ``h2o3_tpu.<name>``), so the sinks below see
+  every record exactly once;
+- **context filter** — each record is stamped with the active telemetry
+  ``span_id`` (telemetry/spans.py) and ``job_id``
+  (core/request_ctx.py), tying log lines to the span tree and to the
+  per-job flight recorder capsule;
+- **ring sinks** — a combined ring plus per-level rings back
+  ``GET /3/Logs``; the structured record dicts feed the flight
+  recorder (telemetry/flight_recorder.py) so a job's capsule carries
+  its own log lines;
+- **file sink** — ``H2O3TPU_LOG_DIR`` enables a rotating per-process
+  file (``h2o3tpu-<pid>.log``, the reference's per-node log-file
+  discipline; size/backups via ``H2O3TPU_LOG_FILE_MB`` /
+  ``H2O3TPU_LOG_FILE_BACKUPS``) that ``GET /3/Logs/download`` serves;
+- **JSON lines** — ``H2O3TPU_LOG_JSON=1`` switches the stream and file
+  sinks to one-JSON-object-per-line (``ts``, ``level``, ``logger``,
+  ``msg``, ``span_id``, ``job_id``, ``thread``), scrape-ready.
 """
 
 from __future__ import annotations
 
-import logging
 import collections
+import json
+import logging
+import logging.handlers
+import os
+import threading
+from typing import Dict, List, Optional
 
-_BUFFER: collections.deque = collections.deque(maxlen=10000)
+ROOT = "h2o3_tpu"
+
+_RING_CAPACITY = 10000
+_LEVEL_RING_CAPACITY = 2000
+
+_BUFFER: collections.deque = collections.deque(maxlen=_RING_CAPACITY)
+_LEVEL_BUFFERS: Dict[str, collections.deque] = {
+    lvl: collections.deque(maxlen=_LEVEL_RING_CAPACITY)
+    for lvl in ("DEBUG", "INFO", "WARNING", "ERROR", "CRITICAL")}
+_setup_lock = threading.Lock()
+_file_path: Optional[str] = None
 
 
-class _BufferHandler(logging.Handler):
+class ContextFilter(logging.Filter):
+    """Stamp every record with the active span/job ids — the join key
+    between a flat log line, the span tree, and a job's capsule.
+    Lazy imports: log.py is imported before telemetry on some paths and
+    must never create a cycle; missing context is just empty."""
+
+    def filter(self, record: logging.LogRecord) -> bool:
+        span_id = job_id = ""
+        try:
+            from h2o3_tpu.telemetry.spans import current_span_id
+            span_id = current_span_id() or ""
+        except Exception:   # noqa: BLE001 - logging must never fail
+            pass
+        try:
+            from h2o3_tpu.core.request_ctx import current_job
+            job = current_job()
+            job_id = getattr(job, "key", "") if job is not None else ""
+        except Exception:   # noqa: BLE001
+            pass
+        record.span_id = span_id
+        record.job_id = job_id
+        return True
+
+
+class JsonFormatter(logging.Formatter):
+    """One JSON object per line — the machine end of the pipeline."""
+
+    def format(self, record: logging.LogRecord) -> str:
+        d = {"ts": round(record.created, 3),
+             "level": record.levelname,
+             "logger": record.name,
+             "msg": record.getMessage(),
+             "span_id": getattr(record, "span_id", ""),
+             "job_id": getattr(record, "job_id", ""),
+             "thread": record.threadName}
+        if record.exc_info:
+            d["exc"] = self.formatException(record.exc_info)
+        return json.dumps(d)
+
+
+class _TextFormatter(logging.Formatter):
+    """Human format; the span/job stamp renders only when present."""
+
+    def format(self, record: logging.LogRecord) -> str:
+        sid = getattr(record, "span_id", "")
+        jid = getattr(record, "job_id", "")
+        ctx = " ".join(x for x in (sid, jid) if x)
+        record.ctx = f" [{ctx}]" if ctx else ""
+        return super().format(record)
+
+
+class _RingHandler(logging.Handler):
+    """Combined + per-level rings, plus the flight-recorder feed."""
+
     def emit(self, record: logging.LogRecord) -> None:
-        _BUFFER.append(self.format(record))
+        try:
+            line = self.format(record)
+        except Exception:   # noqa: BLE001
+            line = record.getMessage()
+        _BUFFER.append(line)
+        buf = _LEVEL_BUFFERS.get(record.levelname)
+        if buf is not None:
+            buf.append(line)
+        try:
+            from h2o3_tpu.telemetry import flight_recorder
+            if flight_recorder.is_recording():
+                flight_recorder.record_log({
+                    "ts_ms": int(record.created * 1000),
+                    "level": record.levelname,
+                    "logger": record.name,
+                    "msg": record.getMessage(),
+                    "span_id": getattr(record, "span_id", ""),
+                    "job_id": getattr(record, "job_id", ""),
+                })
+        except Exception:   # noqa: BLE001 - capture is best-effort
+            pass
 
 
-_logger = logging.getLogger("h2o3_tpu")
-if not _logger.handlers:
-    _h = logging.StreamHandler()
-    _h.setFormatter(logging.Formatter("%(asctime)s %(levelname).1s %(name)s: %(message)s"))
-    _logger.addHandler(_h)
-    _b = _BufferHandler()
-    _b.setFormatter(logging.Formatter("%(asctime)s %(levelname).1s %(message)s"))
-    _logger.addHandler(_b)
-    _logger.setLevel(logging.INFO)
-    _logger.propagate = False
+def _formatter(json_lines: bool) -> logging.Formatter:
+    if json_lines:
+        return JsonFormatter()
+    return _TextFormatter(
+        "%(asctime)s %(levelname).1s %(name)s%(ctx)s: %(message)s")
 
 
-def get_logger(name: str = "h2o3_tpu") -> logging.Logger:
+def configure(level: Optional[str] = None,
+              log_dir: Optional[str] = None,
+              json_lines: Optional[bool] = None) -> None:
+    """(Re)build the pipeline on the ``h2o3_tpu`` root logger.
+
+    Arguments default to the env knobs (``H2O3TPU_LOG_LEVEL``,
+    ``H2O3TPU_LOG_DIR``, ``H2O3TPU_LOG_JSON``); safe to call again —
+    ``init()`` re-runs it so knobs set after import take effect."""
+    global _file_path
+    if level is None:
+        level = os.environ.get("H2O3TPU_LOG_LEVEL", "INFO")
+    if log_dir is None:
+        log_dir = os.environ.get("H2O3TPU_LOG_DIR", "")
+    if json_lines is None:
+        json_lines = os.environ.get("H2O3TPU_LOG_JSON", "0") == "1"
+    with _setup_lock:
+        root = logging.getLogger(ROOT)
+        for h in list(root.handlers):
+            root.removeHandler(h)
+            try:
+                if isinstance(h, logging.handlers.RotatingFileHandler):
+                    h.close()
+            except Exception:   # noqa: BLE001
+                pass
+        # the filter rides each HANDLER (a logger-level filter would
+        # only see records logged directly on the root, not on
+        # h2o3_tpu.* children — stdlib filter propagation rules)
+        ctx_filter = ContextFilter()
+
+        stream = logging.StreamHandler()
+        stream.setFormatter(_formatter(json_lines))
+        stream.addFilter(ctx_filter)
+        root.addHandler(stream)
+
+        ring = _RingHandler()
+        ring.setFormatter(_formatter(json_lines))
+        ring.addFilter(ctx_filter)
+        root.addHandler(ring)
+
+        _file_path = None
+        if log_dir:
+            try:
+                os.makedirs(log_dir, exist_ok=True)
+                max_mb = int(os.environ.get("H2O3TPU_LOG_FILE_MB", "64"))
+                backups = int(os.environ.get("H2O3TPU_LOG_FILE_BACKUPS",
+                                             "3"))
+                path = os.path.join(log_dir, f"h2o3tpu-{os.getpid()}.log")
+                fh = logging.handlers.RotatingFileHandler(
+                    path, maxBytes=max_mb << 20, backupCount=backups)
+                fh.setFormatter(_formatter(json_lines))
+                fh.addFilter(ctx_filter)
+                root.addHandler(fh)
+                _file_path = path
+            except OSError:
+                root.warning("log dir %r unusable; file sink disabled",
+                             log_dir)
+        root.setLevel(getattr(logging, str(level).upper(), logging.INFO))
+        root.propagate = False
+
+
+configure()
+
+
+def get_logger(name: str = ROOT) -> logging.Logger:
+    """Logger in the ``h2o3_tpu`` hierarchy. Bare names are normalized
+    to ``h2o3_tpu.<name>`` children — a logger outside the configured
+    root would bypass every sink above (the ``/3/Logs`` replay, the
+    file, the flight recorder)."""
+    if name != ROOT and not name.startswith(ROOT + "."):
+        name = f"{ROOT}.{name}"
     return logging.getLogger(name)
 
 
-def log_buffer() -> list:
-    """Recent log lines — backs GET /3/Logs (water/api/LogsHandler.java)."""
-    return list(_BUFFER)
+def log_buffer(level: Optional[str] = None,
+               last: Optional[int] = None) -> List[str]:
+    """Recent log lines — backs GET /3/Logs (water/api/LogsHandler.java).
+    ``level`` selects one per-level ring; default is the combined ring."""
+    if level:
+        buf = _LEVEL_BUFFERS.get(str(level).upper())
+        lines = list(buf) if buf is not None else []
+    else:
+        lines = list(_BUFFER)
+    if last is not None and last > 0:
+        lines = lines[-last:]
+    return lines
+
+
+def log_file_path() -> Optional[str]:
+    """Rotating-file sink path (None when H2O3TPU_LOG_DIR is unset)."""
+    return _file_path
+
+
+def level_counts() -> Dict[str, int]:
+    """Ring occupancy per level (the /3/Logs summary line)."""
+    return {lvl: len(buf) for lvl, buf in _LEVEL_BUFFERS.items()}

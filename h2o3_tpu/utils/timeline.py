@@ -36,10 +36,17 @@ def record(kind: str, what: str, **info) -> None:
                 info["span_id"] = sid
         except Exception:   # noqa: BLE001 - the ring must never fail
             pass
+    ev = {"seq": 0, "ts_ms": int(time.time() * 1000),
+          "kind": kind, "what": what, **info}
     with _lock:
         _seq += 1
-        _events.append({"seq": _seq, "ts_ms": int(time.time() * 1000),
-                        "kind": kind, "what": what, **info})
+        ev["seq"] = _seq
+        _events.append(ev)
+    try:
+        from h2o3_tpu.telemetry import flight_recorder
+        flight_recorder.record_event(ev)
+    except Exception:   # noqa: BLE001 - the ring must never fail
+        pass
 
 
 def snapshot(last: Optional[int] = None) -> List[Dict]:

@@ -258,13 +258,20 @@ def test_bench_wedged_config_costs_one_line(tmp_path):
     and the recorded budget never goes below 0."""
     p, lines = _run_bench(tmp_path, {
         "H2O3TPU_BENCH_BUDGET_S": "60",
-        "H2O3TPU_BENCH_CONFIG_TIMEOUT_S": "3"})
+        "H2O3TPU_BENCH_CONFIG_TIMEOUT_S": "3",
+        "H2O3TPU_BENCH_TRACE_DIR": str(tmp_path / "traces")})
     assert p.returncode == 0, p.stderr[-2000:]
     by_metric = {}
     for ln in lines:
         by_metric.setdefault(ln["metric"], []).append(ln)
     assert "value" in by_metric["stub config stub_a"][0]
     assert "value" in by_metric["stub config stub_b"][0]
+    # every SUCCESSFUL config also banked a Chrome-trace artifact
+    trace_line = by_metric["trace stub_a"][0]
+    with open(trace_line["trace_path"]) as f:
+        trace = json.load(f)
+    assert all({"ph", "ts", "pid", "tid"} <= set(e)
+               for e in trace["traceEvents"])
     wedge = by_metric["stub_wedge"][0]
     assert "wedged" in wedge["error"]
     budget = by_metric["budget"][0]
