@@ -505,20 +505,114 @@ def bench_grid():
         peak_hbm_gb=round(_hbm_peak() / 1e9, 2))
 
 
+def bench_treekernel():
+    """Kernel-level histogram+split+partition throughput
+    (rows·features/sec), fused Pallas level pass vs the XLA composition
+    on identical shapes — the ISSUE 6 microbench behind the flagship
+    GBM number. Native Pallas on TPU; on other backends the kernels run
+    through the interpreter at a token size (the line then measures the
+    interpreter, and says so)."""
+    import jax
+    import jax.numpy as jnp
+    from h2o3_tpu.frame.binning import BinnedMatrix
+    from h2o3_tpu.models.tree import TreeScalars
+    from h2o3_tpu.ops.pallas import treekernel as tk
+    from h2o3_tpu.parallel.mesh import (get_mesh, padded_rows,
+                                        put_sharded, row_sharding)
+
+    native = jax.default_backend() == "tpu"
+    n = (1 << 23 if not FAST else 1 << 21) if native else 1 << 14
+    F, B, L, d, block_rows = 10, 65, 8, 3, 4096
+    n = padded_rows(n)
+    r = np.random.RandomState(13)
+    mesh = get_mesh()
+    bm = BinnedMatrix(
+        bins=put_sharded(jnp.asarray(r.randint(0, B, (n, F)).astype(np.int8)),
+                         row_sharding()),
+        nbins=jnp.full((F,), B - 1, jnp.int32),
+        edges=jnp.zeros((F, B - 2), jnp.float32),
+        is_cat=np.zeros((F,), bool), names=[f"x{i}" for i in range(F)],
+        nbins_total=B, nrows=n, domains=[None] * F)
+    tiles = bm.tile_view(block_rows)           # bin-major tile layout
+    bins = tiles.bins
+    nid = put_sharded(jnp.asarray(r.randint(0, L, n).astype(np.int32)),
+                      row_sharding())
+    w = jnp.asarray((r.rand(n) > 0.05).astype(np.float32))
+    g = jnp.asarray(r.randn(n).astype(np.float32))
+    h = jnp.asarray(r.rand(n).astype(np.float32))
+    stats = jnp.stack([w, w * g, w * h], axis=1).astype(jnp.float32)
+    # any nonneg prev histogram exercises the sibling-subtract path;
+    # throughput does not care that it is synthetic
+    prev = jnp.asarray(
+        np.abs(r.randn(L // 2, F, B, 3)).astype(np.float32)) * 8.0
+    cm = jnp.ones((F,), bool)
+    nb = bm.nbins
+    lo = jnp.full((1,), -jnp.inf, jnp.float32)
+    hi = jnp.full((1,), jnp.inf, jnp.float32)
+    sc = TreeScalars(jnp.float32(10.0), jnp.float32(1.0),
+                     jnp.float32(1e-5), jnp.int32(30))
+    kw = dict(d=d, n_nodes=L, n_bins=B, block_rows=block_rows, mesh=mesh)
+
+    def run_pallas(bins, nid, stats, prev):
+        out = tk.fused_level(bins, nid, stats, prev, cm, nb, None, None,
+                             lo, hi, sc, interpret=not native, **kw)
+        return out[1], out[-1]          # gains + routed ids force all
+
+    def run_xla(bins, nid, prev):
+        out = tk.xla_level(bins, nid, w, g, h, prev, cm, nb, None, None,
+                           lo, hi, sc, **kw)
+        return out[1], out[-1]
+
+    jp = jax.jit(run_pallas)
+    jx = jax.jit(run_xla)
+    for f in jax.block_until_ready(jp(bins, nid, stats, prev)):
+        pass                            # warmup/compile
+    jax.block_until_ready(jx(bins, nid, prev))
+    reps = 10 if native else 3
+    c0 = _compile_count()
+    t0 = time.time()
+    for _ in range(reps):
+        out = jp(bins, nid, stats, prev)
+    jax.block_until_ready(out)
+    t_pallas = (time.time() - t0) / reps
+    t0 = time.time()
+    for _ in range(reps):
+        out = jx(bins, nid, prev)
+    jax.block_until_ready(out)
+    t_xla = (time.time() - t0) / reps
+    rate_p = n * F / t_pallas
+    rate_x = n * F / t_xla
+    _emit(
+        f"treekernel fused hist+split+partition level d={d} "
+        f"{n/1e6:.1f}M rows x {F}F x {B}B "
+        f"({'native Pallas' if native else 'Pallas interpreter'})",
+        rate_p, "rows-feat/sec/chip",
+        rate_p / rate_x, "XLA histogram+scan+route, same shapes/mesh",
+        xla_rows_feat_per_sec=round(rate_x, 1),
+        pallas_level_ms=round(t_pallas * 1e3, 2),
+        xla_level_ms=round(t_xla * 1e3, 2),
+        tile_rows=tiles.rows, tiles=tiles.ntiles,
+        mode="native" if native else "interpret",
+        compiles_timed=_compile_count() - c0,
+        peak_hbm_gb=round(_hbm_peak() / 1e9, 2))
+
+
 CONFIGS = [("gbm", bench_gbm), ("glm", bench_glm), ("dl", bench_dl),
            ("xgb", bench_xgb), ("sort", bench_sort),
-           ("grid", bench_grid),
+           ("grid", bench_grid), ("treekernel", bench_treekernel),
            ("automl", bench_automl), ("gbm-full", bench_gbm_full)]
 
 # minimum seconds a config plausibly needs; skipped (with a JSON note)
 # rather than started when the remaining budget is below it
 _MIN_NEED = {"gbm": 60, "glm": 90, "dl": 60, "xgb": 60, "sort": 60,
-             "grid": 120, "automl": 180, "gbm-full": 600}
+             "grid": 120, "treekernel": 60, "automl": 180,
+             "gbm-full": 600}
 
 # hard per-config wallclock cap (child process killed past it): a
 # wedged worker costs one line, never the scoreboard
 _HARD_CAP = {"gbm": 900, "glm": 600, "dl": 600, "xgb": 600, "sort": 400,
-             "grid": 600, "automl": 900, "gbm-full": 1200}
+             "grid": 600, "treekernel": 400, "automl": 900,
+             "gbm-full": 1200}
 
 
 def _stub_ok(name):
@@ -552,10 +646,32 @@ def _stub_grid():
           batched=model_batch.enabled())
 
 
+def _stub_treekernel():
+    """`treekernel` line without a backend: drives the Pallas PLANNER —
+    the pure knob/backend decision table and the VMEM tile sizing
+    (ops/pallas.decide / vmem_tile_rows) — so the harness exercises the
+    kernel-layer plumbing even where no accelerator (or no Pallas)
+    exists."""
+    from h2o3_tpu.ops import pallas as plx
+    decisions = {}
+    for knob in ("auto", "off", "interpret", "on"):
+        for backend in ("tpu", "cpu"):
+            mode, reason = plx.decide(knob, backend, 8, True)
+            decisions[f"{knob}/{backend}"] = mode + (
+                f" ({reason})" if reason else "")
+    # unavailable pallas always resolves off, never raises
+    assert plx.decide("auto", "tpu", 8, False)[0] == "off"
+    rows = plx.vmem_tile_rows(10, 65, 32)
+    assert rows % 8 == 0 and rows >= 8
+    _emit("treekernel fused level (stub; knob/tile planner, no backend)",
+          float(rows), "rows/tile", 1.0, "stub", decisions=decisions)
+
+
 if STUB:
     CONFIGS = [("stub_a", _stub_ok("stub_a")),
                ("stub_wedge", _stub_wedge),
                ("grid", _stub_grid),
+               ("treekernel", _stub_treekernel),
                ("stub_b", _stub_ok("stub_b"))]
     _MIN_NEED = {n: 1 for n, _ in CONFIGS}
     _HARD_CAP = {n: 30 for n, _ in CONFIGS}

@@ -62,6 +62,13 @@ class Config:
     # vmapped batch: "auto" (default) batches eligible buckets of >= 2
     # combos; "off"/"0" forces the sequential per-combo walk
     batch_models: str = "auto"
+    # -- performance kernels (ops/pallas/) -----------------------------
+    # fused Pallas tree kernels (histogram+split+partition per level):
+    # "auto" = Pallas on TPU backends, XLA elsewhere; "off" = always the
+    # XLA path; "interpret" = force the kernels through the Pallas
+    # interpreter (CPU parity testing). The XLA path remains the
+    # always-available fallback (ops/pallas.decide)
+    pallas: str = "auto"
 
     # fields that parse as int from the environment (annotations are
     # strings under `from __future__ import annotations`, so resolve

@@ -32,6 +32,11 @@ class Frame:
         # device_matrix cache: column-name tuple -> stacked [Npad, F]
         # device array (invalidated on column mutation)
         self._matrix_cache: Dict[tuple, jax.Array] = {}
+        # bin_frame cache: (features, nbins, nbins_cats, hist type,
+        # weights digest) -> BinnedMatrix (frame/binning.py) — same
+        # mutation-invalidation contract as _matrix_cache, so grid/
+        # AutoML sweeps stop re-binning the same frame per model family
+        self._bin_cache: Dict[tuple, object] = {}
         self.nrows = nrows
         self.key = key or make_key("frame")
         DKV.put(self.key, self)
@@ -94,8 +99,9 @@ class Frame:
             new_cols[new] = c
         self._cols = new_cols
         self._order = list(new_names)
-        # name-keyed cache: stale after rename
+        # name-keyed caches: stale after rename
         getattr(self, "_matrix_cache", {}).clear()
+        getattr(self, "_bin_cache", {}).clear()
         # a mutated frame no longer matches its source file — the
         # Cleaner must not evict it back to a FileBackedFrame stub
         self._source_paths = None
@@ -161,6 +167,7 @@ class Frame:
         if col.name not in self._order:
             self._order.append(col.name)
         getattr(self, "_matrix_cache", {}).clear()   # column set changed
+        getattr(self, "_bin_cache", {}).clear()
         self._source_paths = None    # mutated: no source-stub eviction
 
     def drop(self, names: Sequence[str]) -> "Frame":

@@ -39,6 +39,7 @@ from h2o3_tpu.models.tree import (Tree, TreeParams, TreeScalars,
                                   exact_f32_for, grow_tree,
                                   predict_forest, predict_tree,
                                   stack_trees, unstack_model_trees)
+from h2o3_tpu.ops import pallas as pallas_ops
 from h2o3_tpu.parallel.mesh import (get_mesh, put_sharded,
                                     row_sharding)
 from h2o3_tpu import telemetry
@@ -296,7 +297,8 @@ def _neutral_tp(tp: TreeParams) -> TreeParams:
                       nbins_total=tp.nbins_total,
                       block_rows=tp.block_rows,
                       cat_feats=tp.cat_feats,
-                      exact_f32=tp.exact_f32)   # static: changes the program
+                      exact_f32=tp.exact_f32,   # static: changes the program
+                      pallas=tp.pallas)         # static: kernel backend
 
 
 def _boost_step_impl(bins, nb, y, w, margin, key, knobs, *, tp, dist,
@@ -760,7 +762,8 @@ class GBMEstimator(ModelBuilder):
             # put a 12K-iteration inner scan in every tree at 50M and
             # underfeed the MXU contraction
             block_rows=16384 if bm.bins.shape[0] > 8_388_608 else 4096,
-            exact_f32=exact_f32_for(bm))
+            exact_f32=exact_f32_for(bm),
+            pallas=pallas_ops.resolve_tree_mode())
 
         constraints = _build_constraints(p, x, frame, category)
         interaction_sets = _build_interaction_sets(p, x)
@@ -1192,7 +1195,8 @@ def fit_gbm_batched(builder_cls, params_list: List[dict], frame: Frame,
             nbins_total=bm.nbins_total,
             cat_feats=tuple(bool(v) for v in bm.is_cat),
             block_rows=16384 if bm.bins.shape[0] > 8_388_608 else 4096,
-            exact_f32=exact_f32_for(bm))
+            exact_f32=exact_f32_for(bm),
+            pallas=pallas_ops.resolve_tree_mode())
 
     tps = [_tp_of(b.params) for b in builders]
     tp0 = tps[0]                 # shared static program (depth buckets)

@@ -32,6 +32,7 @@ import numpy as np
 
 from h2o3_tpu.ops.histogram import histogram
 from h2o3_tpu.ops.segments import segment_sum
+from h2o3_tpu.ops.split_scan import best_splits
 
 
 class TreeScalars(NamedTuple):
@@ -121,6 +122,14 @@ class TreeParams:
                                      # orders — uniform-weight
                                      # normalization covers the exact-
                                      # equality contracts instead
+    pallas: str = "off"              # fused level-loop backend:
+                                     # "off" = XLA, "native"/"interpret"
+                                     # = ops/pallas/treekernel. STATIC
+                                     # on purpose: the knob decision
+                                     # must be part of the jit key so a
+                                     # mid-process flip recompiles
+                                     # instead of reusing a stale
+                                     # program (ops/pallas.resolve_tree_mode)
 
     @property
     def has_cats(self) -> bool:
@@ -150,115 +159,18 @@ def row_feature_values(bins, f_r):
 def _best_splits(hist, nb, col_mask, params: TreeParams,
                  constraints=None, lo=None, hi=None, scalars=None,
                  is_cat=None):
-    """Vectorized DTree.findBestSplitPoint over all nodes of a level.
-
-    hist: [L, F, B, 3] of {w, g, h}; col_mask [F] (per-tree sampling) or
-    [L, F] (per-node mtries, DRF). With ``constraints`` ([F] in
-    {-1,0,+1}) and per-node value bounds lo/hi ([L]), splits on
-    constrained features must order their (bound-clipped) child Newton
-    values per the constraint direction — the monotone-constraints
-    contract of the reference GBM (hex/tree/DHistogram constraints +
-    hex/tree/Constraints).
-
-    Categorical features (``is_cat`` [F] bool, active when
-    params.has_cats): bins are re-ordered PER NODE by their Newton value
-    -g/(h+λ) and the threshold scan runs over that order, so the best
-    "prefix" is the best category SUBSET — the static-shape formulation
-    of the reference's bitset splits (hex/tree/DTree.java:619-697
-    findBestSplitPoint sorts by prediction then scans). Returns per-node
-    best (gain, feat, thresh, na_left, left_val, right_val, leftmask)
-    where leftmask [L, B-1] marks the ORIGINAL bin ids going left.
-    """
+    """Vectorized DTree.findBestSplitPoint over all nodes of a level —
+    thin adapter over the shared implementation (ops/split_scan.py),
+    which the fused Pallas kernels evaluate too so both tree backends
+    stay bit-exact by construction. See ops.split_scan.best_splits for
+    the full contract."""
     sc = scalars if scalars is not None else scalars_of(params)
-    lam = sc.reg_lambda
-    B = hist.shape[2]
-    w, g, h = hist[..., 0], hist[..., 1], hist[..., 2]
-    wv = w[:, :, : B - 1]
-    gv = g[:, :, : B - 1]
-    hv = h[:, :, : B - 1]
-    order = None
-    if params.has_cats and is_cat is not None:
-        # per-(node, feature) bin order: Newton value ascending for cats,
-        # natural bin order for numerics (identity keeps the exact
-        # numeric semantics). Empty bins key to 0 and sort mid-sequence;
-        # their left/right membership carries no weight either way.
-        # empty bins key to +inf so they sort AFTER every populated bin:
-        # the t <= nb-2 threshold-validity mask then stays correct in
-        # sorted space (populated bins occupy a prefix of it)
-        val = jnp.where(wv > 0, -gv / (hv + lam + 1e-10), jnp.inf)
-        pos = jnp.arange(B - 1, dtype=jnp.float32)
-        key = jnp.where(is_cat[None, :, None], val, pos[None, None, :])
-        order = jnp.argsort(key, axis=2, stable=True)
-        wv = jnp.take_along_axis(wv, order, axis=2)
-        gv = jnp.take_along_axis(gv, order, axis=2)
-        hv = jnp.take_along_axis(hv, order, axis=2)
-    # cumulative over (possibly re-ordered) value bins; NA bin is B-1
-    cw = jnp.cumsum(wv, axis=2)
-    cg = jnp.cumsum(gv, axis=2)
-    ch = jnp.cumsum(hv, axis=2)
-    naw, nag, nah = w[:, :, B - 1], g[:, :, B - 1], h[:, :, B - 1]
-    tw = cw[:, :, -1] + naw
-    tg = cg[:, :, -1] + nag
-    th = ch[:, :, -1] + nah
-    if lo is None:
-        lo = jnp.full((hist.shape[0],), -jnp.inf, jnp.float32)
-        hi = jnp.full((hist.shape[0],), jnp.inf, jnp.float32)
-
-    def gain(gl, hl, gr, hr):
-        return (gl * gl / (hl + lam) + gr * gr / (hr + lam)
-                - tg[:, :, None] ** 2 / (th[:, :, None] + lam))
-
-    def child_vals(gl, hl, gr, hr):
-        lv = jnp.clip(-gl / (hl + lam), lo[:, None, None], hi[:, None, None])
-        rv = jnp.clip(-gr / (hr + lam), lo[:, None, None], hi[:, None, None])
-        return lv, rv
-
-    def masked_gain(wl, gl, hl):
-        wr = tw[:, :, None] - wl
-        gr = tg[:, :, None] - gl
-        hr = th[:, :, None] - hl
-        ok = (wl >= sc.min_rows) & (wr >= sc.min_rows)
-        lv, rv = child_vals(gl, hl, gr, hr)
-        if constraints is not None:
-            c = constraints[None, :, None].astype(jnp.float32)
-            ok = ok & (c * (rv - lv) >= 0)
-        return jnp.where(ok, gain(gl, hl, gr, hr), -jnp.inf), lv, rv
-
-    g_nar, lv_nar, rv_nar = masked_gain(cw, cg, ch)         # NA → right
-    g_nal, lv_nal, rv_nal = masked_gain(
-        cw + naw[:, :, None], cg + nag[:, :, None],
-        ch + nah[:, :, None])                               # NA → left
-    # threshold validity: t <= nb[f]-2 (splitting at last real bin is void)
-    t_ids = jnp.arange(B - 1, dtype=jnp.int32)
-    valid_t = t_ids[None, :] <= (nb[:, None] - 2)           # [F, B-1]
-    cm = col_mask if col_mask.ndim == 2 else col_mask[None, :]   # [L|1, F]
-    mask = valid_t[None, :, :] & cm[:, :, None]
-    g_nar = jnp.where(mask, g_nar, -jnp.inf)
-    g_nal = jnp.where(mask, g_nal, -jnp.inf)
-
-    stacked = jnp.stack([g_nar, g_nal], axis=-1)            # [L, F, B-1, 2]
-    L = stacked.shape[0]
-    flat = stacked.reshape(L, -1)
-    best = jnp.argmax(flat, axis=1)
-    best_gain = jnp.take_along_axis(flat, best[:, None], axis=1)[:, 0]
-    na_left = (best % 2).astype(bool)
-    best_t = ((best // 2) % (B - 1)).astype(jnp.int32)
-    best_f = (best // (2 * (B - 1))).astype(jnp.int32)
-    lvals = jnp.stack([lv_nar, lv_nal], axis=-1).reshape(L, -1)
-    rvals = jnp.stack([rv_nar, rv_nal], axis=-1).reshape(L, -1)
-    best_lv = jnp.take_along_axis(lvals, best[:, None], axis=1)[:, 0]
-    best_rv = jnp.take_along_axis(rvals, best[:, None], axis=1)[:, 0]
-    if order is not None:
-        # original-bin-id membership of the winning prefix: position of
-        # bin b within the winning feature's order <= t  ⇔  b goes left
-        order_win = jnp.take_along_axis(
-            order, best_f[:, None, None], axis=1)[:, 0]     # [L, B-1]
-        ranks = jnp.argsort(order_win, axis=1)              # inverse perm
-        leftmask = ranks <= best_t[:, None]
-    else:
-        leftmask = (jnp.arange(B - 1, dtype=jnp.int32)[None, :]
-                    <= best_t[:, None])
-    return best_gain, best_f, best_t, na_left, best_lv, best_rv, leftmask
+    return best_splits(
+        hist, nb, col_mask, min_rows=sc.min_rows,
+        reg_lambda=sc.reg_lambda,
+        is_cat=is_cat if (params.has_cats and is_cat is not None)
+        else None,
+        constraints=constraints, lo=lo, hi=hi)
 
 
 def _pack_leftmask(leftmask, W: int):
@@ -359,44 +271,64 @@ def grow_tree(bins, nb, w, g, h, col_mask, *, params: TreeParams, mesh,
     # small matmul whose exactness the weight≡duplication metric
     # contracts actually observe
     prec = jax.lax.Precision.HIGHEST if params.exact_f32 else None
+    # fused Pallas level loop (ops/pallas/treekernel.py): histogram +
+    # split scan + row partition in one pass over the bin-major tiles,
+    # selected per fit via the STATIC params.pallas knob. The stats
+    # block {w, w·g, w·h} is level-invariant, so it is built once here
+    # (the XLA path rebuilds the same values inside ops/histogram.py).
+    use_fused = params.pallas in ("native", "interpret")
+    if use_fused:
+        from h2o3_tpu.ops.pallas.treekernel import fused_level
+        stats3 = jnp.stack([w, w * g, w * h], axis=1).astype(jnp.float32)
     prev_hist = None
     for d in range(D):
         L = 2 ** d
-        if prev_hist is None:
-            hist = histogram(bins, nid, w, g, h, n_nodes=L, n_bins=B,
-                             mesh=mesh, block_rows=params.block_rows)
-        else:
-            # sibling subtraction: histogram only the LEFT children (even
-            # node slots), derive right = parent − left. Halves the
-            # histogram matmul at every level ≥ 1 (the LightGBM/XGBoost
-            # smaller-child trick, made static-shape by always picking
-            # left; the reference recomputes both children,
-            # hex/tree/ScoreBuildHistogram2.java).
-            even = (nid % 2 == 0).astype(jnp.float32)
-            lh = histogram(bins, nid >> 1, w * even, g, h, n_nodes=L // 2,
-                           n_bins=B, mesh=mesh, block_rows=params.block_rows)
-            rh = prev_hist - lh
-            # f32 cancellation guard: w and h are nonnegative sums, so
-            # clamp tiny negative residue (|err| ≲ parent·2^-23); g may
-            # be legitimately negative and stays as computed
-            rh = rh.at[..., 0].set(jnp.maximum(rh[..., 0], 0.0))
-            rh = rh.at[..., 2].set(jnp.maximum(rh[..., 2], 0.0))
-            hist = jnp.stack([lh, rh], axis=1).reshape(L, *lh.shape[1:])
-        prev_hist = hist
         cm = col_mask
         if mtries > 0 and mtries < F:
             key, sub = jax.random.split(key)
             cm = _mtries_mask(sub, L, F, mtries) & col_mask[None, :]
         if interaction_sets is not None:
             cm = (cm if cm.ndim == 2 else cm[None, :]) & allowed
-        bg, bf, bt, bnal, blv, brv, leftmask = _best_splits(
-            hist, nb, cm, params, constraints=constraints, lo=lo, hi=hi,
-            scalars=sc, is_cat=is_cat)
-        split = bg > sc.msi
-        if sc.depth_limit is not None:
-            # depth-bucketed program: levels past the ACTUAL depth never
-            # split (one compiled program per DEPTH_BUCKET, not per depth)
-            split = split & (jnp.int32(d) < sc.depth_limit)
+        if use_fused:
+            (hist, bg, bf, bt, bnal, blv, brv, leftmask, split,
+             nid_next) = fused_level(
+                bins, nid, stats3, prev_hist, cm, nb, is_cat,
+                constraints, lo, hi, sc, d=d, n_nodes=L, n_bins=B,
+                block_rows=params.block_rows, mesh=mesh,
+                interpret=(params.pallas == "interpret"))
+        else:
+            if prev_hist is None:
+                hist = histogram(bins, nid, w, g, h, n_nodes=L, n_bins=B,
+                                 mesh=mesh, block_rows=params.block_rows)
+            else:
+                # sibling subtraction: histogram only the LEFT children
+                # (even node slots), derive right = parent − left. Halves
+                # the histogram matmul at every level ≥ 1 (the
+                # LightGBM/XGBoost smaller-child trick, made static-shape
+                # by always picking left; the reference recomputes both
+                # children, hex/tree/ScoreBuildHistogram2.java).
+                even = (nid % 2 == 0).astype(jnp.float32)
+                lh = histogram(bins, nid >> 1, w * even, g, h,
+                               n_nodes=L // 2, n_bins=B, mesh=mesh,
+                               block_rows=params.block_rows)
+                rh = prev_hist - lh
+                # f32 cancellation guard: w and h are nonnegative sums,
+                # so clamp tiny negative residue (|err| ≲ parent·2^-23);
+                # g may be legitimately negative and stays as computed
+                rh = rh.at[..., 0].set(jnp.maximum(rh[..., 0], 0.0))
+                rh = rh.at[..., 2].set(jnp.maximum(rh[..., 2], 0.0))
+                hist = jnp.stack([lh, rh], axis=1).reshape(L, *lh.shape[1:])
+            bg, bf, bt, bnal, blv, brv, leftmask = _best_splits(
+                hist, nb, cm, params, constraints=constraints, lo=lo,
+                hi=hi, scalars=sc, is_cat=is_cat)
+            split = bg > sc.msi
+            if sc.depth_limit is not None:
+                # depth-bucketed program: levels past the ACTUAL depth
+                # never split (one compiled program per DEPTH_BUCKET,
+                # not per depth)
+                split = split & (jnp.int32(d) < sc.depth_limit)
+            nid_next = None
+        prev_hist = hist
         feats = feats.at[d, :L].set(jnp.where(split, bf, 0))
         threshs = threshs.at[d, :L].set(jnp.where(split, bt, B))
         na_lefts = na_lefts.at[d, :L].set(jnp.where(split, bnal, False))
@@ -441,10 +373,14 @@ def grow_tree(bins, nb, w, g, h, col_mask, *, params: TreeParams, mesh,
             # interleave children: node l → children 2l, 2l+1
             lo = jnp.stack([lo_l, lo_r], axis=1).reshape(-1)
             hi = jnp.stack([hi_l, hi_r], axis=1).reshape(-1)
-        # route rows (the reference's DecidedNode assignment pass)
-        nid = _level_goleft(feats[d], threshs[d], na_lefts[d],
-                            is_splits[d], cat_splits[d], left_words[d],
-                            nid, bins, B)
+        # route rows (the reference's DecidedNode assignment pass);
+        # the fused kernel already partitioned inside its second phase
+        if nid_next is not None:
+            nid = nid_next
+        else:
+            nid = _level_goleft(feats[d], threshs[d], na_lefts[d],
+                                is_splits[d], cat_splits[d],
+                                left_words[d], nid, bins, B)
 
     # leaf Newton values from final assignment (GammaPass analogue)
     nleaf = 2 ** D

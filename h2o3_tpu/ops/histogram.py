@@ -28,12 +28,17 @@ from jax.sharding import PartitionSpec as P
 
 from h2o3_tpu.parallel.mesh import DATA_AXIS, MODEL_AXIS
 
-# A Pallas kernel exists (ops/pallas_histogram) but measures ~2x slower
-# than the XLA formulation on v5e (the one-hot construction is VPU-bound
-# either way, and XLA fuses it into the matmul at larger row blocks than
-# fit VMEM). Opt in with H2O3_TPU_PALLAS_HIST=1 — read ONCE at import:
-# histogram() only runs at trace time inside jit-cached programs, so a
-# mid-process toggle could never take effect anyway.
+# A standalone Pallas histogram kernel exists (ops/pallas_histogram) but
+# measures ~2x slower than the XLA formulation on v5e (the one-hot
+# construction is VPU-bound either way, and XLA fuses it into the matmul
+# at larger row blocks than fit VMEM). Opt in with H2O3_TPU_PALLAS_HIST=1
+# — read ONCE at import: histogram() only runs at trace time inside
+# jit-cached programs, so a mid-process toggle could never take effect
+# anyway. The FUSED tree kernels (ops/pallas/treekernel.py, knob
+# H2O3TPU_PALLAS) supersede it for the grow_tree level loop by folding
+# the split scan and row partition into the same pass — this module
+# stays the always-available XLA fallback and the non-tree histogram
+# entry point.
 import os as _os
 _USE_PALLAS_FLAG = _os.environ.get("H2O3_TPU_PALLAS_HIST") == "1"
 

@@ -1,0 +1,134 @@
+"""Pallas TPU kernel layer — knob resolution, fallback policy, telemetry.
+
+The fused tree kernels live in ``ops/pallas/treekernel.py``; this module
+is the POLICY layer and deliberately imports neither jax nor the kernels
+at module scope, so it stays importable (and testable) where
+``jax.experimental.pallas`` does not exist at all — the import-guard
+contract: a missing Pallas can only ever mean "XLA path, one logged
+fallback", never an ImportError in a training run.
+
+Knob (``H2O3TPU_PALLAS`` env / ``Config.pallas``):
+
+    auto       Pallas on TPU backends, XLA everywhere else (default)
+    off        always XLA
+    on         force native Pallas (TPU only in practice)
+    interpret  force the kernels through the Pallas interpreter — the
+               CPU tier-1 parity mode (bit-exact vs the XLA path)
+
+Every fallback decision increments ``pallas_fallbacks_total{reason=}``
+and logs ONCE per reason per process (no per-tree spam); every kernel
+program instantiation increments ``pallas_kernel_launches_total{kernel=}``
+at trace time (compiled programs re-run without touching Python, so the
+counter reads as "distinct kernel builds", not per-step executions).
+Both flow into each job's flight-recorder capsule via the start→end
+counter deltas like any other counter.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional, Tuple
+
+_AVAILABLE: Optional[bool] = None
+_LOGGED_REASONS = set()        # single logged fallback per reason/process
+
+
+def available() -> bool:
+    """True when ``jax.experimental.pallas`` imports (cached)."""
+    global _AVAILABLE
+    if _AVAILABLE is None:
+        try:
+            import jax.experimental.pallas  # noqa: F401
+            _AVAILABLE = True
+        except Exception:      # noqa: BLE001 - any import failure = absent
+            _AVAILABLE = False
+    return _AVAILABLE
+
+
+def knob_value() -> str:
+    """The H2O3TPU_PALLAS knob (env wins over Config default)."""
+    env = os.environ.get("H2O3TPU_PALLAS")
+    if env:
+        return env
+    try:
+        from h2o3_tpu.core.config import ARGS
+        return getattr(ARGS, "pallas", "auto") or "auto"
+    except Exception:          # noqa: BLE001 - config must never gate this
+        return "auto"
+
+
+def decide(knob: str, backend: str, data_shards: int,
+           avail: bool) -> Tuple[str, Optional[str]]:
+    """Pure decision table: (mode, fallback_reason).
+
+    mode is 'off' | 'native' | 'interpret'; reason is None when Pallas
+    was selected. ``data_shards`` rides along for the bench stub's
+    planner line — the kernels shard over 'data' like the XLA path, so
+    shard count never forces a fallback.
+    """
+    knob = (knob or "auto").strip().lower()
+    if knob in ("off", "0", "false", "xla"):
+        return "off", "knob_off"
+    if not avail:
+        return "off", "pallas_unavailable"
+    if knob == "interpret":
+        return "interpret", None
+    if knob in ("on", "native", "1", "force"):
+        return "native", None
+    if knob == "auto":
+        if backend != "tpu":
+            return "off", "non_tpu_backend"
+        return "native", None
+    return "off", "unknown_knob"
+
+
+def resolve_tree_mode() -> str:
+    """Resolve the tree-kernel mode for a fit (counts + logs fallbacks).
+
+    Called once per model fit by the tree builders; the result rides in
+    ``TreeParams.pallas`` (a STATIC jit field), so flipping the knob
+    mid-process compiles a fresh boosting program instead of silently
+    reusing a cached one with the old decision.
+    """
+    import jax
+    mode, reason = decide(knob_value(), jax.default_backend(), 1,
+                          available())
+    if reason is not None:
+        record_fallback(reason)
+    return mode
+
+
+def record_fallback(reason: str) -> None:
+    """Count a Pallas→XLA fallback; log once per reason per process."""
+    from h2o3_tpu import telemetry
+    telemetry.counter("pallas_fallbacks_total", reason=reason).inc()
+    if reason not in _LOGGED_REASONS:
+        _LOGGED_REASONS.add(reason)
+        from h2o3_tpu.utils.log import get_logger
+        get_logger("h2o3_tpu.ops.pallas").info(
+            "Pallas tree kernels falling back to XLA (%s); further "
+            "occurrences counted in pallas_fallbacks_total, not logged",
+            reason)
+
+
+def record_launch(kernel: str) -> None:
+    """Count a pallas_call instantiation (trace time)."""
+    from h2o3_tpu import telemetry
+    telemetry.counter("pallas_kernel_launches_total", kernel=kernel).inc()
+
+
+def vmem_tile_rows(n_features: int, n_bins: int, n_nodes: int,
+                   budget_bytes: int = 8 << 20) -> int:
+    """Row extent of a bin-major tile that fits the phase-A working set
+    in a VMEM budget: the int8 bins tile, the f32 one-hot (feature, bin)
+    indicator, the f32 node⊗stat routing block, and double-counted
+    histogram accumulator + output. Pure math (the bench stub's planner
+    runs it with no backend); floors to a sublane multiple of 8.
+    """
+    per_row = (n_features                    # int8 bins lane
+               + 4 * n_features * n_bins     # f32 one-hot right
+               + 4 * 3 * n_nodes             # f32 left block
+               + 64)                         # slack
+    fixed = 2 * 4 * 3 * n_nodes * n_features * n_bins
+    rows = max((int(budget_bytes) - fixed) // per_row, 8)
+    return max(8, (rows // 8) * 8)

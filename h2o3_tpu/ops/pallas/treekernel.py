@@ -1,0 +1,491 @@
+"""Fused Pallas tree kernels: histogram + best-split + partition per level.
+
+The XLA level loop (models/tree.py grow_tree) touches the binned matrix
+three times per depth level — one-hot matmul histograms
+(ops/histogram.py), the split scan, then ``_level_goleft`` re-reads the
+matrix to route rows — with every intermediate round-tripping HBM. This
+module fuses the whole per-level inner loop the way the GPU tree-boosting
+systems do (Booster arxiv 2011.02022; XGBoost-GPU arxiv 1806.11248):
+
+- single data shard: ONE ``pallas_call`` over a (phase, tile) grid.
+  Phase 0 streams bin-major tiles (frame/binning.py tile layout: int8,
+  feature-major lanes, NA folded in as bin B-1) through VMEM and
+  accumulates the [3L, F·B] histogram in a VMEM scratch on the MXU;
+  the phase boundary derives the level histogram (sibling subtraction
+  against the parent level), runs the shared split scan
+  (ops/split_scan.py — the SAME function the XLA path calls, so the
+  two paths are bit-exact by construction), and parks the decisions in
+  the kernel's output refs; phase 1 re-streams the tiles and routes
+  every row to its child, all without leaving the chip.
+- sharded mesh: the same phase bodies split into a per-shard histogram
+  kernel, the cross-shard ``psum`` (the MRTask reduce tree,
+  water/MRTask.java:891 — a hard barrier no fusion can remove), the
+  boundary math, and a per-shard partition kernel.
+
+Numerics contract: with ``interpret=True`` (CPU tier-1) every output is
+bit-exact vs the XLA path on the same mesh — f32 accumulation with the
+XLA path's exact row-block structure, identical split tie-breaking
+(shared code), integer routing. Native TPU runs may pick VMEM-sized
+tiles instead (ops/pallas.vmem_tile_rows) and trade the bitwise match
+for throughput; the XLA path remains the always-available fallback
+behind ``H2O3TPU_PALLAS`` (core/config.py).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+from jax.sharding import PartitionSpec as P
+
+from h2o3_tpu.ops import pallas as pallas_policy
+from h2o3_tpu.ops.split_scan import best_splits
+from h2o3_tpu.parallel.mesh import DATA_AXIS, shard_map
+
+
+# --------------------------------------------------------------- tile math
+
+
+def _tile_geometry(n_rows: int, block_rows: int):
+    """(C, nblk, n_pad): the XLA path's exact row-block structure
+    (ops/histogram.py _local_histogram) — sharing it is what makes the
+    f32 accumulation order, and therefore the histograms, bit-identical
+    in interpret mode."""
+    C = min(block_rows, n_rows)
+    nblk = (n_rows + C - 1) // C
+    return C, nblk, nblk * C
+
+
+def _pad_rows(arr, n_pad: int):
+    n = arr.shape[0]
+    if n == n_pad:
+        return arr
+    return jnp.pad(arr, ((0, n_pad - n),) + ((0, 0),) * (arr.ndim - 1))
+
+
+# ----------------------------------------------------- shared phase bodies
+
+
+def _hist_block(bins, nid, stats, *, n_nodes_h: int, n_bins: int, d: int):
+    """One tile's [3Lh, F·B] partial histogram — VMEM one-hots feeding
+    the MXU. Values (not just sums) match ops/histogram._block_hist: the
+    one-hot indicators are exact 0/1 and the stats ride untouched, so
+    the f32 contraction sees identical operands. At levels d >= 1 only
+    LEFT-child rows accumulate, into their PARENT's slot (the sibling-
+    subtraction trick of grow_tree, kept inside the kernel)."""
+    C, F = bins.shape
+    bins = bins.astype(jnp.int32)
+    if d > 0:
+        even = ((nid % 2) == 0).astype(jnp.float32)      # [C, 1]
+        stats = stats * even
+        nid = nid >> 1
+    feat_off = jax.lax.broadcasted_iota(jnp.int32, (C, F), 1) * n_bins
+    fb = bins + feat_off                                 # [C, F] in [0, FB)
+    lane = jax.lax.broadcasted_iota(jnp.int32, (C, F * n_bins), 1)
+    right = (lane == fb[:, 0:1]).astype(jnp.float32)
+    for f in range(1, F):
+        right += (lane == fb[:, f:f + 1]).astype(jnp.float32)
+    lane3 = jax.lax.broadcasted_iota(jnp.int32, (C, n_nodes_h * 3), 1)
+    node_of_k = lane3 // 3
+    stat_of_k = lane3 - 3 * node_of_k
+    node_hit = (nid == node_of_k).astype(jnp.float32)    # [C, 3Lh]
+    # stat broadcast via SELECT (not masked add): a NaN stat lane must
+    # not bleed into its siblings' columns the way 0*NaN would
+    stat_b = jnp.where(stat_of_k == 0, stats[:, 0:1],
+                       jnp.where(stat_of_k == 1, stats[:, 1:2],
+                                 stats[:, 2:3]))
+    left = node_hit * stat_b                             # [C, 3Lh]
+    return jax.lax.dot_general(
+        left.T, right, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+
+
+def _level_boundary(lh, prev_hist, cm, nb, is_cat, constraints, lo, hi,
+                    knobs, dl, *, d: int, n_nodes: int, n_bins: int,
+                    n_features: int):
+    """Histogram → split decisions, between the two row passes.
+
+    Line-for-line the XLA level head of grow_tree: reshape the matmul
+    accumulator to [Lh, F, B, 3], sibling-subtract against the parent
+    level (with the f32 cancellation clamps), then the SHARED split scan
+    (ops/split_scan.best_splits) and the split/categorical flags."""
+    Lh = max(n_nodes // 2, 1)
+    lh4 = lh.reshape(Lh, 3, n_features, n_bins).transpose(0, 2, 3, 1)
+    if d == 0:
+        hist = lh4
+    else:
+        rh = prev_hist - lh4
+        rh = rh.at[..., 0].set(jnp.maximum(rh[..., 0], 0.0))
+        rh = rh.at[..., 2].set(jnp.maximum(rh[..., 2], 0.0))
+        hist = jnp.stack([lh4, rh], axis=1).reshape(n_nodes,
+                                                    *lh4.shape[1:])
+    bg, bf, bt, bnal, blv, brv, leftmask = best_splits(
+        hist, nb, cm != 0, min_rows=knobs[0, 0], reg_lambda=knobs[0, 1],
+        is_cat=is_cat, constraints=constraints, lo=lo, hi=hi)
+    split = bg > knobs[0, 2]
+    split = split & (jnp.int32(d) < dl[0, 0])
+    if is_cat is not None:
+        cs = is_cat[bf] & split
+    else:
+        cs = jnp.zeros_like(split)
+    return hist, bg, bf, bt, bnal, blv, brv, leftmask, split, cs
+
+
+def _partition_block(bins, nid, bf, bt, bnal, isp, cs, leftmask, *,
+                     n_bins: int):
+    """Route one tile's rows to their children — gather-free
+    ``_level_goleft`` semantics (one-hot selects + a 0/1 matmul for the
+    categorical left-set membership). Pure integer/boolean work ⇒
+    bit-exact against the XLA routing by construction."""
+    C, F = bins.shape
+    L = bf.shape[0]
+    bins = bins.astype(jnp.int32)
+    noh = nid == jax.lax.broadcasted_iota(jnp.int32, (C, L), 1)  # [C, L]
+    f_r = jnp.sum(jnp.where(noh, bf[None, :], 0), axis=1,
+                  keepdims=True)                                 # [C, 1]
+    t_r = jnp.sum(jnp.where(noh, bt[None, :], 0), axis=1)        # [C]
+    nal_r = jnp.sum(jnp.where(noh, bnal.astype(jnp.int32)[None, :], 0),
+                    axis=1) > 0
+    isp_r = jnp.sum(jnp.where(noh, isp.astype(jnp.int32)[None, :], 0),
+                    axis=1) > 0
+    cs_r = jnp.sum(jnp.where(noh, cs.astype(jnp.int32)[None, :], 0),
+                   axis=1) > 0
+    fio = jax.lax.broadcasted_iota(jnp.int32, (C, F), 1)
+    b_r = jnp.sum(jnp.where(f_r == fio, bins, 0), axis=1)        # [C]
+    isna = b_r == (n_bins - 1)
+    go_num = b_r <= t_r
+    # leftmask[nid, b_r] without a 2D gather: 0/1 matmul over nodes,
+    # then a lane select over bins (exact — operands are indicators)
+    row_mask = jax.lax.dot_general(
+        noh.astype(jnp.float32), leftmask.astype(jnp.float32),
+        (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)                      # [C, B-1]
+    bio = jax.lax.broadcasted_iota(jnp.int32, (C, n_bins - 1), 1)
+    inset = jnp.sum(jnp.where(bio == b_r[:, None], row_mask, 0.0),
+                    axis=1) > 0.5
+    go_split = jnp.where(cs_r, inset, go_num)
+    goleft = jnp.where(isp_r, jnp.where(isna, nal_r, go_split), True)
+    return 2 * nid + jnp.where(goleft, 0, 1)[:, None]
+
+
+# --------------------------------------------- single-shard fused kernel
+
+
+def _fused_kernel(bins_ref, nid_ref, stats_ref, prev_ref, cm_ref, nb_ref,
+                  iscat_ref, cons_ref, lo_ref, hi_ref, knobs_ref, dl_ref,
+                  hist_ref, bg_ref, bf_ref, bt_ref, bnal_ref, blv_ref,
+                  brv_ref, lmask_ref, isp_ref, newnid_ref,
+                  acc_ref, cs_ref, *, d: int, n_nodes: int, n_bins: int,
+                  n_features: int, nblk: int, has_cats: bool,
+                  has_cons: bool):
+    phase = pl.program_id(0)
+    blk = pl.program_id(1)
+
+    @pl.when((phase == 0) & (blk == 0))
+    def _():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    @pl.when(phase == 0)
+    def _():
+        acc_ref[:] += _hist_block(
+            bins_ref[:], nid_ref[:], stats_ref[:],
+            n_nodes_h=max(n_nodes // 2, 1), n_bins=n_bins, d=d)
+        newnid_ref[:] = nid_ref[:]       # placeholder until phase 1
+
+    @pl.when((phase == 1) & (blk == 0))
+    def _():
+        hist, bg, bf, bt, bnal, blv, brv, lmask, split, cs = \
+            _level_boundary(
+                acc_ref[:], prev_ref[:] if d > 0 else None, cm_ref[:],
+                nb_ref[0], iscat_ref[0] != 0 if has_cats else None,
+                cons_ref[0] if has_cons else None, lo_ref[0], hi_ref[0],
+                knobs_ref[:], dl_ref[:], d=d, n_nodes=n_nodes,
+                n_bins=n_bins, n_features=n_features)
+        hist_ref[:] = hist
+        bg_ref[0, :] = bg
+        bf_ref[0, :] = bf
+        bt_ref[0, :] = bt
+        bnal_ref[0, :] = bnal.astype(jnp.int32)
+        blv_ref[0, :] = blv
+        brv_ref[0, :] = brv
+        lmask_ref[:] = lmask.astype(jnp.int32)
+        isp_ref[0, :] = split.astype(jnp.int32)
+        cs_ref[0, :] = cs.astype(jnp.int32)
+
+    @pl.when(phase == 1)
+    def _():
+        newnid_ref[:] = _partition_block(
+            bins_ref[:], nid_ref[:], bf_ref[0, :], bt_ref[0, :],
+            bnal_ref[0, :] != 0, isp_ref[0, :] != 0, cs_ref[0, :] != 0,
+            lmask_ref[:] != 0, n_bins=n_bins)
+
+
+def _fused_call(bins, nid, stats, prev, cm2, nb2, iscat, cons, lo2, hi2,
+                knobs, dl, *, d, n_nodes, n_bins, block_rows, interpret):
+    """The tentpole: hist + split + partition in ONE pallas_call over the
+    bin-major tiles — phase 0 accumulates, the boundary decides, phase 1
+    re-streams the same tiles and routes."""
+    N, F = bins.shape
+    C, nblk, n_pad = _tile_geometry(N, block_rows)
+    bins_p = _pad_rows(bins, n_pad)
+    nid_p = _pad_rows(nid, n_pad).reshape(-1, 1)
+    stats_p = _pad_rows(stats, n_pad)
+    Lh = max(n_nodes // 2, 1)
+    L, B = n_nodes, n_bins
+    Lcm = cm2.shape[0]
+    Llo = lo2.shape[1]
+
+    pallas_policy.record_launch("tree_fused_level")
+    grid = (2, nblk)
+    full = lambda *shape: pl.BlockSpec(       # noqa: E731 - spec shorthand
+        shape, lambda p, b: (0,) * len(shape))
+    tile = lambda *shape: pl.BlockSpec(       # noqa: E731
+        shape, lambda p, b: (b,) + (0,) * (len(shape) - 1))
+    kern = functools.partial(
+        _fused_kernel, d=d, n_nodes=L, n_bins=B, n_features=F, nblk=nblk,
+        has_cats=iscat is not None, has_cons=cons is not None)
+    outs = pl.pallas_call(
+        kern,
+        grid=grid,
+        in_specs=[
+            tile(C, F), tile(C, 1), tile(C, 3),
+            full(Lh, F, B, 3), full(Lcm, F), full(1, F),
+            full(1, F), full(1, F), full(1, Llo), full(1, Llo),
+            full(1, 3), full(1, 1),
+        ],
+        out_specs=[
+            full(L, F, B, 3),
+            full(1, L), full(1, L), full(1, L), full(1, L),
+            full(1, L), full(1, L), full(L, B - 1), full(1, L),
+            tile(C, 1),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((L, F, B, 3), jnp.float32),
+            jax.ShapeDtypeStruct((1, L), jnp.float32),
+            jax.ShapeDtypeStruct((1, L), jnp.int32),
+            jax.ShapeDtypeStruct((1, L), jnp.int32),
+            jax.ShapeDtypeStruct((1, L), jnp.int32),
+            jax.ShapeDtypeStruct((1, L), jnp.float32),
+            jax.ShapeDtypeStruct((1, L), jnp.float32),
+            jax.ShapeDtypeStruct((L, B - 1), jnp.int32),
+            jax.ShapeDtypeStruct((1, L), jnp.int32),
+            jax.ShapeDtypeStruct((n_pad, 1), jnp.int32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((3 * Lh, F * B), jnp.float32),
+            pltpu.VMEM((1, L), jnp.int32),
+        ],
+        interpret=interpret,
+    )(bins_p, nid_p, stats_p,
+      prev if prev is not None else jnp.zeros((Lh, F, B, 3), jnp.float32),
+      cm2, nb2, iscat if iscat is not None else jnp.zeros((1, F), jnp.int8),
+      cons if cons is not None else jnp.zeros((1, F), jnp.int8),
+      lo2, hi2, knobs, dl)
+    (hist, bg, bf, bt, bnal, blv, brv, lmask, isp, newnid) = outs
+    return (hist, bg[0], bf[0], bt[0], bnal[0] != 0, blv[0], brv[0],
+            lmask != 0, isp[0] != 0, newnid[:N, 0])
+
+
+# --------------------------------------------- sharded two-kernel variant
+
+
+def _hist_kernel(bins_ref, nid_ref, stats_ref, out_ref, acc_ref, *,
+                 d: int, n_nodes_h: int, n_bins: int):
+    i = pl.program_id(0)
+
+    @pl.when(i == 0)
+    def _():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    acc_ref[:] += _hist_block(bins_ref[:], nid_ref[:], stats_ref[:],
+                              n_nodes_h=n_nodes_h, n_bins=n_bins, d=d)
+
+    @pl.when(i == pl.num_programs(0) - 1)
+    def _():
+        out_ref[:] = acc_ref[:]
+
+
+def _hist_call(bins, nid, stats, *, d, n_nodes, n_bins, block_rows,
+               interpret):
+    """Per-shard histogram kernel → [3Lh, F·B] (caller psums)."""
+    N, F = bins.shape
+    C, nblk, n_pad = _tile_geometry(N, block_rows)
+    Lh = max(n_nodes // 2, 1)
+    pallas_policy.record_launch("tree_hist")
+    return pl.pallas_call(
+        functools.partial(_hist_kernel, d=d, n_nodes_h=Lh, n_bins=n_bins),
+        grid=(nblk,),
+        in_specs=[
+            pl.BlockSpec((C, F), lambda i: (i, 0)),
+            pl.BlockSpec((C, 1), lambda i: (i, 0)),
+            pl.BlockSpec((C, 3), lambda i: (i, 0)),
+        ],
+        out_specs=pl.BlockSpec((3 * Lh, F * n_bins), lambda i: (0, 0)),
+        out_shape=jax.ShapeDtypeStruct((3 * Lh, F * n_bins), jnp.float32),
+        scratch_shapes=[pltpu.VMEM((3 * Lh, F * n_bins), jnp.float32)],
+        interpret=interpret,
+    )(_pad_rows(bins, n_pad), _pad_rows(nid, n_pad).reshape(-1, 1),
+      _pad_rows(stats, n_pad))
+
+
+def _partition_kernel(bins_ref, nid_ref, bf_ref, bt_ref, bnal_ref,
+                      isp_ref, cs_ref, lmask_ref, newnid_ref, *,
+                      n_bins: int):
+    newnid_ref[:] = _partition_block(
+        bins_ref[:], nid_ref[:], bf_ref[0], bt_ref[0], bnal_ref[0] != 0,
+        isp_ref[0] != 0, cs_ref[0] != 0, lmask_ref[:] != 0, n_bins=n_bins)
+
+
+def _partition_call(bins, nid, bf, bt, bnal, isp, cs, lmask, *, n_bins,
+                    block_rows, interpret):
+    """Per-shard split+partition kernel → routed node ids [N]."""
+    N, F = bins.shape
+    C, nblk, n_pad = _tile_geometry(N, block_rows)
+    L = bf.shape[0]
+    pallas_policy.record_launch("tree_partition")
+    full = lambda *shape: pl.BlockSpec(       # noqa: E731
+        shape, lambda i: (0,) * len(shape))
+    newnid = pl.pallas_call(
+        functools.partial(_partition_kernel, n_bins=n_bins),
+        grid=(nblk,),
+        in_specs=[
+            pl.BlockSpec((C, F), lambda i: (i, 0)),
+            pl.BlockSpec((C, 1), lambda i: (i, 0)),
+            full(1, L), full(1, L), full(1, L), full(1, L), full(1, L),
+            full(L, n_bins - 1),
+        ],
+        out_specs=pl.BlockSpec((C, 1), lambda i: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct((n_pad, 1), jnp.int32),
+        interpret=interpret,
+    )(_pad_rows(bins, n_pad), _pad_rows(nid, n_pad).reshape(-1, 1),
+      bf[None, :], bt[None, :], bnal.astype(jnp.int32)[None, :],
+      isp.astype(jnp.int32)[None, :], cs.astype(jnp.int32)[None, :],
+      lmask.astype(jnp.int32))
+    return newnid[:N, 0]
+
+
+# ----------------------------------------------------------- entry points
+
+
+def fused_level(bins, nid, stats, prev_hist, col_mask, nb, is_cat,
+                constraints, lo, hi, scalars, *, d: int, n_nodes: int,
+                n_bins: int, block_rows: int, mesh, interpret: bool):
+    """One tree level, fused: returns (hist [L,F,B,3], gain, feat,
+    thresh, na_left, left_val, right_val, leftmask, split, new_nid).
+
+    Drop-in for grow_tree's per-level XLA sequence (histogram →
+    _best_splits → _level_goleft), with identical semantics: ``stats``
+    is the level-invariant [N, 3] {w, w·g, w·h} block, ``prev_hist`` the
+    previous level's histogram (None at the root — sibling subtraction
+    starts at level 1), and the returned ``split`` already folds in the
+    min-split-improvement and traced depth-limit masks. Rows must be
+    pre-padded to the mesh (N %% data-shards == 0), as grow_tree's are.
+
+    Native mode caps the tile rows at the VMEM-sized suggestion;
+    interpret mode keeps the XLA path's exact block structure so tier-1
+    can assert bitwise parity.
+    """
+    knobs = jnp.stack([scalars.min_rows, scalars.reg_lambda,
+                       scalars.msi]).astype(jnp.float32).reshape(1, 3)
+    dl = (scalars.depth_limit if scalars.depth_limit is not None
+          else jnp.int32(1 << 30))
+    dl = jnp.asarray(dl, jnp.int32).reshape(1, 1)
+    cm2 = (col_mask if col_mask.ndim == 2
+           else col_mask[None, :]).astype(jnp.int8)
+    nb2 = jnp.asarray(nb, jnp.int32)[None, :]
+    iscat = None if is_cat is None else is_cat.astype(jnp.int8)[None, :]
+    cons = (None if constraints is None
+            else jnp.asarray(constraints, jnp.int8)[None, :])
+    lo2 = jnp.asarray(lo, jnp.float32)[None, :]
+    hi2 = jnp.asarray(hi, jnp.float32)[None, :]
+    if not interpret:
+        block_rows = min(block_rows, pallas_policy.vmem_tile_rows(
+            bins.shape[1], n_bins, n_nodes))
+    F = bins.shape[1]
+
+    ndata = mesh.shape[DATA_AXIS]
+    if ndata == 1:
+        return _fused_call(bins, nid, stats, prev_hist, cm2, nb2, iscat,
+                           cons, lo2, hi2, knobs, dl, d=d,
+                           n_nodes=n_nodes, n_bins=n_bins,
+                           block_rows=block_rows, interpret=interpret)
+
+    # sharded: per-shard hist kernel, psum barrier, shared boundary
+    # math, per-shard partition kernel — same bodies, same numbers
+    has_cats = iscat is not None
+    has_cons = cons is not None
+    Lh = max(n_nodes // 2, 1)
+    prev = (prev_hist if prev_hist is not None
+            else jnp.zeros((Lh, F, n_bins, 3), jnp.float32))
+    iscat_in = iscat if has_cats else jnp.zeros((1, F), jnp.int8)
+    cons_in = cons if has_cons else jnp.zeros((1, F), jnp.int8)
+
+    @functools.partial(
+        shard_map, mesh=mesh,
+        in_specs=(P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS)) + (P(),) * 9,
+        out_specs=(P(),) * 9 + (P(DATA_AXIS),), check_vma=False)
+    def _task(bins_l, nid_l, stats_l, prev, cm2, nb2, iscat_a, cons_a,
+              lo2, hi2, knobs, dl):
+        lh = _hist_call(bins_l, nid_l, stats_l, d=d, n_nodes=n_nodes,
+                        n_bins=n_bins, block_rows=block_rows,
+                        interpret=interpret)
+        lh = jax.lax.psum(lh, DATA_AXIS)
+        hist, bg, bf, bt, bnal, blv, brv, lmask, split, cs = \
+            _level_boundary(
+                lh, prev if d > 0 else None, cm2, nb2[0],
+                iscat_a[0] != 0 if has_cats else None,
+                cons_a[0] if has_cons else None, lo2[0], hi2[0], knobs,
+                dl, d=d, n_nodes=n_nodes, n_bins=n_bins, n_features=F)
+        newnid_l = _partition_call(bins_l, nid_l, bf, bt, bnal, split,
+                                   cs, lmask, n_bins=n_bins,
+                                   block_rows=block_rows,
+                                   interpret=interpret)
+        return (hist, bg, bf, bt, bnal, blv, brv, lmask, split, newnid_l)
+
+    return _task(bins, nid, stats, prev, cm2, nb2, iscat_in, cons_in,
+                 lo2, hi2, knobs, dl)
+
+
+def xla_level(bins, nid, w, g, h, prev_hist, col_mask, nb, is_cat,
+              constraints, lo, hi, scalars, *, d: int, n_nodes: int,
+              n_bins: int, block_rows: int, mesh):
+    """Reference composition — grow_tree's per-level XLA sequence as one
+    callable, for the interpret-parity tests and the bench `treekernel`
+    leg. Same return tuple as fused_level."""
+    from h2o3_tpu.models.tree import _level_goleft, _pack_leftmask
+    from h2o3_tpu.ops.histogram import histogram
+    L, B = n_nodes, n_bins
+    if d == 0 or prev_hist is None:
+        hist = histogram(bins, nid, w, g, h, n_nodes=L, n_bins=B,
+                         mesh=mesh, block_rows=block_rows)
+    else:
+        even = (nid % 2 == 0).astype(jnp.float32)
+        lh = histogram(bins, nid >> 1, w * even, g, h, n_nodes=L // 2,
+                       n_bins=B, mesh=mesh, block_rows=block_rows)
+        rh = prev_hist - lh
+        rh = rh.at[..., 0].set(jnp.maximum(rh[..., 0], 0.0))
+        rh = rh.at[..., 2].set(jnp.maximum(rh[..., 2], 0.0))
+        hist = jnp.stack([lh, rh], axis=1).reshape(L, *lh.shape[1:])
+    bg, bf, bt, bnal, blv, brv, leftmask = best_splits(
+        hist, nb, col_mask, min_rows=scalars.min_rows,
+        reg_lambda=scalars.reg_lambda, is_cat=is_cat,
+        constraints=constraints, lo=lo, hi=hi)
+    split = bg > scalars.msi
+    if scalars.depth_limit is not None:
+        split = split & (jnp.int32(d) < scalars.depth_limit)
+    feat_d = jnp.where(split, bf, 0)
+    thresh_d = jnp.where(split, bt, B)
+    nal_d = jnp.where(split, bnal, False)
+    if is_cat is not None:
+        cs = is_cat[bf] & split
+        W = max(1, (B - 1 + 31) // 32)
+        words = jnp.where(cs[:, None], _pack_leftmask(leftmask, W), 0)
+    else:
+        cs = jnp.zeros_like(split)
+        words = jnp.zeros((L, 1), jnp.uint32)
+    newnid = _level_goleft(feat_d, thresh_d, nal_d, split, cs, words,
+                           nid, bins, B)
+    return (hist, bg, bf, bt, bnal, blv, brv, leftmask, split, newnid)

@@ -79,6 +79,8 @@ def pallas_local_histogram(bins, nid, stats, n_nodes: int, n_bins: int,
     Drop-in replacement for ops/histogram._local_histogram on TPU
     backends (CPU tests run it with interpret=True).
     """
+    from h2o3_tpu.ops import pallas as pallas_policy
+    pallas_policy.record_launch("histogram")
     N, F = bins.shape
     C = min(block_rows, N)
     nblk = (N + C - 1) // C
