@@ -418,6 +418,32 @@ def bench_sort():
           merge_seconds=round(dt, 2), out_rows=m.nrows)
 
 
+def bench_cloud():
+    """Cloud control plane (ISSUE 7): shutdown → init reformation cost
+    plus heartbeat agreement round-trip over the live mesh — the two
+    latencies a multi-host pod pays at bootstrap and once per interval
+    for the life of the cloud."""
+    import h2o3_tpu
+    from h2o3_tpu.core import heartbeat
+    t0 = time.time()
+    h2o3_tpu.shutdown()
+    h2o3_tpu.init()
+    boot_s = time.time() - t0
+    heartbeat.monitor.start(interval_s=3600, thread=False)  # manual rounds
+    assert heartbeat.monitor.round()          # warmup/compile
+    reps = 50
+    t0 = time.time()
+    for _ in range(reps):
+        assert heartbeat.monitor.round()
+    rtt = (time.time() - t0) / reps
+    heartbeat.monitor.stop()
+    _emit("cloud bootstrap + heartbeat agreement round-trip",
+          1.0 / rtt, "rounds/sec", 1.0,
+          "H2O HeartBeatThread 1 round/sec/node",
+          bootstrap_s=round(boot_s, 3),
+          heartbeat_rtt_ms=round(rtt * 1e3, 3))
+
+
 def bench_automl():
     from h2o3_tpu.automl import H2OAutoML
     from h2o3_tpu.io.stream import stream_import_csv
@@ -600,18 +626,19 @@ def bench_treekernel():
 CONFIGS = [("gbm", bench_gbm), ("glm", bench_glm), ("dl", bench_dl),
            ("xgb", bench_xgb), ("sort", bench_sort),
            ("grid", bench_grid), ("treekernel", bench_treekernel),
+           ("cloud", bench_cloud),
            ("automl", bench_automl), ("gbm-full", bench_gbm_full)]
 
 # minimum seconds a config plausibly needs; skipped (with a JSON note)
 # rather than started when the remaining budget is below it
 _MIN_NEED = {"gbm": 60, "glm": 90, "dl": 60, "xgb": 60, "sort": 60,
-             "grid": 120, "treekernel": 60, "automl": 180,
+             "grid": 120, "treekernel": 60, "cloud": 30, "automl": 180,
              "gbm-full": 600}
 
 # hard per-config wallclock cap (child process killed past it): a
 # wedged worker costs one line, never the scoreboard
 _HARD_CAP = {"gbm": 900, "glm": 600, "dl": 600, "xgb": 600, "sort": 400,
-             "grid": 600, "treekernel": 400, "automl": 900,
+             "grid": 600, "treekernel": 400, "cloud": 300, "automl": 900,
              "gbm-full": 1200}
 
 
@@ -646,6 +673,33 @@ def _stub_grid():
           batched=model_batch.enabled())
 
 
+def _stub_cloud():
+    """`cloud` line without a backend: drives the heartbeat monitor's
+    miss/degrade/recover state machine via fault injection — the
+    bootstrap + peer-health plumbing, no jax dispatches (rounds fail at
+    the injection hook before touching a device)."""
+    from h2o3_tpu.core import heartbeat, watchdog
+    mon = heartbeat.HeartbeatMonitor()
+    mon.interval_s, mon.miss_budget, mon.timeout_s = 0.01, 2, 5.0
+    mon.peers = {0: {"last_seen": time.time(), "healthy": True}}
+    watchdog.inject_fault("heartbeat", times=2)
+    try:
+        t0 = time.time()
+        assert mon.round() is False and mon.healthy()
+        assert mon.round() is False and not mon.healthy()
+        detect_s = time.time() - t0
+        # the flag now kills the next chunk, classified infra
+        assert watchdog.is_infra_error(
+            heartbeat.CloudUnhealthyError(mon.reason() or "down"))
+    finally:
+        watchdog.clear_faults()
+    rounds = mon.rounds
+    _emit("cloud heartbeat (stub; miss->degrade state machine, "
+          "no backend)", rounds / max(detect_s, 1e-6), "rounds/sec",
+          1.0, "stub", miss_budget=mon.miss_budget,
+          detect_ms=round(detect_s * 1e3, 3))
+
+
 def _stub_treekernel():
     """`treekernel` line without a backend: drives the Pallas PLANNER —
     the pure knob/backend decision table and the VMEM tile sizing
@@ -672,6 +726,7 @@ if STUB:
                ("stub_wedge", _stub_wedge),
                ("grid", _stub_grid),
                ("treekernel", _stub_treekernel),
+               ("cloud", _stub_cloud),
                ("stub_b", _stub_ok("stub_b"))]
     _MIN_NEED = {n: 1 for n, _ in CONFIGS}
     _HARD_CAP = {n: 30 for n, _ in CONFIGS}

@@ -188,15 +188,30 @@ def _frame_json(fr: Frame, rows: int = 10, row_offset: int = 0) -> dict:
 
 @route("GET", "/3/Cloud")
 def _cloud(params, body):
-    """Cluster status (water/api/CloudHandler, schemas3/CloudV3.java)."""
+    """Cluster status (water/api/CloudHandler, schemas3/CloudV3.java).
+
+    ``healthy``/``last_ping`` per node come from the heartbeat monitor
+    (core/heartbeat.py) when it runs — the HeartBeatThread → CloudV3
+    wiring of the reference — and degrade to the formation-time verdict
+    when it does not (single-process cloud, monitor off)."""
     import os
     info = cloud_mod.cluster_info()
+    hb = info.get("heartbeat", {})
+    peers = hb.get("peers", {})
     now = int(__import__("time").time() * 1000)
+    mesh_devs = list(cloud_mod.mesh_mod.get_mesh().devices.flat)
     nodes = []
     for i, d in enumerate(info["devices"]):
+        # device i belongs to a process; without the monitor every
+        # device reports the cloud-level verdict
+        pst = peers.get(str(getattr(mesh_devs[i], "process_index", 0)))
+        healthy = bool(pst["healthy"]) if pst else info["cloud_healthy"]
+        last_ping = (int(pst["last_seen"] * 1000) if pst else now)
         nodes.append({
-            "h2o": d, "ip_port": f"127.0.0.1:{54321 + i}", "healthy": True,
-            "last_ping": now, "pid": os.getpid(), "num_cpus": os.cpu_count(),
+            "h2o": d, "ip_port": f"127.0.0.1:{54321 + i}",
+            "healthy": healthy,
+            "last_ping": last_ping, "pid": os.getpid(),
+            "num_cpus": os.cpu_count(),
             "cpus_allowed": os.cpu_count(), "nthreads": os.cpu_count(),
             "sys_load": 0.0, "my_cpu_pct": 0, "sys_cpu_pct": 0,
             "mem_value_size": 0, "pojo_mem": 0, "free_mem": 0,
@@ -217,8 +232,11 @@ def _cloud(params, body):
             "cloud_uptime_millis": info["cloud_uptime_ms"],
             "cloud_internal_timezone": "UTC",
             "datafile_parser_timezone": "UTC",
-            "cloud_healthy": info["cloud_healthy"], "bad_nodes": 0,
-            "consensus": True, "locked": True, "is_client": False,
+            "cloud_healthy": info["cloud_healthy"],
+            "bad_nodes": sum(1 for n in nodes if not n["healthy"]),
+            "consensus": info["cloud_healthy"],
+            "locked": True, "is_client": False,
+            "heartbeat": hb,
             "nodes": nodes, "internal_security_enabled": False,
             "web_ip": "127.0.0.1"}
 

@@ -8,6 +8,15 @@ across hosts (its coordinator barrier replaces the heartbeat quorum); the
 "cloud" object is a ``jax.sharding.Mesh``. Cloud shape locks at first use
 just like Paxos._cloudLocked (water/Paxos.java:32) because the mesh is
 baked into compiled programs.
+
+Hardening (ISSUE 7): ``jax.distributed.initialize`` runs under the
+shared watchdog RetryPolicy with a bounded coordinator-connect timeout
+(``H2O3TPU_CLOUD_TIMEOUT_S``); a post-init roll call over the
+coordination-service KV store names the process ids that went missing
+when formation is partial; ``core/heartbeat.py`` watches peer health for
+the life of the cloud; ``shutdown()`` tears all of it down — heartbeat,
+cleaner, mesh, distributed client — so a later ``init()`` reforms
+cleanly instead of attaching to stale state.
 """
 
 from __future__ import annotations
@@ -19,6 +28,8 @@ from typing import Optional
 import jax
 
 from h2o3_tpu.core import config as _config
+from h2o3_tpu.core import heartbeat as heartbeat_mod
+from h2o3_tpu.core import watchdog
 from h2o3_tpu.core.kv import DKV
 from h2o3_tpu.parallel import mesh as mesh_mod
 from h2o3_tpu.utils.log import get_logger
@@ -27,6 +38,71 @@ from h2o3_tpu.version import __version__
 log = get_logger("h2o3_tpu.cloud")
 
 _STARTED = False
+_CLOUD_START_MS = 0        # wall-clock ms at init() (cloud_uptime_ms base)
+_DISTRIBUTED = False       # this process ran jax.distributed.initialize
+
+BOOT_KV_PREFIX = "h2o3tpu/boot/"
+
+
+def _cloud_timeout_s(cfg) -> float:
+    return float(os.environ.get("H2O3TPU_CLOUD_TIMEOUT_S",
+                                cfg.cloud_timeout_s))
+
+
+def _distributed_init(coordinator_address: str, num_processes: int,
+                      process_id: int, timeout_s: float) -> None:
+    """One jax.distributed.initialize attempt, retryable: a failed
+    attempt tears the half-open client down so the next one starts
+    clean (initialize raises on double-init)."""
+    global _DISTRIBUTED
+    watchdog.maybe_fail("cloud_init")
+    try:
+        jax.distributed.initialize(
+            coordinator_address=coordinator_address,
+            num_processes=num_processes,
+            process_id=process_id,
+            initialization_timeout=max(int(timeout_s), 1))
+        _DISTRIBUTED = True
+    except Exception as e:
+        log.warning("cloud formation attempt failed (coordinator=%s "
+                    "process %s/%s): %s", coordinator_address, process_id,
+                    num_processes, e)
+        try:
+            jax.distributed.shutdown()
+        except Exception:   # noqa: BLE001 - nothing half-open to close
+            pass
+        raise
+
+
+def _roll_call(num_processes: int, process_id: int,
+               timeout_s: float) -> None:
+    """Post-init agreement: every process publishes its id and waits at
+    a barrier. When a peer dies between connect and first use, THIS is
+    where the hole gets a name — the diagnostic lists exactly which
+    process ids never reported, instead of the first collective
+    hanging."""
+    from jax._src import distributed
+    client = distributed.global_state.client
+    if client is None:      # single-process init path
+        return
+    client.key_value_set(f"{BOOT_KV_PREFIX}{process_id}",
+                         f"{os.uname().nodename}:{os.getpid()}",
+                         allow_overwrite=True)
+    try:
+        client.wait_at_barrier("h2o3tpu_boot_rollcall",
+                               max(int(timeout_s * 1000), 1000))
+    except Exception as e:
+        seen = set()
+        try:
+            for key, _val in client.key_value_dir_get(BOOT_KV_PREFIX):
+                seen.add(int(key.rsplit("/", 1)[-1]))
+        except Exception:   # noqa: BLE001 - diagnostics are best-effort
+            pass
+        missing = sorted(set(range(num_processes)) - seen)
+        raise RuntimeError(
+            f"UNAVAILABLE: partial cloud formation — expected "
+            f"{num_processes} processes, missing ids {missing or '?'} "
+            f"after {timeout_s:.0f}s roll call ({e})") from e
 
 
 def init(backend: Optional[str] = None,
@@ -42,9 +118,10 @@ def init(backend: Optional[str] = None,
     ``coordinator_address``/``num_processes``/``process_id`` and every host
     calls this with the same arguments — ``jax.distributed.initialize`` is
     the clouding protocol (replaces multicast/flatfile discovery,
-    water/init/NetworkInit.java:62-174).
+    water/init/NetworkInit.java:62-174), retried under the shared
+    watchdog policy and bounded by ``H2O3TPU_CLOUD_TIMEOUT_S``.
     """
-    global _STARTED
+    global _STARTED, _CLOUD_START_MS
     if (_STARTED and backend is None and coordinator_address is None
             and data_axis is None and model_axis is None
             and num_processes is None and process_id is None
@@ -79,14 +156,34 @@ def init(backend: Optional[str] = None,
         log.warning("persistent XLA cache unavailable: %s", e)
 
     if coordinator_address is not None and not _STARTED:
-        jax.distributed.initialize(coordinator_address=coordinator_address,
-                                   num_processes=num_processes,
-                                   process_id=process_id)
+        timeout_s = _cloud_timeout_s(cfg)
+        # the CPU backend only speaks cross-process collectives through
+        # gloo; the flag must be set BEFORE the first backend client is
+        # created or the psum tree dies with "Multiprocess computations
+        # aren't implemented on the CPU backend" — the standing
+        # multiprocess-CPU failure this PR retires
+        try:
+            jax.config.update("jax_cpu_collectives_implementation", "gloo")
+        except Exception as e:       # noqa: BLE001 — TPU-only jaxes
+            log.warning("cpu collectives unavailable (multi-process CPU "
+                        "meshes will not form): %s", e)
+        watchdog.retry_call(
+            lambda: _distributed_init(coordinator_address,
+                                      int(num_processes),
+                                      int(process_id), timeout_s),
+            site="cloud_init")
+        _roll_call(int(num_processes), int(process_id), timeout_s)
 
     devices = jax.devices(cfg.backend) if cfg.backend else jax.devices()
     m = mesh_mod.make_mesh(devices, cfg.data_axis, cfg.model_axis)
     mesh_mod.set_global_mesh(m)
     _STARTED = True
+    _CLOUD_START_MS = int(time.time() * 1000)
+    # peer health: always for multi-process clouds (a dead peer hangs
+    # every collective — someone must notice), opt-in for single-process
+    hb = (cfg.heartbeat or "auto").lower()
+    if hb == "on" or (hb == "auto" and jax.process_count() > 1):
+        heartbeat_mod.monitor.start()
     info = cluster_info()
     log.info("cloud up: %s", info)
     # Cleaner thread (water/Cleaner.java): opt-in — spilling mid-test
@@ -104,23 +201,48 @@ def cluster_info() -> dict:
     """GET /3/Cloud shape (water/api/CloudHandler.java)."""
     m = mesh_mod.get_mesh()
     devs = list(m.devices.flat)
+    hb = heartbeat_mod.monitor.status()
+    now_ms = int(time.time() * 1000)
     return {
         "version": __version__,
         "cloud_name": _config.ARGS.name,
         "cloud_size": len(devs),
-        "cloud_healthy": True,
+        # hardcoded True until ISSUE 7: now the heartbeat monitor's
+        # verdict (trivially healthy when the monitor is off)
+        "cloud_healthy": hb["healthy"],
         "mesh_shape": dict(m.shape),
         "process_count": jax.process_count(),
         "process_index": jax.process_index(),
         "devices": [str(d) for d in devs],
         "platform": devs[0].platform if devs else "none",
         "build_age_sec": 0,
-        "cloud_uptime_ms": int(time.time() * 1000),
+        "cloud_uptime_ms": (now_ms - _CLOUD_START_MS
+                            if _STARTED and _CLOUD_START_MS else 0),
+        "heartbeat": hb,
     }
 
 
 def shutdown() -> None:
-    """Drop all state (reference: POST /3/Shutdown)."""
-    global _STARTED
+    """Drop all state (reference: POST /3/Shutdown).
+
+    Tears down everything ``init()`` built — heartbeat and cleaner
+    threads, the DKV, the global mesh, and the jax.distributed client —
+    so a subsequent ``init()`` reforms the cloud instead of attaching to
+    a stale mesh or a dead coordinator."""
+    global _STARTED, _CLOUD_START_MS, _DISTRIBUTED
+    heartbeat_mod.monitor.stop()
+    try:
+        from h2o3_tpu.core.cleaner import cleaner
+        cleaner.stop()
+    except Exception:       # noqa: BLE001 - cleaner is optional
+        pass
     DKV.clear()
+    mesh_mod.set_global_mesh(None)
+    if _DISTRIBUTED:
+        try:
+            jax.distributed.shutdown()
+        except Exception as e:   # noqa: BLE001 - already down is fine
+            log.warning("jax.distributed shutdown: %s", e)
+        _DISTRIBUTED = False
     _STARTED = False
+    _CLOUD_START_MS = 0

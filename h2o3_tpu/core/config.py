@@ -39,6 +39,24 @@ class Config:
     infra_backoff_max_s: float = 30.0   # backoff ceiling
     # backend liveness probe deadline; 0 = unbounded (probe_backend)
     probe_timeout_s: float = 60.0
+    # -- cloud formation + peer health (core/cloud.py, core/heartbeat.py)
+    # coordinator-connect bound for jax.distributed.initialize AND the
+    # post-init roll-call barrier; the analogue of the reference's
+    # stall_till_cloudsize timeout (water/H2O.java waitForCloudSize)
+    cloud_timeout_s: float = 120.0
+    # seconds between heartbeat rounds (HeartBeatThread pings every
+    # second in the reference, water/HeartBeatThread.java:16)
+    heartbeat_interval_s: float = 1.0
+    # consecutive missed rounds before the cloud is declared unhealthy
+    # (Paxos ejects after HeartBeatThread.TIMEOUT misses)
+    heartbeat_miss_budget: int = 3
+    # per-round deadline for the agreement check; 0 = use the interval
+    heartbeat_timeout_s: float = 5.0
+    # peer-health monitor: "auto" (default) runs it for multi-process
+    # clouds where a dead peer would hang every collective; "on" forces
+    # it for single-process clouds too (rounds become tiny bounded
+    # psums); "off" disables it entirely
+    heartbeat: str = "auto"
     # -- request hardening (api/server.py admission gate + bounds) -----
     # max requests executing handlers concurrently; the analogue of the
     # reference's bounded Jetty thread pool (water/api/RequestServer)
@@ -76,9 +94,12 @@ class Config:
     _INT_FIELDS = frozenset({"port", "nthreads", "data_axis", "model_axis",
                              "block_rows", "nbins", "infra_max_attempts",
                              "rest_max_inflight", "rest_queue_depth",
-                             "rest_max_body_mb", "flight_recorder_keep"})
+                             "rest_max_body_mb", "flight_recorder_keep",
+                             "heartbeat_miss_budget"})
     _FLOAT_FIELDS = frozenset({"infra_backoff_base_s", "infra_backoff_max_s",
-                               "probe_timeout_s", "rest_queue_wait_s"})
+                               "probe_timeout_s", "rest_queue_wait_s",
+                               "cloud_timeout_s", "heartbeat_interval_s",
+                               "heartbeat_timeout_s"})
 
     @staticmethod
     def from_env(**overrides) -> "Config":

@@ -15,6 +15,7 @@ import time
 import traceback
 from typing import Any, Callable, Optional
 
+from h2o3_tpu.core import heartbeat as heartbeat_mod
 from h2o3_tpu.core import request_ctx, watchdog
 from h2o3_tpu.core.kv import DKV, make_key
 from h2o3_tpu.core.scope import Scope
@@ -102,6 +103,7 @@ class Job:
             # jobs keep theirs (pollers read FAILED results' state)
             sc = Scope()
             sc.__enter__()
+            cloud_lost = False
             try:
                 # the telemetry capsule key is DKV.put INSIDE this
                 # Scope: a cancelled job's capsule is swept with its
@@ -124,6 +126,13 @@ class Job:
                         if (attempt >= policy.max_attempts
                                 or not is_infra_error(e)
                                 or self._cancel_requested.is_set()):
+                            raise
+                        if (isinstance(e, heartbeat_mod.CloudUnhealthyError)
+                                and not heartbeat_mod.monitor.healthy()):
+                            # fail-fast contract: retrying against a
+                            # cloud that is STILL unhealthy just burns
+                            # the backoff budget — recovery_dir
+                            # snapshot/resume is the comeback path
                             raise
                         delay = policy.delay(attempt)
                         log.warning("job %s: retrying after infra error "
@@ -161,6 +170,11 @@ class Job:
                 self.exception = "".join(
                     traceback.format_exception(type(e), e, e.__traceback__))
                 self.status = FAILED
+                # a cloud-unhealthy failure sweeps its partial keys like
+                # a cancellation: the half-built model came off a
+                # degraded mesh and must not linger in the DKV (resume
+                # comes from recovery_dir snapshots, not these keys)
+                cloud_lost = isinstance(e, heartbeat_mod.CloudUnhealthyError)
                 _tl("job", f"failed {self.description}", key=self.key,
                     error=str(e)[:200])
                 log.error("job %s failed: %s", self.key, e)
@@ -168,7 +182,7 @@ class Job:
                     raise
             finally:
                 self.end_time = time.time()
-                if self.status != CANCELLED:
+                if self.status != CANCELLED and not cloud_lost:
                     sc.keep(*sc._tracked)
                 sc.__exit__(None, None, None)
 
@@ -219,6 +233,7 @@ class Job:
             raise request_ctx.DeadlineExceeded(
                 f"job {self.key}: request deadline exceeded "
                 f"(observed at progress update)")
+        heartbeat_mod.check_healthy("job.update")
 
     @property
     def progress(self) -> float:

@@ -23,7 +23,7 @@ import contextvars
 import time
 from typing import Iterator, Optional
 
-from h2o3_tpu.core import watchdog
+from h2o3_tpu.core import heartbeat, watchdog
 
 
 class DeadlineExceeded(Exception):
@@ -100,12 +100,16 @@ def check_deadline(site: str = "") -> None:
 def cancel_point(site: str = "") -> None:
     """Cooperative cancellation checkpoint — call at chunk boundaries.
 
-    Observes (1) a cancel() on the current job and (2) the request
-    deadline, raising JobCancelledException / DeadlineExceeded so the
-    job layer marks the work CANCELLED and frees the worker within one
-    chunk (water/Job.java stop_requested() polling contract)."""
+    Observes (1) a cancel() on the current job, (2) the request
+    deadline, and (3) cloud health (core/heartbeat.py), raising
+    JobCancelledException / DeadlineExceeded / CloudUnhealthyError so
+    the job layer frees the worker within one chunk
+    (water/Job.java stop_requested() polling contract) — for an
+    unhealthy cloud that means failing fast HERE instead of blocking on
+    a collective a dead peer will never join."""
     job = _JOB.get()
     if job is not None and job.cancel_requested():
         from h2o3_tpu.core.job import JobCancelledException
         raise JobCancelledException(getattr(job, "key", "job"))
     check_deadline(site)
+    heartbeat.check_healthy(site)

@@ -22,10 +22,15 @@ This module centralizes what used to be scattered one-shot retries
   classified infra errors.
 - ``is_infra_error()``   — the single classifier for retryable
   infra-class failures (moved here from core/job.py, which re-exports).
+- ``bounded_call()``     — run a callable on a daemon thread with a hard
+  deadline (the thread-timeout prober; a hung device transfer or
+  collective must never hang the caller). Used by ``probe_backend`` and
+  the cloud heartbeat (core/heartbeat.py).
 - fault injection        — ``inject_fault()`` / ``H2O3TPU_FAULTS`` plant
   classified failures at named sites (``probe``, ``job``,
-  ``frame_reduce``, ``frame_map``) so every retry/degradation path runs
-  in tier-1 CPU tests instead of waiting for a real TPU crash.
+  ``frame_reduce``, ``frame_map``, ``heartbeat``, ``cloud_init``) so
+  every retry/degradation path runs in tier-1 CPU tests instead of
+  waiting for a real TPU crash.
 
 Telemetry: ``backend_probes_total``, ``backend_probe_failures_total``,
 ``infra_retries_total{site=}`` (README §Fault tolerance).
@@ -49,9 +54,12 @@ log = get_logger("h2o3_tpu.watchdog")
 # distinct from user errors and worth bounded retries. RESOURCE_EXHAUSTED
 # is retryable because callers purge the jit executable cache first (see
 # core/job.py free_device_memory): the cache pins HBM and the axon plugin
-# reports no memory stats, so pressure shows up as this error.
+# reports no memory stats, so pressure shows up as this error. "Gloo" is
+# the CPU cross-process collective transport: a peer dying mid-collective
+# surfaces as FAILED_PRECONDITION "Gloo collective ... Connection closed
+# by peer", which is cloud infrastructure, never user code.
 INFRA_SIGNS = ("remote_compile", "INTERNAL:", "UNAVAILABLE:",
-               "DEADLINE_EXCEEDED", "RESOURCE_EXHAUSTED")
+               "DEADLINE_EXCEEDED", "RESOURCE_EXHAUSTED", "Gloo")
 
 # exception types never worth a retry, regardless of message. Modules
 # that define their own (e.g. core/job.py JobCancelledException) append
@@ -257,6 +265,36 @@ def retry_call(fn: Callable[[], Any], policy: Optional[RetryPolicy] = None,
 # ------------------------------------------------------------ liveness probe
 
 
+def bounded_call(fn: Callable[[], Any], timeout_s: float,
+                 name: str = "bounded-call") -> Any:
+    """Run ``fn`` on a daemon thread with a hard deadline.
+
+    A wedged worker accepts a transfer/collective and never completes
+    it; the sync is the part that hangs. On deadline the worker thread
+    is abandoned (it dies with the process — for a dead backend that is
+    imminent anyway) and a classified DEADLINE_EXCEEDED error is raised
+    so retry/degradation layers treat it as infra-class."""
+    done = threading.Event()
+    box: Dict[str, Any] = {}
+
+    def _runner():
+        try:
+            box["val"] = fn()
+        except BaseException as e:  # noqa: BLE001 - reraised below
+            box["err"] = e
+        finally:
+            done.set()
+
+    t = threading.Thread(target=_runner, daemon=True, name=name)
+    t.start()
+    if not done.wait(timeout_s):
+        raise TimeoutError(
+            f"DEADLINE_EXCEEDED: {name} hung > {timeout_s}s")
+    if "err" in box:
+        raise box["err"]
+    return box.get("val")
+
+
 def _probe_once() -> None:
     maybe_fail("probe")
     import jax
@@ -284,28 +322,7 @@ def probe_backend(timeout_s: Optional[float] = None) -> float:
     t0 = time.time()
     try:
         if timeout_s:
-            done = threading.Event()
-            box: Dict[str, BaseException] = {}
-
-            def _runner():
-                try:
-                    _probe_once()
-                except BaseException as e:  # noqa: BLE001 - reraised below
-                    box["err"] = e
-                finally:
-                    done.set()
-
-            # daemon thread: if the transfer hangs we abandon it rather
-            # than hang the prober (the leaked thread dies with the
-            # process, which for a dead backend is imminent anyway)
-            t = threading.Thread(target=_runner, daemon=True,
-                                 name="backend-probe")
-            t.start()
-            if not done.wait(timeout_s):
-                raise TimeoutError(
-                    f"DEADLINE_EXCEEDED: backend probe hung > {timeout_s}s")
-            if "err" in box:
-                raise box["err"]
+            bounded_call(_probe_once, timeout_s, name="backend-probe")
         else:
             _probe_once()
     except BaseException:

@@ -58,10 +58,12 @@ def frame_reduce(map_fn: Callable[..., Any], *arrays, mesh=None) -> Any:
     # dies here with INTERNAL/UNAVAILABLE — tier-1 tests plant that
     # failure (watchdog.inject_fault) to exercise the job-level retries
     watchdog.maybe_fail("frame_reduce")
-    # chunk boundary: the one place a cancelled/expired request can be
-    # observed without preempting compiled code (a scan only yields
-    # between dispatches) — a cancel or deadline frees this worker
-    # within one chunk instead of finishing the whole job
+    # chunk boundary: the one place a cancelled/expired request — or an
+    # unhealthy cloud (core/heartbeat.py) — can be observed without
+    # preempting compiled code (a scan only yields between dispatches).
+    # A cancel or deadline frees this worker within one chunk; a
+    # heartbeat-declared dead peer fails the job HERE with
+    # CloudUnhealthyError instead of hanging forever inside the psum
     request_ctx.cancel_point("frame_reduce")
     telemetry.counter("frame_reduce_total").inc()
 

@@ -61,7 +61,10 @@ def make_mesh(devices: Optional[Sequence[jax.Device]] = None,
     return Mesh(dev, (DATA_AXIS, MODEL_AXIS))
 
 
-def set_global_mesh(mesh: Mesh) -> None:
+def set_global_mesh(mesh: Optional[Mesh]) -> None:
+    """Install the process mesh; ``None`` resets so the next
+    ``get_mesh()`` (or ``init()``) rebuilds from current devices —
+    cloud.shutdown() must not leave a stale mesh behind."""
     global _GLOBAL_MESH
     _GLOBAL_MESH = mesh
 
@@ -161,18 +164,23 @@ def fetch_replicated(x):
 
 def shard_rows(x, mesh: Optional[Mesh] = None, block: int = 1,
                fill: float = 0.0):
-    """Pad axis-0 to a shardable length and place with row_sharding."""
+    """Pad axis-0 to a shardable length and place with row_sharding.
+
+    Placement goes through put_sharded: on a multi-process cloud a raw
+    device_put onto a non-addressable sharding pays a cross-process
+    assert_equal broadcast per call (and on CPU without collectives it
+    simply fails — the old multiprocess-CPU standing failure)."""
     mesh = mesh or get_mesh()
     n = x.shape[0]
     npad = padded_rows(n, mesh, block)
     if npad != n:
         pad_widths = [(0, npad - n)] + [(0, 0)] * (x.ndim - 1)
         x = np.pad(np.asarray(x), pad_widths, constant_values=fill)
-    return jax.device_put(x, row_sharding(mesh))
+    return put_sharded(x, row_sharding(mesh))
 
 
 def valid_mask(n: int, npad: int, mesh: Optional[Mesh] = None):
     """float32 1/0 mask marking real rows among padded."""
     m = np.zeros((npad,), dtype=np.float32)
     m[:n] = 1.0
-    return jax.device_put(m, row_sharding(mesh))
+    return put_sharded(m, row_sharding(mesh))

@@ -50,15 +50,31 @@ gbm = GBMEstimator(ntrees=10, max_depth=4, seed=3).train(fr, y="y")
 glm = GLMEstimator(family="gaussian", lambda_=0.0).train(fr, y="y")
 
 gbm_pred = gbm.predict(fr).col("predict").to_numpy()
+
+# peer health: the heartbeat monitor auto-starts for multi-process
+# clouds; give it one interval to publish + read beats, then record
+# what this process sees of its peers
+import time                                   # noqa: E402
+from h2o3_tpu.core import heartbeat           # noqa: E402
+heartbeat.monitor.round()
+time.sleep(0.1)
+info = h2o3_tpu.cluster_info()
 result = {
     "process_count": len({d.process_index
                           for d in jax.devices("cpu")}),
     "gbm_mse": float(gbm.training_metrics["MSE"]),
     "gbm_pred_head": [float(v) for v in gbm_pred[:16]],
     "glm_coefficients": {k: float(v) for k, v in glm.coefficients.items()},
+    "cloud_healthy": info["cloud_healthy"],
+    "heartbeat_running": info["heartbeat"]["running"],
+    "peers_seen": sorted(int(p) for p in info["heartbeat"]["peers"]),
+    "uptime_ms": info["cloud_uptime_ms"],
 }
 
 if int(pid) == 0:
     with open(outfile, "w") as f:
         json.dump(result, f)
 print(f"WORKER-{pid}-DONE", flush=True)
+# exercise the full teardown path on a REAL multi-process cloud:
+# heartbeat stops, mesh resets, the distributed client disconnects
+h2o3_tpu.shutdown()

@@ -1,0 +1,253 @@
+"""Cloud peer-health + fail-fast degradation (ISSUE 7): heartbeat
+rounds/misses, `CloudUnhealthyError` at chunk boundaries, the
+heartbeat-loss-mid-GBM acceptance (no hang, no leaked RUNNING job,
+partial keys swept), hardened bootstrap retries, and shutdown → init
+reformation. All tier-1, all via fault injection — no real multi-host
+needed — and all UNDER the conftest DKV/Scope leak check."""
+
+import os
+import time
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import h2o3_tpu
+from h2o3_tpu.core import cloud, heartbeat, watchdog
+from h2o3_tpu.core.heartbeat import CloudUnhealthyError
+from h2o3_tpu.core.job import DONE, FAILED, RUNNING, Job
+from h2o3_tpu.core.kv import DKV
+from h2o3_tpu.parallel import mesh as mesh_mod
+from h2o3_tpu.parallel.map_reduce import frame_map, frame_reduce
+
+
+@pytest.fixture(autouse=True)
+def _clean_monitor():
+    """Every test starts and ends with a stopped, healthy monitor and
+    no planted faults — an unhealthy flag leaking across tests would
+    fail every subsequent frame_reduce."""
+    watchdog.clear_faults()
+    heartbeat.monitor.stop()
+    yield
+    watchdog.clear_faults()
+    heartbeat.monitor.stop()
+
+
+# ------------------------------------------------------ heartbeat rounds
+
+
+def test_heartbeat_round_agreement_updates_peers():
+    from h2o3_tpu import telemetry
+    heartbeat.monitor.start(interval_s=30, miss_budget=3, timeout_s=10,
+                            thread=False)
+    before = telemetry.REGISTRY.value("heartbeat_rounds_total")
+    assert heartbeat.monitor.round() is True
+    st = heartbeat.monitor.status()
+    assert st["healthy"]
+    assert st["peers"]["0"]["healthy"]
+    assert time.time() - st["peers"]["0"]["last_seen"] < 5.0
+    assert telemetry.REGISTRY.value("heartbeat_rounds_total") > before
+
+
+def test_heartbeat_miss_budget_flips_unhealthy_then_recovers():
+    from h2o3_tpu import telemetry
+    heartbeat.monitor.start(interval_s=30, miss_budget=2, timeout_s=10,
+                            thread=False)
+    watchdog.inject_fault("heartbeat", times=2)
+    assert heartbeat.monitor.round() is False
+    assert heartbeat.monitor.healthy()          # 1 miss < budget
+    assert heartbeat.monitor.round() is False
+    assert not heartbeat.monitor.healthy()      # budget exhausted
+    assert "heartbeat misses" in heartbeat.monitor.reason()
+    assert watchdog.fired("heartbeat") == 2
+    assert telemetry.REGISTRY.value("cloud_peers_healthy") == 0
+    # cluster_info + the degraded-mode contract: healthy=False flows out
+    assert h2o3_tpu.cluster_info()["cloud_healthy"] is False
+    # peers return → next agreement round ends degraded mode
+    assert heartbeat.monitor.round() is True
+    assert heartbeat.monitor.healthy()
+    assert h2o3_tpu.cluster_info()["cloud_healthy"] is True
+
+
+def test_heartbeat_timeout_is_a_miss():
+    """A hung agreement check (wedged backend) is bounded by the
+    thread-timeout prober and counted as a miss, never a hang."""
+    heartbeat.monitor.start(interval_s=30, miss_budget=1, timeout_s=0.2,
+                            thread=False)
+    ev = __import__("threading").Event()
+    heartbeat.monitor._psum_fn = lambda x: ev.wait(30)  # wedge the round
+    heartbeat.monitor._psum_mesh = mesh_mod.get_mesh()
+    t0 = time.time()
+    assert heartbeat.monitor.round() is False
+    assert time.time() - t0 < 5.0
+    assert not heartbeat.monitor.healthy()
+    ev.set()
+
+
+# ------------------------------------------------- fail-fast chunk gates
+
+
+def test_unhealthy_cloud_fails_frame_reduce_fast():
+    # monitor thread NOT started: a background agreement round would
+    # legitimately mark the (actually fine) CPU cloud healthy again —
+    # this unit pins the flag → chunk-boundary contract
+    heartbeat.monitor.mark_unhealthy("test: peer 1 presumed dead")
+    with pytest.raises(CloudUnhealthyError, match="UNAVAILABLE"):
+        frame_reduce(lambda x: x.sum(), jnp.ones(8))
+    with pytest.raises(CloudUnhealthyError):
+        frame_map(lambda x: x * 2, jnp.ones(8))
+    heartbeat.monitor.mark_healthy()
+    assert float(frame_reduce(lambda x: x.sum(), jnp.ones(8))) == 8.0
+
+
+def test_cloud_unhealthy_error_is_infra_class():
+    e = CloudUnhealthyError("3 consecutive heartbeat misses", site="t")
+    assert watchdog.is_infra_error(e)
+    # ...so the shared retry/recovery stack composes with it, but a
+    # cancellation never becomes retryable by association
+    from h2o3_tpu.core.job import JobCancelledException
+    assert not watchdog.is_infra_error(JobCancelledException("k"))
+
+
+def test_job_retries_when_cloud_recovers():
+    """Transient unhealthiness composes with job-level infra retries:
+    the first attempt dies on CloudUnhealthyError, the cloud recovers,
+    the retry succeeds."""
+    calls = []
+
+    def work(j):
+        calls.append(1)
+        if len(calls) == 1:
+            raise CloudUnhealthyError("blip", site="test")
+        return "ok"
+
+    policy_env = {"H2O3TPU_INFRA_BACKOFF_BASE_S": "0.01"}
+    old = {k: os.environ.get(k) for k in policy_env}
+    os.environ.update(policy_env)
+    try:
+        job = Job("recovering work").start(work)
+    finally:
+        for k, v in old.items():
+            os.environ.pop(k, None) if v is None else \
+                os.environ.__setitem__(k, v)
+    assert job.status == DONE and job.result == "ok"
+    assert len(calls) == 2
+
+
+def test_job_fails_fast_while_cloud_still_unhealthy():
+    """No futile retries against a cloud that has not recovered — the
+    comeback path is recovery_dir snapshot/resume, not backoff."""
+    heartbeat.monitor.mark_unhealthy("still down")
+    calls = []
+
+    def work(j):
+        calls.append(1)
+        heartbeat.check_healthy("test")
+
+    job = Job("doomed work").start(work, background=True).join(30)
+    assert job.status == FAILED
+    assert len(calls) == 1, "retried against an unhealthy cloud"
+    assert "CloudUnhealthyError" in job.exception
+
+
+# ------------------------------------------------- acceptance: GBM fit
+
+
+def test_heartbeat_loss_mid_gbm_fails_fast_and_sweeps():
+    """ISSUE 7 acceptance: injected heartbeat loss during a running GBM
+    fit → the job FAILS with a classified CloudUnhealthyError within one
+    heartbeat interval of the next chunk boundary — no hang, no leaked
+    RUNNING job, partial keys swept."""
+    from h2o3_tpu.models.gbm import GBMEstimator
+    r = np.random.RandomState(9)
+    n = 3000
+    X = r.randn(n, 4)
+    yv = (X[:, 0] + 0.5 * X[:, 1] > 0).astype(int)
+    fr = h2o3_tpu.Frame.from_numpy(
+        {**{f"x{i}": X[:, i] for i in range(4)},
+         "y": np.array(["n", "p"], dtype=object)[yv]},
+        categorical=["y"])
+    before = set(DKV.keys())
+
+    heartbeat.monitor.start(interval_s=0.05, miss_budget=2, timeout_s=5)
+    est = GBMEstimator(ntrees=400, max_depth=5, seed=1)
+    est.train(fr, y="y", background=True)
+    job = est._job
+    # let the fit reach its boost loop, then kill the heartbeat: the
+    # background monitor thread (0.05s interval) burns the miss budget
+    deadline = time.time() + 60
+    while job.progress <= 0.0 and job.status == RUNNING \
+            and time.time() < deadline:
+        time.sleep(0.01)
+    assert job.status == RUNNING, (job.status, job.exception)
+    watchdog.inject_fault("heartbeat", times=10_000)
+    while heartbeat.monitor.healthy() and time.time() < deadline:
+        time.sleep(0.01)
+    t_lost = time.time()
+    assert not heartbeat.monitor.healthy()
+
+    job.join(60)
+    assert job.status == FAILED, (job.status, job.exception)
+    assert "CloudUnhealthyError" in job.exception
+    assert "UNAVAILABLE" in job.exception
+    # fail-fast: one chunk boundary + one heartbeat interval, not a
+    # retry-backoff stall (bounded generously for busy CI hosts)
+    assert job.end_time - t_lost < 10.0
+    # no leaked RUNNING job, partial keys swept (job key lives in the
+    # test scope; telemetry capsules are bounded intentional retention)
+    leaked = {k for k in set(DKV.keys()) - before - {job.key, fr.key}
+              if not k.endswith("_telemetry")}
+    assert not leaked, f"degraded fit leaked keys: {sorted(leaked)}"
+
+
+# -------------------------------------------------- hardened bootstrap
+
+
+def test_cloud_init_fault_injection_bounded_retries(monkeypatch):
+    """Formation attempts run under the shared RetryPolicy: a flaky
+    coordinator costs bounded retries, then a classified error — and
+    shutdown() → init() reforms the single-process cloud afterwards."""
+    monkeypatch.setenv("H2O3TPU_INFRA_MAX_ATTEMPTS", "2")
+    monkeypatch.setenv("H2O3TPU_INFRA_BACKOFF_BASE_S", "0.01")
+    h2o3_tpu.shutdown()
+    watchdog.inject_fault("cloud_init", times=10)
+    try:
+        with pytest.raises(watchdog.InjectedFault, match="UNAVAILABLE"):
+            h2o3_tpu.init(backend="cpu",
+                          coordinator_address="127.0.0.1:1",
+                          num_processes=1, process_id=0)
+        assert watchdog.fired("cloud_init") == 2   # max_attempts, no more
+    finally:
+        watchdog.clear_faults()
+        info = h2o3_tpu.init(backend="cpu")
+    assert info["cloud_size"] == 8 and info["cloud_healthy"]
+
+
+def test_cloud_timeout_knob(monkeypatch):
+    from h2o3_tpu.core import config as _config
+    assert cloud._cloud_timeout_s(_config.ARGS) == \
+        _config.ARGS.cloud_timeout_s
+    monkeypatch.setenv("H2O3TPU_CLOUD_TIMEOUT_S", "7.5")
+    assert cloud._cloud_timeout_s(_config.ARGS) == 7.5
+
+
+def test_shutdown_then_init_reforms_clean():
+    """shutdown() tears down heartbeat + mesh + start-time so init()
+    REFORMS instead of attaching to stale state (satellite 2)."""
+    heartbeat.monitor.start(interval_s=30)
+    h2o3_tpu.shutdown()
+    assert not heartbeat.monitor.running
+    assert mesh_mod._GLOBAL_MESH is None
+    assert not cloud._STARTED
+    info = h2o3_tpu.init(backend="cpu")
+    assert cloud._STARTED
+    assert info["cloud_size"] == 8 and info["cloud_healthy"]
+    assert 0 <= info["cloud_uptime_ms"] < 60_000
+
+
+def test_cluster_info_uptime_is_a_delta():
+    """Satellite 1 regression: cloud_uptime_ms reported epoch millis
+    (~1.7e12); it must be the delta since init()."""
+    info = h2o3_tpu.cluster_info()
+    assert info["cloud_uptime_ms"] < 24 * 3600 * 1000
+    assert info["heartbeat"]["miss_budget"] >= 1

@@ -11,6 +11,7 @@ import os
 import socket
 import subprocess
 import sys
+import time
 
 import numpy as np
 import pytest
@@ -18,6 +19,12 @@ import pytest
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 WORKER = os.path.join(REPO, "tests", "mp_worker.py")
 N_PROC = 2
+# hard per-worker wallclock cap: a wedged worker (half-formed cloud, a
+# collective missing a peer) costs one failed test with its logs, never
+# a hung tier-1 run
+WORKER_TIMEOUT_S = float(os.environ.get("H2O3TPU_MP_TIMEOUT_S", "300"))
+
+pytestmark = pytest.mark.multiprocess
 
 
 def _free_port():
@@ -42,16 +49,27 @@ def mp_result(tmp_path_factory):
         for i in range(N_PROC)
     ]
     logs = []
+    deadline = time.time() + WORKER_TIMEOUT_S
     for p in procs:
         try:
-            stdout, _ = p.communicate(timeout=600)
+            stdout, _ = p.communicate(
+                timeout=max(deadline - time.time(), 1.0))
         except subprocess.TimeoutExpired:
-            p.kill()
-            stdout = "TIMEOUT"
+            # one wedged worker means the cloud never formed — kill the
+            # whole pod so the OTHER workers' logs (usually the ones
+            # naming the missing peer) get captured too
+            for q in procs:
+                if q.poll() is None:
+                    q.kill()
+            stdout, _ = p.communicate()
+            stdout = (stdout or "") + \
+                f"\n[TIMEOUT after {WORKER_TIMEOUT_S:.0f}s]"
         logs.append(stdout)
     for i, p in enumerate(procs):
-        assert p.returncode == 0, \
-            f"worker {i} failed:\n{logs[i][-3000:]}"
+        assert p.returncode == 0, (
+            f"worker {i} failed (rc={p.returncode}):\n"
+            + "\n".join(f"--- worker {j} log ---\n{lg[-3000:]}"
+                        for j, lg in enumerate(logs)))
     with open(out) as f:
         return json.load(f)
 
@@ -76,6 +94,16 @@ def _single_process_reference():
 
 def test_multiprocess_cloud_forms(mp_result):
     assert mp_result["process_count"] == N_PROC
+
+
+def test_multiprocess_peer_health(mp_result):
+    """The heartbeat monitor runs on every member of a multi-process
+    cloud and sees all peers' beats (per-peer last-seen over the
+    coordination-service KV store)."""
+    assert mp_result["heartbeat_running"]
+    assert mp_result["cloud_healthy"]
+    assert mp_result["peers_seen"] == list(range(N_PROC))
+    assert 0 <= mp_result["uptime_ms"] < 10 * 60 * 1000
 
 
 def test_multiprocess_gbm_matches_single_process(mp_result):

@@ -141,7 +141,12 @@ def test_overload_sheds_503_and_exempt_endpoints_survive(gated_port):
             t0 = time.time()
             st, _, _ = _req(gated_port, "GET", path, timeout=10)
             assert st == 200, path
-            assert time.time() - t0 < 2.0, \
+            # "bounded" = answered promptly, never parked behind the
+            # 10s queue wait or the 30s burst hold; 5s absorbs GIL
+            # contention from the 12-thread burst on a busy CI host
+            # (observed 3.7s for /3/Metrics mid-suite) without ever
+            # accepting a queued response as a pass
+            assert time.time() - t0 < 5.0, \
                 f"{path} latency unbounded under overload"
         _RELEASE.set()
         results = [f.result(timeout=30) for f in futs]

@@ -71,6 +71,11 @@ def test_cloud_up(port):
     assert st == 200
     assert j["cloud_size"] == 8
     assert j["cloud_healthy"]
+    # uptime is a DELTA since init(), not epoch milliseconds (the
+    # pre-ISSUE-7 bug reported ~1.7e12); the test session is minutes old
+    assert 0 <= j["cloud_uptime_millis"] < 4 * 3600 * 1000
+    assert all(n["healthy"] for n in j["nodes"])
+    assert j["bad_nodes"] == 0
 
 
 def test_frames_roundtrip(port):
