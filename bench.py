@@ -200,6 +200,20 @@ def _compile_count() -> int:
     return int(telemetry.REGISTRY.value("xla_compile_total"))
 
 
+def _roofline_fields(algo):
+    """Hardware-relative axis per config (telemetry/roofline.py): the
+    last fit's MFU and HBM-bandwidth utilization as FRACTIONS of the
+    detected device peaks — BENCH rounds become comparable across
+    backends, not just across rows/sec."""
+    try:
+        from h2o3_tpu.telemetry import roofline
+        f = roofline.last_fit(algo)
+        return {"mfu": round(f["mfu"], 6),
+                "hbm_util": round(f["hbm_util"], 6)}
+    except Exception:   # noqa: BLE001 - accounting must never fail a config
+        return {}
+
+
 # ---------------------------------------------------------------- configs
 
 
@@ -250,7 +264,8 @@ def _gbm_at(n_rows: int, ntrees: int, depth: int, tag: str):
         mfu_pct=round(_tree_mfu_pct(rows_per_sec, depth, 10), 2),
         peak_hbm_gb=round(_hbm_peak() / 1e9, 2),
         compiles_timed=_compile_count() - c0,
-        compiles_total=_compile_count())
+        compiles_total=_compile_count(),
+        **_roofline_fields("gbm"))
 
 
 def bench_gbm():
@@ -302,7 +317,8 @@ def bench_glm():
             mfu_pct=round(100 * row_iters * flops_per_row_iter / 197e12, 3),
             auc=round(float(m.training_metrics["AUC"]), 4),
             compiles_timed=_compile_count() - c0,
-            peak_hbm_gb=round(_hbm_peak() / 1e9, 2))
+            peak_hbm_gb=round(_hbm_peak() / 1e9, 2),
+            **_roofline_fields("glm"))
 
 
 def bench_dl():
@@ -352,7 +368,8 @@ def bench_dl():
         train_seconds=round(dt, 2), mfu_pct=round(100 * mfu, 2),
         train_err=err,
         compiles_timed=_compile_count() - c0,
-        peak_hbm_gb=round(_hbm_peak() / 1e9, 2))
+        peak_hbm_gb=round(_hbm_peak() / 1e9, 2),
+        **_roofline_fields("deeplearning"))
 
 
 def bench_xgb():
@@ -377,7 +394,8 @@ def bench_xgb():
         mfu_pct=round(_tree_mfu_pct(rps, 6, 10), 2),
         auc=round(float(m.training_metrics["AUC"]), 4),
         compiles_timed=_compile_count() - c0,
-        peak_hbm_gb=round(_hbm_peak() / 1e9, 2))
+        peak_hbm_gb=round(_hbm_peak() / 1e9, 2),
+        **_roofline_fields("xgboost"))
 
 
 def bench_sort():
@@ -700,6 +718,30 @@ def _stub_cloud():
           detect_ms=round(detect_s * 1e3, 3))
 
 
+def _stub_roofline():
+    """`roofline` line without a backend: drives the peak table and the
+    analytic per-algo estimators (telemetry/roofline.py) — mfu/hbm_util
+    fields flow even where no accelerator exists, so the harness
+    exercises the hardware-relative axis plumbing end to end."""
+    from h2o3_tpu.telemetry import roofline
+    peaks = roofline.peaks_for("TPU v5 lite")
+    assert peaks["flops"] > 0 and peaks["hbm_bytes_per_s"] > 0
+    est = roofline.analytic_tree_cost(rows=5_000_000, features=10,
+                                      trees=100, depth=6, bins=65)
+    seconds = 50.0                      # flagship-shaped pretend fit
+    mfu = est["flops"] / (seconds * peaks["flops"])
+    hbm = est["bytes"] / (seconds * peaks["hbm_bytes_per_s"])
+    assert mfu > 0 and hbm > 0
+    glm = roofline.analytic_glm_cost(rows=11_000_000, coefs=29,
+                                     iterations=8)
+    dl = roofline.analytic_dl_cost(1_000_000 * 8.0, [784, 200, 200, 10])
+    assert glm["flops"] > 0 and dl["flops"] > 0
+    _emit("roofline GBM flagship shape (stub; analytic estimators + "
+          "peak table, no backend)", 100 * mfu, "mfu_pct", 1.0, "stub",
+          mfu=round(mfu, 6), hbm_util=round(hbm, 6),
+          peak_source=peaks["source"])
+
+
 def _stub_treekernel():
     """`treekernel` line without a backend: drives the Pallas PLANNER —
     the pure knob/backend decision table and the VMEM tile sizing
@@ -727,6 +769,7 @@ if STUB:
                ("grid", _stub_grid),
                ("treekernel", _stub_treekernel),
                ("cloud", _stub_cloud),
+               ("roofline", _stub_roofline),
                ("stub_b", _stub_ok("stub_b"))]
     _MIN_NEED = {n: 1 for n, _ in CONFIGS}
     _HARD_CAP = {n: 30 for n, _ in CONFIGS}

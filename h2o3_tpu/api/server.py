@@ -200,17 +200,35 @@ def _cloud(params, body):
     peers = hb.get("peers", {})
     now = int(__import__("time").time() * 1000)
     mesh_devs = list(cloud_mod.mesh_mod.get_mesh().devices.flat)
+    # published identity + per-node load from the cluster fan-in
+    # snapshots (telemetry/cluster.py) — replaces the old default-0
+    # process_index attribute guess; single-process clouds still get
+    # their own (live) summary
+    owner_map, summaries = {}, {}
+    try:
+        from h2o3_tpu.telemetry import cluster as _cluster
+        col = _cluster.collect()
+        owner_map = _cluster.device_owner_map(col)
+        summaries = _cluster.node_summaries(col)
+    except Exception:   # noqa: BLE001 - summaries are best-effort
+        pass
+    from h2o3_tpu.telemetry import roofline as _roofline
+    peaks = _roofline.device_peaks()
     nodes = []
     for i, d in enumerate(info["devices"]):
-        # device i belongs to a process; without the monitor every
-        # device reports the cloud-level verdict
-        pst = peers.get(str(getattr(mesh_devs[i], "process_index", 0)))
+        # device i belongs to a process: published identity first, the
+        # device's own process_index attribute as the fallback
+        pidx = owner_map.get(
+            d, getattr(mesh_devs[i], "process_index", 0))
+        pst = peers.get(str(pidx))
         healthy = bool(pst["healthy"]) if pst else info["cloud_healthy"]
         last_ping = (int(pst["last_seen"] * 1000) if pst else now)
+        summ = summaries.get(int(pidx), {})
         nodes.append({
             "h2o": d, "ip_port": f"127.0.0.1:{54321 + i}",
-            "healthy": healthy,
-            "last_ping": last_ping, "pid": os.getpid(),
+            "healthy": healthy and not summ.get("stale", False),
+            "last_ping": last_ping,
+            "pid": summ.get("pid", os.getpid()),
             "num_cpus": os.cpu_count(),
             "cpus_allowed": os.cpu_count(), "nthreads": os.cpu_count(),
             "sys_load": 0.0, "my_cpu_pct": 0, "sys_cpu_pct": 0,
@@ -218,7 +236,16 @@ def _cloud(params, body):
             "max_mem": 0, "swap_mem": 0, "num_keys": len(list(DKV.keys())),
             "free_disk": 0, "max_disk": 0, "rpcs_active": 0,
             "fjthrds": [], "fjqueue": [], "tcps_active": 0,
-            "open_fds": -1, "gflops": 0.0, "mem_bw": 0.0,
+            "open_fds": -1,
+            "gflops": peaks["flops"] / 1e9,
+            "mem_bw": peaks["hbm_bytes_per_s"],
+            "process_index": int(pidx),
+            "metrics_summary": {
+                "jobs_inflight": summ.get("jobs_inflight", 0),
+                "last_publish_age_s": summ.get("last_publish_age_s", 0.0),
+                "peak_hbm": summ.get("peak_hbm", 0),
+                "stale": summ.get("stale", False),
+            },
         })
     return {"__meta": {"schema_version": 3, "schema_name": "CloudV3",
                        "schema_type": "Iced"},
@@ -1455,14 +1482,48 @@ healthy: {info["cloud_healthy"]}</p>
     return {"__html__": html}
 
 
+def _cluster_requested(params) -> bool:
+    """``?cluster=1`` opt-in, honored only on a multi-process cloud —
+    with process_count()==1 every cluster view IS the local view
+    (bit-identical by construction, asserted in tier-1)."""
+    if str(params.get("cluster") or "").lower() not in ("1", "true",
+                                                        "yes"):
+        return False
+    try:
+        import jax
+        return jax.process_count() > 1
+    except Exception:   # noqa: BLE001 - no backend → local view
+        return False
+
+
 @route("GET", "/3/Metrics")
 def _metrics(params, body):
     """Runtime telemetry snapshot (h2o3_tpu/telemetry): registry
     counters/gauges/histograms + recent spans. ``?format=prometheus``
     returns text exposition 0.0.4 for a scraping agent; the JSON shape
-    additionally carries the span ring and per-span-name aggregate."""
+    additionally carries the span ring and per-span-name aggregate.
+    ``?cluster=1`` on a multi-process cloud merges every peer's fan-in
+    snapshot (telemetry/cluster.py): counters summed across nodes,
+    gauges/histograms per-node with a ``node=`` label, peers past their
+    publish window served stale-but-labeled (``stale_nodes``)."""
     from h2o3_tpu import telemetry
     fmt = str(params.get("format") or "").lower()
+    if _cluster_requested(params):
+        from h2o3_tpu.telemetry import cluster
+        col = cluster.collect()
+        if fmt in ("prometheus", "prom", "text"):
+            return {"__bytes__": cluster.merged_prometheus(col).encode(),
+                    "__ctype__":
+                        "text/plain; version=0.0.4; charset=utf-8"}
+        summaries = cluster.node_summaries(col)
+        return {"metrics": cluster.merged_metrics(col),
+                "spans": telemetry.spans_snapshot(50),
+                "span_aggregate": telemetry.spans_aggregate(),
+                "cluster": {
+                    "process_count": col["process_count"],
+                    "stale_nodes": col["stale_nodes"],
+                    "nodes": [summaries[n] for n in sorted(summaries)],
+                }}
     if fmt in ("prometheus", "prom", "text"):
         return {"__bytes__": telemetry.to_prometheus().encode(),
                 "__ctype__": "text/plain; version=0.0.4; charset=utf-8"}
@@ -1563,13 +1624,25 @@ def _selfbench(params, body):
 def _logs(params, body):
     """Recent log lines (water/api/LogsHandler role) from the structured
     pipeline's ring buffers: ``?level=ERROR`` selects a per-level ring,
-    ``?last=N`` bounds the tail."""
+    ``?last=N`` bounds the tail. ``?cluster=1`` on a multi-process
+    cloud merges every peer's published tail, timestamp-ordered, each
+    line prefixed with its node id."""
     from h2o3_tpu.utils.log import level_counts, log_buffer, log_file_path
     level = params.get("level")
     try:
         last = int(float(params.get("last") or 0)) or None
     except (TypeError, ValueError):
         last = None
+    if _cluster_requested(params):
+        from h2o3_tpu.telemetry import cluster
+        merged = cluster.merged_logs(level=level, last=last)
+        return {"log": "\n".join(merged["lines"]),
+                "lines": merged["lines"],
+                "level": (level or "ALL").upper(),
+                "level_counts": level_counts(),
+                "file": log_file_path() or "",
+                "cluster": {"process_count": merged["process_count"],
+                            "stale_nodes": merged["stale_nodes"]}}
     lines = log_buffer(level=level, last=last)
     return {"log": "\n".join(lines),
             "lines": lines,
@@ -1628,8 +1701,14 @@ def _job_telemetry(params, body, key=None):
 @route("GET", "/3/Trace")
 def _process_trace(params, body):
     """The whole process ring (spans + timeline + compiles) as Chrome
-    trace JSON — the zoomed-out view when no single job is suspect."""
+    trace JSON — the zoomed-out view when no single job is suspect.
+    ``?cluster=1`` on a multi-process cloud merges every peer's
+    published ring tails into ONE trace with ``pid`` = process_index,
+    so Perfetto renders one track group per host."""
     from h2o3_tpu.telemetry import trace_export
+    if _cluster_requested(params):
+        from h2o3_tpu.telemetry import cluster
+        return cluster.merged_trace()
     try:
         nspans = int(float(params.get("spans") or 2048))
         nevents = int(float(params.get("events") or 2048))

@@ -173,6 +173,11 @@ def init(backend: Optional[str] = None,
                                       int(process_id), timeout_s),
             site="cloud_init")
         _roll_call(int(num_processes), int(process_id), timeout_s)
+        # stamp this process's cloud identity on every log record and
+        # flight-recorder capsule (utils/log.py ContextFilter) so merged
+        # cluster views stay attributable — set here, NOT read from
+        # jax.process_index() inside the logging hot path
+        _log.set_node(int(process_id))
 
     devices = jax.devices(cfg.backend) if cfg.backend else jax.devices()
     m = mesh_mod.make_mesh(devices, cfg.data_axis, cfg.model_axis)
@@ -222,13 +227,41 @@ def cluster_info() -> dict:
     }
 
 
+def _sweep_coordination_keys() -> None:
+    """Delete THIS process's heartbeat/bootstrap/telemetry entries from
+    the coordination-service KV store. Runs during shutdown, before the
+    distributed client disconnects: a reformed cloud (shutdown → init)
+    must never read the previous incarnation's ghost beats or stale
+    metric snapshots."""
+    try:
+        from jax._src import distributed
+        client = distributed.global_state.client
+    except Exception:       # noqa: BLE001 - no distributed runtime
+        return
+    if client is None:
+        return
+    pidx = heartbeat_mod.monitor._pid
+    try:
+        pidx = jax.process_index()
+    except Exception:       # noqa: BLE001 - keep the monitor's capture
+        pass
+    from h2o3_tpu.telemetry import cluster
+    for prefix in (heartbeat_mod.KV_PREFIX, BOOT_KV_PREFIX,
+                   cluster.KV_PREFIX):
+        try:
+            client.key_value_delete(f"{prefix}{pidx}")
+        except Exception:   # noqa: BLE001 - absent key / service down
+            pass
+
+
 def shutdown() -> None:
     """Drop all state (reference: POST /3/Shutdown).
 
     Tears down everything ``init()`` built — heartbeat and cleaner
-    threads, the DKV, the global mesh, and the jax.distributed client —
-    so a subsequent ``init()`` reforms the cloud instead of attaching to
-    a stale mesh or a dead coordinator."""
+    threads, this process's coordination-KV entries (beats, roll-call
+    marker, telemetry snapshot), the DKV, the global mesh, and the
+    jax.distributed client — so a subsequent ``init()`` reforms the
+    cloud instead of attaching to stale state."""
     global _STARTED, _CLOUD_START_MS, _DISTRIBUTED
     heartbeat_mod.monitor.stop()
     try:
@@ -236,6 +269,7 @@ def shutdown() -> None:
         cleaner.stop()
     except Exception:       # noqa: BLE001 - cleaner is optional
         pass
+    _sweep_coordination_keys()
     DKV.clear()
     mesh_mod.set_global_mesh(None)
     if _DISTRIBUTED:

@@ -75,6 +75,23 @@ class Config:
     # completed-job telemetry capsules retained in the DKV (newest
     # first); cancelled jobs' capsules are swept with their Scope
     flight_recorder_keep: int = 32
+    # -- cluster telemetry fan-in (telemetry/cluster.py) ---------------
+    # per-peer metric/trace/log snapshots over the coordination-service
+    # KV store: "auto" (default) publishes on multi-process clouds only,
+    # "on" forces, "off" disables — the ?cluster=1 views then degrade to
+    # the local process
+    cluster_metrics: str = "auto"
+    # seconds between snapshot publishes (piggybacked on the heartbeat
+    # beat cadence — a publish never outpaces the beat)
+    cluster_metrics_interval_s: float = 5.0
+    # a peer whose newest snapshot is older than this is reported in
+    # stale_nodes (its last data still serves, labeled stale)
+    cluster_metrics_stale_s: float = 15.0
+    # -- roofline accounting (telemetry/roofline.py) -------------------
+    # per-fit FLOP/byte accounting against device peaks: "auto" =
+    # analytic estimates everywhere + Compiled.cost_analysis() totals on
+    # TPU backends; "analytic" / "cost" force one path; "off" disables
+    roofline: str = "auto"
     # -- model batching (parallel/model_batch.py) ----------------------
     # grid/AutoML combos sharing one compiled program train as a single
     # vmapped batch: "auto" (default) batches eligible buckets of >= 2
@@ -99,7 +116,9 @@ class Config:
     _FLOAT_FIELDS = frozenset({"infra_backoff_base_s", "infra_backoff_max_s",
                                "probe_timeout_s", "rest_queue_wait_s",
                                "cloud_timeout_s", "heartbeat_interval_s",
-                               "heartbeat_timeout_s"})
+                               "heartbeat_timeout_s",
+                               "cluster_metrics_interval_s",
+                               "cluster_metrics_stale_s"})
 
     @staticmethod
     def from_env(**overrides) -> "Config":

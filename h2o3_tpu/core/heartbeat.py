@@ -305,6 +305,15 @@ class HeartbeatMonitor:
         now = time.time()
         client.key_value_set(f"{KV_PREFIX}{self._pid}", repr(now),
                              allow_overwrite=True)
+        # cluster-telemetry snapshot piggybacks on the beat cadence —
+        # same out-of-band rule (KV write, never a device collective),
+        # same bounded_call window; its own interval rate-limits it and
+        # a publish failure never counts as a heartbeat miss
+        try:
+            from h2o3_tpu.telemetry import cluster
+            cluster.maybe_publish()
+        except Exception as e:      # noqa: BLE001 - publish is best-effort
+            log.debug("cluster telemetry publish skipped: %s", e)
         beats = {}
         for key, val in client.key_value_dir_get(KV_PREFIX):
             try:

@@ -88,6 +88,9 @@ class Job:
         from h2o3_tpu.utils.timeline import record as _tl
         _tl("job", f"start {self.description}", key=self.key)
         telemetry.counter("jobs_started_total").inc()
+        # live in-flight count: the per-node load summary GET /3/Cloud
+        # and the cluster fan-in snapshots report (telemetry/cluster.py)
+        telemetry.gauge("jobs_inflight").add(1)
 
         # the flight-recorder handle crosses the _run → _body closure
         # boundary via this cell (attach must run on the WORKER thread —
@@ -209,6 +212,7 @@ class Job:
                     _body()
             finally:
                 flight_recorder.detach(handle, status=self.status)
+                telemetry.gauge("jobs_inflight").add(-1)
                 telemetry.counter("jobs_completed_total",
                                   status=self.status).inc()
                 telemetry.histogram("job_duration_seconds").observe(

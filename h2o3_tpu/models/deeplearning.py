@@ -34,6 +34,7 @@ from h2o3_tpu.models import metrics as mm
 from h2o3_tpu.models.model import (EarlyStopper, Model, ModelBuilder,
                                    ModelCategory, adapt_domain, infer_category)
 from h2o3_tpu.parallel.mesh import get_mesh, row_sharding, shard_rows
+from h2o3_tpu.telemetry import observed_jit
 
 ACTS = {
     "rectifier": jax.nn.relu,
@@ -177,6 +178,7 @@ _loss_eval = partial(jax.jit, static_argnames=(
     "nclasses"))(_loss)
 
 
+@observed_jit("dl.train_chunk")
 @partial(jax.jit, static_argnames=_STEP_STATICS + (
     "nsteps", "batch", "n", "rate", "rate_annealing",
     "momentum_start", "momentum_stable", "momentum_ramp"))

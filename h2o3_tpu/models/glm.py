@@ -201,6 +201,7 @@ def _irls_iter(X1, coef, y, w, off, l1, l2, family: str, link: str,
     return new_coef, delta, dev
 
 
+@observed_jit("glm.irls_solve")
 @partial(jax.jit, static_argnames=("family", "link", "use_l1"))
 def _irls_solve(X1, coef, y, w, off, l1, l2, beta_eps, max_iter,
                 family: str, link: str, tweedie_power, theta=1e-5,

@@ -346,8 +346,11 @@ class ModelBuilder:
                     "fold_assignment is incompatible with fold_column "
                     "(hex/ModelBuilder fold-spec validation)")
             from h2o3_tpu import telemetry
+            from h2o3_tpu.telemetry import roofline
             with telemetry.span(f"{self.algo}.fit", algo=self.algo,
                                 nfolds=nfolds):
+                rf_probe = roofline.fit_probe(self.algo)
+                t_fit = time.time()
                 if nfolds >= 2:
                     from h2o3_tpu.ml.cv import train_with_cv
                     model = train_with_cv(self, training_frame, x, y,
@@ -356,6 +359,12 @@ class ModelBuilder:
                 else:
                     model = self._fit(training_frame, x, y, j,
                                       validation_frame=validation_frame)
+                # roofline accounting INSIDE the span: the MFU/HBM
+                # numbers annotate the fit span and therefore land in
+                # the job's flight-recorder capsule (never raises)
+                roofline.record_model_fit(self, model, training_frame, x,
+                                          seconds=time.time() - t_fit,
+                                          probe=rf_probe)
             telemetry.histogram("model_fit_seconds",
                                 algo=self.algo).observe(time.time() - t0)
             if custom_metric_func is not None and y is not None:

@@ -40,6 +40,8 @@ from h2o3_tpu.telemetry.spans import aggregate as spans_aggregate
 from h2o3_tpu.telemetry.compile_observer import (compiles_snapshot, install,
                                                  observed_jit)
 from h2o3_tpu.telemetry import trace_export
+from h2o3_tpu.telemetry import cluster
+from h2o3_tpu.telemetry import roofline
 
 snapshot = REGISTRY.snapshot
 to_prometheus = REGISTRY.to_prometheus
@@ -56,4 +58,5 @@ __all__ = [
     "add_collective_bytes", "spans_snapshot", "spans_aggregate",
     "install", "observed_jit", "snapshot", "to_prometheus",
     "compiles_snapshot", "flight_recorder", "trace_export",
+    "cluster", "roofline",
 ]

@@ -1,0 +1,453 @@
+"""Cluster telemetry fan-in — cross-process metric/trace/log snapshots.
+
+PR 7 made the pod cloud genuinely multi-process, but the PR 1/5
+telemetry stack stayed process-local: ``GET /3/Metrics`` on the
+coordinator only ever showed process 0, so a dead-slow peer was
+invisible exactly where the reference's CloudHandler/WaterMeter
+contract promises whole-cloud visibility. This module is the fan-in:
+
+- every peer periodically publishes a compact snapshot — registry
+  counters/gauges/histograms, recent span/timeline/compile ring tails,
+  a structured log tail, inflight-job and HBM-peak summaries — to the
+  coordination-service KV store (``h2o3tpu/telemetry/<process_index>``,
+  zlib+base64). Publishing piggybacks on the heartbeat beat cadence
+  (core/heartbeat.py ``_kv_round``): same out-of-band-by-design rule —
+  NEVER a device collective, which could deadlock training collectives
+  across processes;
+- the coordinator's REST tier merges them on demand (``?cluster=1`` on
+  ``/3/Metrics`` / ``/3/Trace`` / ``/3/Logs``, api/server.py): counters
+  summed across nodes, gauges/histograms per-node with a
+  ``node=<process_index>`` label, traces fused into ONE Chrome trace
+  with ``pid`` = process_index (one Perfetto track group per host),
+  logs merged timestamp-ordered;
+- degradation contract: a peer that misses its publish window serves
+  its LAST snapshot, labeled in ``stale_nodes`` — never a block, never
+  a 500. With ``process_count() == 1`` the ``?cluster=1`` views are
+  exactly the local views (api/server.py short-circuits before calling
+  in here).
+
+Knobs: ``H2O3TPU_CLUSTER_METRICS`` (auto|on|off),
+``H2O3TPU_CLUSTER_METRICS_INTERVAL_S`` (publish cadence, default 5),
+``H2O3TPU_CLUSTER_METRICS_STALE_S`` (staleness threshold, default 15)
+— env over core/config.py, the watchdog/gate pattern.
+"""
+
+from __future__ import annotations
+
+import base64
+import json
+import os
+import threading
+import time
+import zlib
+from typing import Dict, List, Optional, Tuple
+
+from h2o3_tpu.telemetry.registry import (BYTES_BUCKETS, REGISTRY, counter,
+                                         gauge, histogram)
+
+KV_PREFIX = "h2o3tpu/telemetry/"
+
+# ring-tail caps per snapshot: the KV value must stay a bounded control
+# -plane payload (compressed, typically a few KB), not a trace dump
+MAX_SPANS = 192
+MAX_EVENTS = 192
+MAX_COMPILES = 96
+MAX_LOGS = 160
+
+_lock = threading.Lock()
+_last_publish = 0.0
+_seq = 0
+
+
+# ------------------------------------------------------------- knobs
+
+
+def _knob(env: str, attr: str, default):
+    v = os.environ.get(env)
+    if v is not None:
+        try:
+            return type(default)(v) if not isinstance(default, str) else v
+        except ValueError:
+            pass
+    try:
+        from h2o3_tpu.core import config as _cfg
+        return getattr(_cfg.ARGS, attr)
+    except Exception:   # noqa: BLE001 - config not importable yet
+        return default
+
+
+def enabled_mode() -> str:
+    m = str(_knob("H2O3TPU_CLUSTER_METRICS", "cluster_metrics",
+                  "auto")).lower()
+    return m if m in ("auto", "on", "off") else "auto"
+
+
+def interval_s() -> float:
+    return float(_knob("H2O3TPU_CLUSTER_METRICS_INTERVAL_S",
+                       "cluster_metrics_interval_s", 5.0))
+
+
+def stale_s() -> float:
+    return float(_knob("H2O3TPU_CLUSTER_METRICS_STALE_S",
+                       "cluster_metrics_stale_s", 15.0))
+
+
+# ----------------------------------------------------------- process
+
+
+def _client():
+    from jax._src import distributed
+    return distributed.global_state.client
+
+
+def _identity() -> Tuple[int, int]:
+    """(process_index, process_count) WITHOUT re-entering backend init:
+    the heartbeat monitor captured them at start; fall back to jax only
+    when the monitor never ran (REST thread — backend already up)."""
+    from h2o3_tpu.core import heartbeat
+    mon = heartbeat.monitor
+    if mon.peers:
+        return mon._pid, max(mon._nproc, len(mon.peers))
+    try:
+        import jax
+        return jax.process_index(), jax.process_count()
+    except Exception:   # noqa: BLE001
+        return 0, 1
+
+
+# ----------------------------------------------------------- publish
+
+
+def local_snapshot() -> Dict:
+    """This process's publishable snapshot — also what the coordinator
+    uses for ITSELF when merging (always live, never stale)."""
+    from h2o3_tpu.telemetry import compile_observer
+    from h2o3_tpu.telemetry import spans as spans_mod
+    from h2o3_tpu.utils import log as log_mod
+    from h2o3_tpu.utils import timeline
+    node, _ = _identity()
+    peak_hbm = 0
+    try:
+        import jax
+        st = jax.local_devices()[0].memory_stats() or {}
+        peak_hbm = int(st.get("peak_bytes_in_use", 0) or 0)
+    except Exception:   # noqa: BLE001 - stats are best-effort
+        pass
+    devices = []
+    try:
+        import jax
+        devices = [str(d) for d in jax.local_devices()]
+    except Exception:   # noqa: BLE001
+        pass
+    return {
+        "node": node,
+        "ts": time.time(),
+        "seq": _seq,
+        "host": os.uname().nodename,
+        "pid": os.getpid(),
+        "devices": devices,
+        "metrics": REGISTRY.snapshot(),
+        "spans": spans_mod.snapshot(MAX_SPANS),
+        "events": timeline.snapshot(MAX_EVENTS),
+        "compiles": compile_observer.compiles_snapshot(MAX_COMPILES),
+        "logs": log_mod.log_records(MAX_LOGS),
+        "jobs_inflight": int(REGISTRY.value("jobs_inflight")),
+        "peak_hbm": peak_hbm,
+    }
+
+
+def _encode(snap: Dict) -> str:
+    raw = json.dumps(snap, separators=(",", ":"), default=str).encode()
+    return "z:" + base64.b64encode(zlib.compress(raw, 6)).decode("ascii")
+
+
+def _decode(val: str) -> Optional[Dict]:
+    try:
+        if val.startswith("z:"):
+            raw = zlib.decompress(base64.b64decode(val[2:]))
+        else:
+            raw = val.encode()
+        return json.loads(raw)
+    except Exception:   # noqa: BLE001 - a torn/garbled value is a miss
+        return None
+
+
+def publish(force: bool = False) -> bool:
+    """Publish this process's snapshot to the coordination KV. Returns
+    True on success; False when disabled, single-process, rate-limited,
+    or the KV write failed (counted, never raised)."""
+    global _last_publish, _seq
+    if enabled_mode() == "off":
+        return False
+    node, nproc = _identity()
+    if nproc <= 1 and enabled_mode() != "on":
+        return False
+    now = time.time()
+    with _lock:
+        if not force and now - _last_publish < interval_s():
+            return False
+        _last_publish = now
+        _seq += 1
+    try:
+        client = _client()
+        if client is None:
+            return False
+        payload = _encode(local_snapshot())
+        client.key_value_set(f"{KV_PREFIX}{node}", payload,
+                             allow_overwrite=True)
+        counter("cluster_publish_total").inc()
+        histogram("cluster_publish_bytes",
+                  buckets=BYTES_BUCKETS).observe(len(payload))
+        return True
+    except Exception as e:   # noqa: BLE001 - publishing is best-effort
+        counter("cluster_publish_failures_total").inc()
+        from h2o3_tpu.utils.log import get_logger
+        get_logger("cluster").debug("snapshot publish failed: %s", e)
+        return False
+
+
+def maybe_publish() -> bool:
+    """Rate-limited publish — the heartbeat piggyback entry point."""
+    return publish(force=False)
+
+
+def sweep_own_keys() -> None:
+    """Delete this process's snapshot from the KV (cloud shutdown) so a
+    reformed cloud never reads a previous incarnation's ghost data."""
+    try:
+        client = _client()
+        if client is None:
+            return
+        node, _ = _identity()
+        client.key_value_delete(f"{KV_PREFIX}{node}")
+    except Exception:   # noqa: BLE001 - already gone / already down
+        pass
+
+
+# ----------------------------------------------------------- collect
+
+
+def collect() -> Dict:
+    """Read every peer's published snapshot. Returns
+    ``{"nodes": {node: snapshot}, "ages": {node: seconds},
+    "stale_nodes": [...], "process_count": N, "self": idx}``.
+    The local node's entry is the LIVE snapshot (age 0). Peers past the
+    staleness window — or that never published — land in stale_nodes;
+    a KV read failure marks every peer stale rather than raising."""
+    self_idx, nproc = _identity()
+    now = time.time()
+    nodes: Dict[int, Dict] = {self_idx: local_snapshot()}
+    ages: Dict[int, float] = {self_idx: 0.0}
+    stale: List[int] = []
+    peer_ids = [p for p in range(nproc) if p != self_idx]
+    if peer_ids:
+        entries: Dict[int, Dict] = {}
+        try:
+            client = _client()
+            if client is None:
+                raise RuntimeError("no coordination-service client")
+            for key, val in client.key_value_dir_get(KV_PREFIX):
+                try:
+                    n = int(key.rsplit("/", 1)[-1])
+                except ValueError:
+                    continue
+                snap = _decode(val)
+                if snap is not None:
+                    entries[n] = snap
+        except Exception:   # noqa: BLE001 - degrade to all-stale, no 500
+            entries = {}
+        # heartbeat's verdict folds in: a peer the monitor already
+        # declared unhealthy is stale NOW, not after the window
+        try:
+            from h2o3_tpu.core import heartbeat
+            hb_peers = heartbeat.monitor.peers
+        except Exception:   # noqa: BLE001
+            hb_peers = {}
+        for p in peer_ids:
+            snap = entries.get(p)
+            if snap is None:
+                stale.append(p)
+                continue
+            age = max(0.0, now - float(snap.get("ts", 0.0)))
+            nodes[p] = snap
+            ages[p] = age
+            hb = hb_peers.get(p)
+            if age > stale_s() or (hb is not None and not hb["healthy"]):
+                stale.append(p)
+    gauge("cluster_stale_nodes").set(len(stale))
+    return {"nodes": nodes, "ages": ages, "stale_nodes": sorted(stale),
+            "process_count": nproc, "self": self_idx}
+
+
+def node_summaries(col: Optional[Dict] = None) -> Dict[int, Dict]:
+    """Per-node operational summary for GET /3/Cloud: published
+    identity, inflight jobs, last-publish age, peak HBM."""
+    col = col or collect()
+    out: Dict[int, Dict] = {}
+    for n, snap in col["nodes"].items():
+        out[int(n)] = {
+            "node": int(n),
+            "host": snap.get("host", ""),
+            "pid": snap.get("pid", 0),
+            "devices": snap.get("devices", []),
+            "jobs_inflight": int(snap.get("jobs_inflight", 0) or 0),
+            "last_publish_age_s": round(col["ages"].get(int(n), 0.0), 3),
+            "peak_hbm": int(snap.get("peak_hbm", 0) or 0),
+            "stale": int(n) in col["stale_nodes"],
+        }
+    return out
+
+
+def device_owner_map(col: Optional[Dict] = None) -> Dict[str, int]:
+    """str(device) → owning process_index, from published identity —
+    replaces the default-0 ``process_index`` attribute guess on the
+    /3/Cloud node blocks."""
+    col = col or collect()
+    out: Dict[str, int] = {}
+    for n, snap in col["nodes"].items():
+        for d in snap.get("devices", []) or []:
+            out[str(d)] = int(n)
+    return out
+
+
+# ------------------------------------------------------------- merge
+
+
+def _lkey(labels: Dict) -> tuple:
+    return tuple(sorted((k, str(v)) for k, v in (labels or {}).items()))
+
+
+def merged_metrics(col: Optional[Dict] = None) -> Dict:
+    """Fold per-node registry snapshots into the cluster view: counters
+    SUMMED across nodes per (name, labels); gauges and histograms kept
+    per-node with a ``node=<process_index>`` label (summing a gauge —
+    or a histogram's bucket vector — across nodes would fabricate a
+    distribution no single process observed)."""
+    col = col or collect()
+    csum: Dict[tuple, Dict] = {}
+    gauges: List[Dict] = []
+    hists: List[Dict] = []
+    for n in sorted(col["nodes"]):
+        m = col["nodes"][n].get("metrics") or {}
+        for c in m.get("counters", []):
+            key = (c["name"], _lkey(c.get("labels")))
+            e = csum.get(key)
+            if e is None:
+                csum[key] = {"name": c["name"],
+                             "labels": dict(c.get("labels") or {}),
+                             "value": float(c.get("value", 0.0))}
+            else:
+                e["value"] += float(c.get("value", 0.0))
+        for g in m.get("gauges", []):
+            gauges.append({"name": g["name"],
+                           "labels": {**(g.get("labels") or {}),
+                                      "node": str(n)},
+                           "value": g.get("value", 0.0)})
+        for h in m.get("histograms", []):
+            hists.append({"name": h["name"],
+                          "labels": {**(h.get("labels") or {}),
+                                     "node": str(n)},
+                          "count": h.get("count", 0),
+                          "sum": h.get("sum", 0.0),
+                          "buckets": h.get("buckets", [])})
+    counters = [csum[k] for k in sorted(csum)]
+    return {"counters": counters, "gauges": gauges, "histograms": hists}
+
+
+def merged_prometheus(col: Optional[Dict] = None) -> str:
+    """Cluster-merged Prometheus text exposition 0.0.4 — the same line
+    grammar registry.to_prometheus emits, over merged_metrics()."""
+    m = merged_metrics(col)
+
+    def _esc(v) -> str:
+        return str(v).replace("\\", r"\\").replace('"', r'\"') \
+                     .replace("\n", r"\n")
+
+    def _lbl(labels: Dict, extra: str = "") -> str:
+        items = [f'{k}="{_esc(v)}"' for k, v in sorted(labels.items())]
+        if extra:
+            items.append(extra)
+        return "{" + ",".join(items) + "}" if items else ""
+
+    by_name: Dict[str, List[Tuple[str, Dict]]] = {}
+    for kind, entries in (("counter", m["counters"]),
+                          ("gauge", m["gauges"]),
+                          ("histogram", m["histograms"])):
+        for e in entries:
+            by_name.setdefault(e["name"], []).append((kind, e))
+    lines: List[str] = []
+    for name in sorted(by_name):
+        kind = by_name[name][0][0]
+        lines.append(f"# TYPE {name} {kind}")
+        for _k, e in by_name[name]:
+            if kind in ("counter", "gauge"):
+                lines.append(f"{name}{_lbl(e['labels'])} {e['value']:g}")
+            else:
+                for bound, c in e.get("buckets", []):
+                    le = 'le="%g"' % float(bound)
+                    lines.append(f"{name}_bucket{_lbl(e['labels'], le)} "
+                                 f"{int(c)}")
+                inf = 'le="+Inf"'
+                lines.append(f"{name}_bucket{_lbl(e['labels'], inf)} "
+                             f"{int(e['count'])}")
+                lines.append(f"{name}_sum{_lbl(e['labels'])} "
+                             f"{e['sum']:g}")
+                lines.append(f"{name}_count{_lbl(e['labels'])} "
+                             f"{int(e['count'])}")
+    return "\n".join(lines) + "\n"
+
+
+def merged_trace(col: Optional[Dict] = None) -> Dict:
+    """Per-peer ring tails fused into ONE Chrome trace: ``pid`` =
+    process_index, so Perfetto renders one track group per host
+    (telemetry/trace_export.cluster_trace)."""
+    from h2o3_tpu.telemetry import trace_export
+    col = col or collect()
+    nodes = {}
+    for n in sorted(col["nodes"]):
+        snap = col["nodes"][n]
+        label = f"h2o3-tpu node {n}"
+        host = snap.get("host")
+        if host:
+            label += f" ({host})"
+        if int(n) in col["stale_nodes"]:
+            label += " [stale]"
+        nodes[int(n)] = {"spans": snap.get("spans", []),
+                         "events": snap.get("events", []),
+                         "compiles": snap.get("compiles", []),
+                         "label": label}
+    return trace_export.cluster_trace(
+        nodes, extra={"cluster": True,
+                      "process_count": col["process_count"],
+                      "stale_nodes": col["stale_nodes"]})
+
+
+def merged_logs(col: Optional[Dict] = None,
+                level: Optional[str] = None,
+                last: Optional[int] = None) -> Dict:
+    """Merged, timestamp-ordered log tail with node ids."""
+    col = col or collect()
+    recs: List[Dict] = []
+    for n in sorted(col["nodes"]):
+        for r in col["nodes"][n].get("logs", []) or []:
+            rr = dict(r)
+            rr["node"] = int(rr.get("node", n))
+            recs.append(rr)
+    if level:
+        lv = str(level).upper()
+        recs = [r for r in recs if r.get("level") == lv]
+    recs.sort(key=lambda r: (r.get("ts_ms", 0), r.get("node", 0)))
+    if last is not None and last > 0:
+        recs = recs[-last:]
+    lines = [f"[node {r['node']}] {r.get('line', '')}" for r in recs]
+    return {"records": recs, "lines": lines,
+            "stale_nodes": col["stale_nodes"],
+            "process_count": col["process_count"]}
+
+
+def reset() -> None:
+    """Tests only — clear the publish rate limiter."""
+    global _last_publish, _seq
+    with _lock:
+        _last_publish = 0.0
+        _seq = 0

@@ -44,6 +44,47 @@ _compile_ring_lock = threading.Lock()
 _MAX_SHAPE_LABELS = 32
 _shape_labels: Dict[str, set] = {}
 
+# AOT replay sources for roofline accounting (telemetry/roofline.py):
+# on each fresh compile the observed jit entry point's call signature is
+# stashed as ABSTRACT shapes (jax.ShapeDtypeStruct — no device buffers
+# retained), so Compiled.cost_analysis() can later be taken off a
+# re-lowering of the exact executable the fit ran, without holding HBM.
+_aot_sources: Dict[str, tuple] = {}
+_aot_lock = threading.Lock()
+
+
+def _abstractify(x):
+    shape = getattr(x, "shape", None)
+    dtype = getattr(x, "dtype", None)
+    if isinstance(shape, tuple) and dtype is not None:
+        import jax
+        return jax.ShapeDtypeStruct(shape, dtype)
+    return x
+
+
+def _record_aot_source(name: str, jit_fn, args, kwargs) -> None:
+    try:
+        import jax
+        aargs = jax.tree_util.tree_map(_abstractify, args)
+        akwargs = {k: jax.tree_util.tree_map(_abstractify, v)
+                   for k, v in kwargs.items()}
+        with _aot_lock:
+            _aot_sources[name] = (jit_fn, aargs, akwargs)
+    except Exception:   # noqa: BLE001 - accounting must never break a fit
+        pass
+
+
+def aot_source(name: str):
+    """(jit_fn, abstract_args, abstract_kwargs) of the most recent fresh
+    compile of an observed entry point, or None."""
+    with _aot_lock:
+        return _aot_sources.get(name)
+
+
+def aot_source_names():
+    with _aot_lock:
+        return sorted(_aot_sources)
+
 _COMPILE_EVENTS = ("backend_compile_duration",      # jax >= 0.4.31
                    "backend_compile_time_sec")      # older spelling
 
@@ -147,6 +188,9 @@ def observed_jit(name: str) -> Callable:
                     else "jit_cache_hit_total", fn=name, shapes=sig).inc()
             if fresh:
                 spans.annotate(fresh_compile=name)
+                # miss-only: interning abstract shapes per call would tax
+                # hot entry points (ops.segment_sum) for nothing new
+                _record_aot_source(name, jit_fn, args, kwargs)
             return out
         return wrapper
     return deco

@@ -85,11 +85,18 @@ class JobTelemetry:
 
     __slots__ = ("job_key", "description", "start_ms", "end_ms", "status",
                  "spans", "events", "compiles", "logs", "metric_deltas",
-                 "dropped", "_counters0", "_lock")
+                 "dropped", "node", "_counters0", "_lock")
 
     def __init__(self, job_key: str, description: str):
         self.job_key = job_key
         self.description = description
+        # cloud identity: merged cluster views and single-file log
+        # shipping must stay attributable to the producing process
+        try:
+            from h2o3_tpu.utils.log import current_node
+            self.node = current_node()
+        except Exception:   # noqa: BLE001
+            self.node = 0
         self.start_ms = int(time.time() * 1000)
         self.end_ms = 0
         self.status = "RUNNING"
@@ -138,6 +145,7 @@ class JobTelemetry:
                 "job_key": self.job_key,
                 "description": self.description,
                 "status": self.status,
+                "node": self.node,
                 "start_ms": self.start_ms,
                 "end_ms": self.end_ms,
                 "duration_ms": (self.end_ms - self.start_ms)

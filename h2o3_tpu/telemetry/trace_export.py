@@ -94,12 +94,11 @@ def _meta_event(pid: int, tid: Optional[int], name: str, label: str) -> Dict:
             "args": {"name": label}}
 
 
-def build_trace(spans: Iterable[Dict], events: Iterable[Dict] = (),
-                compiles: Iterable[Dict] = (),
-                process_name: str = "h2o3-tpu",
-                extra: Optional[Dict] = None) -> Dict:
-    """Assemble Chrome trace JSON from already-snapshotted telemetry."""
-    pid = os.getpid()
+def _events_for(spans: Iterable[Dict], events: Iterable[Dict],
+                compiles: Iterable[Dict], pid: int,
+                process_name: str) -> List[Dict]:
+    """All trace events of ONE process/track-group (shared by the
+    single-process build_trace and the multi-node cluster_trace)."""
     spans = list(spans)
     tids, tid_labels = _span_tids(spans)
     out: List[Dict] = [_meta_event(pid, None, "process_name", process_name)]
@@ -114,6 +113,34 @@ def build_trace(spans: Iterable[Dict], events: Iterable[Dict] = (),
         out.append(_instant_event(e, pid, tid))
     for c in compiles:
         out.append(_compile_event(c, pid))
+    return out
+
+
+def build_trace(spans: Iterable[Dict], events: Iterable[Dict] = (),
+                compiles: Iterable[Dict] = (),
+                process_name: str = "h2o3-tpu",
+                extra: Optional[Dict] = None) -> Dict:
+    """Assemble Chrome trace JSON from already-snapshotted telemetry."""
+    out = _events_for(spans, events, compiles, os.getpid(), process_name)
+    trace = {"traceEvents": out, "displayTimeUnit": "ms",
+             "otherData": {"source": "h2o3_tpu.telemetry.trace_export"}}
+    if extra:
+        trace["otherData"].update(extra)
+    return trace
+
+
+def cluster_trace(nodes: Dict[int, Dict],
+                  extra: Optional[Dict] = None) -> Dict:
+    """Fuse per-peer ring tails into ONE Chrome trace: each node's
+    events carry ``pid`` = its process_index, so Perfetto renders one
+    track group per host (the telemetry/cluster.py ``?cluster=1``
+    payload). ``nodes[n]`` = {"spans", "events", "compiles", "label"}."""
+    out: List[Dict] = []
+    for n in sorted(nodes):
+        d = nodes[n]
+        out.extend(_events_for(d.get("spans", ()), d.get("events", ()),
+                               d.get("compiles", ()), int(n),
+                               d.get("label", f"h2o3-tpu node {n}")))
     trace = {"traceEvents": out, "displayTimeUnit": "ms",
              "otherData": {"source": "h2o3_tpu.telemetry.trace_export"}}
     if extra:

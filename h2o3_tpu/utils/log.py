@@ -44,8 +44,27 @@ _BUFFER: collections.deque = collections.deque(maxlen=_RING_CAPACITY)
 _LEVEL_BUFFERS: Dict[str, collections.deque] = {
     lvl: collections.deque(maxlen=_LEVEL_RING_CAPACITY)
     for lvl in ("DEBUG", "INFO", "WARNING", "ERROR", "CRITICAL")}
+# structured twin of _BUFFER: (ts_ms, level, line, node) dicts — what a
+# cluster-merged /3/Logs?cluster=1 tail sorts by (telemetry/cluster.py)
+_RECORDS: collections.deque = collections.deque(maxlen=_RING_CAPACITY)
 _setup_lock = threading.Lock()
 _file_path: Optional[str] = None
+
+# this process's cloud identity (jax process_index), stamped on every
+# record so merged cluster views and shipped log files stay
+# attributable. Set by core/cloud.py at init — NEVER read from
+# jax.process_index() here: logging runs before (and during) backend
+# bootstrap and must not re-enter it.
+_NODE = 0
+
+
+def set_node(node: int) -> None:
+    global _NODE
+    _NODE = int(node)
+
+
+def current_node() -> int:
+    return _NODE
 
 
 class ContextFilter(logging.Filter):
@@ -69,6 +88,7 @@ class ContextFilter(logging.Filter):
             pass
         record.span_id = span_id
         record.job_id = job_id
+        record.node = _NODE
         return True
 
 
@@ -82,6 +102,7 @@ class JsonFormatter(logging.Formatter):
              "msg": record.getMessage(),
              "span_id": getattr(record, "span_id", ""),
              "job_id": getattr(record, "job_id", ""),
+             "node": getattr(record, "node", _NODE),
              "thread": record.threadName}
         if record.exc_info:
             d["exc"] = self.formatException(record.exc_info)
@@ -111,6 +132,10 @@ class _RingHandler(logging.Handler):
         buf = _LEVEL_BUFFERS.get(record.levelname)
         if buf is not None:
             buf.append(line)
+        _RECORDS.append({"ts_ms": int(record.created * 1000),
+                         "level": record.levelname,
+                         "line": line,
+                         "node": getattr(record, "node", _NODE)})
         try:
             from h2o3_tpu.telemetry import flight_recorder
             if flight_recorder.is_recording():
@@ -121,6 +146,7 @@ class _RingHandler(logging.Handler):
                     "msg": record.getMessage(),
                     "span_id": getattr(record, "span_id", ""),
                     "job_id": getattr(record, "job_id", ""),
+                    "node": getattr(record, "node", _NODE),
                 })
         except Exception:   # noqa: BLE001 - capture is best-effort
             pass
@@ -218,6 +244,16 @@ def log_buffer(level: Optional[str] = None,
     if last is not None and last > 0:
         lines = lines[-last:]
     return lines
+
+
+def log_records(last: Optional[int] = None) -> List[Dict]:
+    """Structured recent records ({ts_ms, level, line, node}) — the
+    timestamp-ordered feed a cluster-merged log tail is built from
+    (telemetry/cluster.py publishes this ring's tail per peer)."""
+    recs = list(_RECORDS)
+    if last is not None and last > 0:
+        recs = recs[-last:]
+    return recs
 
 
 def log_file_path() -> Optional[str]:

@@ -274,6 +274,11 @@ def test_bench_wedged_config_costs_one_line(tmp_path):
                for e in trace["traceEvents"])
     wedge = by_metric["stub_wedge"][0]
     assert "wedged" in wedge["error"]
+    # the roofline stub emits the hardware-relative fields (ISSUE 8):
+    # every BENCH line carries mfu/hbm_util even without a backend
+    rf = next(v[0] for k, v in by_metric.items()
+              if k.startswith("roofline"))
+    assert rf["mfu"] > 0 and rf["hbm_util"] > 0
     budget = by_metric["budget"][0]
     assert budget["left_s"] >= 0.0
     assert budget["budget_s"] >= 0.0
@@ -308,8 +313,8 @@ def test_bench_dead_backend_fails_fast_per_config(tmp_path):
         "H2O3TPU_BENCH_CONFIG_TIMEOUT_S": "3"})
     assert p.returncode == 0, p.stderr[-2000:]
     errors = [ln for ln in lines if "error" in ln]
-    # one per stub config (incl. grid, treekernel, cloud)
-    assert len(errors) == 6
+    # one per stub config (incl. grid, treekernel, cloud, roofline)
+    assert len(errors) == 7
     assert all("backend dead" in ln["error"] for ln in errors)
     budget = [ln for ln in lines if ln["metric"] == "budget"][0]
     assert budget["left_s"] >= 0.0

@@ -347,6 +347,14 @@ def test_metric_names_documented_in_readme():
     assert not missing, (
         f"metric names not documented in README §Observability: "
         f"{missing}")
+    # the ISSUE 8 surface is part of the stable contract: the cluster
+    # fan-in + roofline names must stay documented even if a refactor
+    # moves their instrumentation call sites out of the literal scan
+    for required in ("model_fit_mfu", "model_fit_hbm_util",
+                     "roofline_fits_total", "cluster_publish_total",
+                     "cluster_publish_bytes", "cluster_stale_nodes",
+                     "jobs_inflight"):
+        assert required in section, required
 
 
 # ----------------------------------------------------------- REST tier
