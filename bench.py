@@ -641,23 +641,69 @@ def bench_treekernel():
         peak_hbm_gb=round(_hbm_peak() / 1e9, 2))
 
 
+def bench_checkpoint():
+    """In-fit checkpoint overhead (ISSUE 9): the SAME GBM fit with and
+    without FitCheckpointer snapshotting at the default 25-tree cadence
+    — the overhead %% is the acceptance number (<= 2%% of fit wall time
+    on the flagship config; core/recovery.py)."""
+    import tempfile
+
+    import h2o3_tpu
+    from h2o3_tpu import telemetry
+    from h2o3_tpu.core import recovery
+    from h2o3_tpu.models.gbm import GBMEstimator
+    n = 200_000 if FAST else 1_000_000
+    r = np.random.RandomState(11)
+    X = r.randn(n, 8).astype(np.float32)
+    yv = (X[:, 0] + 0.5 * X[:, 1] + 0.5 * r.randn(n) > 0).astype(int)
+    cols = {f"x{i}": X[:, i] for i in range(8)}
+    cols["y"] = np.array(["N", "Y"], object)[yv]
+    fr = h2o3_tpu.Frame.from_numpy(cols, categorical=["y"])
+    del X
+    kw = dict(ntrees=100, max_depth=6, seed=1)
+    wm = GBMEstimator(**{**kw, "ntrees": 25}).train(fr, y="y")  # warmup
+    from h2o3_tpu.core.kv import DKV
+    DKV.remove(wm.key)
+    t0 = time.time()
+    GBMEstimator(**kw).train(fr, y="y")
+    t_plain = time.time() - t0
+    d = tempfile.mkdtemp(prefix="h2o3tpu_bench_ckpt_")
+    w0 = telemetry.REGISTRY.total("fit_checkpoints_written_total")
+    with recovery.fit_checkpoint_scope(d):
+        t0 = time.time()
+        GBMEstimator(**kw).train(fr, y="y")
+        t_ckpt = time.time() - t0
+    writes = int(telemetry.REGISTRY.total("fit_checkpoints_written_total")
+                 - w0)
+    overhead_pct = 100.0 * (t_ckpt - t_plain) / max(t_plain, 1e-9)
+    _emit(
+        f"checkpoint GBM-100trees-d6 {n/1e3:.0f}K rows (in-fit "
+        f"snapshotting every 25 trees vs none)",
+        overhead_pct, "overhead_pct",
+        t_plain / max(t_ckpt, 1e-9), "same fit without checkpointing",
+        plain_seconds=round(t_plain, 2),
+        checkpointed_seconds=round(t_ckpt, 2),
+        snapshots_written=writes,
+        peak_hbm_gb=round(_hbm_peak() / 1e9, 2))
+
+
 CONFIGS = [("gbm", bench_gbm), ("glm", bench_glm), ("dl", bench_dl),
            ("xgb", bench_xgb), ("sort", bench_sort),
            ("grid", bench_grid), ("treekernel", bench_treekernel),
-           ("cloud", bench_cloud),
+           ("cloud", bench_cloud), ("checkpoint", bench_checkpoint),
            ("automl", bench_automl), ("gbm-full", bench_gbm_full)]
 
 # minimum seconds a config plausibly needs; skipped (with a JSON note)
 # rather than started when the remaining budget is below it
 _MIN_NEED = {"gbm": 60, "glm": 90, "dl": 60, "xgb": 60, "sort": 60,
              "grid": 120, "treekernel": 60, "cloud": 30, "automl": 180,
-             "gbm-full": 600}
+             "checkpoint": 90, "gbm-full": 600}
 
 # hard per-config wallclock cap (child process killed past it): a
 # wedged worker costs one line, never the scoreboard
 _HARD_CAP = {"gbm": 900, "glm": 600, "dl": 600, "xgb": 600, "sort": 400,
              "grid": 600, "treekernel": 400, "cloud": 300, "automl": 900,
-             "gbm-full": 1200}
+             "checkpoint": 600, "gbm-full": 1200}
 
 
 def _stub_ok(name):
@@ -763,6 +809,34 @@ def _stub_treekernel():
           float(rows), "rows/tile", 1.0, "stub", decisions=decisions)
 
 
+def _stub_checkpoint():
+    """Backend-free FitCheckpointer state machine: snapshot cadence,
+    atomic write, load, bit-flip quarantine (ISSUE 9)."""
+    import tempfile
+
+    from h2o3_tpu.core.recovery import FitCheckpointer
+    d = tempfile.mkdtemp(prefix="h2o3tpu_stub_ckpt_")
+    fc = FitCheckpointer(os.path.join(d, "gbm_stub.fitsnap"), "gbm", 5)
+    t0 = time.time()
+    n_snap = 0
+    for unit in range(5, 55, 5):
+        if fc.maybe_save(unit, lambda: {"done": unit,
+                                        "payload": b"x" * 4096}):
+            n_snap += 1
+    dt = max(time.time() - t0, 1e-9)
+    loaded = fc.load()
+    assert loaded is not None and loaded[0] == 50, loaded
+    with open(fc.path, "r+b") as f:       # bit-flip → quarantine
+        f.seek(2)
+        f.write(b"\xff\xff")
+    assert fc.load() is None
+    assert any(fn.endswith(".corrupt") for fn in os.listdir(d))
+    fc.clear()
+    _emit("checkpoint FitCheckpointer (stub; snapshot/load/quarantine "
+          "state machine, no backend)", n_snap / dt, "snapshots/sec",
+          1.0, "stub", snapshots=n_snap, quarantined=1)
+
+
 if STUB:
     CONFIGS = [("stub_a", _stub_ok("stub_a")),
                ("stub_wedge", _stub_wedge),
@@ -770,6 +844,7 @@ if STUB:
                ("treekernel", _stub_treekernel),
                ("cloud", _stub_cloud),
                ("roofline", _stub_roofline),
+               ("checkpoint", _stub_checkpoint),
                ("stub_b", _stub_ok("stub_b"))]
     _MIN_NEED = {n: 1 for n, _ in CONFIGS}
     _HARD_CAP = {n: 30 for n, _ in CONFIGS}

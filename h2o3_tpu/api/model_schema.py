@@ -323,6 +323,10 @@ def _params_v3(model: Model) -> List[dict]:
     for n in names:
         dv = defaults.get(n)
         av = model.params.get(n, dv)
+        if n == "checkpoint" and av is not None and not isinstance(av, str):
+            # a donor passed as a Model object serializes as its key
+            # (the wire type is Key<Model>, h2o-py sends the key string)
+            av = getattr(av, "key", str(av))
         # numpy scalars (e.g. np.bool_ from grid hyper expansion) must
         # become native JSON types, not str() — a wire "False" breaks
         # pyunit expect_model_param's float(actual) coercion

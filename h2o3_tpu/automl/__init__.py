@@ -81,6 +81,11 @@ class H2OAutoML:
         self._skip_steps: set = set()       # step ids done pre-crash
         self._prior_models: List = []       # models restored on resume
         self._step_models: dict = {}        # step id -> snapshot files
+        # snapshot-dir listing cache: each nested grid-step dir is read
+        # ONCE per run (one os.listdir), never one os.path.exists per
+        # model per step snapshot — resume_automl on a wide leaderboard
+        # paid a filesystem stat per restored model per step
+        self._snapshot_listing: dict = {}   # step id -> {relative paths}
         if balance_classes:
             log.warning("balance_classes is not implemented; ignoring")
 
@@ -173,6 +178,21 @@ class H2OAutoML:
             "models": self._step_models,
         })
 
+    def _step_snapshot_files(self, step_id: str) -> set:
+        """Relative snapshot paths under the step's nested recovery dir,
+        read with ONE os.listdir per step per run (cached — was one
+        os.path.exists per model per step snapshot)."""
+        cached = self._snapshot_listing.get(step_id)
+        if cached is not None:
+            return cached
+        sub = os.path.join(self._recovery.dir, step_id)
+        files: set = set()
+        if os.path.isdir(sub):
+            files = {f"{step_id}/{f}" for f in os.listdir(sub)
+                     if f.endswith(".bin")}
+        self._snapshot_listing[step_id] = files
+        return files
+
     def _on_step_done(self, step_id: str, models: List, y: str, x) -> None:
         """Persist leaderboard membership + step completion after every
         trained model reaches the leaderboard (Recovery.onModel role).
@@ -180,12 +200,12 @@ class H2OAutoML:
         everything else snapshots here."""
         if self._recovery is None:
             return
+        grid_files = self._step_snapshot_files(step_id)
         files = []
         for m in models:
-            fname = f"{m.key}.bin"
-            if os.path.exists(os.path.join(self._recovery.dir,
-                                           step_id, fname)):
-                files.append(f"{step_id}/{fname}")   # grid snapshot
+            rel = f"{step_id}/{m.key}.bin"
+            if rel in grid_files:
+                files.append(rel)                    # grid snapshot
             else:
                 files.append(self._recovery.save_model(m))
         self._step_models[step_id] = files
@@ -310,6 +330,13 @@ class H2OAutoML:
                     self._log_event("error",
                                     f"all-models ensemble failed: {e}")
 
+        if self._recovery is not None:
+            # the plan completed: unconsumed in-fit snapshots under the
+            # recovery dir (combo/model killed then resumed elsewhere)
+            # must not leak into the next resume
+            from h2o3_tpu.core import recovery as recovery_mod
+            recovery_mod.clear_fit_snapshots(
+                os.path.join(self._recovery.dir, "fit_state"))
         self._log_event("done",
                         f"{len(self.leaderboard_obj.models)} models in "
                         f"{time.time() - t0:.0f}s; leader="

@@ -193,7 +193,18 @@ def run_step(aml, step, budget: Budget, training_frame, y, x) -> List:
             params.setdefault("stopping_tolerance", aml.stopping_tolerance)
         params = {k: v for k, v in params.items()
                   if k in cls.accepted_params()}
-        m = train_capped(cls(**params), training_frame, y, x, budget)
+        if aml._recovery is not None:
+            # in-fit checkpoint composition (core/recovery.py): the
+            # model in flight snapshots INSIDE the recovery dir, so a
+            # SIGKILL mid-fit resumes inside the fit on the next
+            # resume_automl() — not from round 0 of the step
+            from h2o3_tpu.core import recovery as _recovery
+            with _recovery.fit_checkpoint_scope(
+                    os.path.join(aml._recovery.dir, "fit_state")):
+                m = train_capped(cls(**params), training_frame, y, x,
+                                 budget)
+        else:
+            m = train_capped(cls(**params), training_frame, y, x, budget)
         m.output["automl_step"] = step.id
         trained_count = 1
         return [m]

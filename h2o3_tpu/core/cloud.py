@@ -270,6 +270,14 @@ def shutdown() -> None:
     except Exception:       # noqa: BLE001 - cleaner is optional
         pass
     _sweep_coordination_keys()
+    try:
+        # orphaned FitCheckpointer tmp files / partial snapshot dirs
+        # (a kill mid-write leaves *.tmp debris; completed .fitsnap
+        # snapshots are resumable state and stay)
+        from h2o3_tpu.core import recovery as _recovery
+        _recovery.sweep_fit_checkpoints()
+    except Exception:       # noqa: BLE001 - sweep is best-effort
+        pass
     DKV.clear()
     mesh_mod.set_global_mesh(None)
     if _DISTRIBUTED:

@@ -39,6 +39,15 @@ class Config:
     infra_backoff_max_s: float = 30.0   # backoff ceiling
     # backend liveness probe deadline; 0 = unbounded (probe_backend)
     probe_timeout_s: float = 60.0
+    # -- in-fit checkpointing (core/recovery.py FitCheckpointer) -------
+    # directory for periodic mid-fit snapshots (GBM tree chunks, GLM
+    # lambda iterations, DL epoch boundaries); "" = off. Grid/AutoML
+    # recovery_dir= overrides this per combo via fit_checkpoint_scope
+    fit_checkpoint_dir: str = ""
+    # snapshot cadence in algo-native units (GBM trees / DL steps /
+    # GLM lambdas); 0 = per-algo default (GBM 25 trees, DL one epoch,
+    # GLM every lambda)
+    fit_checkpoint_every: int = 0
     # -- cloud formation + peer health (core/cloud.py, core/heartbeat.py)
     # coordinator-connect bound for jax.distributed.initialize AND the
     # post-init roll-call barrier; the analogue of the reference's
@@ -112,7 +121,8 @@ class Config:
                              "block_rows", "nbins", "infra_max_attempts",
                              "rest_max_inflight", "rest_queue_depth",
                              "rest_max_body_mb", "flight_recorder_keep",
-                             "heartbeat_miss_budget"})
+                             "heartbeat_miss_budget",
+                             "fit_checkpoint_every"})
     _FLOAT_FIELDS = frozenset({"infra_backoff_base_s", "infra_backoff_max_s",
                                "probe_timeout_s", "rest_queue_wait_s",
                                "cloud_timeout_s", "heartbeat_interval_s",

@@ -115,8 +115,12 @@ class Job:
                     flight_recorder.publish(rec_cell[0])
                 # bounded retries for infra-class errors only, under the
                 # shared watchdog policy (backoff + jitter, attempts from
-                # core/config.py). The work restarts from scratch — model
-                # builds are idempotent; progress just re-accumulates.
+                # core/config.py). Supervisor contract: when the failed
+                # work left an in-fit snapshot (core/recovery.py
+                # FitCheckpointer), re-entering the fit resumes from it
+                # instead of round 0 — the builder consults the same
+                # checkpointer on entry; otherwise the work restarts
+                # from scratch (model builds are idempotent).
                 policy = watchdog.policy_from_config()
                 attempt = 0
                 while True:
@@ -138,19 +142,38 @@ class Job:
                             # snapshot/resume is the comeback path
                             raise
                         delay = policy.delay(attempt)
-                        log.warning("job %s: retrying after infra error "
-                                    "in %.1fs (attempt %d/%d): %s",
-                                    self.key, delay, attempt,
-                                    policy.max_attempts, e)
-                        _tl("job", f"infra-retry {self.description}",
-                            key=self.key, error=str(e)[:200])
+                        # consult the in-fit checkpointer: a surviving
+                        # snapshot means the retry RE-ENTERS the fit at
+                        # its last persisted boundary (bit-identical
+                        # continuation) instead of restarting at round 0
+                        from h2o3_tpu.core import recovery as _recovery
+                        snap = _recovery.thread_fit_snapshot()
+                        if snap is not None:
+                            log.warning(
+                                "job %s: infra error; supervisor will "
+                                "resume the %s fit from its snapshot "
+                                "(unit %d) in %.1fs (attempt %d/%d): %s",
+                                self.key, snap[2], snap[1], delay,
+                                attempt, policy.max_attempts, e)
+                            _tl("job",
+                                f"infra-resume {self.description}",
+                                key=self.key, unit=snap[1],
+                                error=str(e)[:200])
+                        else:
+                            log.warning(
+                                "job %s: retrying after infra error "
+                                "in %.1fs (attempt %d/%d): %s",
+                                self.key, delay, attempt,
+                                policy.max_attempts, e)
+                            _tl("job", f"infra-retry {self.description}",
+                                key=self.key, error=str(e)[:200])
+                            self._worked = 0.0
                         telemetry.counter("infra_retries_total",
                                           site="job").inc()
                         if "RESOURCE_EXHAUSTED" in f"{e}":
                             # HBM pressure: purge executable caches
                             # before the retry or it just exhausts again
                             free_device_memory("RESOURCE_EXHAUSTED retry")
-                        self._worked = 0.0
                         policy.sleep(delay)
                 if self.dest and self.result is not None:
                     DKV.put(self.dest, self.result)

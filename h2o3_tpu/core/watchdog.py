@@ -28,9 +28,10 @@ This module centralizes what used to be scattered one-shot retries
   the cloud heartbeat (core/heartbeat.py).
 - fault injection        — ``inject_fault()`` / ``H2O3TPU_FAULTS`` plant
   classified failures at named sites (``probe``, ``job``,
-  ``frame_reduce``, ``frame_map``, ``heartbeat``, ``cloud_init``) so
-  every retry/degradation path runs in tier-1 CPU tests instead of
-  waiting for a real TPU crash.
+  ``frame_reduce``, ``frame_map``, ``heartbeat``, ``cloud_init``,
+  ``fit_chunk`` — the GBM/GLM/DL training-loop host boundaries where
+  the FitCheckpointer snapshots) so every retry/degradation path runs
+  in tier-1 CPU tests instead of waiting for a real TPU crash.
 
 Telemetry: ``backend_probes_total``, ``backend_probe_failures_total``,
 ``infra_retries_total{site=}`` (README §Fault tolerance).

@@ -15,6 +15,7 @@ stopping, recovery snapshots and leaderboard order exactly.
 from __future__ import annotations
 
 import itertools
+import os
 import time
 from typing import Dict, List, Optional, Sequence
 
@@ -196,6 +197,12 @@ class GridSearch:
         failures: List[dict] = []
         job = Job(f"grid {self.builder_cls.algo}", work=float(len(combos)))
         job.status = "RUNNING"
+        # recovery composition (core/recovery.py FitCheckpointer): with
+        # a recovery_dir, every sequential combo trains under an in-fit
+        # checkpoint scope INSIDE the dir — a SIGKILL mid-combo resumes
+        # inside the combo on the next resume_grid(), not at combo start
+        fit_dir = (os.path.join(self.recovery_dir, "fit_state")
+                   if self.recovery_dir else None)
         # ---- model-batched pre-training (parallel/model_batch.py) ----
         # eligible shape buckets train as ONE vmapped program up front;
         # the walk below then consumes the pre-trained models in combo
@@ -217,9 +224,11 @@ class GridSearch:
             try:
                 m = pre.pop(i, None)
                 if m is None:
+                    from h2o3_tpu.core import recovery as _recovery
                     b = self.builder_cls(**params)
-                    m = b.train(training_frame, y=y, x=x,
-                                validation_frame=validation_frame)
+                    with _recovery.fit_checkpoint_scope(fit_dir):
+                        m = b.train(training_frame, y=y, x=x,
+                                    validation_frame=validation_frame)
                 telemetry.counter("grid_models_total",
                                   algo=self.builder_cls.algo).inc()
                 m.output["grid_params"] = combo
@@ -249,6 +258,11 @@ class GridSearch:
         # them, so they must not linger in the store either
         for m in pre.values():
             DKV.remove(m.key)
+        if fit_dir:
+            # the walk completed: unconsumed in-fit snapshots (e.g. a
+            # combo that got batch-trained on resume) must not leak
+            from h2o3_tpu.core import recovery as _recovery
+            _recovery.clear_fit_snapshots(fit_dir)
         job.status = "DONE"
         sort_metric = (self.criteria.get("sort_metric")
                        or (default_sort_metric(models[0]) if models else "mse"))

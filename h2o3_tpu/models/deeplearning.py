@@ -28,11 +28,16 @@ import numpy as np
 
 from h2o3_tpu.parallel.mesh import fetch_replicated as _fetch_np
 
+from h2o3_tpu.core import recovery as _recovery
+from h2o3_tpu.core.watchdog import maybe_fail
 from h2o3_tpu.frame.datainfo import build_datainfo, stats_of
 from h2o3_tpu.frame.frame import Frame
 from h2o3_tpu.models import metrics as mm
 from h2o3_tpu.models.model import (EarlyStopper, Model, ModelBuilder,
-                                   ModelCategory, adapt_domain, infer_category)
+                                   ModelCategory, adapt_domain,
+                                   checkpoint_error, infer_category,
+                                   resolve_checkpoint_model,
+                                   validate_checkpoint_params)
 from h2o3_tpu.parallel.mesh import get_mesh, row_sharding, shard_rows
 from h2o3_tpu.telemetry import observed_jit
 
@@ -433,23 +438,42 @@ class DeepLearningEstimator(ModelBuilder):
         seed = int(p["seed"]) if int(p["seed"]) >= 0 else 0xD1
         key = jax.random.PRNGKey(seed)
         key, kinit = jax.random.split(key)
+        done0 = 0
+        prior_opt = prior_key = None
         if p.get("checkpoint") is not None:
-            # resume weights from a prior model (DeepLearningModelInfo
-            # checkpoint restart semantics)
-            from h2o3_tpu.core.kv import DKV
-            ck = p["checkpoint"]
-            prior = ck if isinstance(ck, DeepLearningModel) else DKV.get(str(ck))
-            if prior is None or prior.algo != "deeplearning":
-                raise ValueError(f"checkpoint model '{ck}' not found")
+            # checkpoint restart (DeepLearningModelInfo semantics):
+            # ``epochs`` names the new TOTAL and training CONTINUES from
+            # the donor's step count, the optimizer state is restored so
+            # ADADELTA accumulators / momentum do not cold-start, and
+            # the minibatch PRNG stream resumes where the donor stopped
+            prior = resolve_checkpoint_model(
+                "deeplearning", p["checkpoint"], DeepLearningModel)
             shapes = [tuple(np.asarray(l["W"]).shape) for l in prior.net]
             want = [(sizes[i], sizes[i + 1] * (2 if act == "maxout"
                                                and i < len(sizes) - 2 else 1))
                     for i in range(len(sizes) - 1)]
             if shapes != want:
-                raise ValueError("hidden layout cannot change across "
-                                 "checkpoint restart")
+                raise checkpoint_error(
+                    "deeplearning", "hidden",
+                    "Field _hidden cannot be modified if checkpoint is "
+                    "provided (hidden layout cannot change across "
+                    "checkpoint restart)")
+            validate_checkpoint_params(
+                "deeplearning", prior.params, p,
+                ("activation", "standardize", "adaptive_rate",
+                 "use_all_factor_levels", "autoencoder"))
+            prior_epochs = float(prior.params.get("epochs", 0.0))
+            if float(p["epochs"]) <= prior_epochs:
+                raise checkpoint_error(
+                    "deeplearning", "epochs",
+                    f"If checkpoint is provided, epochs ({p['epochs']}) "
+                    "must be higher than the checkpoint model's epochs "
+                    f"({prior_epochs})")
             params_net = [{"W": jnp.asarray(l["W"]), "b": jnp.asarray(l["b"])}
                           for l in prior.net]
+            done0 = int(getattr(prior, "_steps_trained", 0) or 0)
+            prior_opt = getattr(prior, "_opt_state", None)
+            prior_key = getattr(prior, "_prng_key", None)
         else:
             params_net = _init_params(kinit, sizes, act == "maxout")
 
@@ -470,6 +494,12 @@ class DeepLearningEstimator(ModelBuilder):
                               "mu": jnp.float32(p["momentum_start"])}
                           for k in ("W", "b")}
                          for l in params_net]
+        if prior_opt is not None:
+            # optimizer state continues across the restart (adaptive_rate
+            # is validated non-modifiable and layer shapes match)
+            opt_state = jax.tree_util.tree_map(jnp.asarray, prior_opt)
+        if prior_key is not None:
+            key = jnp.asarray(prior_key)
 
         batch = int(p["mini_batch_size"])
         if batch <= 1:
@@ -522,7 +552,31 @@ class DeepLearningEstimator(ModelBuilder):
         # eval itself is the jitted program, never the eager layer loop
         score_stride = max(chunk, -(-total_steps // 10))
         next_score = score_stride
-        done = 0
+        # checkpoint= continuation starts at the donor's step count (the
+        # lr/momentum schedules read the GLOBAL step, so annealing
+        # continues rather than restarting)
+        done = min(done0, total_steps)
+        # in-fit checkpointer (core/recovery.py): epoch-boundary partial
+        # state — net, optimizer state, PRNG key, early-stop + scoring
+        # history — so a killed fit resumes bit-identically
+        fc = None
+        if getattr(self, "_cv_fold_mask", None) is None:
+            fc = _recovery.fit_checkpointer(
+                "deeplearning", p, y, x, frame.nrows,
+                default_every=max(chunk, int(round(n / max(batch, 1)))))
+            if fc is not None:
+                _loaded = fc.load()
+                if _loaded is not None:
+                    _st = _loaded[1]
+                    done = int(_st["done"])
+                    params_net = jax.tree_util.tree_map(
+                        jnp.asarray, _st["net"])
+                    opt_state = jax.tree_util.tree_map(
+                        jnp.asarray, _st["opt"])
+                    key = jnp.asarray(_st["key"])
+                    next_score = _st["next_score"]
+                    stopper.history = list(_st["stop_hist"])
+                    scoring_history = list(_st["scoring_history"])
         from h2o3_tpu import telemetry
         while done < total_steps:
             k = min(chunk, total_steps - done)
@@ -552,6 +606,19 @@ class DeepLearningEstimator(ModelBuilder):
                 scoring_history.append({"step": done, "loss": lv})
                 if stopper.should_stop(lv):
                     break
+            if fc is not None:
+                _d = done
+                fc.maybe_save(done, lambda: {
+                    "done": _d,
+                    "net": jax.tree_util.tree_map(np.asarray, params_net),
+                    "opt": jax.tree_util.tree_map(np.asarray, opt_state),
+                    "key": np.asarray(key),
+                    "next_score": next_score,
+                    "stop_hist": list(stopper.history),
+                    "scoring_history": list(scoring_history)})
+            maybe_fail("fit_chunk")
+        if fc is not None:
+            fc.clear()
 
         rc = None if (auto_enc or y is None) else frame.col(y)
         output = {"category": category or "AutoEncoder", "response": y,
@@ -564,6 +631,12 @@ class DeepLearningEstimator(ModelBuilder):
         model = DeepLearningModel(p, output, params_net, stats_of(di),
                                   list(x), act, bool(p["standardize"]),
                                   resp_stats)
+        # continuation state for checkpoint= restarts (host-lowered so a
+        # pickled model restarts on any mesh): optimizer accumulators,
+        # global step count, and the minibatch PRNG position
+        model._opt_state = jax.tree_util.tree_map(np.asarray, opt_state)
+        model._steps_trained = int(done)
+        model._prng_key = np.asarray(key)
         # training_metrics below re-scores `frame`: hand it the design
         # we already expanded instead of rebuilding it
         global _DESIGN_MEMO

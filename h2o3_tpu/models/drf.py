@@ -35,7 +35,9 @@ from h2o3_tpu.frame.binning import BinnedMatrix, bin_frame, rebin_for_scoring
 from h2o3_tpu.frame.frame import Frame
 from h2o3_tpu.models import metrics as mm
 from h2o3_tpu.models.model import (Model, ModelBuilder, ModelCategory,
-                                   adapt_domain, infer_category)
+                                   adapt_domain, checkpoint_error,
+                                   infer_category, resolve_checkpoint_model,
+                                   validate_checkpoint_params)
 from h2o3_tpu.models.tree import (Tree, TreeParams, bucket_depth,
                                   exact_f32_for, grow_tree, predict_forest,
                                   scalars_of, stack_trees)
@@ -255,8 +257,14 @@ class DRFEstimator(ModelBuilder):
         stopping_tolerance=1e-3, binomial_double_trees=False,
         distribution="auto", calibrate_model=False,
         calibration_frame=None, calibration_method="PlattScaling",
-        histogram_type="auto",
+        histogram_type="auto", checkpoint=None,
     )
+
+    # SharedTree checkpoint-non-modifiable parameters (hex/tree/
+    # SharedTree CHECKPOINT_NON_MODIFIABLE_FIELDS + DRF's own knobs)
+    CHECKPOINT_NON_MODIFIABLE = (
+        "max_depth", "min_rows", "nbins", "nbins_cats", "sample_rate",
+        "mtries", "histogram_type", "binomial_double_trees")
 
     def __init__(self, **params):
         merged = dict(self.DEFAULTS)
@@ -286,8 +294,36 @@ class DRFEstimator(ModelBuilder):
             w = w * jnp.asarray(np.pad(
                 (~resp_na_host).astype(np.float32),
                 (0, frame.nrows_padded - frame.nrows)))
+        # checkpoint restart (SharedTree _checkpoint semantics): reuse
+        # the donor's bin edges so its trees stay valid, continue the
+        # PRNG key chain, and append trees up to the new ntrees
+        ckpt = None
+        ck = p.get("checkpoint")
+        if ck is not None:
+            ckpt = resolve_checkpoint_model("drf", ck, DRFModel)
+            if ckpt.output["response"] != y:
+                raise checkpoint_error(
+                    "drf", "response_column",
+                    "Field _response_column cannot be modified if "
+                    "checkpoint is provided (checkpoint response "
+                    f"mismatch: {ckpt.output['response']!r} vs {y!r})")
+            if list(ckpt.bm.names) != list(x):
+                raise checkpoint_error(
+                    "drf", "ignored_columns",
+                    "The predictor set cannot be modified if checkpoint "
+                    "is provided (checkpoint feature set mismatch)")
+            if ckpt.output["category"] != category:
+                raise checkpoint_error(
+                    "drf", "response_column",
+                    "checkpoint model category mismatch "
+                    f"({ckpt.output['category']} vs {category})")
+            validate_checkpoint_params("drf", ckpt.params, p,
+                                       self.CHECKPOINT_NON_MODIFIABLE)
+
         shared_bm = getattr(self, "_cv_shared_bm", None)
-        if shared_bm is not None:
+        if ckpt is not None:
+            bm = rebin_for_scoring(ckpt.bm, frame)
+        elif shared_bm is not None:
             bm = shared_bm
         else:
             bm = bin_frame(frame, x, nbins=p["nbins"],
@@ -364,6 +400,22 @@ class DRFEstimator(ModelBuilder):
         seed = int(p["seed"]) if int(p["seed"]) >= 0 else 0xD2F
         key = jax.random.PRNGKey(seed)
         ntrees = int(p["ntrees"])
+        prior_T = 0
+        if ckpt is not None:
+            prior_T = ckpt.forest.feat.shape[0] // max(K, 1)
+            if ntrees <= prior_T:
+                raise checkpoint_error(
+                    "drf", "ntrees",
+                    f"If checkpoint is provided, ntrees ({ntrees}) must "
+                    f"exceed the checkpoint model's tree count "
+                    f"({prior_T})")
+            # _bag_scan's key carry is split once per tree, so prior_T
+            # host-side splits reproduce the evolved chain exactly: the
+            # appended trees are bit-equal to trees prior_T.. of a
+            # single longer run with the same seed
+            for _ in range(prior_T):
+                key, _sub = jax.random.split(key)
+            ntrees = ntrees - prior_T
         output = {"category": category, "response": y, "names": list(x),
                   "nclasses": rc.cardinality if rc.is_categorical else 1,
                   "domain": rc.domain}
@@ -410,6 +462,29 @@ class DRFEstimator(ModelBuilder):
                 sample_rate=float(p["sample_rate"]), mtries=mtries,
                 n_class=K, ntrees=ntrees)
             job.update(1.0, f"{ntrees} trees")
+        if ckpt is not None:
+            if ckpt.forest.feat.shape[1:] != forest.feat.shape[1:]:
+                raise checkpoint_error(
+                    "drf", "training_frame",
+                    "checkpoint restart requires a compatible training "
+                    "frame (donor tree layout "
+                    f"{tuple(ckpt.forest.feat.shape[1:])} vs "
+                    f"{tuple(forest.feat.shape[1:])})")
+            forest = Tree(*(jnp.concatenate([getattr(ckpt.forest, f),
+                                             getattr(forest, f)])
+                            for f in Tree._fields))
+            prior_oob = getattr(ckpt, "_oob", None)
+            if prior_oob is not None and \
+                    tuple(prior_oob[0].shape) == tuple(oob_sum.shape):
+                # OOB accumulators continue: training metrics of the
+                # combined forest are what one longer run would report
+                oob_sum = oob_sum + jnp.asarray(prior_oob[0])
+                oob_cnt = oob_cnt + jnp.asarray(prior_oob[1])
+            else:
+                log.warning("drf checkpoint: donor carries no matching "
+                            "OOB accumulators; OOB training metrics "
+                            "reflect only the appended trees")
+            ntrees = ntrees + prior_T
         model = DRFModel(p, output, forest, bm, ntrees)
         if getattr(self, "_cv_light", False):
             # near-LOO CV fold fit (ml/cv.py): skip OOB metrics / varimp
@@ -423,6 +498,9 @@ class DRFEstimator(ModelBuilder):
             model.output["varimp"] = []
             return model
         gains_total = np.asarray(gains_dev)
+        # host-lowered OOB accumulators ride the model so a checkpoint=
+        # restart can CONTINUE them (pickled device-independent)
+        model._oob = (np.asarray(oob_sum), np.asarray(oob_cnt))
 
         # OOB training metrics (rows never out-of-bag drop out via weight)
         w_oob = w * (oob_cnt > 0).astype(jnp.float32)

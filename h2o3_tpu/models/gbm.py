@@ -28,12 +28,16 @@ import numpy as np
 
 from h2o3_tpu.parallel.mesh import fetch_replicated as _fetch_np
 
+from h2o3_tpu.core import recovery as _recovery
+from h2o3_tpu.core.watchdog import maybe_fail
 from h2o3_tpu.frame.binning import BinnedMatrix, bin_frame, rebin_for_scoring
 from h2o3_tpu.frame.frame import Frame
 from h2o3_tpu.models import metrics as mm
 from h2o3_tpu.models.distribution import Distribution, get_distribution
 from h2o3_tpu.models.model import (Model, ModelBuilder, ModelCategory,
-                                   infer_category)
+                                   checkpoint_error, infer_category,
+                                   resolve_checkpoint_model,
+                                   validate_checkpoint_params)
 from h2o3_tpu.models.tree import (Tree, TreeParams, TreeScalars,
                                   bucket_depth, concat_forests,
                                   exact_f32_for, grow_tree,
@@ -47,6 +51,22 @@ from h2o3_tpu.telemetry import observed_jit
 from h2o3_tpu.utils.log import get_logger
 
 log = get_logger("h2o3_tpu.gbm")
+
+# SharedTree checkpoint-non-modifiable parameters (hex/tree/SharedTree
+# CHECKPOINT_NON_MODIFIABLE_FIELDS): structural knobs a restart cannot
+# change without invalidating the donor model's trees/bin edges
+CHECKPOINT_NON_MODIFIABLE = ("max_depth", "min_rows", "nbins",
+                             "nbins_cats", "sample_rate")
+
+
+def _tree_host(t: Tree) -> dict:
+    """Device-independent (numpy) image of a stacked forest — the
+    FitCheckpointer snapshot payload."""
+    return {f: np.asarray(getattr(t, f)) for f in Tree._fields}
+
+
+def _tree_dev(d: dict) -> Tree:
+    return Tree(*(jnp.asarray(d[f]) for f in Tree._fields))
 
 
 def _tree_keys(key, tree0, ntrees: int):
@@ -687,21 +707,32 @@ class GBMEstimator(ModelBuilder):
         ckpt: Optional[GBMModel] = None
         ck = p.get("checkpoint")
         if ck is not None:
-            from h2o3_tpu.core.kv import DKV
-            ckpt = ck if isinstance(ck, GBMModel) else DKV.get(str(ck))
-            if ckpt is None or ckpt.algo != "gbm":
-                raise ValueError(f"checkpoint model '{ck}' not found")
+            ckpt = resolve_checkpoint_model("gbm", ck, GBMModel)
             if ckpt.output["response"] != y:
-                raise ValueError("checkpoint response mismatch")
+                raise checkpoint_error(
+                    "gbm", "response_column",
+                    "Field _response_column cannot be modified if "
+                    "checkpoint is provided (checkpoint response "
+                    f"mismatch: {ckpt.output['response']!r} vs {y!r})")
             if list(ckpt.bm.names) != list(x):
-                raise ValueError("checkpoint feature set mismatch")
+                raise checkpoint_error(
+                    "gbm", "ignored_columns",
+                    "The predictor set cannot be modified if checkpoint "
+                    "is provided (checkpoint feature set mismatch)")
             if ckpt.output["category"] != category:
-                raise ValueError("checkpoint model category mismatch "
-                                 f"({ckpt.output['category']} vs {category})")
+                raise checkpoint_error(
+                    "gbm", "response_column",
+                    "checkpoint model category mismatch "
+                    f"({ckpt.output['category']} vs {category})")
             if ckpt.dist_name != dist_name:
-                raise ValueError(
-                    "distribution cannot change across checkpoint restart "
-                    f"({ckpt.dist_name} vs {dist_name})")
+                raise checkpoint_error(
+                    "gbm", "distribution",
+                    "Field _distribution cannot be modified if "
+                    "checkpoint is provided: distribution cannot change "
+                    f"across checkpoint restart ({ckpt.dist_name} vs "
+                    f"{dist_name})")
+            validate_checkpoint_params("gbm", ckpt.params, p,
+                                       CHECKPOINT_NON_MODIFIABLE)
 
         # device weights + an equal HOST mirror (_host_weights): every
         # host-side consumer (bin sketch, init means, priors) reads the
@@ -801,18 +832,13 @@ class GBMEstimator(ModelBuilder):
             K_ck = (ckpt.output.get("nclasses", 1)
                     if ckpt.output["category"] == ModelCategory.MULTINOMIAL
                     else 1)
-            # forest arrays are sized at the compile BUCKET of max_depth,
-            # so compare the recorded param, not the array shape
-            ck_depth = int(ckpt.params.get("max_depth",
-                                           ckpt.forest.feat.shape[1]))
-            if ck_depth != int(p["max_depth"]):
-                raise ValueError("max_depth cannot change across checkpoint "
-                                 "restart (reference non-modifiable param)")
             prior_T = ckpt.forest.feat.shape[0] // K_ck
             if ntrees <= prior_T:
-                raise ValueError(
-                    f"ntrees ({ntrees}) must exceed the checkpoint's "
-                    f"tree count ({prior_T})")
+                raise checkpoint_error(
+                    "gbm", "ntrees",
+                    f"If checkpoint is provided, ntrees ({ntrees}) must "
+                    f"exceed the checkpoint model's tree count "
+                    f"({prior_T})")
             ntrees = ntrees - prior_T
         output = {"category": category, "response": y, "names": list(x),
                   "nclasses": rc.cardinality if rc.is_categorical else 1,
@@ -824,6 +850,20 @@ class GBMEstimator(ModelBuilder):
                                float(p["stopping_tolerance"]))
         score_interval = int(p["score_tree_interval"]) or 5
         scoring_history: List[dict] = []
+        # in-fit checkpointer (core/recovery.py): every K trees the
+        # chunk host boundary persists device-independent partial state
+        # (forest so far, margins, PRNG-independent counters, early-stop
+        # + scoring history) so a killed fit resumes bit-identically.
+        # CV fold fits skip it — their params fingerprint would collide
+        # and fold models are discarded after holdout scoring anyway.
+        fc = fc_state = None
+        if not light and getattr(self, "_cv_fold_mask", None) is None:
+            fc = _recovery.fit_checkpointer("gbm", p, y, x, frame.nrows,
+                                            default_every=25)
+            if fc is not None:
+                _loaded = fc.load()
+                if _loaded is not None:
+                    fc_state = _loaded[1]
         # early stopping watches the validation set when given, else training
         # (reference ScoreKeeper semantics, hex/tree/SharedTree.java)
         vbm = val_y = val_w = None
@@ -885,6 +925,16 @@ class GBMEstimator(ModelBuilder):
                 vm_ = jnp.zeros((1, K), jnp.float32)
             chunks_m: List[Tree] = []
             done = 0
+            if fc_state is not None and fc_state.get("path") == "multi":
+                done = int(fc_state["done"])
+                if fc_state["trees"] is not None:
+                    chunks_m.append(_tree_dev(fc_state["trees"]))
+                margins = put_sharded(jnp.asarray(fc_state["margins"]),
+                                      row_sharding(mesh))
+                vm_ = jnp.asarray(fc_state["vm"])
+                gains_total = fc_state["gains_total"].copy()
+                stopper.history = list(fc_state["stop_hist"])
+                scoring_history = list(fc_state["scoring_history"])
             while done < ntrees:
                 kk = min(_chunk, ntrees - done)
                 _ct0 = time.time()
@@ -912,7 +962,22 @@ class GBMEstimator(ModelBuilder):
                 done += keep
                 job.update(kk / ntrees, f"tree {done}/{ntrees}")
                 if keep < kk:
+                    # early stop: the fit completes right after; a crash
+                    # past this point replays from the last boundary and
+                    # stops at the same tree (deterministic stopper)
                     break
+                if fc is not None:
+                    _d, _mg, _vm = done, margins, vm_
+                    fc.maybe_save(done, lambda: {
+                        "path": "multi", "done": _d,
+                        "trees": (_tree_host(concat_forests(chunks_m))
+                                  if chunks_m else None),
+                        "margins": np.asarray(_mg),
+                        "vm": np.asarray(_vm),
+                        "gains_total": gains_total.copy(),
+                        "stop_hist": list(stopper.history),
+                        "scoring_history": list(scoring_history)})
+                maybe_fail("fit_chunk")
                 if _deadline and time.time() > _deadline:
                     log.info("max_runtime_secs: GBM stopping at %d/%d "
                              "trees", done, ntrees)
@@ -993,6 +1058,13 @@ class GBMEstimator(ModelBuilder):
                 # job.update keeps progress reporting + cancellation live
                 chunks = []
                 done = 0
+                if fc_state is not None and fc_state.get("path") == "plain":
+                    done = int(fc_state["done"])
+                    if fc_state["trees"] is not None:
+                        chunks.append(_tree_dev(fc_state["trees"]))
+                    margin = put_sharded(jnp.asarray(fc_state["margin"]),
+                                         row_sharding(mesh))
+                    gains_total = fc_state["gains_total"].copy()
                 while done < ntrees:
                     k = min(_chunk, ntrees - done)
                     _ct0 = time.time()
@@ -1012,6 +1084,15 @@ class GBMEstimator(ModelBuilder):
                         gains_total += np.asarray(gains)
                     done += k
                     job.update(k / ntrees, f"tree {done}/{ntrees}")
+                    if fc is not None:
+                        _d, _mg = done, margin
+                        fc.maybe_save(done, lambda: {
+                            "path": "plain", "done": _d,
+                            "trees": (_tree_host(concat_forests(chunks))
+                                      if chunks else None),
+                            "margin": np.asarray(_mg),
+                            "gains_total": gains_total.copy()})
+                    maybe_fail("fit_chunk")
                     if _deadline and time.time() > _deadline:
                         log.info("max_runtime_secs: GBM stopping at "
                                  "%d/%d trees", done, ntrees)
@@ -1032,6 +1113,16 @@ class GBMEstimator(ModelBuilder):
                     vm_ = jnp.zeros((1,), jnp.float32)
                 chunks = []
                 done = 0
+                if fc_state is not None and fc_state.get("path") == "scored":
+                    done = int(fc_state["done"])
+                    if fc_state["trees"] is not None:
+                        chunks.append(_tree_dev(fc_state["trees"]))
+                    margin = put_sharded(jnp.asarray(fc_state["margin"]),
+                                         row_sharding(mesh))
+                    vm_ = jnp.asarray(fc_state["vm"])
+                    gains_total = fc_state["gains_total"].copy()
+                    stopper.history = list(fc_state["stop_hist"])
+                    scoring_history = list(fc_state["scoring_history"])
                 while done < ntrees:
                     k = min(_chunk, ntrees - done)
                     _ct0 = time.time()
@@ -1059,6 +1150,18 @@ class GBMEstimator(ModelBuilder):
                     job.update(k / ntrees, f"tree {done}/{ntrees}")
                     if keep < k:
                         break
+                    if fc is not None:
+                        _d, _mg, _vm = done, margin, vm_
+                        fc.maybe_save(done, lambda: {
+                            "path": "scored", "done": _d,
+                            "trees": (_tree_host(concat_forests(chunks))
+                                      if chunks else None),
+                            "margin": np.asarray(_mg),
+                            "vm": np.asarray(_vm),
+                            "gains_total": gains_total.copy(),
+                            "stop_hist": list(stopper.history),
+                            "scoring_history": list(scoring_history)})
+                    maybe_fail("fit_chunk")
                     if _deadline and time.time() > _deadline:
                         log.info("max_runtime_secs: GBM stopping at "
                                  "%d/%d trees", done, ntrees)
@@ -1084,6 +1187,9 @@ class GBMEstimator(ModelBuilder):
                     dist.link_inv(mfin), y_dev, w,
                     deviance_fn=lambda yy, pp: dist.deviance(yy, mfin))
 
+        if fc is not None:
+            # training finished: a completed model must never resume
+            fc.clear()
         model.output["scoring_history"] = scoring_history
         if light:
             model.output["varimp"] = None

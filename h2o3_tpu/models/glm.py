@@ -1038,6 +1038,8 @@ class GLMEstimator(ModelBuilder):
                                         "coordinate_descent_naive",
                                         "l_bfgs", "lbfgs"))
         from h2o3_tpu import telemetry
+        from h2o3_tpu.core import recovery as _recovery
+        from h2o3_tpu.core.watchdog import maybe_fail
         if fuse_path:
             # whole regularization path in ONE compiled scan of IRLS
             # while_loops (pyunit_glm_seed: 30 lambdas x CV folds paid a
@@ -1061,7 +1063,27 @@ class GLMEstimator(ModelBuilder):
                 len(lambdas) * int(p["max_iterations"]))
             job.update(1.0, f"lambda path ({len(lambdas)})")
         else:
+            # in-fit checkpointer (core/recovery.py): the IRLS outer
+            # walk's host boundary is the lambda step — snapshot the
+            # warm-start coefficients + path position so a killed
+            # multi-lambda fit resumes at the next lambda, bit-identical
+            # (the fused path is ONE dispatch and has no mid-state)
+            fc = None
+            li0 = 0
+            if len(lambdas) > 1 and \
+                    getattr(self, "_cv_fold_mask", None) is None:
+                fc = _recovery.fit_checkpointer(
+                    "glm", p, y, x, frame.nrows, default_every=1)
+                if fc is not None:
+                    _loaded = fc.load()
+                    if _loaded is not None:
+                        _st = _loaded[1]
+                        li0 = int(_st["li"])
+                        coef = np.asarray(_st["coef"])
+                        best = coef
             for li, lam in enumerate(lambdas):
+                if li < li0:
+                    continue            # resumed past this lambda
                 l1 = lam * alpha
                 l2 = lam * (1.0 - alpha)
                 _st0 = time.time()
@@ -1092,6 +1114,13 @@ class GLMEstimator(ModelBuilder):
                 job.update(1.0 / len(lambdas),
                            f"lambda {li + 1}/{len(lambdas)}")
                 best = coef
+                if fc is not None:
+                    _li, _c = li + 1, coef
+                    fc.maybe_save(li + 1, lambda: {
+                        "li": _li, "coef": np.asarray(_c)})
+                maybe_fail("fit_chunk")
+            if fc is not None:
+                fc.clear()
         coef = np.asarray(best)   # ONE host materialization after the path
 
         output["lambda_best"] = float(lambdas[-1])

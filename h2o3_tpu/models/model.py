@@ -70,6 +70,45 @@ def adapt_domain(test_col, train_domain: List[str]) -> np.ndarray:
     return out
 
 
+def checkpoint_error(algo: str, field: str, message: str) -> ValueError:
+    """H2O-shaped checkpoint validation error
+    (water.exceptions.H2OModelBuilderIllegalArgumentException as
+    h2o-py surfaces it: ``Illegal argument(s) for <ALGO> model ...
+    Details: ERRR on field: _<field>: <message>``)."""
+    return ValueError(
+        f"Illegal argument(s) for {algo.upper()} model: "
+        f"Details: ERRR on field: _{field}: {message}")
+
+
+def validate_checkpoint_params(algo: str, donor_params: Dict,
+                               params: Dict, fields) -> None:
+    """Reject changes to checkpoint-non-modifiable parameters with the
+    reference's error shape (hex/util/CheckpointUtils
+    getAndValidateCheckpointModel: "Field _x cannot be modified if
+    checkpoint is provided!")."""
+    for f in fields:
+        old = donor_params.get(f)
+        new = params.get(f)
+        if old != new:
+            raise checkpoint_error(
+                algo, f,
+                f"Field _{f} cannot be modified if checkpoint is "
+                f"provided (checkpoint model: {old!r}, request: {new!r})")
+
+
+def resolve_checkpoint_model(algo: str, ck, model_cls):
+    """Fetch + type-check the donor model behind ``checkpoint=`` (a
+    Model instance or its DKV key)."""
+    from h2o3_tpu.core.kv import DKV
+    donor = ck if isinstance(ck, model_cls) else DKV.get(str(ck))
+    if donor is None or getattr(donor, "algo", None) != algo:
+        raise checkpoint_error(
+            algo, "checkpoint",
+            f"Checkpoint model '{getattr(ck, 'key', ck)}' not found "
+            f"or not a {algo} model")
+    return donor
+
+
 class EarlyStopper:
     """Metric-based early stopping (reference hex/ScoreKeeper.stopEarly +
     the stopping_rounds/stopping_tolerance contract of SharedTree).
@@ -321,6 +360,11 @@ class ModelBuilder:
             dest_key = make_key(f"model_{self.algo}")
         job = Job(f"{self.algo} train", work=1.0, dest=dest_key)
         self._job = job
+        # capture the in-fit checkpoint directory on the CALLER thread:
+        # a background job runs on a fresh thread whose context would
+        # not inherit the grid/AutoML fit_checkpoint_scope contextvar
+        from h2o3_tpu.core import recovery as _recovery
+        _fit_ckpt_dir = _recovery.fit_checkpoint_dir()
 
         def _run(j: Job) -> Model:
             t0 = time.time()
@@ -348,7 +392,8 @@ class ModelBuilder:
             from h2o3_tpu import telemetry
             from h2o3_tpu.telemetry import roofline
             with telemetry.span(f"{self.algo}.fit", algo=self.algo,
-                                nfolds=nfolds):
+                                nfolds=nfolds), \
+                    _recovery.fit_checkpoint_scope(_fit_ckpt_dir):
                 rf_probe = roofline.fit_probe(self.algo)
                 t_fit = time.time()
                 if nfolds >= 2:

@@ -38,7 +38,12 @@ _DIRECT = {"ntrees", "max_depth", "seed", "nfolds", "weights_column",
            "fold_column", "fold_assignment", "ignored_columns",
            "stopping_rounds", "stopping_metric", "stopping_tolerance",
            "distribution", "min_rows", "learn_rate", "sample_rate",
-           "reg_lambda", "col_sample_rate_per_tree", "nbins"}
+           "reg_lambda", "col_sample_rate_per_tree", "nbins",
+           # H2O-parity checkpoint restart: the donor is the inner
+           # GBMModel (the facade trains native hist-GBM), so ntrees
+           # extension and the non-modifiable-knob validation flow
+           # through models/gbm.py unchanged
+           "checkpoint"}
 
 _ALIASES = {
     "nrounds": "ntrees",

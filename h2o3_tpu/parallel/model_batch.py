@@ -159,6 +159,11 @@ def train_bucket(builder_cls, fixed: dict, combos: Sequence[dict], frame,
     if trainer is None:
         raise BatchIneligible(f"no batched trainer for algo '{algo}'")
     params_list = [{**fixed, **c} for c in combos]
+    if any(p.get("checkpoint") is not None for p in params_list):
+        # a checkpointed combo extends a donor model's forest/weights —
+        # per-model structural state the vmapped program cannot express;
+        # the caller's sequential per-combo walk handles it
+        raise BatchIneligible("checkpoint restart (per-combo fallback)")
     import time as _time
     from h2o3_tpu import telemetry
     t0 = _time.time()

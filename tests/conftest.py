@@ -79,6 +79,12 @@ def _check_keys(request):
         for k in list(DKV.keys()):
             if k not in baseline and k.endswith(TELEMETRY_SUFFIX):
                 DKV.remove(k)
+        # orphaned FitCheckpointer debris (ISSUE 9): a test that killed
+        # or failed a checkpointed fit may leave *.fitsnap.tmp files or
+        # an empty partial snapshot dir behind — sweep them so one
+        # test's crash-sim cannot poison a later resume test
+        from h2o3_tpu.core import recovery as _recovery
+        _recovery.sweep_fit_checkpoints()
         for k in leaked:    # sweep so one leak cannot cascade
             # a leaked RUNNING job is a live worker thread that would
             # keep writing keys after the sweep — cancel it (observed

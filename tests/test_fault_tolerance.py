@@ -313,8 +313,9 @@ def test_bench_dead_backend_fails_fast_per_config(tmp_path):
         "H2O3TPU_BENCH_CONFIG_TIMEOUT_S": "3"})
     assert p.returncode == 0, p.stderr[-2000:]
     errors = [ln for ln in lines if "error" in ln]
-    # one per stub config (incl. grid, treekernel, cloud, roofline)
-    assert len(errors) == 7
+    # one per stub config (incl. grid, treekernel, cloud, roofline,
+    # checkpoint)
+    assert len(errors) == 8
     assert all("backend dead" in ln["error"] for ln in errors)
     budget = [ln for ln in lines if ln["metric"] == "budget"][0]
     assert budget["left_s"] >= 0.0

@@ -355,6 +355,12 @@ def test_metric_names_documented_in_readme():
                      "cluster_publish_bytes", "cluster_stale_nodes",
                      "jobs_inflight"):
         assert required in section, required
+    # the ISSUE 9 in-fit checkpointing surface is part of the stable
+    # contract too (core/recovery.py FitCheckpointer)
+    for required in ("fit_checkpoints_written_total", "fit_resumes_total",
+                     "fit_checkpoint_seconds",
+                     "snapshot_load_failures_total"):
+        assert required in section, required
 
 
 # ----------------------------------------------------------- REST tier

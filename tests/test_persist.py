@@ -107,12 +107,18 @@ def test_dl_checkpoint_restart():
     fr = _frame()
     part = DeepLearningEstimator(hidden=[8], epochs=1, seed=3).train(
         fr, y="y")
-    resumed = DeepLearningEstimator(hidden=[8], epochs=1, seed=3,
+    # H2O semantics: epochs names the new TOTAL and must exceed the
+    # donor's; training CONTINUES (optimizer state + step count restored)
+    resumed = DeepLearningEstimator(hidden=[8], epochs=2, seed=3,
                                     checkpoint=part.key).train(fr, y="y")
     assert resumed.training_metrics["logloss"] <= \
         part.training_metrics["logloss"] * 1.2
-    with pytest.raises(ValueError, match="hidden layout"):
-        DeepLearningEstimator(hidden=[16], epochs=1,
+    assert resumed._steps_trained > part._steps_trained
+    with pytest.raises(ValueError, match="hidden"):
+        DeepLearningEstimator(hidden=[16], epochs=2,
+                              checkpoint=part.key).train(fr, y="y")
+    with pytest.raises(ValueError, match="epochs"):
+        DeepLearningEstimator(hidden=[8], epochs=1, seed=3,
                               checkpoint=part.key).train(fr, y="y")
 
 
