@@ -687,23 +687,86 @@ def bench_checkpoint():
         peak_hbm_gb=round(_hbm_peak() / 1e9, 2))
 
 
+def bench_memgov():
+    """HBM governor overhead (ISSUE 11): the SAME GBM fit with an
+    unlimited budget vs a tight ``H2O3TPU_HBM_BUDGET_MB`` that forces
+    the admission path to spill cold frames before dispatch — the
+    overhead %% plus the spill/restore counts are the scoreboard
+    numbers (core/memgov.py)."""
+    import h2o3_tpu
+    from h2o3_tpu import telemetry
+    from h2o3_tpu.core import memgov
+    from h2o3_tpu.core.cleaner import _frame_nbytes
+    from h2o3_tpu.core.kv import DKV
+    from h2o3_tpu.models.gbm import GBMEstimator
+    n = 100_000 if FAST else 500_000
+    r = np.random.RandomState(13)
+    X = r.randn(n, 8).astype(np.float32)
+    yv = (X[:, 0] + 0.5 * X[:, 1] + 0.5 * r.randn(n) > 0).astype(int)
+    cols = {f"x{i}": X[:, i] for i in range(8)}
+    cols["y"] = np.array(["N", "Y"], object)[yv]
+    fr = h2o3_tpu.Frame.from_numpy(cols, categorical=["y"])
+    # cold residents for the governor to spill ahead of the fit
+    decoys = [h2o3_tpu.Frame.from_numpy(
+        {f"d{i}": r.randn(n).astype(np.float32) for i in range(8)})
+        for _ in range(3)]
+    del X
+    kw = dict(ntrees=50, max_depth=6, seed=1)
+    feats = [f"x{i}" for i in range(8)]
+    wm = GBMEstimator(**{**kw, "ntrees": 10}).train(fr, y="y")  # warmup
+    DKV.remove(wm.key)
+    t0 = time.time()
+    GBMEstimator(**kw).train(fr, y="y")
+    t_plain = time.time() - t0
+    s0 = telemetry.REGISTRY.total("frame_spills_total")
+    r0 = telemetry.REGISTRY.total("frame_restores_total")
+    # budget sized so the fit admits only after ~half the decoy bytes
+    # spill: resident + projected > budget > (resident - decoys) +
+    # projected
+    proj = memgov.estimate_fit_bytes("gbm", kw, fr, feats)
+    decoy_bytes = sum(_frame_nbytes(d) for d in decoys)
+    budget = memgov.governor.resident_bytes() - decoy_bytes // 2 + proj
+    os.environ["H2O3TPU_HBM_BUDGET_MB"] = str(max(budget >> 20, 1))
+    try:
+        t0 = time.time()
+        GBMEstimator(**kw).train(fr, y="y")
+        t_tight = time.time() - t0
+        DKV.get(decoys[0].key)      # touch a spilled decoy: restore
+    finally:
+        os.environ.pop("H2O3TPU_HBM_BUDGET_MB", None)
+    spills = int(telemetry.REGISTRY.total("frame_spills_total") - s0)
+    restores = int(telemetry.REGISTRY.total("frame_restores_total") - r0)
+    overhead_pct = 100.0 * (t_tight - t_plain) / max(t_plain, 1e-9)
+    _emit(
+        f"memgov GBM-50trees-d6 {n/1e3:.0f}K rows (tight HBM budget "
+        f"with admission spills vs unlimited)",
+        overhead_pct, "overhead_pct",
+        t_plain / max(t_tight, 1e-9), "same fit, unlimited budget",
+        plain_seconds=round(t_plain, 2),
+        tight_seconds=round(t_tight, 2),
+        budget_mb=max(budget >> 20, 1),
+        spills=spills, restores=restores,
+        peak_hbm_gb=round(_hbm_peak() / 1e9, 2))
+
+
 CONFIGS = [("gbm", bench_gbm), ("glm", bench_glm), ("dl", bench_dl),
            ("xgb", bench_xgb), ("sort", bench_sort),
            ("grid", bench_grid), ("treekernel", bench_treekernel),
            ("cloud", bench_cloud), ("checkpoint", bench_checkpoint),
+           ("memgov", bench_memgov),
            ("automl", bench_automl), ("gbm-full", bench_gbm_full)]
 
 # minimum seconds a config plausibly needs; skipped (with a JSON note)
 # rather than started when the remaining budget is below it
 _MIN_NEED = {"gbm": 60, "glm": 90, "dl": 60, "xgb": 60, "sort": 60,
              "grid": 120, "treekernel": 60, "cloud": 30, "automl": 180,
-             "checkpoint": 90, "gbm-full": 600}
+             "checkpoint": 90, "memgov": 90, "gbm-full": 600}
 
 # hard per-config wallclock cap (child process killed past it): a
 # wedged worker costs one line, never the scoreboard
 _HARD_CAP = {"gbm": 900, "glm": 600, "dl": 600, "xgb": 600, "sort": 400,
              "grid": 600, "treekernel": 400, "cloud": 300, "automl": 900,
-             "checkpoint": 600, "gbm-full": 1200}
+             "checkpoint": 600, "memgov": 600, "gbm-full": 1200}
 
 
 def _stub_ok(name):
@@ -837,6 +900,52 @@ def _stub_checkpoint():
           1.0, "stub", snapshots=n_snap, quarantined=1)
 
 
+def _stub_memgov():
+    """Backend-free memory-governor admission state machine (ISSUE 11):
+    budget resolution from the knob, the reservation ledger's
+    admit→spill→reject walk, and the actionable rejection shape — no
+    jax dispatches (the cold-frame spill hook is simulated)."""
+    from h2o3_tpu.core import memgov
+    gov = memgov.MemoryGovernor()
+    resident = {"bytes": 96 << 20}
+    spills = []
+
+    def _spill(needed, exclude=None):
+        # each "cold frame" releases 32MB until nothing cold remains
+        if resident["bytes"] >= 32 << 20:
+            resident["bytes"] -= 32 << 20
+            spills.append(32 << 20)
+            return 1
+        return 0
+
+    gov.bytes_in_use = lambda: resident["bytes"]
+    gov.evict_for_admission = _spill
+    os.environ["H2O3TPU_HBM_BUDGET_MB"] = "128"
+    os.environ["H2O3TPU_MEMGOV_WAIT_S"] = "0.05"
+    t0 = time.time()
+    try:
+        # ADMIT after one spill: 96 in use + 64 projected > 128 budget
+        r1 = gov.reserve("fit-a", 64 << 20)
+        assert spills, "admission must spill before admitting"
+        # second fit: spills to the floor, then the ledger (fit-a's
+        # 64MB hold) still blocks it -> bounded wait -> REJECT
+        try:
+            gov.reserve("fit-b", 96 << 20)
+            raise AssertionError("over-budget fit must reject")
+        except memgov.MemoryBudgetExceeded as e:
+            assert e.projected == 96 << 20 and e.budget == 128 << 20
+            assert "rejected before dispatch" in str(e)
+        gov.release(r1)
+        gov.release(gov.reserve("fit-b", 96 << 20))  # admits post-release
+    finally:
+        os.environ.pop("H2O3TPU_HBM_BUDGET_MB", None)
+        os.environ.pop("H2O3TPU_MEMGOV_WAIT_S", None)
+    dt = max(time.time() - t0, 1e-6)
+    _emit("memgov admission (stub; admit->spill->reject ledger state "
+          "machine, no backend)", 3 / dt, "admissions/sec", 1.0, "stub",
+          spills=len(spills), rejected=1)
+
+
 if STUB:
     CONFIGS = [("stub_a", _stub_ok("stub_a")),
                ("stub_wedge", _stub_wedge),
@@ -845,6 +954,7 @@ if STUB:
                ("cloud", _stub_cloud),
                ("roofline", _stub_roofline),
                ("checkpoint", _stub_checkpoint),
+               ("memgov", _stub_memgov),
                ("stub_b", _stub_ok("stub_b"))]
     _MIN_NEED = {n: 1 for n, _ in CONFIGS}
     _HARD_CAP = {n: 30 for n, _ in CONFIGS}

@@ -38,6 +38,7 @@ from h2o3_tpu.io.sql import import_sql_select, import_sql_table
 from h2o3_tpu.io.persist import (load_frame, load_model, persist_manager,
                                  save_frame, save_model)
 from h2o3_tpu.core.kv import DKV
+from h2o3_tpu.core.memgov import MemoryBudgetExceeded
 from h2o3_tpu.core.scope import Scope
 from h2o3_tpu.core.udf import (upload_custom_distribution,
                                upload_custom_metric)
@@ -57,6 +58,7 @@ __all__ = [
     "parse_raw",
     "upload_numpy",
     "DKV",
+    "MemoryBudgetExceeded",
     "save_frame",
     "load_frame",
     "save_model",

@@ -214,6 +214,11 @@ def _cloud(params, body):
         pass
     from h2o3_tpu.telemetry import roofline as _roofline
     peaks = _roofline.device_peaks()
+    # this node's memory truth (core/memgov.py) — the fallback when a
+    # peer's published snapshot predates the hbm field or is absent
+    from h2o3_tpu.core.memgov import governor as _governor
+    _governor.refresh_gauges()
+    local_hbm = _governor.snapshot()
     nodes = []
     for i, d in enumerate(info["devices"]):
         # device i belongs to a process: published identity first, the
@@ -224,6 +229,12 @@ def _cloud(params, body):
         healthy = bool(pst["healthy"]) if pst else info["cloud_healthy"]
         last_ping = (int(pst["last_seen"] * 1000) if pst else now)
         summ = summaries.get(int(pidx), {})
+        hbm = summ.get("hbm") or {}
+        if not hbm:
+            hbm = {"budget": local_hbm["budget_bytes"],
+                   "in_use": local_hbm["bytes_in_use"],
+                   "free": local_hbm["free_bytes"],
+                   "spilled": local_hbm["spilled_bytes"]}
         nodes.append({
             "h2o": d, "ip_port": f"127.0.0.1:{54321 + i}",
             "healthy": healthy and not summ.get("stale", False),
@@ -232,8 +243,13 @@ def _cloud(params, body):
             "num_cpus": os.cpu_count(),
             "cpus_allowed": os.cpu_count(), "nthreads": os.cpu_count(),
             "sys_load": 0.0, "my_cpu_pct": 0, "sys_cpu_pct": 0,
-            "mem_value_size": 0, "pojo_mem": 0, "free_mem": 0,
-            "max_mem": 0, "swap_mem": 0, "num_keys": len(list(DKV.keys())),
+            # real memory truth from the governor: free/max against the
+            # HBM budget, swap = bytes the Cleaner holds on ice
+            "mem_value_size": hbm.get("in_use", 0), "pojo_mem": 0,
+            "free_mem": hbm.get("free", 0),
+            "max_mem": hbm.get("budget", 0),
+            "swap_mem": hbm.get("spilled", 0),
+            "num_keys": len(list(DKV.keys())),
             "free_disk": 0, "max_disk": 0, "rpcs_active": 0,
             "fjthrds": [], "fjqueue": [], "tcps_active": 0,
             "open_fds": -1,

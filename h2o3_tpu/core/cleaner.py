@@ -40,6 +40,8 @@ class SpilledFrame:
         self.names = names
         self.nbytes = nbytes
 
+        self._on_ice = False    # set True once memgov counts the spill
+
     def restore(self):
         from h2o3_tpu.io.persist import load_frame
         fr = load_frame(self.uri, key=self.key)
@@ -48,8 +50,14 @@ class SpilledFrame:
 
     def discard(self) -> None:
         """Best-effort removal of the ice file (restore won / key
-        removed) so spills don't accumulate on disk."""
+        removed / stub clobbered by a newer put) so spills don't
+        accumulate on disk. Idempotent: the governor's bytes-on-ice
+        accounting is settled exactly once per stub."""
         from h2o3_tpu.io.persist import persist_manager
+        if self._on_ice:
+            self._on_ice = False
+            from h2o3_tpu.core.memgov import governor
+            governor.note_unspill(self.nbytes)
         try:
             persist_manager.delete(self.uri)
         except Exception:
@@ -97,11 +105,12 @@ class Cleaner:
 
     # -- policy --------------------------------------------------------
     def pressure(self) -> float:
-        """Fraction of HBM in use (0 when the backend can't say)."""
-        stats = device_memory_stats()
-        if not stats or not stats.get("bytes_limit"):
-            return 0.0
-        return stats["bytes_in_use"] / stats["bytes_limit"]
+        """Fraction of the HBM budget in use, from the governor's
+        single budget truth (core/memgov.py): device stats when the
+        backend reports them, the H2O3TPU_HBM_BUDGET_MB knob against
+        tracked frame/cache bytes otherwise; 0 when ungoverned."""
+        from h2o3_tpu.core.memgov import governor
+        return governor.pressure()
 
     def _lru_frames(self):
         """(atime, key) for every in-memory DKV frame, coldest first.
@@ -145,6 +154,8 @@ class Cleaner:
             if not DKV.replace_if(key, fr, stub):
                 return None
             self.spilled_count += 1
+            from h2o3_tpu import telemetry
+            telemetry.counter("frame_spills_total").inc()
             log.info("evicted %s back to source %s", key, src[0])
             return stub
         from urllib.parse import quote
@@ -162,6 +173,11 @@ class Cleaner:
                 pass
             return None
         self.spilled_count += 1
+        stub._on_ice = True
+        from h2o3_tpu import telemetry
+        from h2o3_tpu.core.memgov import governor
+        telemetry.counter("frame_spills_total").inc()
+        governor.note_spill(stub.nbytes)
         log.info("spilled %s (%.1f MB) to %s", key,
                  stub.nbytes / 1e6, uri)
         return stub
@@ -218,12 +234,14 @@ class Cleaner:
             self._thread = None
 
     def status(self) -> dict:
+        from h2o3_tpu.core.memgov import governor
         stats = device_memory_stats() or {}
         return {"pressure": self.pressure(),
                 "threshold": self.threshold,
                 "spilled": self.spilled_count,
                 "restored": self.restored_count,
-                **stats}
+                **stats,
+                "governor": governor.snapshot()}
 
 
 cleaner = Cleaner()

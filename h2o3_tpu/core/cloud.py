@@ -278,6 +278,13 @@ def shutdown() -> None:
         _recovery.sweep_fit_checkpoints()
     except Exception:       # noqa: BLE001 - sweep is best-effort
         pass
+    try:
+        # the admission ledger and bytes-on-ice accounting die with the
+        # cloud: a reformed cloud must not inherit ghost reservations
+        from h2o3_tpu.core.memgov import governor
+        governor.reset()
+    except Exception:       # noqa: BLE001 - governor is optional
+        pass
     DKV.clear()
     mesh_mod.set_global_mesh(None)
     if _DISTRIBUTED:

@@ -106,6 +106,18 @@ class Config:
     # vmapped batch: "auto" (default) batches eligible buckets of >= 2
     # combos; "off"/"0" forces the sequential per-combo walk
     batch_models: str = "auto"
+    # -- HBM memory governor (core/memgov.py) --------------------------
+    # deterministic HBM budget in MB when the backend reports no
+    # bytes_limit (CPU tests, plugins exporting no memory stats);
+    # 0 = no explicit budget (the governor only observes)
+    hbm_budget_mb: int = 0
+    # bounded wait for concurrent fits' reservations to release before
+    # a pre-dispatch admission rejection (the AdmissionGate contract
+    # applied to bytes instead of request slots)
+    memgov_wait_s: float = 5.0
+    # "auto" (default) = enforce admission whenever a budget source
+    # exists; "off" = observe only, never reject
+    memgov: str = "auto"
     # -- performance kernels (ops/pallas/) -----------------------------
     # fused Pallas tree kernels (histogram+split+partition per level):
     # "auto" = Pallas on TPU backends, XLA elsewhere; "off" = always the
@@ -122,13 +134,14 @@ class Config:
                              "rest_max_inflight", "rest_queue_depth",
                              "rest_max_body_mb", "flight_recorder_keep",
                              "heartbeat_miss_budget",
-                             "fit_checkpoint_every"})
+                             "fit_checkpoint_every", "hbm_budget_mb"})
     _FLOAT_FIELDS = frozenset({"infra_backoff_base_s", "infra_backoff_max_s",
                                "probe_timeout_s", "rest_queue_wait_s",
                                "cloud_timeout_s", "heartbeat_interval_s",
                                "heartbeat_timeout_s",
                                "cluster_metrics_interval_s",
-                               "cluster_metrics_stale_s"})
+                               "cluster_metrics_stale_s",
+                               "memgov_wait_s"})
 
     @staticmethod
     def from_env(**overrides) -> "Config":

@@ -71,6 +71,11 @@ class Job:
         self._cancel_requested = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self.result: Any = None
+        # run exactly once when the job ends, whatever the status —
+        # the memory governor parks its reservation release here
+        # (core/memgov.py; retries re-enter fn, so the work itself
+        # cannot host end-of-job cleanup)
+        self._finalizers: list = []
         # request deadline (absolute monotonic) captured at SUBMISSION
         # time from the request context (api/server.py installs it for
         # ?_timeout_ms= / X-H2O-Deadline-Ms requests); background jobs
@@ -171,9 +176,29 @@ class Job:
                         telemetry.counter("infra_retries_total",
                                           site="job").inc()
                         if "RESOURCE_EXHAUSTED" in f"{e}":
-                            # HBM pressure: purge executable caches
-                            # before the retry or it just exhausts again
+                            # OOM escalation ladder (README §Memory
+                            # governance): rung 1 purges the jit
+                            # executable caches; rung 2 (repeat OOM)
+                            # governor-evicts cold frames plus the
+                            # per-frame device_matrix/bin caches; the
+                            # snapshot consult above is rung 3 — the
+                            # retry RESUMES from the checkpoint rather
+                            # than restarting at round 0
                             free_device_memory("RESOURCE_EXHAUSTED retry")
+                            telemetry.counter("oom_recoveries_total",
+                                              stage="purge_jit").inc()
+                            if attempt >= 2:
+                                from h2o3_tpu.core.memgov import governor
+                                freed = governor.evict_for_oom()
+                                telemetry.counter("oom_recoveries_total",
+                                                  stage="evict").inc()
+                                log.warning(
+                                    "job %s: repeat OOM — evicted cold "
+                                    "frames + %.1f MB of device caches",
+                                    self.key, freed / 1e6)
+                            if snap is not None:
+                                telemetry.counter("oom_recoveries_total",
+                                                  stage="resume").inc()
                         policy.sleep(delay)
                 if self.dest and self.result is not None:
                     DKV.put(self.dest, self.result)
@@ -234,6 +259,11 @@ class Job:
                                        desc=self.description):
                     _body()
             finally:
+                for fin in self._finalizers:
+                    try:
+                        fin()
+                    except Exception:   # noqa: BLE001 - best-effort
+                        pass
                 flight_recorder.detach(handle, status=self.status)
                 telemetry.gauge("jobs_inflight").add(-1)
                 telemetry.counter("jobs_completed_total",
@@ -271,6 +301,11 @@ class Job:
     @property
     def progress_msg(self) -> str:
         return self._msg
+
+    def add_finalizer(self, fn: Callable[[], None]) -> None:
+        """Register end-of-job cleanup (runs once in the worker's
+        finally, after DONE/FAILED/CANCELLED is settled)."""
+        self._finalizers.append(fn)
 
     def cancel(self) -> None:
         self._cancel_requested.set()

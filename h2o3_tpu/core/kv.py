@@ -37,9 +37,16 @@ class _DKV:
     def put(self, key: str, value: Any) -> str:
         import time
         with self._lock:
+            old = self._store.get(key)
             new = key not in self._store
             self._store[key] = value
             self._atime[key] = time.monotonic()
+        if old is not None and old is not value \
+                and getattr(old, "_is_lazy_stub", False):
+            # a newer put clobbered a stub still on ice: reclaim the
+            # orphaned spill file (and its bytes-on-ice accounting)
+            # instead of leaking it until process exit
+            old.discard()
         if new:
             # per-call lifetime tracking (water/Scope.track role)
             from h2o3_tpu.core.scope import track
@@ -58,8 +65,21 @@ class _DKV:
         # file) share the restore/discard duck type.
         from h2o3_tpu.core.cleaner import cleaner
         while v is not None and getattr(v, "_is_lazy_stub", False):
-            fr = v.restore()
+            try:
+                fr = v.restore()
+            except Exception:
+                # a concurrent restore/put may have won and reclaimed
+                # the ice file mid-read — only propagate when the store
+                # still holds THIS stub (the ice is genuinely bad)
+                with self._lock:
+                    cur = self._store.get(key)
+                if cur is v:
+                    raise
+                v = cur
+                continue
             cleaner.restored_count += 1
+            from h2o3_tpu import telemetry
+            telemetry.counter("frame_restores_total").inc()
             with self._lock:
                 # restore() paths end in Frame.__init__, which re-puts
                 # the key itself — so the store already holds `fr` (the

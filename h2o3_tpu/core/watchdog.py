@@ -30,8 +30,11 @@ This module centralizes what used to be scattered one-shot retries
   classified failures at named sites (``probe``, ``job``,
   ``frame_reduce``, ``frame_map``, ``heartbeat``, ``cloud_init``,
   ``fit_chunk`` — the GBM/GLM/DL training-loop host boundaries where
-  the FitCheckpointer snapshots) so every retry/degradation path runs
-  in tier-1 CPU tests instead of waiting for a real TPU crash.
+  the FitCheckpointer snapshots — and ``device_oom``, the same
+  boundaries raising RESOURCE_EXHAUSTED so the OOM escalation ladder
+  of core/job.py runs deterministically) so every retry/degradation
+  path runs in tier-1 CPU tests instead of waiting for a real TPU
+  crash.
 
 Telemetry: ``backend_probes_total``, ``backend_probe_failures_total``,
 ``infra_retries_total{site=}`` (README §Fault tolerance).
@@ -113,7 +116,7 @@ def _parse_env_faults() -> None:
             if site in _faults:
                 continue
         count = int(bits[1]) if len(bits) > 1 and bits[1] else 1
-        sign = bits[2] if len(bits) > 2 and bits[2] else "UNAVAILABLE"
+        sign = bits[2] if len(bits) > 2 and bits[2] else None
         inject_fault(site, times=count, sign=sign)
 
 
@@ -121,8 +124,14 @@ _env_parsed = False
 
 
 def inject_fault(site: str, times: int = 1,
-                 sign: str = "UNAVAILABLE") -> None:
-    """Plant `times` classified failures at a named site."""
+                 sign: Optional[str] = None) -> None:
+    """Plant `times` classified failures at a named site. ``sign``
+    defaults per site: ``device_oom`` faults as RESOURCE_EXHAUSTED (so
+    the job supervisor's OOM escalation ladder runs), everything else
+    as UNAVAILABLE."""
+    if sign is None:
+        sign = "RESOURCE_EXHAUSTED" if site == "device_oom" \
+            else "UNAVAILABLE"
     with _faults_lock:
         _faults[site] = {"left": int(times), "sign": sign}
 

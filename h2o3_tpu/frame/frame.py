@@ -240,6 +240,23 @@ class Frame:
         """Stack numeric views into a padded [Npad, F] float32 device matrix."""
         return self.device_matrix(names)
 
+    def device_cache_nbytes(self) -> int:
+        """Device bytes pinned by the derived caches (device_matrix
+        stacks + bin_frame results) — what the memory governor charges
+        this frame beyond its columns (core/memgov.py)."""
+        from h2o3_tpu.core.memgov import _frame_cache_nbytes
+        return _frame_cache_nbytes(self)
+
+    def drop_device_caches(self) -> int:
+        """Release the derived device caches; returns bytes freed. The
+        OOM escalation ladder's eviction hook (core/memgov.py): these
+        caches rebuild transparently on next use, so dropping them
+        trades recompute for HBM under pressure."""
+        freed = self.device_cache_nbytes()
+        getattr(self, "_matrix_cache", {}).clear()
+        getattr(self, "_bin_cache", {}).clear()
+        return freed
+
     def valid_weights(self) -> jax.Array:
         """1.0 for logical rows, 0.0 for mesh-padding rows."""
         return mesh_mod.valid_mask(self.nrows, self.nrows_padded)

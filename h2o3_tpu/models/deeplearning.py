@@ -617,6 +617,7 @@ class DeepLearningEstimator(ModelBuilder):
                     "stop_hist": list(stopper.history),
                     "scoring_history": list(scoring_history)})
             maybe_fail("fit_chunk")
+            maybe_fail("device_oom")
         if fc is not None:
             fc.clear()
 

@@ -978,6 +978,7 @@ class GBMEstimator(ModelBuilder):
                         "stop_hist": list(stopper.history),
                         "scoring_history": list(scoring_history)})
                 maybe_fail("fit_chunk")
+                maybe_fail("device_oom")
                 if _deadline and time.time() > _deadline:
                     log.info("max_runtime_secs: GBM stopping at %d/%d "
                              "trees", done, ntrees)
@@ -1093,6 +1094,7 @@ class GBMEstimator(ModelBuilder):
                             "margin": np.asarray(_mg),
                             "gains_total": gains_total.copy()})
                     maybe_fail("fit_chunk")
+                    maybe_fail("device_oom")
                     if _deadline and time.time() > _deadline:
                         log.info("max_runtime_secs: GBM stopping at "
                                  "%d/%d trees", done, ntrees)
@@ -1162,6 +1164,7 @@ class GBMEstimator(ModelBuilder):
                             "stop_hist": list(stopper.history),
                             "scoring_history": list(scoring_history)})
                     maybe_fail("fit_chunk")
+                    maybe_fail("device_oom")
                     if _deadline and time.time() > _deadline:
                         log.info("max_runtime_secs: GBM stopping at "
                                  "%d/%d trees", done, ntrees)

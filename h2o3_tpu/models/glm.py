@@ -1119,6 +1119,7 @@ class GLMEstimator(ModelBuilder):
                     fc.maybe_save(li + 1, lambda: {
                         "li": _li, "coef": np.asarray(_c)})
                 maybe_fail("fit_chunk")
+                maybe_fail("device_oom")
             if fc is not None:
                 fc.clear()
         coef = np.asarray(best)   # ONE host materialization after the path

@@ -354,11 +354,26 @@ class ModelBuilder:
         if self.params.get("fold_column") and nfolds < 2 \
                 and self.cv_from_fold_column:
             nfolds = 2      # actual count comes from the fold column
+        # predictive admission (core/memgov.py): estimate the fit's
+        # device footprint and reserve it BEFORE the job dispatches —
+        # an over-budget fit first spills cold frames, then rejects
+        # here with an actionable error naming projected vs available
+        # bytes (never an opaque XLA RESOURCE_EXHAUSTED minutes in).
+        # The reservation releases when the job ends, whatever status.
+        from h2o3_tpu.core import memgov as _memgov
+        _rsv = _memgov.governor.admit_fit(self.algo, self.params,
+                                          training_frame, x,
+                                          validation_frame)
         # the model key must exist BEFORE training starts: the real h2o-py
         # captures job.dest at submission time (h2o-py/h2o/job.py:48)
         if not dest_key:
             dest_key = make_key(f"model_{self.algo}")
-        job = Job(f"{self.algo} train", work=1.0, dest=dest_key)
+        try:
+            job = Job(f"{self.algo} train", work=1.0, dest=dest_key)
+        except BaseException:
+            _memgov.governor.release(_rsv)
+            raise
+        job.add_finalizer(lambda: _memgov.governor.release(_rsv))
         self._job = job
         # capture the in-fit checkpoint directory on the CALLER thread:
         # a background job runs on a fresh thread whose context would

@@ -51,27 +51,27 @@ DEVICE_MERGE_MIN_ROWS = 65536
 
 
 def _merge_out_budget() -> int:
-    """Max bytes the device join result may occupy.
-
-    CPU meshes (the 8-virtual-device test topology, usually on a small
-    host) get a conservative 2GB; accelerators use half the reported
-    HBM limit, or 16GB when the plugin exports no memory stats (axon)."""
+    """Max bytes the device join result may occupy: half the governor's
+    HBM budget (core/memgov.py — device bytes_limit, else the
+    H2O3TPU_HBM_BUDGET_MB knob). Without any budget source, CPU meshes
+    (the 8-virtual-device test topology, usually on a small host) get a
+    conservative 2GB and accelerators the shared 16GB assumption for
+    plugins exporting no memory stats (axon)."""
     import os
     env = os.environ.get("H2O3TPU_MERGE_MAX_OUT_BYTES")
     if env:
         return int(env)
+    from h2o3_tpu.core import memgov
+    lim = memgov.governor.device_limit_bytes()
+    if lim:
+        return int(lim * 0.5)
     # the mesh's devices, NOT jax.devices(): the axon plugin shadows
     # JAX_PLATFORMS, so jax.devices() reports the tunneled chip even
     # when the cloud (and this merge) runs on the CPU mesh
     dev = mesh_mod.get_mesh().devices.flat[0]
     if dev.platform == "cpu":
         return 2 << 30
-    try:
-        stats = dev.memory_stats() or {}
-    except Exception:
-        stats = {}
-    lim = stats.get("bytes_limit")
-    return int(lim * 0.5) if lim else 16 << 30
+    return memgov.DEFAULT_DEVICE_HBM_BYTES
 
 
 def _all_float(keys) -> bool:

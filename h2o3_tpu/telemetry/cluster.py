@@ -153,7 +153,23 @@ def local_snapshot() -> Dict:
         "logs": log_mod.log_records(MAX_LOGS),
         "jobs_inflight": int(REGISTRY.value("jobs_inflight")),
         "peak_hbm": peak_hbm,
+        "hbm": _hbm_snapshot(),
     }
+
+
+def _hbm_snapshot() -> Dict:
+    """This node's memory truth from the governor (core/memgov.py) —
+    budget / in-use / bytes-on-ice, carried in the published snapshot
+    so GET /3/Cloud reports real per-node free_mem/max_mem/swap_mem."""
+    try:
+        from h2o3_tpu.core.memgov import governor
+        s = governor.snapshot()
+        return {"budget": int(s["budget_bytes"]),
+                "in_use": int(s["bytes_in_use"]),
+                "free": int(s["free_bytes"]),
+                "spilled": int(s["spilled_bytes"])}
+    except Exception:   # noqa: BLE001 - stats are best-effort
+        return {"budget": 0, "in_use": 0, "free": 0, "spilled": 0}
 
 
 def _encode(snap: Dict) -> str:
@@ -293,6 +309,7 @@ def node_summaries(col: Optional[Dict] = None) -> Dict[int, Dict]:
             "jobs_inflight": int(snap.get("jobs_inflight", 0) or 0),
             "last_publish_age_s": round(col["ages"].get(int(n), 0.0), 3),
             "peak_hbm": int(snap.get("peak_hbm", 0) or 0),
+            "hbm": snap.get("hbm") or {},
             "stale": int(n) in col["stale_nodes"],
         }
     return out

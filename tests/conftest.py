@@ -85,6 +85,12 @@ def _check_keys(request):
         # test's crash-sim cannot poison a later resume test
         from h2o3_tpu.core import recovery as _recovery
         _recovery.sweep_fit_checkpoints()
+        # orphaned Cleaner ice files (ISSUE 11): a test that spilled a
+        # frame and then removed or clobbered its key without touching
+        # the stub leaves hex://spill/*.npz debris — sweep files no
+        # live stub references so spills cannot accumulate across the
+        # suite (mirrors the *.fitsnap.tmp sweep above)
+        _sweep_orphan_spills(baseline)
         for k in leaked:    # sweep so one leak cannot cascade
             # a leaked RUNNING job is a live worker thread that would
             # keep writing keys after the sweep — cancel it (observed
@@ -103,6 +109,33 @@ def _check_keys(request):
         f"{unbalanced} Scope(s) entered but never exited"
     assert not leaked, \
         f"{len(leaked)} DKV key(s) leaked: {sorted(leaked)[:10]}"
+
+
+def _sweep_orphan_spills(baseline) -> None:
+    """Delete spill npz files in the ice dir that no in-DKV stub still
+    references (hex://spill/* — io/persist.py _IceDriver layout)."""
+    import glob
+    import tempfile
+    from h2o3_tpu.core.kv import DKV
+    ice_root = os.environ.get(
+        "H2O3_TPU_ICE_DIR",
+        os.path.join(tempfile.gettempdir(), "h2o3_tpu_ice"))
+    files = glob.glob(os.path.join(ice_root, "spill", "*.npz"))
+    if not files:
+        return
+    live = set()
+    for k in list(DKV.keys()):
+        v = DKV.get_raw(k)
+        uri = getattr(v, "uri", None)
+        if getattr(v, "_is_lazy_stub", False) and uri:
+            live.add(os.path.basename(uri))
+        del v
+    for p in files:
+        if os.path.basename(p) not in live:
+            try:
+                os.unlink(p)
+            except OSError:
+                pass
 
 
 @pytest.fixture()

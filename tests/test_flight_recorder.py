@@ -361,6 +361,14 @@ def test_metric_names_documented_in_readme():
                      "fit_checkpoint_seconds",
                      "snapshot_load_failures_total"):
         assert required in section, required
+    # the ISSUE 11 memory-governance surface (core/memgov.py) is part
+    # of the stable contract too
+    for required in ("hbm_budget_bytes", "hbm_bytes_in_use",
+                     "frames_spilled_bytes", "frame_spills_total",
+                     "frame_restores_total",
+                     "fit_admission_rejections_total",
+                     "oom_recoveries_total"):
+        assert required in section, required
 
 
 # ----------------------------------------------------------- REST tier
