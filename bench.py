@@ -749,24 +749,84 @@ def bench_memgov():
         peak_hbm_gb=round(_hbm_peak() / 1e9, 2))
 
 
+def bench_ingest():
+    """Chunk-parallel ingest pipeline (ISSUE 12): airlines-CSV MB/s with
+    the tokenizer fan-out vs the SAME pipeline pinned to one worker
+    (bit-identical output by construction — tests/test_ingest_parallel
+    asserts the bits, this config measures the ratio), plus the
+    row-group-parallel Parquet fast path over the same rows."""
+    from h2o3_tpu.core.kv import DKV
+    from h2o3_tpu.io.chunking import resolve_workers
+    from h2o3_tpu.io.formats import parse_parquet
+    from h2o3_tpu.io.stream import stream_import_csv
+    n = 1_000_000 if FAST else 10_000_000
+    path = _airlines_csv(n)
+    nbytes = os.path.getsize(path)
+
+    def _run(workers):
+        fr = stream_import_csv(path, workers=workers)
+        rows = fr.nrows
+        DKV.remove(fr.key)
+        return rows
+
+    _run(1)                                 # warmup/compile both legs
+    t0 = time.time()
+    rows = _run(1)
+    t_seq = max(time.time() - t0, 1e-9)
+    t0 = time.time()
+    _run(None)
+    t_par = max(time.time() - t0, 1e-9)
+    w = resolve_workers()
+    _emit(f"Ingest airlines CSV {n/1e6:.0f}M rows x{w} workers "
+          f"(chunk-parallel tokenize + overlapped transfer)",
+          nbytes / t_par / 1e6, "MB/sec",
+          t_seq / t_par, "same pipeline, workers=1",
+          seq_mb_per_s=round(nbytes / t_seq / 1e6, 1),
+          workers=w, rows=rows, file_mb=round(nbytes / 1e6, 1),
+          seq_seconds=round(t_seq, 2), par_seconds=round(t_par, 2))
+    # Parquet leg: same rows through the arrow-columnar fast path (no
+    # CSV tokenizer at all) — baseline is the sequential CSV wall time
+    import pyarrow.csv as pacsv
+    import pyarrow.parquet as pq
+    ppath = path.rsplit(".", 1)[0] + ".parquet"
+    if not os.path.exists(ppath):
+        pq.write_table(pacsv.read_csv(path), ppath + ".tmp",
+                       row_group_size=1 << 20)
+        os.rename(ppath + ".tmp", ppath)
+    pbytes = os.path.getsize(ppath)
+    DKV.remove(parse_parquet(ppath).key)    # warmup
+    t0 = time.time()
+    fr = parse_parquet(ppath)
+    t_pq = max(time.time() - t0, 1e-9)
+    DKV.remove(fr.key)
+    _emit(f"Ingest airlines Parquet {n/1e6:.0f}M rows "
+          f"(row-group-parallel arrow fast path)",
+          pbytes / t_pq / 1e6, "MB/sec",
+          t_seq / t_pq, "same rows, sequential CSV",
+          parquet_seconds=round(t_pq, 2),
+          file_mb=round(pbytes / 1e6, 1), workers=w)
+
+
 CONFIGS = [("gbm", bench_gbm), ("glm", bench_glm), ("dl", bench_dl),
            ("xgb", bench_xgb), ("sort", bench_sort),
            ("grid", bench_grid), ("treekernel", bench_treekernel),
            ("cloud", bench_cloud), ("checkpoint", bench_checkpoint),
-           ("memgov", bench_memgov),
+           ("memgov", bench_memgov), ("ingest", bench_ingest),
            ("automl", bench_automl), ("gbm-full", bench_gbm_full)]
 
 # minimum seconds a config plausibly needs; skipped (with a JSON note)
 # rather than started when the remaining budget is below it
 _MIN_NEED = {"gbm": 60, "glm": 90, "dl": 60, "xgb": 60, "sort": 60,
              "grid": 120, "treekernel": 60, "cloud": 30, "automl": 180,
-             "checkpoint": 90, "memgov": 90, "gbm-full": 600}
+             "checkpoint": 90, "memgov": 90, "ingest": 90,
+             "gbm-full": 600}
 
 # hard per-config wallclock cap (child process killed past it): a
 # wedged worker costs one line, never the scoreboard
 _HARD_CAP = {"gbm": 900, "glm": 600, "dl": 600, "xgb": 600, "sort": 400,
              "grid": 600, "treekernel": 400, "cloud": 300, "automl": 900,
-             "checkpoint": 600, "memgov": 600, "gbm-full": 1200}
+             "checkpoint": 600, "memgov": 600, "ingest": 600,
+             "gbm-full": 1200}
 
 
 def _stub_ok(name):
@@ -946,6 +1006,39 @@ def _stub_memgov():
           spills=len(spills), rejected=1)
 
 
+def _stub_ingest():
+    """`ingest` line without a backend: drives the chunk PLANNER and the
+    quote-aware byte-range splitter (io/chunking.py, jax-free) over a
+    quoted CSV with embedded newlines/commas — every window must cut at
+    a record boundary (even double-quote parity, never mid-field) and
+    the windows must reassemble to the original byte stream."""
+    import tempfile
+
+    from h2o3_tpu.io import chunking
+    rows = ["h1,h2"]
+    for i in range(4000):
+        rows.append(f'"va{i},x\ny",{i}' if i % 3 else f"v{i},{i}")
+    data = ("\n".join(rows) + "\n").encode()
+    d = tempfile.mkdtemp(prefix="h2o3tpu_stub_ingest_")
+    path = os.path.join(d, "quoted.csv")
+    with open(path, "wb") as f:
+        f.write(data)
+    t0 = time.time()
+    windows = [w for w, _ in chunking.iter_line_chunks([path], 2048)]
+    dt = max(time.time() - t0, 1e-9)
+    assert b"".join(windows) == data, "splitter must be lossless"
+    for w in windows:
+        assert w.endswith(b"\n") and w.count(b'"') % 2 == 0, \
+            "window cut mid-quote"
+    plan = chunking.parse_plan([path], chunk_bytes=2048)
+    assert plan["files"] == 1 and plan["est_chunks"] >= 1
+    assert plan["mode"] in ("chunk-parallel", "sequential"), plan
+    _emit("ingest splitter (stub; quote-aware chunk planner, no "
+          "backend)", len(data) / dt / 1e6, "MB/sec", 1.0, "stub",
+          windows=len(windows), mode=plan["mode"],
+          workers=plan["workers"], est_chunks=plan["est_chunks"])
+
+
 if STUB:
     CONFIGS = [("stub_a", _stub_ok("stub_a")),
                ("stub_wedge", _stub_wedge),
@@ -955,6 +1048,7 @@ if STUB:
                ("roofline", _stub_roofline),
                ("checkpoint", _stub_checkpoint),
                ("memgov", _stub_memgov),
+               ("ingest", _stub_ingest),
                ("stub_b", _stub_ok("stub_b"))]
     _MIN_NEED = {n: 1 for n, _ in CONFIGS}
     _HARD_CAP = {n: 30 for n, _ in CONFIGS}

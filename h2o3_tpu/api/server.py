@@ -532,7 +532,18 @@ def _parse_setup(params, body):
             "check_header": 1 if setup["header"] else 0,
             "number_columns": len(setup["columns"]),
             "chunk_size": 1 << 22,
+            # how the ingest pipeline would run: chunk-parallel vs
+            # sequential vs arrow-columnar, worker count, window size
+            "parse_plan": _chunk_plan(srcs),
             "total_filtered_column_count": len(setup["columns"])}
+
+
+def _chunk_plan(srcs):
+    from h2o3_tpu.io.chunking import parse_plan
+    try:
+        return parse_plan(srcs)
+    except Exception:            # plan reporting must never fail a parse
+        return None
 
 
 @route("POST", "/3/Parse")
@@ -597,7 +608,7 @@ def _parse(params, body):
         return fr
 
     job.start(_run, background=True)
-    return {"job": job.to_dict()}
+    return {"job": job.to_dict(), "parse_plan": _chunk_plan(srcs)}
 
 
 @route("GET", "/3/Frames")

@@ -118,6 +118,14 @@ class Config:
     # "auto" (default) = enforce admission whenever a budget source
     # exists; "off" = observe only, never reject
     memgov: str = "auto"
+    # -- chunk-parallel ingest (io/chunking.py + io/stream.py) ---------
+    # tokenizer workers for the chunk-parallel parse pipeline: 0 = one
+    # per host core (the reference's MultiFileParseTask fans chunks to
+    # the local FJ pool), 1 = the exact sequential fallback path
+    parse_workers: int = 0
+    # byte-window size fed to each tokenizer worker, in MB (the FileVec
+    # chunk-size analogue for the parse plane)
+    parse_chunk_mb: int = 64
     # -- performance kernels (ops/pallas/) -----------------------------
     # fused Pallas tree kernels (histogram+split+partition per level):
     # "auto" = Pallas on TPU backends, XLA elsewhere; "off" = always the
@@ -134,7 +142,8 @@ class Config:
                              "rest_max_inflight", "rest_queue_depth",
                              "rest_max_body_mb", "flight_recorder_keep",
                              "heartbeat_miss_budget",
-                             "fit_checkpoint_every", "hbm_budget_mb"})
+                             "fit_checkpoint_every", "hbm_budget_mb",
+                             "parse_workers", "parse_chunk_mb"})
     _FLOAT_FIELDS = frozenset({"infra_backoff_base_s", "infra_backoff_max_s",
                                "probe_timeout_s", "rest_queue_wait_s",
                                "cloud_timeout_s", "heartbeat_interval_s",

@@ -88,6 +88,21 @@ class Frame:
                                           time=name in times))
         return Frame(cols, n, key=key)
 
+    @staticmethod
+    def from_blocks(accs: Dict[str, "object"], names: List[str],
+                    nrows: int, key: Optional[str] = None,
+                    block: int = 1) -> "Frame":
+        """Assemble BlockAccumulator columns into a Frame — the shared
+        block-assembly tail of the streamed-CSV and Arrow ingest paths.
+
+        ``accs`` maps column name → frame.column.BlockAccumulator whose
+        add_* calls already arrived in window order; each finish() runs
+        the jitted on-device concat/upcast/pad assembly.
+        """
+        npad = mesh_mod.padded_rows(nrows, block=block)
+        cols = [accs[nm].finish(nrows, npad) for nm in names]
+        return Frame(cols, nrows, key=key)
+
     def rename_columns(self, new_names) -> "Frame":
         """In-place positional rename (h2o-py set_names / Parse
         column_names)."""

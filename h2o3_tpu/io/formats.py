@@ -229,43 +229,53 @@ def parse_xlsx(path: str, key: Optional[str] = None) -> Frame:
 
 
 # ---- columnar container formats (h2o-parsers/{parquet,orc,avro}) -----
+#
+# Arrow tables skip the CSV tokenizer entirely: each table (or Parquet
+# row group) converts per-column into the SAME merge entries the
+# chunk-parallel CSV pipeline produces — categorical code blocks with
+# window-local domains, or pre-narrowed NumericBlocks — and feeds the
+# same BlockAccumulators (frame/column.py). Buffers that already match
+# their narrow dtype ship zero-copy to device_put.
+
+_BOOL_DOMAIN = ["false", "true"]     # matches the CSV tokenizer's levels
 
 
-def frame_from_arrow(table, key: Optional[str] = None) -> Frame:
-    """Arrow table → Frame without a pandas detour (the h2o-parsers
-    ParquetParser/OrcParser role): numeric columns become dtype-narrowed
-    device arrays + NA masks, string/dictionary columns intern into
-    categorical domains."""
+def _arrow_entries(table):
+    """Per-column merge entries for one Arrow table / row group:
+    ('cat', int32 codes with -1 NA, local domain) or
+    ('num'|'time', NumericBlock)."""
     import pyarrow as pa
-    arrays: Dict[str, np.ndarray] = {}
-    cats: List[str] = []
-    doms: Dict[str, List[str]] = {}
-    for name, col in zip(table.column_names, table.columns):
+    from h2o3_tpu.frame.column import narrow_numeric_block
+    entries = []
+    for col in table.columns:
         col = col.combine_chunks() if col.num_chunks != 1 else col.chunk(0)
         t = col.type
         if pa.types.is_dictionary(t):
-            idx = col.indices.to_numpy(zero_copy_only=False).astype(
-                np.int32, copy=True)
-            if col.null_count:
-                idx[np.asarray(col.is_null())] = -1
-            arrays[name] = idx
-            cats.append(name)
-            doms[name] = [str(v) for v in col.dictionary.to_pylist()]
+            idx = col.indices.fill_null(-1).to_numpy(
+                zero_copy_only=False).astype(np.int32, copy=False)
+            entries.append(
+                ("cat", idx, [str(v) for v in col.dictionary.to_pylist()]))
         elif (pa.types.is_string(t) or pa.types.is_large_string(t)
               or pa.types.is_binary(t)):
             if pa.types.is_binary(t):
                 col = col.cast(pa.string())   # utf-8 labels, not b'..' reprs
             enc = col.dictionary_encode()     # Arrow-native interning
-            idx = enc.indices.to_numpy(zero_copy_only=False).astype(
-                np.int32, copy=True)
-            if enc.null_count:
-                idx[np.asarray(enc.is_null())] = -1
-            arrays[name] = idx
-            cats.append(name)
-            doms[name] = [str(v) for v in enc.dictionary.to_pylist()]
+            idx = enc.indices.fill_null(-1).to_numpy(
+                zero_copy_only=False).astype(np.int32, copy=False)
+            entries.append(
+                ("cat", idx, [str(v) for v in enc.dictionary.to_pylist()]))
         elif pa.types.is_boolean(t):
-            v = col.to_numpy(zero_copy_only=False).astype(np.float64)
-            arrays[name] = v
+            # bools are two-level categoricals, like the CSV tokenizer
+            # makes of "true"/"false" tokens — an export→re-import
+            # round trip keeps the type
+            v = col.to_numpy(zero_copy_only=False)
+            if col.null_count:
+                codes = np.where(np.asarray(col.is_null()), -1,
+                                 np.where(v.astype(bool), 1, 0))
+                codes = codes.astype(np.int32)
+            else:
+                codes = v.astype(np.int32)
+            entries.append(("cat", codes, _BOOL_DOMAIN))
         elif pa.types.is_timestamp(t) or pa.types.is_date(t):
             # repo time convention is epoch-MILLIS (frame/column.py):
             # normalize whatever unit the container carries
@@ -281,19 +291,141 @@ def frame_from_arrow(table, key: Optional[str] = None) -> Frame:
             v = v * scale
             if col.null_count:
                 v[np.asarray(col.is_null())] = np.nan
-            arrays[name] = v
+            entries.append(("time", narrow_numeric_block(v)))
         else:
-            v = col.to_numpy(zero_copy_only=False).astype(np.float64)
             if col.null_count:
+                # pyarrow null-fills to float64 NaN; mask from finiteness
+                v = col.to_numpy(zero_copy_only=False)
+                v = v.astype(np.float64, copy=False)
                 v[np.asarray(col.is_null())] = np.nan
-            arrays[name] = v
-    return Frame.from_numpy(arrays, categorical=cats, domains=doms,
-                            key=key)
+                entries.append(("num", narrow_numeric_block(v)))
+            elif pa.types.is_integer(t):
+                # null-free integers can't hold NA: the primitive buffer
+                # views zero-copy and, when it already matches its narrow
+                # dtype, ships to device without any host copy
+                v = col.to_numpy(zero_copy_only=False)
+                entries.append(("num", narrow_numeric_block(
+                    v, na=np.zeros(len(v), bool))))
+            else:
+                # null-free floats may still carry NaN payloads → the
+                # finiteness-derived mask (CSV-path semantics)
+                v = col.to_numpy(zero_copy_only=False)
+                entries.append(("num", narrow_numeric_block(
+                    np.asarray(v, np.float64))))
+    return entries
 
 
-def parse_parquet(path: str, key: Optional[str] = None) -> Frame:
+def _arrow_accumulators(schema):
+    """Name → BlockAccumulator for an Arrow schema (T_TIME flagged from
+    the schema so every row group agrees)."""
+    import pyarrow as pa
+    from h2o3_tpu.frame.column import BlockAccumulator
+    return {f.name: BlockAccumulator(
+                f.name, time=pa.types.is_timestamp(f.type) or
+                pa.types.is_date(f.type))
+            for f in schema}
+
+
+def _merge_arrow(accs, names, table) -> int:
+    """Feed one table's entries into the accumulators, in column order;
+    returns the table's row count."""
+    for nm, entry in zip(names, _arrow_entries(table)):
+        if entry[0] == "cat":
+            accs[nm].add_categorical(entry[1], entry[2])
+        else:
+            accs[nm].add_numeric_block(entry[1])
+    return table.num_rows
+
+
+def frame_from_arrow(table, key: Optional[str] = None) -> Frame:
+    """Arrow table → Frame without a pandas detour (the h2o-parsers
+    ParquetParser/OrcParser role): numeric columns become dtype-narrowed
+    device arrays + NA masks, string/dictionary/bool columns intern into
+    categorical domains, timestamps/dates become T_TIME epoch-millis."""
+    names = list(table.column_names)
+    accs = _arrow_accumulators(table.schema)
+    n = _merge_arrow(accs, names, table)
+    return Frame.from_blocks(accs, names, n, key=key, block=8)
+
+
+def parse_parquet(path: str, key: Optional[str] = None,
+                  workers: Optional[int] = None) -> Frame:
+    """Row-group-parallel Parquet ingest — the Arrow-native fast path.
+
+    Row groups are read concurrently on the tokenizer-pool knob
+    (`H2O3TPU_PARSE_WORKERS`; workers=1 reads sequentially) with each
+    worker holding its own ParquetFile handle; the caller thread merges
+    groups strictly in order into the shared BlockAccumulators, so the
+    parallel read is bit-identical to the sequential one. At most
+    workers+2 row groups are resident on the host (memory-governor
+    contract), and each group passes a cancel_point.
+    """
+    import collections as _collections
+    import time as _time
+    from concurrent.futures import ThreadPoolExecutor
     import pyarrow.parquet as pq
-    return frame_from_arrow(pq.read_table(path), key=key)
+    from h2o3_tpu import telemetry
+    from h2o3_tpu.core.request_ctx import cancel_point
+    from h2o3_tpu.io import chunking
+
+    w = chunking.resolve_workers(workers)
+    pf = pq.ParquetFile(path)
+    ng = pf.metadata.num_row_groups
+    try:
+        import os as _os
+        telemetry.counter("ingest_bytes_total", format="parquet").inc(
+            _os.path.getsize(path))
+    except OSError:
+        pass
+    if ng == 0:
+        fr = frame_from_arrow(pf.read(), key=key)
+        telemetry.counter("ingest_rows_total").inc(fr.nrows)
+        return fr
+
+    names = [f.name for f in pf.schema_arrow]
+    accs = _arrow_accumulators(pf.schema_arrow)
+    total = 0
+
+    def _hist(**labels):
+        return telemetry.histogram("parse_chunk_seconds", **labels)
+
+    def _read_group(i: int):
+        # one ParquetFile handle per read: pyarrow readers are not
+        # guaranteed thread-safe for concurrent row-group reads
+        t0 = _time.perf_counter()
+        tbl = pq.ParquetFile(path).read_row_group(i)
+        return tbl, _time.perf_counter() - t0
+
+    def _consume(tbl, read_s: float):
+        nonlocal total
+        cancel_point("parse.row_group")
+        _hist(stage="tokenize").observe(read_s)
+        t0 = _time.perf_counter()
+        total += _merge_arrow(accs, names, tbl)
+        _hist(stage="merge").observe(_time.perf_counter() - t0)
+
+    with telemetry.span("parse.arrow", format="parquet", row_groups=ng,
+                        workers=w):
+        if w == 1 or ng == 1:
+            for i in range(ng):
+                _consume(*_read_group(i))
+        else:
+            futs = _collections.deque()
+            with ThreadPoolExecutor(
+                    max_workers=min(w, ng),
+                    thread_name_prefix="parse-rg") as pool:
+                for i in range(ng):
+                    futs.append(pool.submit(_read_group, i))
+                    # sliding window: bounds resident row groups
+                    while len(futs) > w + 2:
+                        _consume(*futs.popleft().result())
+                while futs:
+                    _consume(*futs.popleft().result())
+        t0 = _time.perf_counter()
+        fr = Frame.from_blocks(accs, names, total, key=key, block=8)
+        _hist(stage="transfer").observe(_time.perf_counter() - t0)
+    telemetry.counter("ingest_rows_total").inc(fr.nrows)
+    return fr
 
 
 def parse_orc(path: str, key: Optional[str] = None) -> Frame:

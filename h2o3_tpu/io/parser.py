@@ -42,14 +42,30 @@ def parse_setup(path: str, nrows_sample: int = 1000,
     the frame — the reference likewise runs format-specific setup on
     sample chunks (water/parser/ParseSetup.java)."""
     import pandas as pd
+    from h2o3_tpu.io import chunking
+    expanded = chunking.expand_paths(path)
+    if expanded and os.path.exists(expanded[0]):
+        path = expanded[0]       # globs/dirs: guess from the first file
     if path.endswith((".parquet", ".pq")):
         # schema only — no data read (multi-GB files must not be parsed
-        # twice just to report types)
+        # twice just to report types). pyarrow.types predicates, not
+        # string equality: DataType.__eq__ against a str is always False,
+        # so the old ("string", "large_string") comparison never matched
+        import pyarrow as pa
         import pyarrow.parquet as pq
         schema = pq.ParquetFile(path).schema_arrow
-        types = {f.name: ("categorical" if f.type in ("string", "large_string")
-                          or str(f.type).startswith("dict") else "numeric")
-                 for f in schema}
+
+        def _arrow_setup_type(t) -> str:
+            if (pa.types.is_dictionary(t) or pa.types.is_string(t)
+                    or pa.types.is_large_string(t) or pa.types.is_binary(t)
+                    or pa.types.is_boolean(t)):
+                # bools ingest as two-level categoricals (io/formats.py)
+                return "categorical"
+            if pa.types.is_timestamp(t) or pa.types.is_date(t):
+                return "time"
+            return "numeric"
+
+        types = {f.name: _arrow_setup_type(f.type) for f in schema}
         return {"columns": list(types), "types": types, "separator": ",",
                 "header": True}
     if path.endswith((".xlsx", ".arff", ".svm", ".svmlight")):
@@ -167,6 +183,7 @@ def import_file(path: str, destination_frame: Optional[str] = None,
         fr = _import_file_eager(path, destination_frame, col_types, header,
                                 na_strings)
     telemetry.histogram("parse_seconds").observe(_time.time() - t0)
+    _ingest_counters(path, fr)
     # provenance for the Cleaner's cheap eviction path: an unmutated
     # file-backed frame can drop straight back to its stub —
     # na_strings included, or rehydrate reparses without NA mapping
@@ -174,6 +191,27 @@ def import_file(path: str, destination_frame: Optional[str] = None,
     fr._source_kwargs = {"col_types": col_types, "header": header,
                          "na_strings": na_strings}
     return fr
+
+
+def _ingest_counters(path, fr) -> None:
+    """ingest_bytes_total{format} / ingest_rows_total for the eager
+    import path (the chunk-parallel streamer and the Parquet row-group
+    reader count their own — parse_parquet self-reports, so the
+    single-file parquet branch is skipped here)."""
+    from h2o3_tpu import telemetry
+    from h2o3_tpu.io import chunking
+    expanded = chunking.expand_paths(path)
+    if len(expanded) == 1 and \
+            chunking.classify_format(expanded[0]) == "parquet":
+        return
+    try:
+        for p in expanded:
+            telemetry.counter(
+                "ingest_bytes_total",
+                format=chunking.classify_format(p)).inc(os.path.getsize(p))
+    except OSError:
+        pass
+    telemetry.counter("ingest_rows_total").inc(fr.nrows)
 
 
 def _import_file_eager(path: str, destination_frame: Optional[str] = None,

@@ -1,295 +1,302 @@
-"""Streaming CSV → device ingest — the FileVec / chunked-parse path.
+"""Streaming CSV → device ingest — the chunk-parallel MultiFileParseTask
+path.
 
 Reference: lazy byte Vecs over external files (water/fvec/FileVec.java:1)
 feeding MultiFileParseTask chunk-at-a-time (water/parser/
 ParseDataset.java:253), with cloud-wide categorical interning
 (ParseDataset.java:356-440).
 
-TPU shape of the same idea: the host reads fixed-size byte windows cut at
-line boundaries, the native threaded tokenizer
-(h2o3_tpu/native/csv_parser.cpp) parses each window, categorical levels
-are interned incrementally against a global running domain, and each
-column ships to HBM as ONE async `jax.device_put` of its assembled
-padded array. Peak host memory is the file's BINARY columns (4 bytes a
-cell), not the raw text; the raw CSV bytes never exist in RAM at once.
+TPU shape of the same idea, now as a three-stage pipeline:
+
+1. SPLIT (producer thread): the quote-aware splitter (io/chunking.py)
+   reads fixed-size byte windows cut at record boundaries and strips
+   repeated per-file headers, fanning windows to the tokenizer pool. A
+   bounded queue gives backpressure, so at most workers+2 raw windows
+   exist on the host at once (the memory-governor "no unbounded host
+   buffering" contract), and each window passes chunk admission against
+   the HBM budget before it is staged.
+2. TOKENIZE (H2O3TPU_PARSE_WORKERS threads): each worker runs the
+   native tokenizer (h2o3_tpu/native/csv_parser.cpp, single-threaded per
+   window — the worker pool IS the parallelism knob) plus per-column
+   dtype narrowing into NumericBlocks / categorical code blocks. ctypes
+   and numpy release the GIL, so threads scale across host cores.
+3. MERGE + TRANSFER (caller thread): windows merge strictly in order
+   into per-column BlockAccumulators (frame/column.py) — global
+   categorical interning, int/float narrowing reconciliation, and one
+   async `jax.device_put` per block. A double-buffered transfer window
+   waits on chunk N-2's device blocks before staging chunk N, so
+   tokenize and H2D transfer overlap instead of running in lockstep.
+
+Because the merge stage is the SAME code consuming the SAME windows in
+the SAME order, the parallel path is bit-identical to the sequential
+one (workers=1), which remains the exact fallback.
 """
 
 from __future__ import annotations
 
-import gzip
+import collections
 import os
-from typing import Dict, IO, List, Optional
+import queue
+import threading
+import time as _time
+from concurrent.futures import ThreadPoolExecutor
+from typing import Dict, List, Optional
 
 import jax
-import jax.numpy as jnp
 import numpy as np
 
-from h2o3_tpu.frame.column import Column, T_CAT, T_NUM
+from h2o3_tpu.frame.column import (BlockAccumulator, block_values_f64,
+                                   narrow_numeric_block)
 from h2o3_tpu.frame.frame import Frame
-from h2o3_tpu.parallel import mesh as mesh_mod
+from h2o3_tpu.io import chunking
+from h2o3_tpu.io.chunking import DEFAULT_CHUNK_BYTES, iter_line_chunks
 from h2o3_tpu.utils.log import get_logger
 
 log = get_logger("h2o3_tpu.stream")
 
-# 64MB windows: small enough that narrowed per-window blocks transfer
-# WHILE the host tokenizes the next window (the wire through the axon
-# tunnel sustains only ~15-20 MB/s, so hiding tokenize time behind it
-# is the difference between adding and maxing the two costs)
-DEFAULT_CHUNK_BYTES = 64 << 20
+# chunks whose device blocks may still be in flight before the merge
+# stage waits on the oldest — the double-buffer depth
+_TRANSFER_DEPTH = 2
+
+_DONE = object()
 
 
-from functools import partial as _partial
+def _tokenize_window(window: bytes, is_first: bool):
+    """Pure per-chunk stage: native tokenize + per-column narrowing.
 
-
-def _open(path: str) -> IO[bytes]:
-    if path.endswith(".gz"):
-        return gzip.open(path, "rb")
-    return open(path, "rb")
-
-
-def _iter_line_chunks(paths: List[str], chunk_bytes: int):
-    """Yield (window, first_of_file) byte windows cut on newline
-    boundaries; each file's first window starts at its header line."""
-    for path in paths:
-        rem = b""
-        first_of_file = True
-        with _open(path) as f:
-            while True:
-                buf = f.read(chunk_bytes)
-                if not buf:
-                    break
-                buf = rem + buf
-                cut = buf.rfind(b"\n")
-                if cut < 0:
-                    rem = buf
-                    continue
-                rem = buf[cut + 1:]
-                yield buf[: cut + 1], first_of_file
-                first_of_file = False
-        if rem:
-            yield (rem if rem.endswith(b"\n") else rem + b"\n"), \
-                first_of_file
-
-
-def _block_int_dtype(lo: float, hi: float):
-    if -128 <= lo and hi <= 127:
-        return np.int8
-    if -32768 <= lo and hi <= 32767:
-        return np.int16
-    return np.int32
-
-
-@_partial(jax.jit, static_argnames=("npad", "dtype", "sizes"))
-def _assemble_col(parts, bit_parts, *, npad: int, dtype: str,
-                  sizes: tuple):
-    """Concatenate the per-window device blocks, upcast to the column's
-    final dtype, pad, and build the NA mask from per-block packed bits
-    (None = block had no NAs) — all on device. One program per
-    (file-window-shape, dtype) signature; the persistent XLA cache
-    amortizes it across runs."""
-    segs = [p.astype(dtype) for p in parts]
-    x = segs[0] if len(segs) == 1 else jnp.concatenate(segs)
-    x = jnp.pad(x, (0, npad - x.shape[0]))
-    x = jax.lax.with_sharding_constraint(x, mesh_mod.row_sharding())
-    msegs = []
-    for bits, sz in zip(bit_parts, sizes):
-        if bits is None:
-            msegs.append(jnp.zeros(sz, bool))
+    Runs on worker threads; touches no shared state. Returns
+    (names_or_None, entries, nrows, seconds) where entries are
+    positional per-column tuples — ('cat', int32 codes, window-local
+    domain) or ('num', NumericBlock) — that the in-order merge maps to
+    global column names.
+    """
+    from h2o3_tpu.native import parse_csv_bytes
+    t0 = _time.perf_counter()
+    res = parse_csv_bytes(window, header=is_first, decode=False,
+                          nthreads=1)
+    if res is None:
+        raise RuntimeError("native csv parser unavailable")
+    cols, domains = res
+    names = list(cols.keys()) if is_first else None
+    entries = []
+    for nm, arr in cols.items():
+        if nm in domains:
+            entries.append(("cat", arr.astype(np.int32, copy=False),
+                            domains[nm]))
         else:
-            idx = jnp.arange(sz, dtype=jnp.int32)
-            b = bits[idx >> 3]
-            msegs.append((
-                (b >> (7 - (idx & 7)).astype(jnp.uint8)) & 1).astype(bool))
-    m = msegs[0] if len(msegs) == 1 else jnp.concatenate(msegs)
-    m = jnp.pad(m, (0, npad - m.shape[0]), constant_values=True)
-    m = jax.lax.with_sharding_constraint(m, mesh_mod.row_sharding())
-    return x, m
+            entries.append(("num",
+                            narrow_numeric_block(np.asarray(arr,
+                                                            np.float64))))
+    nrows = len(next(iter(cols.values()))) if cols else 0
+    return names, entries, nrows, _time.perf_counter() - t0
 
 
-class _ColAcc:
-    """Per-column accumulator: per-window NARROWED device blocks + the
-    global categorical domain.
+class _MergeState:
+    """The in-order merge stage: owns the per-column accumulators.
 
-    Each window's slice ships immediately as an async device_put at the
-    window-local narrow dtype (int8/int16 when the block's values fit —
-    the NewChunk.compress codec role, applied per chunk like the
-    reference), and NA masks ship as packed BITS only for blocks that
-    have NAs. The wire through the tunneled chip is the ingest
-    bottleneck (~15-20 MB/s measured), so bytes-on-wire is the budget:
-    narrowing + bit-masks + transfer/tokenize overlap together turn
-    sum(tokenize, transfer-at-4B/cell) into ~max(tokenize,
-    transfer-at-1-2B/cell)."""
+    One instance per parse; fed window results strictly in window order
+    by both the sequential and parallel drivers, so the resulting
+    frames/domains/dtypes are identical regardless of worker count.
+    """
 
-    def __init__(self, name: str):
-        self.name = name
-        self.parts: List[jax.Array] = []     # device blocks (async put)
-        self.bit_parts: List[Optional[jax.Array]] = []
-        self.sizes: List[int] = []
-        self.levels: Dict[str, int] = {}     # global categorical domain
-        self.order: List[str] = []
-        self.is_cat = False
+    def __init__(self, col_types: Optional[Dict[str, str]]):
+        self.col_types = col_types or {}
+        self.accs: Dict[str, BlockAccumulator] = {}
+        self.names: List[str] = []
+        self.total = 0
 
-    def _push(self, clean: np.ndarray, na: np.ndarray, dtype):
-        self.parts.append(jax.device_put(clean.astype(dtype, copy=False)))
-        self.bit_parts.append(
-            jax.device_put(np.packbits(na)) if na.any() else None)
-        self.sizes.append(len(clean))
+    def merge(self, names: Optional[List[str]], entries, nrows: int):
+        if names is not None and not self.names:
+            self.names = names
+            self.accs = {nm: BlockAccumulator(nm) for nm in names}
+        self.total += nrows
+        for nm, entry in zip(self.names, entries):
+            acc = self.accs[nm]
+            if entry[0] == "cat":
+                acc.add_categorical(entry[1], entry[2])
+            elif self.col_types.get(nm) == "categorical":
+                acc.add_categorical(np.zeros(0, np.int32), [],
+                                    raw_numeric=block_values_f64(entry[1]))
+            else:
+                acc.add_numeric_block(entry[1])
 
-    def add_numeric(self, arr: np.ndarray):
-        if self.is_cat:
-            # numeric window inside a categorical column: values become
-            # their string levels (the reference re-types the column)
-            self.add_categorical(
-                np.where(np.isnan(arr), -1, 0).astype(np.int32),
-                [], raw_numeric=arr)
-            return
-        na = ~np.isfinite(arr)
-        clean = np.where(na, 0.0, arr)
-        # per-chunk integrality/range tracking for the FINAL dtype
-        if not hasattr(self, "_all_int"):
-            self._all_int, self._lo, self._hi = True, np.inf, -np.inf
-        blk_int = np.all(clean == np.round(clean)) and \
-            np.all(np.abs(clean) < 2**31)
-        if self._all_int and blk_int:
-            if clean.size:
-                self._lo = min(self._lo, float(clean.min()))
-                self._hi = max(self._hi, float(clean.max()))
-        else:
-            self._all_int = False
-        if blk_int and clean.size:
-            bd = _block_int_dtype(float(clean.min()), float(clean.max()))
-        elif blk_int:
-            bd = np.int8
-        else:
-            bd = np.float32
-        self._push(clean, na, bd)
+    def new_device_parts(self, prev_counts: Dict[str, int]) -> list:
+        """Device arrays pushed since `prev_counts` — one transfer
+        ticket for the double-buffer."""
+        out = []
+        for nm, acc in self.accs.items():
+            start = min(prev_counts.get(nm, 0), len(acc.parts))
+            out.extend(acc.parts[start:])
+            out.extend(b for b in acc.bit_parts[start:] if b is not None)
+        return out
 
-    def add_categorical(self, codes: np.ndarray, domain: List[str],
-                        raw_numeric: Optional[np.ndarray] = None):
-        if not self.is_cat and self.parts:
-            # column promoted to categorical mid-stream: earlier numeric
-            # blocks are fetched back and re-expressed as levels (rare
-            # type-drift path; one host round trip per prior window —
-            # the reference re-parses the column in the same situation)
-            old = list(zip(self.parts, self.bit_parts, self.sizes))
-            self.parts, self.bit_parts, self.sizes = [], [], []
-            self.is_cat = True
-            for part, bits, sz in old:
-                vals = np.asarray(part, np.float64)
-                if bits is not None:
-                    na_old = np.unpackbits(
-                        np.asarray(bits), count=sz).astype(bool)
-                    vals[na_old] = np.nan
-                self.add_categorical(np.zeros(0, np.int32), [],
-                                     raw_numeric=vals)
-        self.is_cat = True
-        if raw_numeric is not None:
-            strs = np.array([None if np.isnan(v) else
-                             (f"{v:g}") for v in raw_numeric], object)
-            codes = np.empty(len(strs), np.int32)
-            for i, s in enumerate(strs):
-                if s is None:
-                    codes[i] = -1
-                else:
-                    k = self.levels.get(s)
-                    if k is None:
-                        k = self.levels[s] = len(self.order)
-                        self.order.append(s)
-                    codes[i] = k
-            remapped = codes
-        else:
-            lut = np.empty(max(len(domain), 1), np.int32)
-            for j, lvl in enumerate(domain):
-                k = self.levels.get(lvl)
-                if k is None:
-                    k = self.levels[lvl] = len(self.order)
-                    self.order.append(lvl)
-                lut[j] = k
-            remapped = np.where(codes >= 0, lut[np.maximum(codes, 0)], -1)
-        na = remapped < 0
-        clean = np.where(na, 0, remapped)
-        # interning is append-only, so block codes are final; narrow by
-        # the block's max level index (upcast to int32 at assembly)
-        self._push(clean, na,
-                   _block_int_dtype(0, float(clean.max(initial=0))))
+    def part_counts(self) -> Dict[str, int]:
+        return {nm: len(acc.parts) for nm, acc in self.accs.items()}
 
-    def finish(self, n: int, npad: int) -> Column:
-        dtype = np.float32
-        if self.is_cat:
-            dtype = np.int32
-        elif getattr(self, "_all_int", False):
-            dtype = _block_int_dtype(self._lo, self._hi)
-        data, na = _assemble_col(tuple(self.parts), tuple(self.bit_parts),
-                                 npad=npad, dtype=np.dtype(dtype).name,
-                                 sizes=tuple(self.sizes))
-        self.parts, self.bit_parts, self.sizes = [], [], []
-        if self.is_cat:
-            return Column(name=self.name, type=T_CAT, data=data,
-                          na_mask=na, nrows=n, domain=list(self.order))
-        return Column(name=self.name, type=T_NUM, data=data,
-                      na_mask=na, nrows=n)
+
+def _admit_chunk(nbytes: int) -> None:
+    """PR 11 memory-governor chunk admission: before staging another
+    window's blocks toward HBM, make room by spilling cold frames (never
+    rejects mid-parse — eviction is the pressure valve here)."""
+    try:
+        from h2o3_tpu.core.memgov import governor
+        if governor.governed():
+            governor.evict_for_admission(nbytes)
+    except Exception:           # admission is best-effort, parse wins
+        pass
+
+
+class _TransferWindow:
+    """Double-buffered transfer stage: bounds in-flight device blocks to
+    ~_TRANSFER_DEPTH chunks so async device_put overlaps tokenize
+    without unbounded staging, and times the waits as stage=transfer."""
+
+    def __init__(self, hist):
+        self._tickets = collections.deque()
+        self._hist = hist
+
+    def add(self, parts: list) -> None:
+        if parts:
+            self._tickets.append(parts)
+        while len(self._tickets) > _TRANSFER_DEPTH:
+            self._wait_one()
+
+    def drain(self) -> None:
+        while self._tickets:
+            self._wait_one()
+
+    def _wait_one(self) -> None:
+        parts = self._tickets.popleft()
+        t0 = _time.perf_counter()
+        jax.block_until_ready(parts)
+        self._hist(stage="transfer").observe(_time.perf_counter() - t0)
+
+
+def _consume(state: _MergeState, result, hist, transfer: "_TransferWindow",
+             cancel_point) -> None:
+    """Shared merge step for both drivers: cancellation check, in-order
+    accumulator merge, transfer ticketing."""
+    cancel_point("parse.chunk")
+    names, entries, nrows, tok_s = result
+    hist(stage="tokenize").observe(tok_s)
+    before = state.part_counts()
+    t0 = _time.perf_counter()
+    state.merge(names, entries, nrows)
+    hist(stage="merge").observe(_time.perf_counter() - t0)
+    transfer.add(state.new_device_parts(before))
+
+
+def _run_sequential(paths: List[str], chunk_bytes: int, state: _MergeState,
+                    hist, transfer, cancel_point) -> None:
+    for window, is_first in iter_line_chunks(paths, chunk_bytes):
+        _admit_chunk(len(window))
+        _consume(state, _tokenize_window(window, is_first), hist,
+                 transfer, cancel_point)
+
+
+def _run_parallel(paths: List[str], chunk_bytes: int, state: _MergeState,
+                  nworkers: int, hist, transfer, cancel_point) -> None:
+    """Producer → tokenizer pool → in-order merge. The bounded queue is
+    the backpressure: at most nworkers+2 windows (raw bytes or parsed
+    blocks) live on the host at once."""
+    q: "queue.Queue" = queue.Queue(maxsize=nworkers + 2)
+    stop = threading.Event()
+    pool = ThreadPoolExecutor(max_workers=nworkers,
+                              thread_name_prefix="parse-tok")
+
+    def _put(item) -> bool:
+        while not stop.is_set():
+            try:
+                q.put(item, timeout=0.1)
+                return True
+            except queue.Full:
+                continue
+        return False
+
+    def _producer():
+        try:
+            for window, is_first in iter_line_chunks(paths, chunk_bytes):
+                if stop.is_set():
+                    return
+                _admit_chunk(len(window))
+                if not _put(pool.submit(_tokenize_window, window,
+                                        is_first)):
+                    return
+        except BaseException as e:          # surface read errors in merge
+            _put(e)
+        finally:
+            _put(_DONE)
+
+    prod = threading.Thread(target=_producer, name="parse-split",
+                            daemon=True)
+    prod.start()
+    try:
+        while True:
+            item = q.get()
+            if item is _DONE:
+                break
+            if isinstance(item, BaseException):
+                raise item
+            _consume(state, item.result(), hist, transfer, cancel_point)
+    finally:
+        stop.set()
+        while True:                          # unblock a stuck producer
+            try:
+                q.get_nowait()
+            except queue.Empty:
+                break
+        prod.join(timeout=10.0)
+        pool.shutdown(wait=True, cancel_futures=True)
 
 
 def stream_import_csv(path, destination_frame: Optional[str] = None,
-                      chunk_bytes: int = DEFAULT_CHUNK_BYTES,
-                      col_types: Optional[Dict[str, str]] = None) -> Frame:
-    """Chunked native parse with overlapped async H2D transfer."""
-    from h2o3_tpu.native import parse_csv_bytes
-    paths = [path] if isinstance(path, str) else list(path)
+                      chunk_bytes: Optional[int] = None,
+                      col_types: Optional[Dict[str, str]] = None,
+                      workers: Optional[int] = None) -> Frame:
+    """Chunk-parallel native parse with overlapped async H2D transfer.
+
+    ``workers`` (default: H2O3TPU_PARSE_WORKERS / host cores) sizes the
+    tokenizer pool; workers=1 runs the exact sequential fallback. Both
+    paths produce bit-identical frames (data, dtypes, domains, NA
+    masks).
+    """
     from h2o3_tpu import telemetry
+    from h2o3_tpu.core.request_ctx import cancel_point
+    paths = chunking.expand_paths(path)
+    if not paths or not all(os.path.exists(f) for f in paths):
+        raise FileNotFoundError(str(path))
+    nworkers = chunking.resolve_workers(workers)
+    cbytes = chunking.resolve_chunk_bytes(chunk_bytes)
     telemetry.counter("parse_files_total").inc(len(paths))
     try:
         telemetry.counter("parse_bytes_total").inc(
             sum(os.path.getsize(f) for f in paths))
+        for f in paths:
+            telemetry.counter(
+                "ingest_bytes_total",
+                format=chunking.classify_format(f)).inc(
+                    os.path.getsize(f))
     except OSError:
         pass
-    accs: Dict[str, _ColAcc] = {}
-    names: List[str] = []
-    header_line = None
-    total = 0
-    first = True
-    for window, first_of_file in _iter_line_chunks(paths, chunk_bytes):
-        if first_of_file and not first and header_line and \
-                window.startswith(header_line):
-            # repeated header in files 2..N — drop it (the reference
-            # parser likewise skips per-file headers)
-            window = window[len(header_line):]
-            if not window:
-                continue
-        res = parse_csv_bytes(window, header=first, decode=False)
-        if res is None:
-            raise RuntimeError("native csv parser unavailable")
-        cols, domains = res
-        if first:
-            names = list(cols.keys())
-            accs = {nm: _ColAcc(nm) for nm in names}
-            nl = window.find(b"\n")
-            header_line = window[: nl + 1] if nl >= 0 else None
-            first = False
+
+    def hist(**labels):
+        return telemetry.histogram("parse_chunk_seconds", **labels)
+
+    state = _MergeState(col_types)
+    transfer = _TransferWindow(hist)
+    mode = "sequential" if nworkers == 1 else "chunk-parallel"
+    with telemetry.span("parse.stream", mode=mode, workers=nworkers,
+                        files=len(paths)):
+        if nworkers == 1:
+            _run_sequential(paths, cbytes, state, hist, transfer,
+                            cancel_point)
         else:
-            # headerless windows come back as C1..Cn positionally
-            cols = {names[j]: arr
-                    for j, arr in enumerate(cols.values())}
-            domains = {names[int(k[1:]) - 1] if k.startswith("C") else k: v
-                       for k, v in domains.items()}
-        nrows_w = len(next(iter(cols.values()))) if cols else 0
-        total += nrows_w
-        for nm in names:
-            arr = cols[nm]
-            forced = (col_types or {}).get(nm)
-            if nm in domains or forced == "categorical":
-                if nm in domains:
-                    accs[nm].add_categorical(arr.astype(np.int32),
-                                             domains[nm])
-                else:
-                    accs[nm].add_categorical(
-                        np.zeros(0, np.int32), [],
-                        raw_numeric=arr.astype(np.float64))
-            else:
-                accs[nm].add_numeric(np.asarray(arr, np.float64))
-    npad = mesh_mod.padded_rows(total)
-    columns = [accs[nm].finish(total, npad) for nm in names]
-    fr = Frame(columns, total, key=destination_frame)
-    log.info("stream-parsed %s -> %s (%d x %d)", paths[0], fr.key,
-             fr.nrows, fr.ncols)
+            _run_parallel(paths, cbytes, state, nworkers, hist, transfer,
+                          cancel_point)
+        transfer.drain()
+    telemetry.counter("ingest_rows_total").inc(state.total)
+    fr = Frame.from_blocks(state.accs, state.names, state.total,
+                           key=destination_frame)
+    log.info("stream-parsed %s -> %s (%d x %d, %s, workers=%d)",
+             paths[0], fr.key, fr.nrows, fr.ncols, mode, nworkers)
     return fr

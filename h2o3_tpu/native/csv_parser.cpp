@@ -186,15 +186,31 @@ static const char* scan_line(const char* p, const char* limit, char sep,
   return p;
 }
 
-// first line start at/after `off` (0 stays 0); quotes are assumed not to
-// span worker boundaries for the split heuristic — the reference makes the
-// same chunk-boundary assumption (CsvParser cross-chunk stitching)
+// first line start at/after `off` (0 stays 0); only safe for bodies with
+// no '"' at all — quoted bodies go through next_record_start below
 static const char* next_line_start(const char* base, const char* limit,
                                    long off) {
   if (off <= 0) return base;
   const char* p = base + off;
   while (p < limit && *p != '\n') p++;
   return p < limit ? p + 1 : limit;
+}
+
+// quote-parity-aware record start: first newline at/after `off` whose
+// running double-quote parity (seeded with the parity of [base, base+off))
+// is even, i.e. outside any RFC4180-quoted field — so a quoted field with
+// an embedded newline or separator never straddles a worker boundary.
+// "" escapes toggle parity twice and cancel out.
+static const char* next_record_start(const char* base, const char* limit,
+                                     long off, long parity) {
+  if (off <= 0) return base;
+  const char* p = base + off;
+  while (p < limit) {
+    if (*p == '"') parity ^= 1;
+    else if (*p == '\n' && (parity & 1) == 0) return p + 1;
+    p++;
+  }
+  return limit;
 }
 
 struct ColData {
@@ -304,12 +320,37 @@ void* csv_parse(const char* data, long len, char sep, int header,
 
   if (nthreads < 1) nthreads = 1;
   long blen = limit - body;
+  const bool has_quote = memchr(body, '"', (size_t)blen) != nullptr;
   std::vector<ThreadChunk> chunks((size_t)nthreads);
-  for (int t = 0; t < nthreads; t++) {
-    chunks[t].begin = next_line_start(body, limit, blen * t / nthreads);
-    chunks[t].end = next_line_start(body, limit, blen * (t + 1) / nthreads);
+  std::vector<const char*> starts((size_t)nthreads + 1);
+  starts[0] = body;
+  starts[(size_t)nthreads] = limit;
+  if (has_quote) {
+    // quote parity at each naive boundary = prefix quote count (mod 2)
+    std::vector<long> qpfx((size_t)nthreads + 1, 0);
+    for (int t = 0; t < nthreads; t++) {
+      const char* s = body + blen * t / nthreads;
+      const char* e = body + blen * (t + 1) / nthreads;
+      long c = 0;
+      while (s < e) {
+        const char* hit = (const char*)memchr(s, '"', (size_t)(e - s));
+        if (!hit) break;
+        c++;
+        s = hit + 1;
+      }
+      qpfx[(size_t)t + 1] = qpfx[(size_t)t] + c;
+    }
+    for (int t = 1; t < nthreads; t++)
+      starts[(size_t)t] = next_record_start(body, limit, blen * t / nthreads,
+                                            qpfx[(size_t)t] & 1);
+  } else {
+    for (int t = 1; t < nthreads; t++)
+      starts[(size_t)t] = next_line_start(body, limit, blen * t / nthreads);
   }
-  chunks[0].begin = body;
+  for (int t = 0; t < nthreads; t++) {
+    chunks[t].begin = starts[(size_t)t];
+    chunks[t].end = starts[(size_t)t + 1];
+  }
 
   size_t ncols_guess = names.size();
   if (!ncols_guess) {
@@ -328,8 +369,11 @@ void* csv_parse(const char* data, long len, char sep, int header,
   // column degrades to NA exactly as the reference's parse does. This
   // halves the big-file wall time (the full pass 1 re-parsed every
   // field once just to learn the types).
+  // quoted bodies always get the exact full scan: the sample windows are
+  // aligned with the quote-blind next_line_start and could open inside a
+  // quoted field, mis-typing columns
   const long FULL_SCAN_LIMIT = 4 << 20;
-  const bool sampled = blen > FULL_SCAN_LIMIT;
+  const bool sampled = blen > FULL_SCAN_LIMIT && !has_quote;
   std::vector<std::thread> pool;
   std::vector<char> is_str(NC, 0), has_num(NC, 0), has_qe(NC, 0);
   long total_rows = 0;

@@ -314,8 +314,8 @@ def test_bench_dead_backend_fails_fast_per_config(tmp_path):
     assert p.returncode == 0, p.stderr[-2000:]
     errors = [ln for ln in lines if "error" in ln]
     # one per stub config (incl. grid, treekernel, cloud, roofline,
-    # checkpoint, memgov)
-    assert len(errors) == 9
+    # checkpoint, memgov, ingest)
+    assert len(errors) == 10
     assert all("backend dead" in ln["error"] for ln in errors)
     budget = [ln for ln in lines if ln["metric"] == "budget"][0]
     assert budget["left_s"] >= 0.0

@@ -369,6 +369,11 @@ def test_metric_names_documented_in_readme():
                      "fit_admission_rejections_total",
                      "oom_recoveries_total"):
         assert required in section, required
+    # the ISSUE 12 chunk-parallel ingest surface (io/stream.py,
+    # io/formats.py, io/parser.py) is part of the stable contract too
+    for required in ("ingest_bytes_total", "ingest_rows_total",
+                     "parse_chunk_seconds"):
+        assert required in section, required
 
 
 # ----------------------------------------------------------- REST tier
