@@ -807,11 +807,149 @@ def bench_ingest():
           file_mb=round(pbytes / 1e6, 1), workers=w)
 
 
+def bench_serving():
+    """Low-latency scoring tier (ISSUE 14): row-payload predict QPS and
+    tail latency through the continuous micro-batcher vs the SAME
+    requests scored one at a time through ``Model.predict``. Outputs
+    are bit-identical by construction — the serving engine dispatches
+    the model's own compiled program (models/model.py _serve_jit) — so
+    this config measures throughput/latency only, plus the compile
+    observer's per-bucket miss counts (a compile storm here means the
+    row buckets are broken)."""
+    import threading
+
+    import h2o3_tpu
+    from h2o3_tpu import telemetry
+    from h2o3_tpu.core.kv import DKV
+    from h2o3_tpu.models.gbm import GBMEstimator
+    from h2o3_tpu.serving.engine import engine
+    from h2o3_tpu.serving.rows import parse_rows, serving_schema
+
+    n = 20_000 if FAST else 100_000
+    r = np.random.RandomState(14)
+    X = r.randn(n, 8).astype(np.float32)
+    yv = (X[:, 0] + 0.5 * X[:, 1] + 0.5 * r.randn(n) > 0).astype(int)
+    cols = {f"x{i}": X[:, i] for i in range(8)}
+    cols["y"] = np.array(["N", "Y"], object)[yv]
+    fr = h2o3_tpu.Frame.from_numpy(cols, categorical=["y"])
+    model = GBMEstimator(ntrees=20, max_depth=5, seed=1).train(fr, y="y")
+
+    n_clients = 16
+    reqs_per_client = 25 if FAST else 50
+    rows_per_req = 8
+    feats = [f"x{i}" for i in range(8)]
+    rr = np.random.RandomState(15)
+    payloads = []
+    for _ in range(n_clients * reqs_per_client):
+        vals = rr.randn(rows_per_req, len(feats))
+        payloads.append([
+            {f: float(vals[i, j]) for j, f in enumerate(feats)}
+            for i in range(rows_per_req)])
+
+    # sequential baseline: what a naive per-request server does —
+    # parse rows, build a frame, Model.predict, fetch (warmed first so
+    # neither leg pays XLA compiles inside the timed window)
+    schema = serving_schema(model)
+
+    def _predict_once(rows):
+        parsed = parse_rows(schema, rows)
+        pf = h2o3_tpu.Frame.from_numpy(
+            parsed, domains={nm: d for nm, d in schema if d is not None})
+        DKV.remove(pf.key)
+        try:
+            out = model.predict(pf)
+            DKV.remove(out.key)
+        finally:
+            pf.drop_device_caches()
+
+    _predict_once(payloads[0])                   # warm the per-request shape
+    engine.register(model)                       # warm the serving tier
+    n_seq = min(len(payloads), 40 if FAST else 80)
+    t0 = time.time()
+    for rows in payloads[:n_seq]:
+        _predict_once(rows)
+    t_seq = max(time.time() - t0, 1e-9)
+    qps_seq = n_seq / t_seq
+
+    # concurrent leg: n_clients threads hammer engine.score_rows; the
+    # micro-batcher coalesces whatever overlaps into one padded dispatch
+    lat = []
+    lat_lock = threading.Lock()
+    errors = []
+
+    def _client(cid):
+        mine = payloads[cid * reqs_per_client:(cid + 1) * reqs_per_client]
+        for rows in mine:
+            t = time.time()
+            try:
+                engine.score_rows(model, rows)
+            except BaseException as e:   # noqa: BLE001 - scoreboard, not crash
+                errors.append(e)
+                return
+            with lat_lock:
+                lat.append(time.time() - t)
+
+    # untimed warm burst: compiles the coalesced row buckets so the
+    # timed window measures steady-state serving, not first-compile
+    warm_threads = [threading.Thread(
+        target=lambda: engine.score_rows(model, payloads[0]))
+        for _ in range(n_clients)]
+    for t in warm_threads:
+        t.start()
+    for t in warm_threads:
+        t.join()
+    lat.clear()
+
+    d0 = engine._batchers[model.key].dispatches
+    t0 = time.time()
+    threads = [threading.Thread(target=_client, args=(c,))
+               for c in range(n_clients)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    t_conc = max(time.time() - t0, 1e-9)
+    assert not errors, errors[0]
+    qps = len(lat) / t_conc
+    lat_ms = sorted(v * 1e3 for v in lat)
+    p50 = lat_ms[len(lat_ms) // 2]
+    p99 = lat_ms[min(len(lat_ms) - 1, int(len(lat_ms) * 0.99))]
+    dispatches = engine._batchers[model.key].dispatches - d0
+
+    # compile accounting: every serving compile must map to a distinct
+    # row bucket — more misses than buckets means the cache is broken
+    with telemetry.REGISTRY._lock:
+        miss_sigs = [labels for (nm, _), m in
+                     telemetry.REGISTRY._metrics.items()
+                     for labels in [getattr(m, "labels", {})]
+                     if nm.endswith("jit_cache_miss_total")
+                     and labels.get("fn") == "serving.gbm" and m.value > 0]
+    buckets = len(engine._scorers[model.key].buckets)
+    assert len(miss_sigs) <= max(buckets, 1), (miss_sigs, buckets)
+
+    _emit(f"serving GBM row-payload predict {n_clients} clients x "
+          f"{reqs_per_client} reqs x {rows_per_req} rows "
+          f"(continuous micro-batch vs sequential Model.predict)",
+          qps, "requests/sec", qps / qps_seq,
+          "same requests, sequential Model.predict",
+          sequential_qps=round(qps_seq, 1),
+          p50_ms=round(p50, 2), p99_ms=round(p99, 2),
+          requests=len(lat), dispatches=dispatches,
+          mean_batch_width=round(len(lat) / max(dispatches, 1), 2),
+          row_buckets=buckets,
+          serving_compiles=len(miss_sigs),
+          scorer_cache_hits=int(telemetry.REGISTRY.total(
+              "scorer_cache_hits_total")),
+          scorer_cache_misses=int(telemetry.REGISTRY.total(
+              "scorer_cache_misses_total")))
+
+
 CONFIGS = [("gbm", bench_gbm), ("glm", bench_glm), ("dl", bench_dl),
            ("xgb", bench_xgb), ("sort", bench_sort),
            ("grid", bench_grid), ("treekernel", bench_treekernel),
            ("cloud", bench_cloud), ("checkpoint", bench_checkpoint),
            ("memgov", bench_memgov), ("ingest", bench_ingest),
+           ("serving", bench_serving),
            ("automl", bench_automl), ("gbm-full", bench_gbm_full)]
 
 # minimum seconds a config plausibly needs; skipped (with a JSON note)
@@ -819,14 +957,14 @@ CONFIGS = [("gbm", bench_gbm), ("glm", bench_glm), ("dl", bench_dl),
 _MIN_NEED = {"gbm": 60, "glm": 90, "dl": 60, "xgb": 60, "sort": 60,
              "grid": 120, "treekernel": 60, "cloud": 30, "automl": 180,
              "checkpoint": 90, "memgov": 90, "ingest": 90,
-             "gbm-full": 600}
+             "serving": 60, "gbm-full": 600}
 
 # hard per-config wallclock cap (child process killed past it): a
 # wedged worker costs one line, never the scoreboard
 _HARD_CAP = {"gbm": 900, "glm": 600, "dl": 600, "xgb": 600, "sort": 400,
              "grid": 600, "treekernel": 400, "cloud": 300, "automl": 900,
              "checkpoint": 600, "memgov": 600, "ingest": 600,
-             "gbm-full": 1200}
+             "serving": 600, "gbm-full": 1200}
 
 
 def _stub_ok(name):
@@ -1039,6 +1177,95 @@ def _stub_ingest():
           workers=plan["workers"], est_chunks=plan["est_chunks"])
 
 
+def _stub_serving():
+    """`serving` line without a backend (ISSUE 14): drives the full
+    row-parse + micro-batch queue/coalesce/scatter state machine
+    (serving/rows.py + serving/batcher.py, both jax-free) — bounded
+    queue saturation, deadline drops, and request coalescing — with a
+    numpy dispatch standing in for the compiled scorer."""
+    import threading
+
+    from h2o3_tpu.serving.batcher import (MicroBatcher, PendingScore,
+                                          QueueSaturated)
+    from h2o3_tpu.serving.rows import concat_columns, parse_rows
+
+    schema = [("x1", None), ("c1", ["a", "b", "c"])]
+    widths = []
+
+    def _dispatch(batch):
+        cols = concat_columns([p.cols for p in batch])
+        n = sum(p.n for p in batch)
+        assert cols["x1"].shape[0] == n
+        widths.append(len(batch))
+        out = cols["x1"] * 2.0          # stand-in for the device program
+        off = 0
+        for p in batch:
+            p.finish(result=out[off:off + p.n], batch_requests=len(batch))
+            off += p.n
+
+    mb = MicroBatcher("stub-model", _dispatch, max_rows=64, wait_ms=5.0,
+                      queue_depth=8)
+    n_clients, reqs = 4, 50
+    errors = []
+
+    def _client(cid):
+        for i in range(reqs):
+            cols = parse_rows(schema, [{"x1": cid + i, "c1": "b"},
+                                       {"x1": None, "c1": "zzz"}])
+            assert cols["c1"][0] == 1 and cols["c1"][1] == -1
+            p = PendingScore(cols, 2)
+            try:
+                mb.submit(p)
+            except QueueSaturated:
+                time.sleep(0.001)
+                continue
+            assert p.wait(5.0) and p.error is None
+            assert p.result.shape == (2,)
+
+    t0 = time.time()
+    threads = [threading.Thread(target=_client, args=(c,))
+               for c in range(n_clients)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    dt = max(time.time() - t0, 1e-9)
+    served = sum(widths)
+
+    # saturation: an unserviced queue must 503, never block
+    frozen = MicroBatcher("stub-frozen", lambda b: time.sleep(10),
+                          max_rows=4, wait_ms=0.0, queue_depth=2)
+    try:
+        cols = parse_rows(schema, [{"x1": 1.0}])
+        time.sleep(0.05)                # dispatcher is stuck in sleep
+        for _ in range(2):
+            frozen.submit(PendingScore(cols, 1))
+        try:
+            frozen.submit(PendingScore(cols, 1))
+            raise AssertionError("full queue must raise QueueSaturated")
+        except QueueSaturated:
+            pass
+        # expired deadline: failed in-queue, never dispatched
+        late = PendingScore(cols, 1, deadline=time.monotonic() - 1.0)
+        dead = MicroBatcher("stub-dead", _dispatch, max_rows=4,
+                            wait_ms=0.0, queue_depth=4)
+        try:
+            dead.submit(late)
+            assert late.wait(5.0)
+            assert late.error is not None, "expired deadline must fail"
+        finally:
+            dead.close()
+    finally:
+        frozen.close(join=False)
+    mb.close()
+    _emit("serving micro-batch (stub; parse/coalesce/scatter + "
+          "saturation state machine, no backend)", served / dt,
+          "requests/sec", 1.0, "stub", served=served,
+          dispatches=len(widths),
+          mean_batch_width=round(served / max(len(widths), 1), 2),
+          coalesced=any(w > 1 for w in widths))
+
+
 if STUB:
     CONFIGS = [("stub_a", _stub_ok("stub_a")),
                ("stub_wedge", _stub_wedge),
@@ -1049,6 +1276,7 @@ if STUB:
                ("checkpoint", _stub_checkpoint),
                ("memgov", _stub_memgov),
                ("ingest", _stub_ingest),
+               ("serving", _stub_serving),
                ("stub_b", _stub_ok("stub_b"))]
     _MIN_NEED = {n: 1 for n, _ in CONFIGS}
     _HARD_CAP = {n: 30 for n, _ in CONFIGS}

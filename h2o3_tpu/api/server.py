@@ -34,6 +34,7 @@ from h2o3_tpu.core.kv import DKV
 from h2o3_tpu.frame.frame import Frame
 from h2o3_tpu.models import all_algos, get_builder
 from h2o3_tpu.models.model import Model
+from h2o3_tpu.serving.batcher import QueueSaturated
 from h2o3_tpu.utils.log import get_logger
 
 log = get_logger("h2o3_tpu.api")
@@ -896,7 +897,10 @@ def _predict_async(params, body, mid=None, fid=None):
     job = Job(f"predict {mid}", dest=dest)
 
     def _run(j):
-        preds = m.predict(fr)
+        # chunked BigScore: cancel_point at every chunk boundary, so a
+        # cancelled or deadline-expired bulk predict frees its worker
+        # within one chunk like training does (models/model.py)
+        preds = m.predict_in_chunks(fr, job=j)
         DKV.remove(preds.key)
         preds.key = dest
         DKV.put(dest, preds)
@@ -905,6 +909,45 @@ def _predict_async(params, body, mid=None, fid=None):
 
     job.start(_run, background=True)
     return job.to_dict()
+
+
+@route("POST", r"/3/Predictions/models/(?P<mid>[^/]+)")
+def _predict_rows(params, body, mid=None):
+    """Row-payload predict fast path (README §Serving): inline JSON
+    rows — no DKV frame round trip — scored through the serving tier's
+    compiled-scorer cache and continuous micro-batcher, bit-identical
+    to ``Model.predict`` on the same rows. Body:
+    ``{"rows": [{"col": value, ...}, ...]}``; missing keys are NAs."""
+    m = DKV.get(mid)
+    if not isinstance(m, Model):
+        raise KeyError(f"model {mid} not found")
+    rows = params.get("rows")
+    if isinstance(rows, str):
+        try:
+            rows = json.loads(rows)
+        except json.JSONDecodeError as e:
+            raise ValueError(f"malformed 'rows' JSON: {e}") from None
+    if rows is None:
+        raise ValueError("missing 'rows': POST a JSON body "
+                         '{"rows": [{"col": value, ...}, ...]}')
+    from h2o3_tpu.serving import ServingUnsupported
+    from h2o3_tpu.serving.engine import engine
+    try:
+        out, domains, meta = engine.score_rows(m, rows)
+    except ServingUnsupported as e:
+        raise ValueError(str(e)) from None
+    preds = {}
+    for name, arr in out.items():
+        vals = arr.tolist()
+        dom = domains.get(name)
+        if dom is not None:
+            # label the predict column with the training response
+            # domain (what the predictions-frame download shows)
+            vals = [dom[int(v)] if 0 <= int(v) < len(dom) else None
+                    for v in vals]
+        preds[name] = vals
+    return {"model_id": mid, "rows_scored": len(rows),
+            "predictions": preds, "batch": meta}
 
 
 @route("GET", r"/3/Models/(?P<mid>[^/]+)/mojo")
@@ -2173,6 +2216,13 @@ class _Handler(BaseHTTPRequestHandler):
                                 exc_info=True)
                     out = _error_json(path, e, 412)
                     code = 412
+                except QueueSaturated as e:
+                    # per-model predict queue full: the AdmissionGate
+                    # overload contract applied to the scoring queue
+                    telemetry.counter("rest_rejected_total",
+                                      reason="predict_queue_full").inc()
+                    out = _error_json(path, e, 503)
+                    code = 503
                 except Exception as e:   # noqa: BLE001 - request boundary
                     log.exception("handler error on %s %s", method, path)
                     out = _error_json(path, e, 500)
@@ -2186,7 +2236,10 @@ class _Handler(BaseHTTPRequestHandler):
                                     route=endpoint,
                                     status=str(code)).observe(
                     time.monotonic() - t_req)
-                return self._respond(code, out)
+                return self._respond(
+                    code, out,
+                    extra_headers={"Retry-After": "1"}
+                    if code == 503 else None)
         _tl_record("rest", f"{method} {path}", status=404)
         telemetry.counter("rest_requests_total", method=method,
                           endpoint="(no_route)").inc()

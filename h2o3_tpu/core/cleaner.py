@@ -17,6 +17,7 @@ budget.
 
 from __future__ import annotations
 
+import itertools
 import threading
 import time
 from typing import List, Optional
@@ -100,6 +101,7 @@ class Cleaner:
         self.ice_prefix = ice_prefix
         self._thread: Optional[threading.Thread] = None
         self._stop = threading.Event()
+        self._gen = itertools.count()   # per-spill uri generation
         self.spilled_count = 0
         self.restored_count = 0
 
@@ -160,8 +162,14 @@ class Cleaner:
             return stub
         from urllib.parse import quote
         # keys come from user-supplied destination_frame strings: encode
-        # so '..'/'/' cannot escape the ice directory
-        uri = f"{self.ice_prefix}/{quote(key, safe='')}.npz"
+        # so '..'/'/' cannot escape the ice directory. The uri carries a
+        # monotonic generation so every SpilledFrame owns its file
+        # exclusively: a reader's post-restore discard of an OLD stub
+        # must never unlink the ice a newer stub of the same key points
+        # at (that interleaving both tore concurrent restores and lost
+        # the only surviving copy of the frame)
+        uri = (f"{self.ice_prefix}/{quote(key, safe='')}"
+               f".g{next(self._gen)}.npz")
         save_frame(fr, uri)
         stub = SpilledFrame(key, uri, fr.nrows, list(fr.names),
                             _frame_nbytes(fr))

@@ -126,6 +126,17 @@ class Config:
     # byte-window size fed to each tokenizer worker, in MB (the FileVec
     # chunk-size analogue for the parse plane)
     parse_chunk_mb: int = 64
+    # -- low-latency scoring tier (serving/, README §Serving) ----------
+    # row cap for one coalesced predict dispatch; also the ceiling of
+    # the power-of-two row buckets the compiled scorer cache keys on
+    score_batch_max_rows: int = 4096
+    # how long the per-model dispatcher waits to coalesce concurrent
+    # predict requests into one padded device dispatch
+    score_batch_wait_ms: float = 2.0
+    # bounded per-model predict queue; a full queue answers 503 +
+    # Retry-After (the AdmissionGate overload contract on the scoring
+    # queue)
+    score_batch_queue_depth: int = 256
     # -- performance kernels (ops/pallas/) -----------------------------
     # fused Pallas tree kernels (histogram+split+partition per level):
     # "auto" = Pallas on TPU backends, XLA elsewhere; "off" = always the
@@ -143,14 +154,16 @@ class Config:
                              "rest_max_body_mb", "flight_recorder_keep",
                              "heartbeat_miss_budget",
                              "fit_checkpoint_every", "hbm_budget_mb",
-                             "parse_workers", "parse_chunk_mb"})
+                             "parse_workers", "parse_chunk_mb",
+                             "score_batch_max_rows",
+                             "score_batch_queue_depth"})
     _FLOAT_FIELDS = frozenset({"infra_backoff_base_s", "infra_backoff_max_s",
                                "probe_timeout_s", "rest_queue_wait_s",
                                "cloud_timeout_s", "heartbeat_interval_s",
                                "heartbeat_timeout_s",
                                "cluster_metrics_interval_s",
                                "cluster_metrics_stale_s",
-                               "memgov_wait_s"})
+                               "memgov_wait_s", "score_batch_wait_ms"})
 
     @staticmethod
     def from_env(**overrides) -> "Config":

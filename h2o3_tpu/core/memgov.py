@@ -88,6 +88,49 @@ class Reservation:
         return f"<Reservation {self.owner} {self.nbytes / 1e6:.1f}MB>"
 
 
+# auxiliary device-cache registry: subsystems holding device bytes
+# OUTSIDE the DKV frame caches (e.g. the serving tier's compiled-scorer
+# cache) register here so the eviction ladders can reclaim them the
+# same way they drop frame device caches
+_AUX_LOCK = threading.Lock()
+_AUX_CACHES: Dict[str, tuple] = {}   # name -> (nbytes_fn, evict_fn)
+
+
+def register_aux_cache(name: str, nbytes_fn, evict_fn) -> None:
+    """Register an auxiliary device cache with the governor.
+
+    ``nbytes_fn() -> int`` reports the cache's current device bytes;
+    ``evict_fn(exclude=None) -> int`` drops it and returns bytes freed.
+    Idempotent by name (re-registration replaces the hooks)."""
+    with _AUX_LOCK:
+        _AUX_CACHES[name] = (nbytes_fn, evict_fn)
+
+
+def aux_cache_bytes() -> int:
+    """Device bytes held by registered auxiliary caches."""
+    total = 0
+    with _AUX_LOCK:
+        hooks = list(_AUX_CACHES.values())
+    for nbytes_fn, _ in hooks:
+        try:
+            total += int(nbytes_fn() or 0)
+        except Exception:   # noqa: BLE001 - accounting is best-effort
+            pass
+    return total
+
+
+def _evict_aux_caches(exclude: Optional[set] = None) -> int:
+    freed = 0
+    with _AUX_LOCK:
+        hooks = list(_AUX_CACHES.items())
+    for name, (_, evict_fn) in hooks:
+        try:
+            freed += int(evict_fn(exclude=exclude) or 0)
+        except Exception as e:   # noqa: BLE001 - one bad hook must not
+            log.warning("aux cache '%s' eviction failed: %s", name, e)
+    return freed
+
+
 def _frame_cache_nbytes(fr) -> int:
     """Device bytes pinned by a frame's derived caches: the stacked
     ``device_matrix`` arrays and the ``bin_frame`` BinnedMatrix results
@@ -241,7 +284,8 @@ class MemoryGovernor:
     # -- eviction ------------------------------------------------------
     def evict_frame_caches(self, exclude: Optional[set] = None) -> int:
         """Drop every frame's device_matrix/bin_frame caches (previously
-        pinned for the process lifetime); returns bytes released."""
+        pinned for the process lifetime) plus any registered auxiliary
+        device caches (compiled scorers etc.); returns bytes released."""
         from h2o3_tpu.core.kv import DKV
         from h2o3_tpu.frame.frame import Frame
         freed = 0
@@ -252,6 +296,7 @@ class MemoryGovernor:
             if isinstance(v, Frame):
                 freed += v.drop_device_caches()
             del v
+        freed += _evict_aux_caches(exclude=exclude)
         if freed:
             log.info("evicted %.1f MB of frame device caches", freed / 1e6)
         return freed
@@ -366,6 +411,7 @@ class MemoryGovernor:
                 "bytes_in_use": in_use,
                 "free_bytes": max(budget - in_use, 0),
                 "spilled_bytes": self.spilled_bytes(),
+                "aux_cache_bytes": aux_cache_bytes(),
                 "reserved_bytes": self.reserved_bytes(),
                 "reservations": len(self._reservations),
                 "governed": self.governed()}

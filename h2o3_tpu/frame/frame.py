@@ -189,6 +189,38 @@ class Frame:
         keep = [self.col(n) for n in self._order if n not in set(names)]
         return Frame(keep, self.nrows)
 
+    def row_slice(self, lo: int, hi: int) -> "Frame":
+        """Transient sub-frame of rows ``[lo, hi)`` — the chunk view of
+        the chunked bulk-predict path (models/model.py
+        predict_in_chunks). Rebuilt from the cached host views (exact
+        f64 values, so narrowing reproduces the parent's device bytes)
+        and kept OUT of the DKV: callers score it and drop it."""
+        from h2o3_tpu.frame.column import T_STR, T_TIME, T_UUID
+        lo, hi = max(int(lo), 0), min(int(hi), self.nrows)
+        arrays: Dict[str, np.ndarray] = {}
+        domains: Dict[str, List[str]] = {}
+        strings, uuids, times = [], [], []
+        for n in self._order:
+            c = self.col(n)
+            if c.type in (T_STR, T_UUID):
+                arrays[n] = c.strings[lo:hi]
+                (uuids if c.type == T_UUID else strings).append(n)
+                continue
+            v = c.host_view()[lo:hi]
+            if c.is_categorical:
+                # float codes with NaN NAs → -1 (the NA code the
+                # pre-interned-domain path expects)
+                arrays[n] = np.where(np.isnan(v), -1.0, v)
+                domains[n] = list(c.domain or [])
+            else:
+                arrays[n] = v
+                if c.type == T_TIME:
+                    times.append(n)
+        fr = Frame.from_numpy(arrays, domains=domains, strings=strings,
+                              uuids=uuids, times=times)
+        DKV.remove(fr.key)     # transient view, never store-resident
+        return fr
+
     # ---- stats (RollupStats surface on the frame) --------------------
     def summary(self) -> Dict[str, dict]:
         from h2o3_tpu.frame.rollups import prefetch_rollups

@@ -293,26 +293,50 @@ class DeepLearningModel(Model):
         return _forward_scoring(self.net, di.X, self.act)
 
     def _score_raw(self, frame: Frame) -> Dict[str, np.ndarray]:
-        out = self._raw_out(frame)
         n = frame.nrows
-        cat = self.output["category"]
         if self.params.get("autoencoder"):
             di = self._design(frame)
+            out = _forward_scoring(self.net, di.X, self.act)
             mse = np.asarray(jnp.mean((out - di.X) ** 2, axis=1))[:n]
             return {"reconstruction_error": mse}
+        # the model's ONE compiled scoring program — the same
+        # executable the serving tier dispatches, so row-payload
+        # predictions match bit-for-bit (Model._serve_jit)
+        di = self._design(frame)
+        return self._serve_finish(np.asarray(self._serve_jit()(di.X)), n)
+
+    def _serve_dev(self, X):
+        """Device half of the serving fast path (serving/engine.py jits
+        this per row bucket): EXACTLY the device math of ``_score_raw``
+        on a prepared design matrix (``_design(frame).X``). Autoencoders
+        take the engine's eager fallback (their host tail needs the
+        design matrix itself)."""
+        out = _forward_scoring(self.net, X, self.act)
+        if self.output["category"] in (ModelCategory.BINOMIAL,
+                                       ModelCategory.MULTINOMIAL):
+            return jax.nn.softmax(out, axis=1)
+        return out
+
+    def _serve_finish(self, fetched: np.ndarray, n: int) -> Dict[str, np.ndarray]:
+        """Host half of the serving fast path: the exact host tail of
+        ``_score_raw`` applied to the fetched device output (the
+        regression de-standardization deliberately stays host-side —
+        ``_score_raw`` does it in numpy, and moving a f32-array ×
+        python-float product onto the device would risk a ULP drift)."""
+        cat = self.output["category"]
         if cat == ModelCategory.BINOMIAL:
-            p = np.asarray(jax.nn.softmax(out, axis=1))[:n]
+            p = fetched[:n]
             t = self.output.get("default_threshold", 0.5)
             return {"predict": (p[:, 1] >= t).astype(np.int32),
                     "p0": p[:, 0], "p1": p[:, 1]}
         if cat == ModelCategory.MULTINOMIAL:
-            p = np.asarray(jax.nn.softmax(out, axis=1))[:n]
+            p = fetched[:n]
             o = {"predict": p.argmax(axis=1).astype(np.int32)}
             for k in range(p.shape[1]):
                 o[f"p{k}"] = p[:, k]
             return o
         mu, sd = self.resp_stats
-        return {"predict": np.asarray(out[:, 0])[:n] * sd + mu}
+        return {"predict": fetched[:n, 0] * sd + mu}
 
     def anomaly(self, frame: Frame) -> Frame:
         """Autoencoder per-row reconstruction MSE (reference

@@ -141,11 +141,17 @@ class DRFModel(Model):
         if self.output["category"] == ModelCategory.BINOMIAL:
             K = 1
         T = self.forest.feat.shape[0] // K
+        # explicit reciprocal multiply, NOT division: XLA rewrites
+        # x / <constant> into x * reciprocal inside a jitted program
+        # but keeps true division in eager mode, a 1-ULP drift that
+        # breaks the serving bit-identity contract (README §Serving) —
+        # with the multiply spelled out, both paths run the same op
+        inv_t = jnp.float32(1.0 / T)
         outs = []
         for k in range(K):
             f = Tree(*(a.reshape((T, K) + a.shape[1:])[:, k]
                        for a in self.forest))
-            outs.append(predict_forest(f, bm.bins, B) / T)
+            outs.append(predict_forest(f, bm.bins, B) * inv_t)
         return jnp.stack(outs, axis=1)
 
     def _probs(self, bm: BinnedMatrix):
@@ -159,19 +165,11 @@ class DRFModel(Model):
 
     def _score_raw(self, frame: Frame) -> Dict[str, np.ndarray]:
         bm = rebin_for_scoring(self.bm, frame)
-        n = frame.nrows
-        cat = self.output["category"]
-        if cat == ModelCategory.REGRESSION:
-            return {"predict": np.asarray(self._mean_votes(bm))[:n, 0]}
-        p = np.asarray(self._probs(bm))[:n]
-        if cat == ModelCategory.BINOMIAL:
-            t = self.output.get("default_threshold", 0.5)
-            return {"predict": (p[:, 1] >= t).astype(np.int32),
-                    "p0": p[:, 0], "p1": p[:, 1]}
-        out = {"predict": p.argmax(axis=1).astype(np.int32)}
-        for k in range(p.shape[1]):
-            out[f"p{k}"] = p[:, k]
-        return out
+        # the model's ONE compiled scoring program — the same
+        # executable the serving tier dispatches, so row-payload
+        # predictions match bit-for-bit (Model._serve_jit)
+        return self._serve_finish(np.asarray(self._serve_jit()(bm.bins)),
+                                  frame.nrows)
 
     def _score_dev(self, frame: Frame):
         """Device-resident holdout scoring for ml/cv.py light mode —
@@ -185,6 +183,32 @@ class DRFModel(Model):
         if cat == ModelCategory.BINOMIAL:
             return p[:, 1]
         return p
+
+    def _serve_dev(self, bins):
+        """Device half of the serving fast path (serving/engine.py jits
+        this per row bucket): EXACTLY the device math of ``_score_raw``
+        on a pre-binned matrix."""
+        import types
+        bm = types.SimpleNamespace(bins=bins)
+        if self.output["category"] == ModelCategory.REGRESSION:
+            return self._mean_votes(bm)
+        return self._probs(bm)
+
+    def _serve_finish(self, fetched: np.ndarray, n: int) -> Dict[str, np.ndarray]:
+        """Host half of the serving fast path: the exact host tail of
+        ``_score_raw`` applied to the fetched device output."""
+        cat = self.output["category"]
+        if cat == ModelCategory.REGRESSION:
+            return {"predict": fetched[:n, 0]}
+        p = fetched[:n]
+        if cat == ModelCategory.BINOMIAL:
+            t = self.output.get("default_threshold", 0.5)
+            return {"predict": (p[:, 1] >= t).astype(np.int32),
+                    "p0": p[:, 0], "p1": p[:, 1]}
+        out = {"predict": p.argmax(axis=1).astype(np.int32)}
+        for k in range(p.shape[1]):
+            out[f"p{k}"] = p[:, k]
+        return out
 
     def predict_leaf_node_assignment(self, frame: Frame) -> Frame:
         """Per-tree terminal node ids (h2o-py predict_leaf_node_assignment

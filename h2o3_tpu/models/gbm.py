@@ -494,9 +494,15 @@ class GBMModel(Model):
 
     def _score_raw(self, frame: Frame) -> Dict[str, np.ndarray]:
         bm = rebin_for_scoring(self.bm, frame)
-        marg = self._margins(bm, self._frame_offset(frame,
-                                                    bm.bins.shape[0]))
         n = frame.nrows
+        off = self._frame_offset(frame, bm.bins.shape[0])
+        if off is None:
+            # the model's ONE compiled scoring program — the same
+            # executable the serving tier dispatches, so row-payload
+            # predictions match bit-for-bit (Model._serve_jit)
+            return self._serve_finish(_fetch_np(self._serve_jit()(bm.bins)),
+                                      n)
+        marg = self._margins(bm, off)
         cat = self.output["category"]
         if cat == ModelCategory.BINOMIAL:
             dist = get_distribution("bernoulli")
@@ -528,6 +534,39 @@ class GBMModel(Model):
         if cat == ModelCategory.MULTINOMIAL:
             return jax.nn.softmax(marg, axis=1)
         return get_distribution(self.dist_name, **self.params).link_inv(marg)
+
+    def _serve_dev(self, bins):
+        """Device half of the serving fast path (serving/engine.py jits
+        this per row bucket): EXACTLY the device math of ``_score_raw``
+        on a pre-binned matrix. Offset models take the engine's eager
+        fallback, so no offset input rides here."""
+        import types
+        bm = types.SimpleNamespace(bins=bins,
+                                   nbins_total=self.bm.nbins_total)
+        marg = self._margins(bm)
+        cat = self.output["category"]
+        if cat == ModelCategory.BINOMIAL:
+            return get_distribution("bernoulli").link_inv(marg)
+        if cat == ModelCategory.MULTINOMIAL:
+            return jax.nn.softmax(marg, axis=1)
+        return get_distribution(self.dist_name, **self.params).link_inv(marg)
+
+    def _serve_finish(self, fetched: np.ndarray, n: int) -> Dict[str, np.ndarray]:
+        """Host half of the serving fast path: the exact host tail of
+        ``_score_raw`` applied to the fetched device output."""
+        cat = self.output["category"]
+        if cat == ModelCategory.BINOMIAL:
+            p1 = fetched[:n]
+            t = self.output.get("default_threshold", 0.5)
+            return {"predict": (p1 >= t).astype(np.int32),
+                    "p0": 1.0 - p1, "p1": p1}
+        if cat == ModelCategory.MULTINOMIAL:
+            p = fetched[:n]
+            out = {"predict": p.argmax(axis=1).astype(np.int32)}
+            for k in range(p.shape[1]):
+                out[f"p{k}"] = p[:, k]
+            return out
+        return {"predict": fetched[:n]}
 
     def predict_leaf_node_assignment(self, frame: Frame) -> Frame:
         """Per-tree terminal node ids (h2o-py predict_leaf_node_assignment

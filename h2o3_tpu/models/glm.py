@@ -579,24 +579,56 @@ class GLMModel(Model):
     def _score_raw(self, frame: Frame) -> Dict[str, np.ndarray]:
         n = frame.nrows
         cat = self.output["category"]
-        if self.output.get("family") == "ordinal":
-            # whole cumulative-logit pipeline on device, ONE fetch at
-            # the end — the previous path round-tripped eta through the
-            # host and ran the sigmoid in NumPy mid-predict, a blocking
-            # device sync per scoring call (costly on a remote chip)
-            probs = _fetch_np(self._ordinal_probs(frame))[:n]
-            out = {"predict": probs.argmax(axis=1).astype(np.int32)}
-            for k in range(probs.shape[1]):
-                out[f"p{k}"] = probs[:, k]
-            return out
+        off = self._frame_offset(frame)
+        ordinal = self.output.get("family") == "ordinal"
+        if off is None or ordinal or self.coef_multinomial is not None:
+            # the model's ONE compiled scoring program — the same
+            # executable the serving tier dispatches, so row-payload
+            # predictions match bit-for-bit (Model._serve_jit; the
+            # whole pipeline stays on device, ONE fetch at the end —
+            # offset is a no-op for multinomial/ordinal, GLM.java:978)
+            X1 = self._design(frame)
+            return self._serve_finish(_fetch_np(self._serve_jit()(X1)), n)
         eta = self._eta(frame)
-        if cat == ModelCategory.MULTINOMIAL:
-            p = _fetch_np(jax.nn.softmax(eta, axis=1))[:n]
+        mu = _fetch_np(self.family.linkinv(eta))[:n]
+        if cat == ModelCategory.BINOMIAL:
+            t = self.output.get("default_threshold", 0.5)
+            return {"predict": (mu >= t).astype(np.int32),
+                    "p0": 1.0 - mu, "p1": mu}
+        return {"predict": mu}
+
+    def _serve_dev(self, X1):
+        """Device half of the serving fast path (serving/engine.py jits
+        this per row bucket): EXACTLY the device math of ``_score_raw``
+        on a prepared design matrix (``_design`` output, intercept
+        column included). Offset/interactions models take the engine's
+        eager fallback."""
+        if self.output.get("family") == "ordinal":
+            P = X1.shape[1] - 1
+            eta = X1[:, :P] @ jnp.asarray(self.coef[:P], jnp.float32)
+            alphas = jnp.asarray(self.output["ordinal_alphas"], jnp.float32)
+            cum = jax.nn.sigmoid(alphas[None, :] - eta[:, None])
+            cum = jnp.concatenate(
+                [jnp.zeros((eta.shape[0], 1), jnp.float32), cum,
+                 jnp.ones((eta.shape[0], 1), jnp.float32)], axis=1)
+            return jnp.diff(cum, axis=1)
+        if self.coef_multinomial is not None:
+            return jax.nn.softmax(
+                X1 @ jnp.asarray(self.coef_multinomial, jnp.float32), axis=1)
+        return self.family.linkinv(X1 @ jnp.asarray(self.coef, jnp.float32))
+
+    def _serve_finish(self, fetched: np.ndarray, n: int) -> Dict[str, np.ndarray]:
+        """Host half of the serving fast path: the exact host tail of
+        ``_score_raw`` applied to the fetched device output."""
+        cat = self.output["category"]
+        if self.output.get("family") == "ordinal" or \
+                cat == ModelCategory.MULTINOMIAL:
+            p = fetched[:n]
             out = {"predict": p.argmax(axis=1).astype(np.int32)}
             for k in range(p.shape[1]):
                 out[f"p{k}"] = p[:, k]
             return out
-        mu = _fetch_np(self.family.linkinv(eta))[:n]
+        mu = fetched[:n]
         if cat == ModelCategory.BINOMIAL:
             t = self.output.get("default_threshold", 0.5)
             return {"predict": (mu >= t).astype(np.int32),

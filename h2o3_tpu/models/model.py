@@ -17,6 +17,7 @@ categorical codes are remapped into training domains (unseen level → NA).
 from __future__ import annotations
 
 import time
+import weakref
 from typing import Dict, List, Optional, Sequence
 
 import numpy as np
@@ -29,6 +30,10 @@ from h2o3_tpu.frame.frame import Frame
 from h2o3_tpu.utils.log import get_logger
 
 log = get_logger("h2o3_tpu.model")
+
+# per-model compiled scoring programs (Model._serve_jit) — weak-keyed
+# so an evicted/deleted model releases its executables
+_SERVE_JIT_CACHE: "weakref.WeakKeyDictionary" = weakref.WeakKeyDictionary()
 
 
 class ModelCategory:
@@ -155,9 +160,30 @@ class Model:
     def _score_raw(self, frame: Frame) -> Dict[str, np.ndarray]:
         raise NotImplementedError
 
-    def predict(self, frame: Frame) -> Frame:
-        """Bulk scoring → prediction Frame (BigScore, hex/Model.java:2085)."""
-        cols = self._score_raw(frame)
+    def _serve_jit(self):
+        """The model's ONE compiled scoring program: ``jax.jit`` of
+        ``_serve_dev``, cached per model instance. Both ``_score_raw``
+        (on its no-offset path) and the serving tier score through THIS
+        object, so row-payload predictions are bit-identical to
+        ``Model.predict`` by construction — identical traced program,
+        identical XLA fusions — rather than by hoping eager op-by-op
+        execution matches a fused program (it does not: XLA rewrites
+        e.g. divide-by-constant into reciprocal multiplies only inside
+        a jitted program). Cached OUTSIDE the instance dict (weak-keyed
+        module map) so models stay picklable for checkpoints."""
+        fn = _SERVE_JIT_CACHE.get(self)
+        if fn is None:
+            import jax
+            fn = jax.jit(self._serve_dev)
+            _SERVE_JIT_CACHE[self] = fn
+        return fn
+
+    def _finish_predict(self, cols: Dict[str, np.ndarray]):
+        """Shared post-processing of raw score columns: predict-column
+        domain labeling and calibrated probabilities. ONE implementation
+        for ``predict``, the chunked bulk path, and the serving tier —
+        the bit-identity contract of README §Serving rides on all three
+        funneling through here. Returns ``(out, domains)``."""
         out: Dict[str, np.ndarray] = {}
         domains: Dict[str, List[str]] = {}
         for name, arr in cols.items():
@@ -170,6 +196,48 @@ class Model:
             cp1 = cal.apply(np.asarray(out["p1"], dtype=np.float64))
             out["cal_p0"] = 1.0 - cp1
             out["cal_p1"] = cp1
+        return out, domains
+
+    def predict(self, frame: Frame) -> Frame:
+        """Bulk scoring → prediction Frame (BigScore, hex/Model.java:2085)."""
+        out, domains = self._finish_predict(self._score_raw(frame))
+        return Frame.from_numpy(out, domains=domains)
+
+    def predict_in_chunks(self, frame: Frame, job=None,
+                          chunk_rows: Optional[int] = None) -> Frame:
+        """Bulk scoring with chunk-boundary cancellation — the BigScore
+        MRTask contract (water/Job.java stop_requested() polled per
+        chunk): a cancelled or deadline-expired bulk predict frees its
+        worker within one chunk instead of after the full frame. Used
+        by the async ``/4/Predictions`` job path; bit-identical to
+        ``predict`` (every per-chunk op is row-local, and the shared
+        ``_finish_predict`` tail runs once over the reassembled
+        columns)."""
+        import os as _os
+        from h2o3_tpu.core import request_ctx
+        if chunk_rows is None:
+            chunk_rows = int(_os.environ.get(
+                "H2O3TPU_PREDICT_CHUNK_ROWS", 262144))
+        n = frame.nrows
+        if chunk_rows <= 0 or n <= chunk_rows:
+            request_ctx.cancel_point("predict.chunk")
+            if job is not None:
+                job.update(0.9)
+            return self.predict(frame)
+        parts: List[Dict[str, np.ndarray]] = []
+        for lo in range(0, n, chunk_rows):
+            request_ctx.cancel_point("predict.chunk")
+            hi = min(lo + chunk_rows, n)
+            sub = frame.row_slice(lo, hi)
+            try:
+                parts.append(self._score_raw(sub))
+            finally:
+                sub.drop_device_caches()
+            if job is not None:
+                job.update(0.05 + 0.85 * (hi / n))
+        merged = {nm: np.concatenate([p[nm] for p in parts])
+                  for nm in parts[0]}
+        out, domains = self._finish_predict(merged)
         return Frame.from_numpy(out, domains=domains)
 
     def model_performance(self, frame: Frame):

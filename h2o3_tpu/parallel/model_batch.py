@@ -77,6 +77,26 @@ def enabled() -> bool:
     return mode() not in ("0", "off", "false", "no")
 
 
+def row_bucket(n: int, max_rows: int) -> int:
+    """Serving face of the shape-bucket planner: the padded row count a
+    predict batch of ``n`` logical rows compiles at.
+
+    Powers of two from 8 up to the first power of two >= ``max_rows``
+    (the ``H2O3TPU_SCORE_BATCH_MAX_ROWS`` cap), so a storm of
+    variably-sized micro-batches converges on a handful of compiled
+    programs per model instead of one trace per distinct row count —
+    the same geometric-bucket argument as DEPTH_BUCKETS in the tree
+    layer. Always a multiple of the 8-row mesh block, so
+    ``Frame.from_numpy(pad_to=bucket)`` pads to exactly the bucket.
+    """
+    n = max(int(n), 1)
+    cap = max(int(max_rows), 1)
+    b = 8
+    while b < n and b < cap:
+        b <<= 1
+    return b
+
+
 def _canon(v):
     """Hashable canonical form of a hyper value (JSON round trips lists)."""
     if isinstance(v, (list, tuple)):

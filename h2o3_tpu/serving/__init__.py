@@ -1,0 +1,25 @@
+"""Low-latency scoring tier (README §Serving).
+
+``rows`` and ``batcher`` are jax-free and import eagerly (the bench
+stub leg runs them with no backend in the process); the compiled-scorer
+engine pulls in jax and loads lazily via :func:`get_engine`.
+"""
+
+from h2o3_tpu.serving.batcher import (MicroBatcher, PendingScore,
+                                      QueueSaturated, batch_knobs)
+from h2o3_tpu.serving.rows import (Schema, ServingUnsupported,
+                                   concat_columns, domains_of,
+                                   parse_rows, serving_schema)
+
+__all__ = [
+    "MicroBatcher", "PendingScore", "QueueSaturated", "batch_knobs",
+    "Schema", "ServingUnsupported", "concat_columns", "domains_of",
+    "parse_rows", "serving_schema", "get_engine",
+]
+
+
+def get_engine():
+    """The process-wide :class:`~h2o3_tpu.serving.engine.ScoringEngine`
+    (lazy: importing it compiles nothing but does import jax)."""
+    from h2o3_tpu.serving.engine import engine
+    return engine

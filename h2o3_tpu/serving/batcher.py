@@ -1,0 +1,226 @@
+"""Continuous micro-batching for the low-latency scoring tier (jax-free).
+
+Concurrent REST predict calls enqueue parsed row payloads into a
+bounded per-model queue; ONE dispatcher thread per model coalesces
+whatever is waiting (up to ``H2O3TPU_SCORE_BATCH_WAIT_MS``, capped at
+``H2O3TPU_SCORE_BATCH_MAX_ROWS`` rows) into a single padded device
+dispatch and scatters per-request slices back. The accelerator
+tree-traversal literature (Booster, arXiv 2011.02022) shows amortized
+dispatch dominates per-row scoring — this is that amortization applied
+to the REST tier.
+
+Composes with the PR 3 request-hardening contract:
+- the REST admission gate already bounds handler concurrency upstream;
+  the queue bound here (``H2O3TPU_SCORE_BATCH_QUEUE_DEPTH``) is the
+  per-model backpressure — a full queue raises :class:`QueueSaturated`
+  which the REST tier maps to 503 + Retry-After;
+- request deadlines ride on each :class:`PendingScore` — expired
+  entries are failed with ``DeadlineExceeded`` (→ 408) before they
+  waste a device dispatch, and the submitting thread waits with its
+  own remaining budget;
+- the dispatcher calls ``cancel_point`` between dispatches, so an
+  unhealthy cloud fails queued predictions fast instead of blocking
+  them on a device a dead peer owns.
+
+This module is deliberately backend-free (stdlib + the engine-supplied
+``dispatch_fn``): the bench ``_stub_serving`` leg drives the full
+queue/coalesce/scatter state machine with no jax in the process.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from collections import deque
+from typing import Callable, Dict, List, Optional
+
+from h2o3_tpu.core import config as _config
+from h2o3_tpu.core import request_ctx
+from h2o3_tpu.utils.log import get_logger
+
+log = get_logger("h2o3_tpu.serving.batcher")
+
+
+class QueueSaturated(RuntimeError):
+    """The per-model predict queue is full — the REST tier answers 503
+    with Retry-After (the AdmissionGate overload contract, applied to
+    the scoring queue)."""
+
+
+def batch_knobs() -> Dict[str, float]:
+    """Resolved micro-batch knobs, env-at-call-time (the
+    policy_from_config pattern: tests and bench children set
+    ``H2O3TPU_SCORE_BATCH_*`` without rebuilding config.ARGS)."""
+    env = os.environ.get
+    a = _config.ARGS
+    return {
+        "max_rows": max(1, int(env("H2O3TPU_SCORE_BATCH_MAX_ROWS",
+                                   a.score_batch_max_rows))),
+        "wait_ms": max(0.0, float(env("H2O3TPU_SCORE_BATCH_WAIT_MS",
+                                      a.score_batch_wait_ms))),
+        "queue_depth": max(1, int(env("H2O3TPU_SCORE_BATCH_QUEUE_DEPTH",
+                                      a.score_batch_queue_depth))),
+    }
+
+
+class PendingScore:
+    """One request's seat in the micro-batch: parsed columns in, a
+    per-request result slice (or error) out."""
+
+    __slots__ = ("cols", "n", "deadline", "enqueue_t", "result", "error",
+                 "meta", "_event")
+
+    def __init__(self, cols: Dict, n: int,
+                 deadline: Optional[float] = None):
+        self.cols = cols
+        self.n = int(n)
+        self.deadline = deadline          # absolute time.monotonic()
+        self.enqueue_t = time.monotonic()
+        self.result = None
+        self.error: Optional[BaseException] = None
+        self.meta: Dict = {}
+        self._event = threading.Event()
+
+    def finish(self, result=None, error: Optional[BaseException] = None,
+               **meta) -> None:
+        self.result = result
+        self.error = error
+        self.meta.update(meta)
+        self._event.set()
+
+    @property
+    def done(self) -> bool:
+        return self._event.is_set()
+
+    def wait(self, timeout: Optional[float] = None) -> bool:
+        return self._event.wait(timeout)
+
+
+class MicroBatcher:
+    """Bounded queue + coalescing dispatcher for ONE model.
+
+    ``dispatch_fn(batch)`` receives the coalesced ``PendingScore`` list
+    and must ``finish()`` every entry (the engine scatters per-request
+    slices); if it raises instead, every unfinished entry is failed
+    with that error.
+    """
+
+    def __init__(self, name: str, dispatch_fn: Callable[[List[PendingScore]], None],
+                 max_rows: Optional[int] = None,
+                 wait_ms: Optional[float] = None,
+                 queue_depth: Optional[int] = None,
+                 cancel_site: str = "serving.dispatch"):
+        knobs = batch_knobs()
+        self.name = name
+        self.dispatch_fn = dispatch_fn
+        self.max_rows = int(max_rows if max_rows is not None
+                            else knobs["max_rows"])
+        self.wait_ms = float(wait_ms if wait_ms is not None
+                             else knobs["wait_ms"])
+        self.queue_depth = int(queue_depth if queue_depth is not None
+                               else knobs["queue_depth"])
+        self.cancel_site = cancel_site
+        self.dispatches = 0
+        self._q: deque = deque()
+        self._cond = threading.Condition()
+        self._closed = False
+        self._thread = threading.Thread(
+            target=self._loop, name=f"score-batch:{name}", daemon=True)
+        self._thread.start()
+
+    # -- producer side -------------------------------------------------
+    def submit(self, pending: PendingScore) -> None:
+        """Enqueue one request; raises :class:`QueueSaturated` when the
+        bounded queue is full (→ 503 at the REST tier)."""
+        with self._cond:
+            if self._closed:
+                raise RuntimeError(f"batcher {self.name} is closed")
+            if len(self._q) >= self.queue_depth:
+                raise QueueSaturated(
+                    f"predict queue for {self.name} is full "
+                    f"({self.queue_depth} waiting); retry later")
+            self._q.append(pending)
+            self._cond.notify()
+
+    def depth(self) -> int:
+        with self._cond:
+            return len(self._q)
+
+    # -- dispatcher side -----------------------------------------------
+    def _collect(self) -> List[PendingScore]:
+        """Block for the first request, then coalesce whatever arrives
+        within ``wait_ms`` up to ``max_rows`` rows. An oversized single
+        request rides alone (the engine windows it internally)."""
+        with self._cond:
+            while not self._q and not self._closed:
+                self._cond.wait(0.25)
+            if not self._q:
+                return []
+            batch = [self._q.popleft()]
+        rows = batch[0].n
+        limit = time.monotonic() + self.wait_ms / 1000.0
+        while rows < self.max_rows:
+            with self._cond:
+                while self._q and rows + self._q[0].n <= self.max_rows:
+                    p = self._q.popleft()
+                    batch.append(p)
+                    rows += p.n
+                left = limit - time.monotonic()
+                if left <= 0 or self._closed or rows >= self.max_rows:
+                    break
+                self._cond.wait(left)
+        return batch
+
+    def _loop(self) -> None:
+        while True:
+            batch = self._collect()
+            if not batch:
+                if self._closed:
+                    return
+                continue
+            # chunk-boundary cancellation: cloud health fails queued
+            # predictions fast (no job/deadline context rides on the
+            # dispatcher thread — per-request deadlines are checked
+            # individually below)
+            try:
+                request_ctx.cancel_point(self.cancel_site)
+            except BaseException as e:   # noqa: BLE001 - fan the failure out
+                for p in batch:
+                    p.finish(error=e)
+                continue
+            now = time.monotonic()
+            live = []
+            for p in batch:
+                if p.deadline is not None and now >= p.deadline:
+                    p.finish(error=request_ctx.DeadlineExceeded(
+                        f"request deadline expired in the predict queue "
+                        f"({now - p.deadline:.3f}s past)"))
+                else:
+                    live.append(p)
+            if not live:
+                continue
+            self.dispatches += 1
+            try:
+                self.dispatch_fn(live)
+            except BaseException as e:   # noqa: BLE001 - request boundary
+                log.warning("micro-batch dispatch failed for %s: %s",
+                            self.name, e, exc_info=True)
+                for p in live:
+                    if not p.done:
+                        p.finish(error=e)
+
+    def close(self, join: bool = True) -> None:
+        with self._cond:
+            self._closed = True
+            self._cond.notify_all()
+        if join:
+            self._thread.join(timeout=2.0)
+        # fail anything still queued — callers must never hang on a
+        # closed batcher
+        with self._cond:
+            drained = list(self._q)
+            self._q.clear()
+        for p in drained:
+            p.finish(error=RuntimeError(
+                f"batcher {self.name} closed while request was queued"))

@@ -1,0 +1,113 @@
+"""Row-payload parsing for the serving fast path (jax-free).
+
+The row-payload endpoint (``POST /3/Predictions/models/{mid}``) carries
+inline JSON rows — no DKV frame round trip. This module turns those
+rows into host numpy columns ALREADY ADAPTED to the model's training
+schema (the adaptTestForTrain role, hex/Model.java:1850, applied at
+parse time): categorical values are mapped into the TRAINING domain
+(unseen level / missing → -1 = NA) and numerics become float64 with
+NaN NAs. Downstream the engine builds a transient padded Frame from
+these columns, so the device sees exactly the bytes ``Model.predict``
+would see on a client-built frame of the same rows — the foundation of
+the bit-identity contract (README §Serving).
+
+Deliberately import-safe without a backend: the bench stub leg
+(``_stub_serving``) drives parsing + micro-batching with no jax in the
+process.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+
+class ServingUnsupported(Exception):
+    """This model cannot take the compiled fast path (unknown algo,
+    autoencoder, interactions, offset column...). The engine falls back
+    to the eager ``_score_raw`` path on the same transient frame — the
+    endpoint stays universal, only the compile cache is bypassed."""
+
+
+# (name, training_domain_or_None) per input column, in model order
+Schema = List[Tuple[str, Optional[List[str]]]]
+
+
+def serving_schema(model) -> Schema:
+    """The model's input schema: feature names + training categorical
+    domains (None = numeric). Tree models carry it in their binning
+    spec; GLM/DL in their DataInfo stats."""
+    algo = getattr(model, "algo", "")
+    if algo in ("gbm", "drf"):
+        bm = model.bm
+        return [(nm, (bm.domains[j] if bm.is_cat[j] else None))
+                for j, nm in enumerate(bm.names)]
+    if algo in ("glm", "deeplearning"):
+        doms = list(model.di_stats.get("domains") or [])
+        return [(nm, (doms[j] if j < len(doms) else None))
+                for j, nm in enumerate(model.features)]
+    raise ServingUnsupported(
+        f"no serving schema for algo '{algo}' "
+        f"(fast path supports gbm/drf/glm/deeplearning)")
+
+
+def parse_rows(schema: Schema, rows: Sequence[dict]) -> Dict[str, np.ndarray]:
+    """JSON rows → training-adapted host columns.
+
+    Categorical: int32 codes in the TRAINING domain, -1 = NA (missing,
+    null, or a level unseen at training time — the reference maps those
+    to NA too). Numeric: float64, NaN = NA. Missing keys are NAs, never
+    errors: a scoring client may legitimately omit sparse columns.
+    """
+    if not isinstance(rows, (list, tuple)) or not rows:
+        raise ValueError("'rows' must be a non-empty JSON array of objects")
+    for i, r in enumerate(rows):
+        if not isinstance(r, dict):
+            raise ValueError(
+                f"row {i} is not a JSON object (got {type(r).__name__})")
+    n = len(rows)
+    cols: Dict[str, np.ndarray] = {}
+    for name, dom in schema:
+        if dom is not None:
+            lut = {lvl: i for i, lvl in enumerate(dom)}
+            out = np.full(n, -1, np.int32)
+            for i, r in enumerate(rows):
+                v = r.get(name)
+                if v is None:
+                    continue
+                # training domains are interned as strings
+                # (water/parser/Categorical.java) — coerce to match
+                out[i] = lut.get(v if isinstance(v, str) else str(v), -1)
+            cols[name] = out
+        else:
+            out = np.full(n, np.nan, np.float64)
+            for i, r in enumerate(rows):
+                v = r.get(name)
+                if v is None or v == "":
+                    continue
+                try:
+                    out[i] = float(v)
+                except (TypeError, ValueError):
+                    raise ValueError(
+                        f"row {i}: column '{name}' expects a number, "
+                        f"got {v!r}") from None
+            cols[name] = out
+    return cols
+
+
+def domains_of(schema: Schema) -> Dict[str, List[str]]:
+    """{name: training_domain} for the categorical columns — the
+    ``domains=`` argument of ``Frame.from_numpy`` (pre-interned integer
+    codes, no re-factorize)."""
+    return {name: dom for name, dom in schema if dom is not None}
+
+
+def concat_columns(parts: Sequence[Dict[str, np.ndarray]]
+                   ) -> Dict[str, np.ndarray]:
+    """Stack per-request parsed columns into one batch (the micro-batch
+    gather before the single padded device dispatch)."""
+    if len(parts) == 1:
+        return parts[0]
+    names = list(parts[0])
+    return {nm: np.concatenate([p[nm] for p in parts]) for nm in names}

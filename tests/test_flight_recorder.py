@@ -374,6 +374,14 @@ def test_metric_names_documented_in_readme():
     for required in ("ingest_bytes_total", "ingest_rows_total",
                      "parse_chunk_seconds"):
         assert required in section, required
+    # the ISSUE 14 low-latency serving surface (serving/engine.py,
+    # serving/batcher.py) is part of the stable contract too
+    for required in ("predict_requests_total", "predict_batch_width",
+                     "predict_seconds", "scorer_cache_hits_total",
+                     "scorer_cache_misses_total",
+                     "scorer_cache_evictions_total",
+                     "scorer_cache_bytes"):
+        assert required in section, required
 
 
 # ----------------------------------------------------------- REST tier

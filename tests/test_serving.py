@@ -1,0 +1,507 @@
+"""Low-latency scoring tier (ISSUE 14): compiled scorer cache,
+continuous micro-batching, and the row-payload predict fast path.
+
+The acceptance contract:
+- row-payload predictions are BIT-IDENTICAL to ``Model.predict`` on the
+  same rows (both paths dispatch the model's one compiled program,
+  ``Model._serve_jit`` — identical traced program, identical XLA
+  fusions), across GBM/DRF/GLM/DL, categorical domains, NAs, and
+  calibrated probabilities;
+- the compile observer sees exactly ONE fresh compile per (model, row
+  bucket) across a concurrent request storm;
+- the bounded predict queue raises QueueSaturated (→ 503) instead of
+  blocking, and expired deadlines fail in-queue (→ 408) without
+  spending a device dispatch;
+- the scorer cache registers with the memory governor and survives
+  eviction by re-registering on the next request.
+"""
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.parse
+import urllib.request
+
+import numpy as np
+import pytest
+
+import h2o3_tpu
+from h2o3_tpu.core import request_ctx
+from h2o3_tpu.core.kv import DKV
+from h2o3_tpu.serving.batcher import (MicroBatcher, PendingScore,
+                                      QueueSaturated)
+from h2o3_tpu.serving.engine import engine
+from h2o3_tpu.serving.rows import (ServingUnsupported, domains_of,
+                                   parse_rows, serving_schema)
+from h2o3_tpu.telemetry import REGISTRY
+
+# the engine's scorer cache and batcher threads are process-global by
+# design (like the DKV); REST handler threads create keys the
+# thread-local Scope cannot track
+pytestmark = pytest.mark.allow_key_leak
+
+N_ROWS = 240
+
+
+def _frame(resp):
+    r = np.random.RandomState(14)
+    cols = {}
+    x1 = r.randn(N_ROWS)
+    x1[::17] = np.nan                       # numeric NAs
+    cols["x1"] = x1
+    cols["x2"] = r.randn(N_ROWS) * 3 + 1
+    cols["x3"] = r.randint(0, 50, N_ROWS).astype(np.float64)
+    cols["c1"] = np.array([["a", "b", "c", "d"][i % 4]
+                           for i in range(N_ROWS)], dtype=object)
+    cols["c2"] = np.array([["u", "v"][i % 2]
+                           for i in range(N_ROWS)], dtype=object)
+    if resp == "bin":
+        yv = (np.nan_to_num(x1) + cols["x2"] * 0.2
+              + r.randn(N_ROWS) > 0.5).astype(int)
+        cols["y"] = np.array(["no", "yes"], dtype=object)[yv]
+    elif resp == "mul":
+        yv = r.randint(0, 3, N_ROWS)
+        cols["y"] = np.array(["r", "g", "b"], dtype=object)[yv]
+    else:
+        cols["y"] = cols["x2"] * 0.5 + r.randn(N_ROWS)
+    return h2o3_tpu.Frame.from_numpy(cols, categorical=["c1", "c2", "y"]
+                                     if resp != "reg"
+                                     else ["c1", "c2"])
+
+
+def _train(tag):
+    algo, resp = tag.split("-")
+    fr = _frame(resp)
+    x = [c for c in fr.names if c != "y"]
+    if algo == "gbm":
+        from h2o3_tpu.models.gbm import GBMEstimator
+        m = GBMEstimator(ntrees=5, max_depth=3, seed=1).train(
+            fr, y="y", x=x)
+    elif algo == "drf":
+        from h2o3_tpu.models.drf import DRFEstimator
+        m = DRFEstimator(ntrees=5, max_depth=3, seed=1).train(
+            fr, y="y", x=x)
+    elif algo == "glm":
+        from h2o3_tpu.models.glm import GLMEstimator
+        m = GLMEstimator(seed=1).train(fr, y="y", x=x)
+    else:
+        from h2o3_tpu.models.deeplearning import DeepLearningEstimator
+        m = DeepLearningEstimator(hidden=[6], epochs=1, seed=1).train(
+            fr, y="y", x=x)
+    return m, fr
+
+
+def _rows_of(model, fr, lo=0, hi=None):
+    """JSON-shaped row payloads reproducing fr[lo:hi] exactly —
+    including NAs (None) — in the model's serving schema."""
+    schema = serving_schema(model)
+    hi = fr.nrows if hi is None else hi
+    cache = {nm: fr.col(nm).to_numpy() for nm, _ in schema
+             if nm in fr.names}
+    rows = []
+    for i in range(lo, hi):
+        r = {}
+        for nm, dom in schema:
+            if nm not in cache:
+                continue
+            v = float(cache[nm][i])
+            if np.isnan(v):
+                r[nm] = None
+            elif dom is not None:
+                r[nm] = dom[int(v)]
+            else:
+                r[nm] = v
+        rows.append(r)
+    return rows
+
+
+def _assert_bit_identical(tag, base_frame, out, domains):
+    for name in base_frame.names:
+        a = base_frame.col(name).to_numpy()
+        b = np.asarray(out[name])
+        assert np.array_equal(np.asarray(a, dtype=np.float64),
+                              np.asarray(b, dtype=np.float64),
+                              equal_nan=True), (
+            f"{tag}/{name}: max diff "
+            f"{np.nanmax(np.abs(np.asarray(a, np.float64) - np.asarray(b, np.float64)))}")
+    # the predict column's domain must be the training response domain
+    dom = base_frame.col("predict").domain \
+        if base_frame.col("predict").domain else None
+    assert domains.get("predict") == dom
+
+
+# ------------------------------------------------- bit-parity sweep
+
+
+CASES = ["gbm-bin", "gbm-mul", "gbm-reg", "drf-mul", "drf-reg",
+         "glm-bin", "glm-mul", "dl-bin", "dl-reg"]
+
+
+@pytest.fixture(scope="module", params=CASES)
+def served_case(request):
+    m, fr = _train(request.param)
+    return request.param, m, fr
+
+
+def test_row_payload_bit_identical(served_case):
+    """Acceptance: the row-payload fast path (parse → micro-batch →
+    compiled dispatch → scatter) returns bit-identical columns to
+    ``Model.predict`` on the same rows — cats, NAs, probabilities,
+    class labels, everything."""
+    tag, m, fr = served_case
+    base = m.predict(fr)
+    out, domains, meta = engine.score_rows(m, _rows_of(m, fr))
+    assert meta["batch_rows"] >= fr.nrows
+    _assert_bit_identical(tag, base, out, domains)
+    DKV.remove(base.key)
+
+
+def test_calibrated_probabilities_bit_identical():
+    """Platt-calibrated cal_p0/cal_p1 flow through the shared
+    ``_finish_predict`` tail — bit-identical on both paths."""
+    from h2o3_tpu.ml.calibration import Calibrator
+    m, fr = _train("gbm-bin")
+    m.calibrator = Calibrator("plattscaling", (1.3, -0.2))
+    base = m.predict(fr)
+    assert "cal_p1" in base.names
+    out, domains, _ = engine.score_rows(m, _rows_of(m, fr))
+    assert "cal_p1" in out and "cal_p0" in out
+    _assert_bit_identical("gbm-cal", base, out, domains)
+    DKV.remove(base.key)
+
+
+def test_unseen_level_scores_as_na():
+    """A categorical level unseen at training time maps to NA (-1 code)
+    — same prediction as an explicitly missing value (the reference's
+    adaptTestForTrain contract)."""
+    m, fr = _train("gbm-bin")
+    rows = _rows_of(m, fr, 0, 1)
+    row_na = dict(rows[0], c1=None)
+    row_unseen = dict(rows[0], c1="never-seen-level")
+    out_na, _, _ = engine.score_rows(m, [row_na])
+    out_un, _, _ = engine.score_rows(m, [row_unseen])
+    for k in out_na:
+        np.testing.assert_array_equal(out_na[k], out_un[k])
+
+
+def test_mojo_cross_check():
+    """Serving-tier predictions agree with the offline MOJO runtime to
+    float precision on the same raw rows (testdir_javapredict role)."""
+    from h2o3_tpu.genmodel import load_mojo
+    m, fr = _train("gbm-bin")
+    rows = _rows_of(m, fr, 0, 64)
+    out, _, _ = engine.score_rows(m, rows)
+    import tempfile
+    with tempfile.TemporaryDirectory() as d:
+        path = f"{d}/serving_gbm.zip"
+        m.download_mojo(path)
+        mojo = load_mojo(path)
+    doms = dict(serving_schema(m))
+    raw = {}
+    for nm in mojo.names:
+        vals = [r.get(nm) for r in rows]
+        if doms.get(nm) is None:
+            raw[nm] = np.array([np.nan if v is None else float(v)
+                                for v in vals], dtype=np.float64)
+        else:
+            raw[nm] = np.array(vals, dtype=object)
+    offline = mojo.predict(raw)
+    for k in ("p0", "p1"):
+        a = np.asarray(out[k], dtype=np.float64)
+        b = np.asarray(offline[k], dtype=np.float64)
+        assert np.allclose(a, b, atol=1e-4), (
+            k, float(np.abs(a - b).max()))
+
+
+# -------------------------------------------- one compile per bucket
+
+
+def test_one_compile_per_bucket_under_storm():
+    """Acceptance: a concurrent request storm compiles each (model, row
+    bucket) exactly ONCE — every further hit on a bucket is an
+    executable-cache hit, visible in the compile observer's
+    jit_cache_{miss,hit}_total{fn="serving.gbm"} counters."""
+
+    def _misses():
+        with REGISTRY._lock:
+            return sum(
+                m.value for (nm, _), m in REGISTRY._metrics.items()
+                if nm.endswith("jit_cache_miss_total")
+                and getattr(m, "labels", {}).get("fn") == "serving.gbm")
+
+    def _hits():
+        with REGISTRY._lock:
+            return sum(
+                m.value for (nm, _), m in REGISTRY._metrics.items()
+                if nm.endswith("jit_cache_hit_total")
+                and getattr(m, "labels", {}).get("fn") == "serving.gbm")
+
+    m, fr = _train("gbm-bin")          # fresh model: empty jit cache
+    rows = _rows_of(m, fr, 0, 3)
+    base = m.predict(fr)
+    expect = {nm: base.col(nm).to_numpy()[:3] for nm in base.names}
+    DKV.remove(base.key)
+    m0, h0 = _misses(), _hits()
+    errors = []
+
+    def _client():
+        for _ in range(6):
+            try:
+                out, _, _ = engine.score_rows(m, rows)
+            except BaseException as e:   # noqa: BLE001 - assert after join
+                errors.append(e)
+                return
+            for k, v in expect.items():
+                if not np.array_equal(np.asarray(out[k], np.float64),
+                                      np.asarray(v, np.float64),
+                                      equal_nan=True):
+                    errors.append(AssertionError(f"{k} drifted"))
+                    return
+
+    threads = [threading.Thread(target=_client) for _ in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errors, errors[0]
+    buckets = engine._scorers[m.key].buckets
+    assert buckets, "storm must have populated row buckets"
+    # registration warm-up + storm compiled exactly len(buckets)
+    # programs for this (fresh) model — one per padded row bucket
+    assert _misses() - m0 == len(buckets), (buckets, _misses() - m0)
+    assert _hits() - h0 > 0, "storm must hit the executable cache"
+    # the scorer-cache counters tell the same story
+    assert REGISTRY.value("scorer_cache_hits_total",
+                          algo="gbm", path="compiled") > 0
+
+
+# ------------------------------------- backpressure and deadlines
+
+
+def test_queue_saturation_raises_not_blocks():
+    """A full bounded queue raises QueueSaturated immediately (the REST
+    tier maps it to 503 + Retry-After) — never blocks the caller."""
+    started = threading.Event()
+
+    def _stuck(batch):
+        started.set()
+        time.sleep(5.0)
+        for p in batch:
+            p.finish(result=None)
+
+    mb = MicroBatcher("sat-test", _stuck, max_rows=4, wait_ms=0.0,
+                      queue_depth=2)
+    try:
+        cols = {"x1": np.zeros(1)}
+        mb.submit(PendingScore(cols, 1))
+        started.wait(2.0)              # dispatcher now stuck in _stuck
+        mb.submit(PendingScore(cols, 1))
+        mb.submit(PendingScore(cols, 1))
+        with pytest.raises(QueueSaturated):
+            mb.submit(PendingScore(cols, 1))
+    finally:
+        mb.close(join=False)
+
+
+def test_expired_deadline_fails_in_queue():
+    """An expired request deadline fails with DeadlineExceeded (→ 408)
+    BEFORE spending a device dispatch."""
+    dispatched = []
+    mb = MicroBatcher("dl-test", lambda b: dispatched.append(b),
+                      max_rows=4, wait_ms=0.0, queue_depth=4)
+    try:
+        p = PendingScore({"x1": np.zeros(1)}, 1,
+                         deadline=time.monotonic() - 1.0)
+        mb.submit(p)
+        assert p.wait(5.0)
+        assert isinstance(p.error, request_ctx.DeadlineExceeded)
+        assert not dispatched
+    finally:
+        mb.close()
+
+
+def test_score_rows_honors_request_deadline():
+    """engine.score_rows inherits the ambient request deadline
+    (request_ctx) — an already-expired one raises DeadlineExceeded."""
+    m, fr = _train("gbm-reg")
+    rows = _rows_of(m, fr, 0, 2)
+    engine.register(m)                 # warm-up outside the deadline
+    with request_ctx.deadline_scope(time.monotonic() - 0.5):
+        with pytest.raises(request_ctx.DeadlineExceeded):
+            engine.score_rows(m, rows)
+    out, _, _ = engine.score_rows(m, rows)      # healthy afterwards
+    assert len(out["predict"]) == 2
+
+
+# --------------------------------------------- memgov integration
+
+
+def test_eviction_and_reregistration():
+    """The scorer cache is a memgov auxiliary cache: eviction drops
+    compiled scorers (counted), the next request transparently
+    re-registers, and the governor's ladder can reach it."""
+    from h2o3_tpu.core import memgov
+    m, fr = _train("glm-bin")
+    rows = _rows_of(m, fr, 0, 4)
+    engine.score_rows(m, rows)
+    assert m.key in engine._scorers
+    assert engine.cache_nbytes() > 0
+    assert memgov.aux_cache_bytes() >= engine.cache_nbytes()
+    e0 = REGISTRY.total("scorer_cache_evictions_total")
+    freed = engine.evict()
+    assert freed > 0
+    assert m.key not in engine._scorers
+    assert REGISTRY.total("scorer_cache_evictions_total") > e0
+    out, _, _ = engine.score_rows(m, rows)      # re-registers
+    assert m.key in engine._scorers
+    assert len(out["predict"]) == 4
+
+
+def test_serving_unsupported_algo():
+    class _Fake:
+        algo = "kmeans"
+    with pytest.raises(ServingUnsupported):
+        serving_schema(_Fake())
+
+
+def test_parse_rows_errors():
+    schema = [("x1", None), ("c1", ["a", "b"])]
+    with pytest.raises(ValueError, match="non-empty"):
+        parse_rows(schema, [])
+    with pytest.raises(ValueError, match="expects a number"):
+        parse_rows(schema, [{"x1": "not-a-number"}])
+    cols = parse_rows(schema, [{"x1": 1.5, "c1": "b"}, {}])
+    assert cols["x1"][0] == 1.5 and np.isnan(cols["x1"][1])
+    assert cols["c1"][0] == 1 and cols["c1"][1] == -1
+    assert domains_of(schema) == {"c1": ["a", "b"]}
+
+
+# --------------------------------- chunked bulk scoring (satellite)
+
+
+def test_chunked_predict_bit_identical():
+    """predict_in_chunks == predict, bit-exact, at any chunk size — the
+    row_slice sub-frames reproduce the parent's device bytes."""
+    m, fr = _train("gbm-mul")
+    base = m.predict(fr)
+    for chunk_rows in (64, 100):
+        ch = m.predict_in_chunks(fr, chunk_rows=chunk_rows)
+        for nm in base.names:
+            np.testing.assert_array_equal(
+                base.col(nm).to_numpy(), ch.col(nm).to_numpy(),
+                err_msg=f"chunk_rows={chunk_rows}/{nm}")
+        DKV.remove(ch.key)
+    DKV.remove(base.key)
+
+
+def test_chunked_predict_observes_deadline():
+    """Satellite (a): the chunked bulk-scoring loop calls cancel_point
+    at every chunk boundary — an expired request deadline aborts the
+    predict within one chunk instead of scoring the full frame."""
+    m, fr = _train("glm-reg")
+    with request_ctx.deadline_scope(time.monotonic() - 0.5):
+        with pytest.raises(request_ctx.DeadlineExceeded):
+            m.predict_in_chunks(fr, chunk_rows=32)
+
+
+def test_chunked_predict_observes_job_cancel():
+    from h2o3_tpu.core.job import Job, JobCancelledException
+    m, fr = _train("glm-reg")
+    job = Job("cancelled bulk predict")
+    job.cancel()
+    with request_ctx.job_scope(job):
+        with pytest.raises(JobCancelledException):
+            m.predict_in_chunks(fr, chunk_rows=32)
+
+
+# ------------------------------------------------------- REST tier
+
+
+@pytest.fixture(scope="module")
+def port():
+    from h2o3_tpu.api.server import start_server, stop_server
+    p = start_server(port=0, background=True)
+    yield p
+    stop_server()
+
+
+def _req(port, method, path, **params):
+    url = f"http://127.0.0.1:{port}{path}"
+    data = None
+    if method == "POST":
+        data = urllib.parse.urlencode(
+            {k: (json.dumps(v) if isinstance(v, (list, dict)) else v)
+             for k, v in params.items()}).encode()
+    elif params:
+        url += "?" + urllib.parse.urlencode(params)
+    req = urllib.request.Request(url, data=data, method=method)
+    if data:
+        req.add_header("Content-Type",
+                       "application/x-www-form-urlencoded")
+    try:
+        with urllib.request.urlopen(req) as resp:
+            return resp.status, json.loads(resp.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+def test_rest_row_payload_predict(port):
+    """POST /3/Predictions/models/{mid} with inline JSON rows returns
+    per-column predictions matching Model.predict — labels from the
+    training domain, probabilities bit-identical."""
+    m, fr = _train("gbm-bin")
+    rows = _rows_of(m, fr, 0, 8)
+    st, j = _req(port, "POST", f"/3/Predictions/models/{m.key}",
+                 rows=rows)
+    assert st == 200, j
+    assert j["model_id"] == m.key and j["rows_scored"] == 8
+    base = m.predict(fr)
+    dom = m.output["domain"]
+    want_labels = [dom[int(v)] for v in
+                   base.col("predict").to_numpy()[:8]]
+    assert j["predictions"]["predict"] == want_labels
+    np.testing.assert_array_equal(
+        np.asarray(j["predictions"]["p1"], dtype=np.float64),
+        base.col("p1").to_numpy()[:8])
+    assert j["batch"]["batch_rows"] >= 8
+    DKV.remove(base.key)
+
+
+def test_rest_row_payload_errors(port):
+    st, j = _req(port, "POST", "/3/Predictions/models/no_such_model",
+                 rows=[{"x1": 1}])
+    assert st == 404
+    m, _ = _train("glm-bin")
+    st, j = _req(port, "POST", f"/3/Predictions/models/{m.key}")
+    assert st == 412 and "rows" in j["msg"]
+    st, j = _req(port, "POST", f"/3/Predictions/models/{m.key}",
+                 rows=[{"x1": "banana"}])
+    assert st == 412 and "expects a number" in j["msg"]
+
+
+def test_rest_async_bulk_predict_chunked(port, monkeypatch):
+    """Satellite (a): /4/Predictions scores through predict_in_chunks —
+    forced to multiple chunks here — and the banked predictions frame
+    is bit-identical to Model.predict."""
+    monkeypatch.setenv("H2O3TPU_PREDICT_CHUNK_ROWS", "64")
+    m, fr = _train("drf-reg")
+    st, j = _req(port, "POST",
+                 f"/4/Predictions/models/{m.key}/frames/{fr.key}")
+    assert st == 200, j
+    key = j["key"]["name"]
+    t0 = time.time()
+    while time.time() - t0 < 120:
+        st, jj = _req(port, "GET", f"/3/Jobs/{key}")
+        assert st == 200
+        job = jj["jobs"][0]
+        if job["status"] in ("DONE", "FAILED", "CANCELLED"):
+            break
+        time.sleep(0.2)
+    assert job["status"] == "DONE", job
+    preds = DKV.get(job["dest"]["name"])
+    base = m.predict(fr)
+    for nm in base.names:
+        np.testing.assert_array_equal(base.col(nm).to_numpy(),
+                                      preds.col(nm).to_numpy())
+    DKV.remove(base.key)
