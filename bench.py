@@ -549,6 +549,73 @@ def bench_grid():
         peak_hbm_gb=round(_hbm_peak() / 1e9, 2))
 
 
+def bench_sched():
+    """Cluster work scheduler (ISSUE 15, parallel/scheduler.py): the
+    same 16-combo GBM grid through the scheduled path — items planned,
+    leased, models detached, lowered to device-independent bytes and
+    reinstalled (the exact cross-host contract) — vs the
+    coordinator-only walk. On the single-process bench cloud the
+    scheduled run degrades to the inline executor, so this line prices
+    the scheduling + serialization tax every distributed run pays; the
+    model counts must match exactly (the bit-parity contract's cheap
+    proxy here, asserted in full by the multiprocess tier-1 test)."""
+    import h2o3_tpu
+    from h2o3_tpu.core import config as _cfg
+    from h2o3_tpu.ml.grid import GridSearch
+    from h2o3_tpu.models.gbm import GBMEstimator
+    from h2o3_tpu.parallel import scheduler
+    n = 50_000 if FAST else 200_000
+    r = np.random.RandomState(23)
+    X = r.randn(n, 6).astype(np.float32)
+    yv = (X[:, 0] - 0.5 * X[:, 2] + 0.5 * r.randn(n) > 0).astype(int)
+    cols = {f"x{i}": X[:, i] for i in range(6)}
+    cols["y"] = np.array(["N", "Y"], object)[yv]
+    fr = h2o3_tpu.Frame.from_numpy(cols, categorical=["y"])
+    hyper = {"learn_rate": [0.05, 0.08, 0.1, 0.15],
+             "sample_rate": [0.7, 1.0],
+             "min_rows": [5.0, 20.0]}            # 16 combos
+    n_combos = 4 * 2 * 2
+    fixed = dict(ntrees=10, max_depth=5, seed=7)
+
+    def _run(sched_mode):
+        prev = _cfg.ARGS.scheduler
+        _cfg.ARGS.scheduler = sched_mode
+        try:
+            t0 = time.time()
+            g = GridSearch(GBMEstimator, hyper, **fixed).train(fr, y="y")
+            return time.time() - t0, g
+        finally:
+            _cfg.ARGS.scheduler = prev
+
+    # warmup compiles both paths on a 2-combo slice
+    whyper = {"learn_rate": [0.05, 0.1]}
+    prev = _cfg.ARGS.scheduler
+    for m in ("on", "off"):
+        _cfg.ARGS.scheduler = m
+        try:
+            GridSearch(GBMEstimator, whyper, **fixed).train(fr, y="y")
+        finally:
+            _cfg.ARGS.scheduler = prev
+    s0 = scheduler.snapshot()
+    t_on, g_on = _run("on")
+    s1 = scheduler.snapshot()
+    t_off, g_off = _run("off")
+    assert len(g_on.models) == len(g_off.models) == n_combos
+    assert s1["runs"] == s0["runs"] + 1, (s0, s1)
+    mps_on = n_combos / t_on
+    mps_off = n_combos / t_off
+    _emit(
+        f"sched GBM {n_combos} combos {n/1e3:.0f}K rows "
+        f"(scheduled lease/detach/install path vs coordinator-only walk)",
+        mps_on, "models/sec",
+        mps_on / mps_off, "coordinator-only walk, same config",
+        scheduled_seconds=round(t_on, 1),
+        coordinator_seconds=round(t_off, 1),
+        sched_items=s1["items_done"] - s0["items_done"],
+        n_models=len(g_on.models),
+        leases_held_now=scheduler.leases_held())
+
+
 def bench_treekernel():
     """Kernel-level histogram+split+partition throughput
     (rows·features/sec), fused Pallas level pass vs the XLA composition
@@ -949,7 +1016,7 @@ CONFIGS = [("gbm", bench_gbm), ("glm", bench_glm), ("dl", bench_dl),
            ("grid", bench_grid), ("treekernel", bench_treekernel),
            ("cloud", bench_cloud), ("checkpoint", bench_checkpoint),
            ("memgov", bench_memgov), ("ingest", bench_ingest),
-           ("serving", bench_serving),
+           ("serving", bench_serving), ("sched", bench_sched),
            ("automl", bench_automl), ("gbm-full", bench_gbm_full)]
 
 # minimum seconds a config plausibly needs; skipped (with a JSON note)
@@ -957,14 +1024,14 @@ CONFIGS = [("gbm", bench_gbm), ("glm", bench_glm), ("dl", bench_dl),
 _MIN_NEED = {"gbm": 60, "glm": 90, "dl": 60, "xgb": 60, "sort": 60,
              "grid": 120, "treekernel": 60, "cloud": 30, "automl": 180,
              "checkpoint": 90, "memgov": 90, "ingest": 90,
-             "serving": 60, "gbm-full": 600}
+             "serving": 60, "sched": 120, "gbm-full": 600}
 
 # hard per-config wallclock cap (child process killed past it): a
 # wedged worker costs one line, never the scoreboard
 _HARD_CAP = {"gbm": 900, "glm": 600, "dl": 600, "xgb": 600, "sort": 400,
              "grid": 600, "treekernel": 400, "cloud": 300, "automl": 900,
              "checkpoint": 600, "memgov": 600, "ingest": 600,
-             "serving": 600, "gbm-full": 1200}
+             "serving": 600, "sched": 600, "gbm-full": 1200}
 
 
 def _stub_ok(name):
@@ -1266,6 +1333,48 @@ def _stub_serving():
           coalesced=any(w > 1 for w in widths))
 
 
+def _stub_sched():
+    """`sched` line without a backend (ISSUE 15): drives the
+    scheduler's coordinator state machine (parallel/scheduler.py
+    RunBoard) dry — lease → complete → dead-peer reassign → stale
+    generation rejection — plus the chunked zlib+base64 blob transport
+    every published result rides; no jax, no KV server."""
+    from h2o3_tpu.parallel.scheduler import (RunBoard, _B64_CHUNK,
+                                             _decode, _encode)
+    n_items, procs = 64, [0, 1, 2, 3]
+    t0 = time.time()
+    board = RunBoard(n_items, procs, offset=1)
+    # every item leased exactly once, rotated from the run offset
+    leased = sorted(i for p in procs for i in board.assignments(p))
+    assert leased == list(range(n_items))
+    assert board.owner(0) == procs[1]          # offset rotation
+    # half the items complete on their first owners
+    for i in range(0, n_items, 2):
+        assert board.on_result(i, board.owner(i), board.generation(i))
+    # host 2 dies: its unresulted leases reassign over the alive hosts
+    moved = board.on_dead(2)
+    assert moved and all(p != 2 for _, p, _g in moved)
+    assert board.on_dead(2) == []              # idempotent per host
+    # a result published at the PRE-reassignment generation is ignored
+    idx0, _new_pid, new_gen = moved[0]
+    assert not board.on_result(idx0, 2, new_gen - 1)
+    # the new owners drain everything that is left
+    for p in board.alive():
+        for i, g in sorted(board.assignments(p).items()):
+            board.on_result(i, p, g)
+    assert board.complete() and not board.pending()
+    # chunked result-blob transport round-trips losslessly
+    blob = os.urandom(300_000)
+    b64 = _encode(blob)
+    nparts = (len(b64) + _B64_CHUNK - 1) // _B64_CHUNK
+    assert _decode(b64) == blob
+    dt = max(time.time() - t0, 1e-6)
+    _emit("sched RunBoard 64 items 4 hosts (stub; lease->complete->"
+          "reassign state machine, no backend)", n_items / dt,
+          "items/sec", 1.0, "stub", reassigned=len(moved),
+          blob_parts=nparts)
+
+
 if STUB:
     CONFIGS = [("stub_a", _stub_ok("stub_a")),
                ("stub_wedge", _stub_wedge),
@@ -1277,6 +1386,7 @@ if STUB:
                ("memgov", _stub_memgov),
                ("ingest", _stub_ingest),
                ("serving", _stub_serving),
+               ("sched", _stub_sched),
                ("stub_b", _stub_ok("stub_b"))]
     _MIN_NEED = {n: 1 for n, _ in CONFIGS}
     _HARD_CAP = {n: 30 for n, _ in CONFIGS}
@@ -1348,17 +1458,69 @@ def _child_one(name: str) -> int:
         return 1
 
 
+def _stub_probe() -> int:
+    """STUB-mode probe without the package import. Replicates
+    watchdog.maybe_fail("probe") + _consume_shared over the same env
+    contract (H2O3TPU_FAULTS / H2O3TPU_FAULT_STATE) in pure stdlib:
+    the harness tests spawn ~50 probe children per run, and each
+    ``from h2o3_tpu.core import watchdog`` costs ~1s of package import
+    to reach a hook that needs only os/time."""
+    site = "probe"
+    count, sign = 0, "UNAVAILABLE"
+    for part in os.environ.get("H2O3TPU_FAULTS", "").split(","):
+        bits = part.strip().split(":")
+        if bits[0] != site:
+            continue
+        count = int(bits[1]) if len(bits) > 1 and bits[1] else 1
+        if len(bits) > 2 and bits[2]:
+            sign = bits[2]
+        break
+    if count <= 0:
+        return 0
+    state = os.environ.get("H2O3TPU_FAULT_STATE") or None
+    fail = True         # a fresh process always has its budget left
+    if state is not None:
+        path = os.path.join(state, f"fault_{site}.count")
+        os.makedirs(state, exist_ok=True)
+        lock = path + ".lock"
+        for _ in range(200):                      # ~2s worst case
+            try:
+                fd = os.open(lock, os.O_CREAT | os.O_EXCL | os.O_WRONLY)
+                os.close(fd)
+                break
+            except FileExistsError:
+                time.sleep(0.01)
+        try:
+            consumed = 0
+            if os.path.exists(path):
+                with open(path) as f:
+                    consumed = int(f.read().strip() or 0)
+            fail = consumed < count
+            if fail:
+                with open(path, "w") as f:
+                    f.write(str(consumed + 1))
+        finally:
+            try:
+                os.unlink(lock)
+            except OSError:
+                pass
+    if fail:
+        print("# probe failed: InjectedFault(\"%s: injected fault at "
+              "site '%s'\")" % (sign, site), file=sys.stderr)
+        return 1
+    return 0
+
+
 def _child_probe() -> int:
     """Backend liveness probe in a fresh process (core/watchdog.py):
     jax.devices() + a tiny device_put round-trip. In stub mode only the
     fault-injection hook runs — the harness under test, not the chip."""
+    if STUB:
+        return _stub_probe()
     from h2o3_tpu.core import watchdog
     try:
-        if STUB:
-            watchdog.maybe_fail("probe")
-        else:
-            rt = watchdog.probe_backend()
-            print(f"# probe ok ({rt:.2f}s)", file=sys.stderr)
+        rt = watchdog.probe_backend()
+        print(f"# probe ok ({rt:.2f}s)", file=sys.stderr)
         return 0
     except Exception as e:   # noqa: BLE001 - child boundary
         print(f"# probe failed: {e!r}"[:300], file=sys.stderr)

@@ -187,6 +187,20 @@ def _frame_json(fr: Frame, rows: int = 10, row_offset: int = 0) -> dict:
 # ------------------------------------------------------------- handlers
 
 
+def _local_sched_snapshot(pidx) -> dict:
+    """This node's live scheduler counters for /3/Cloud — only for the
+    serving process itself; peers without a published ``sched`` field
+    (snapshot predates the scheduler) show ``{}``."""
+    try:
+        import jax
+        if int(pidx) != jax.process_index():
+            return {}
+        from h2o3_tpu.parallel import scheduler
+        return scheduler.snapshot()
+    except Exception:   # noqa: BLE001 - occupancy is best-effort
+        return {}
+
+
 @route("GET", "/3/Cloud")
 def _cloud(params, body):
     """Cluster status (water/api/CloudHandler, schemas3/CloudV3.java).
@@ -263,6 +277,10 @@ def _cloud(params, body):
                 "peak_hbm": summ.get("peak_hbm", 0),
                 "stale": summ.get("stale", False),
             },
+            # work-scheduler occupancy (parallel/scheduler.py): leases
+            # this host currently holds plus lifetime item counters —
+            # peers via their published snapshot, this node live
+            "sched": summ.get("sched") or _local_sched_snapshot(pidx),
         })
     return {"__meta": {"schema_version": 3, "schema_name": "CloudV3",
                        "schema_type": "Iced"},
@@ -810,6 +828,14 @@ def _job_cancel(params, body, key=None):
 
 @route("GET", "/3/Jobs")
 def _jobs(params, body):
+    """Job list (water/api/JobsHandler). ``?cluster=1`` on a
+    multi-process cloud merges every peer's job list from the telemetry
+    fan-in (telemetry/cluster.py) — each entry stamped with its owning
+    ``node`` (job keys are process-local counters, so same-key entries
+    on different nodes are distinct jobs, never deduped)."""
+    if _cluster_requested(params):
+        from h2o3_tpu.telemetry import cluster
+        return cluster.merged_jobs()
     return {"jobs": list_jobs()}
 
 

@@ -260,6 +260,16 @@ class H2OAutoML:
             # dispatch queue already overlaps host prep with device
             # execution inside one thread; on a pod, raise via env.
             par = 1
+        from h2o3_tpu.parallel import scheduler as _sched
+        if par > 1 and _sched.active():
+            # the cluster work scheduler already fans steps across
+            # hosts, and its SPMD run() entry needs every process to
+            # reach scheduled runs in the same order — thread-parallel
+            # step submission would interleave differently per process
+            self._log_event(
+                "scheduler", "H2O3TPU_AUTOML_PARALLEL ignored on a "
+                "scheduled cloud (steps fan out across hosts instead)")
+            par = 1
         from concurrent.futures import ThreadPoolExecutor, as_completed
         groups = sorted({s.group for s in plan if s.kind != "ensemble"})
         for g in groups:

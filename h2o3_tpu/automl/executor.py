@@ -193,20 +193,51 @@ def run_step(aml, step, budget: Budget, training_frame, y, x) -> List:
             params.setdefault("stopping_tolerance", aml.stopping_tolerance)
         params = {k: v for k, v in params.items()
                   if k in cls.accepted_params()}
-        if aml._recovery is not None:
-            # in-fit checkpoint composition (core/recovery.py): the
-            # model in flight snapshots INSIDE the recovery dir, so a
-            # SIGKILL mid-fit resumes inside the fit on the next
-            # resume_automl() — not from round 0 of the step
-            from h2o3_tpu.core import recovery as _recovery
-            with _recovery.fit_checkpoint_scope(
-                    os.path.join(aml._recovery.dir, "fit_state")):
-                m = train_capped(cls(**params), training_frame, y, x,
-                                 budget)
-        else:
-            m = train_capped(cls(**params), training_frame, y, x, budget)
+        fit_dir = (os.path.join(aml._recovery.dir, "fit_state")
+                   if aml._recovery is not None else None)
+        m = _train_plain(cls, params, training_frame, y, x, budget,
+                         fit_dir, step)
         m.output["automl_step"] = step.id
         trained_count = 1
         return [m]
     finally:
         budget.finish(trained_count)
+
+
+def _train_plain(cls, params, training_frame, y, x, budget: Budget,
+                 fit_dir: Optional[str], step):
+    """Train one plain-model AutoML step. On a scheduled cloud
+    (parallel/scheduler.py) the step becomes a 1-item scheduled run —
+    the run-sequence rotation spreads successive steps across hosts,
+    and a host death mid-step reassigns it (the traveling fit snapshot
+    resumes mid-fit). Otherwise the step trains locally, inside the
+    in-fit checkpoint scope when the run has a recovery dir (a SIGKILL
+    mid-fit resumes inside the fit on the next resume_automl(), not
+    from round 0 of the step)."""
+    from h2o3_tpu.core import recovery as _recovery
+    from h2o3_tpu.parallel import scheduler as _sched
+    if _sched.active():
+        def execute(_k):
+            from h2o3_tpu.parallel import mesh as mesh_mod
+            with mesh_mod.local_mesh_scope():
+                lf = training_frame.local_copy()
+                # every process holds its own SPMD timer copy; only the
+                # executing host's timer can fire against its local job
+                m = train_capped(cls(**params), lf, y, x, budget)
+                return _sched.lower_to_bytes(_sched.detach_model(m))
+        res = _sched.run(f"automl:{step.id}", 1, execute,
+                         fit_dir=fit_dir, deadline=budget.deadline)
+        rec = res.get(0)
+        if rec is None:
+            raise TimeoutError(
+                "budget deadline hit before the scheduled step finished")
+        if not rec["ok"]:
+            if "max_runtime_secs_per_model" in rec["error"]:
+                raise TimeoutError(rec["error"])
+            raise RuntimeError(rec["error"])
+        return _sched.install_model(_sched.from_bytes(rec["data"]))
+    if fit_dir:
+        with _recovery.fit_checkpoint_scope(fit_dir):
+            return train_capped(cls(**params), training_frame, y, x,
+                                budget)
+    return train_capped(cls(**params), training_frame, y, x, budget)

@@ -173,6 +173,13 @@ def init(backend: Optional[str] = None,
                                       int(process_id), timeout_s),
             site="cloud_init")
         _roll_call(int(num_processes), int(process_id), timeout_s)
+        # reformed-cloud hygiene for the work scheduler: swept at INIT,
+        # where the roll-call barrier proves no process is mid-run —
+        # never at shutdown, where processes arrive at different times
+        # and a sweep wedges a peer still reading its last run
+        if int(process_id) == 0:
+            from h2o3_tpu.parallel import scheduler as _scheduler_mod
+            _scheduler_mod.sweep_keys()
         # stamp this process's cloud identity on every log record and
         # flight-recorder capsule (utils/log.py ContextFilter) so merged
         # cluster views stay attributable — set here, NOT read from
@@ -224,7 +231,15 @@ def cluster_info() -> dict:
         "cloud_uptime_ms": (now_ms - _CLOUD_START_MS
                             if _STARTED and _CLOUD_START_MS else 0),
         "heartbeat": hb,
+        # cluster work scheduler (parallel/scheduler.py): this host's
+        # lease/throughput view; GET /3/Cloud?cluster=1 merges peers'
+        "scheduler": _scheduler_snapshot(),
     }
+
+
+def _scheduler_snapshot() -> dict:
+    from h2o3_tpu.parallel import scheduler
+    return scheduler.snapshot()
 
 
 def _sweep_coordination_keys() -> None:
@@ -252,6 +267,13 @@ def _sweep_coordination_keys() -> None:
             client.key_value_delete(f"{prefix}{pidx}")
         except Exception:   # noqa: BLE001 - absent key / service down
             pass
+    # scheduler run subtrees are NOT swept here: processes reach
+    # shutdown at different times, and deleting h2o3tpu/sched/ while a
+    # lagging peer still polls its last run's done manifest wedges that
+    # peer forever. Old runs are garbage-collected run-over-run instead
+    # (scheduler.run deletes the run-before-last, which every process
+    # has provably finished installing), and the subtree dies with the
+    # coordination service itself.
 
 
 def shutdown() -> None:

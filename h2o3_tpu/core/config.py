@@ -137,6 +137,22 @@ class Config:
     # Retry-After (the AdmissionGate overload contract on the scoring
     # queue)
     score_batch_queue_depth: int = 256
+    # -- cluster work scheduler (parallel/scheduler.py) ----------------
+    # fan independent fits (grid combos, AutoML steps, CV folds) across
+    # cloud processes over the coordination-service KV: "auto" (default)
+    # schedules on multi-process clouds only, "on" forces the code path
+    # (single process = everything leases to process 0), "off" keeps
+    # every fit on the coordinator
+    scheduler: str = "auto"
+    # seconds between KV polls in the worker lease loop and the
+    # coordinator's completion wait (cheap control-plane reads)
+    scheduler_poll_s: float = 0.2
+    # a leased item whose owner's heartbeat goes stale past
+    # interval * miss_budget is reassigned after this extra grace
+    scheduler_reassign_grace_s: float = 0.0
+    # hard wall on one scheduled run's completion wait; 0 = no deadline
+    # (budget enforcement lives in grid/AutoML, not the scheduler)
+    scheduler_timeout_s: float = 0.0
     # -- performance kernels (ops/pallas/) -----------------------------
     # fused Pallas tree kernels (histogram+split+partition per level):
     # "auto" = Pallas on TPU backends, XLA elsewhere; "off" = always the
@@ -163,7 +179,10 @@ class Config:
                                "heartbeat_timeout_s",
                                "cluster_metrics_interval_s",
                                "cluster_metrics_stale_s",
-                               "memgov_wait_s", "score_batch_wait_ms"})
+                               "memgov_wait_s", "score_batch_wait_ms",
+                               "scheduler_poll_s",
+                               "scheduler_reassign_grace_s",
+                               "scheduler_timeout_s"})
 
     @staticmethod
     def from_env(**overrides) -> "Config":

@@ -39,9 +39,11 @@ Telemetry: ``heartbeat_rounds_total``, ``heartbeat_misses_total{peer=}``,
 
 from __future__ import annotations
 
+import contextlib
+import contextvars
 import threading
 import time
-from typing import Any, Dict, Optional
+from typing import Any, Dict, List, Optional
 
 from h2o3_tpu.core import config as _config
 from h2o3_tpu.core import watchdog
@@ -337,6 +339,42 @@ class HeartbeatMonitor:
 
 monitor = HeartbeatMonitor()
 
+# chunk boundaries inside this scope skip the cloud-unhealthy fail-fast:
+# scheduled work items (parallel/scheduler.py) train purely on LOCAL
+# devices, so a dead peer cannot wedge them — failing them fast would
+# abandon exactly the work that can still finish and serve the
+# reassignment of the dead peer's items
+_LOCAL_WORK: contextvars.ContextVar[bool] = \
+    contextvars.ContextVar("h2o3tpu_local_work", default=False)
+
+
+@contextlib.contextmanager
+def local_work_scope():
+    """Mark this thread's work as local-device-only: ``check_healthy``
+    becomes a no-op so an unhealthy cloud (a dead peer) does not kill
+    fits that issue no cross-process collectives. Cancel/deadline checks
+    in Job.update still apply."""
+    token = _LOCAL_WORK.set(True)
+    try:
+        yield
+    finally:
+        _LOCAL_WORK.reset(token)
+
+
+def dead_peers() -> List[int]:
+    """Process ids whose beat is stale past ``interval * miss_budget``.
+
+    Deliberately based on ``last_seen`` staleness, not the per-peer
+    ``healthy`` flag — ``mark_unhealthy`` flips every peer's flag, so
+    staleness is the only signal that distinguishes the actually-dead
+    peer from the bystanders (the scheduler's reassignment trigger)."""
+    now = time.time()
+    stale_after = monitor.interval_s * monitor.miss_budget
+    with monitor._lock:
+        return [p for p, st in monitor.peers.items()
+                if p != monitor._pid
+                and now - st["last_seen"] > stale_after]
+
 
 def check_healthy(site: str = "") -> None:
     """Fail-fast checkpoint — called at chunk boundaries alongside
@@ -345,6 +383,8 @@ def check_healthy(site: str = "") -> None:
     interval instead of hanging on the next collective."""
     reason = monitor._unhealthy_reason
     if reason is not None:
+        if _LOCAL_WORK.get():
+            return                     # local-only work: peers irrelevant
         from h2o3_tpu import telemetry
         telemetry.counter("cloud_unhealthy_failfast_total").inc()
         raise CloudUnhealthyError(reason, site=site)

@@ -173,6 +173,24 @@ _fit_dirs_used: set = set()
 # resume-vs-restart without reaching into builder internals
 _thread_state = threading.local()
 
+# post-save observer for the current context — the cluster work
+# scheduler (parallel/scheduler.py) installs a hook that republishes
+# every written snapshot to the coordination-service KV so a reassigned
+# work item's new owner can resume the fit mid-flight
+_post_save_var: contextvars.ContextVar = contextvars.ContextVar(
+    "h2o3tpu_fit_post_save", default=None)
+
+
+@contextlib.contextmanager
+def post_save_scope(hook: Callable[[str, bytes], None]):
+    """Call ``hook(path, blob)`` after every ``FitCheckpointer.save``
+    in this context (exceptions in the hook never fail the fit)."""
+    tok = _post_save_var.set(hook)
+    try:
+        yield
+    finally:
+        _post_save_var.reset(tok)
+
 
 def fit_checkpoint_dir() -> Optional[str]:
     """Resolved in-fit snapshot directory: the contextvar scope wins
@@ -280,6 +298,12 @@ class FitCheckpointer:
         os.replace(tmp, self.path)
         self._last_unit = int(unit)
         _thread_state.last = (self.path, int(unit), self.algo)
+        hook = _post_save_var.get()
+        if hook is not None:
+            try:
+                hook(self.path, blob)
+            except Exception as e:   # noqa: BLE001 - observer only
+                log.warning("fit checkpoint post-save hook failed: %s", e)
         telemetry.counter("fit_checkpoints_written_total",
                           algo=self.algo).inc()
         telemetry.histogram("fit_checkpoint_seconds").observe(

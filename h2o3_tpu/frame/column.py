@@ -186,6 +186,16 @@ def column_from_numpy(name: str, values: np.ndarray, nrows_padded: int,
         host64 = vals64.copy()
         host64[na[:n]] = np.nan
         object.__setattr__(col, "_host_cache", host64)
+    elif not getattr(sharding, "is_fully_addressable", True):
+        # multi-process cloud: every process holds the same full host
+        # copy at ingest (the put_sharded contract), so retain the host
+        # view NOW — host_view() would otherwise have to allgather the
+        # cross-process shards, and scheduled work items
+        # (parallel/scheduler.py) must never issue a collective. One f64
+        # host copy per column, multi-process clouds only.
+        host64 = data[:n].astype(np.float64)
+        host64[na[:n]] = np.nan
+        object.__setattr__(col, "_host_cache", host64)
     return col
 
 

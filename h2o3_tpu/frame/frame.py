@@ -221,6 +221,25 @@ class Frame:
         DKV.remove(fr.key)     # transient view, never store-resident
         return fr
 
+    def local_copy(self) -> "Frame":
+        """Rebuild this frame on the CURRENT mesh from the cached host
+        views — the scheduled-work-item input (parallel/scheduler.py).
+        Called under ``mesh.local_mesh_scope()`` it yields a frame whose
+        device arrays live only on this process's devices, built through
+        the same from_numpy narrowing/padding a single-process ingest
+        runs (the scheduler's bit-parity contract). Collective-free on
+        multi-process clouds: column_from_numpy retained the host copies
+        at ingest. Cached per device set; kept out of the DKV."""
+        devs = tuple(str(d) for d in mesh_mod.get_mesh().devices.flat)
+        cache = getattr(self, "_local_copies", None)
+        if cache is None:
+            cache = self._local_copies = {}
+        fr = cache.get(devs)
+        if fr is None:
+            fr = self.row_slice(0, self.nrows)
+            cache[devs] = fr
+        return fr
+
     # ---- stats (RollupStats surface on the frame) --------------------
     def summary(self) -> Dict[str, dict]:
         from h2o3_tpu.frame.rollups import prefetch_rollups

@@ -16,6 +16,7 @@ import numpy as np
 
 from h2o3_tpu.parallel.mesh import fetch_replicated as _fetch_np
 from h2o3_tpu.parallel.mesh import padded_rows as _pad_rows
+from h2o3_tpu.parallel import scheduler as _scheduler
 
 from h2o3_tpu.frame.frame import Frame
 from h2o3_tpu.models import metrics as mm
@@ -252,6 +253,52 @@ def train_with_cv(builder, frame: Frame, x: Sequence[str], y: str,
         _DKV.remove(probe.key)
         del probe
 
+    # ---- cluster-scheduled fold models (parallel/scheduler.py) -------
+    # the subset-frame fold path is embarrassingly parallel: each fold
+    # trains on its own rebuilt frame with no shared device state, so on
+    # a multi-host cloud the folds fan out as work items (local mesh +
+    # host frame copies) and come back as device-independent model bytes
+    # every process installs identically. The fast path (shared binning
+    # + fold masking on the parent frame) and GLM lambda-search CV keep
+    # their single-program sweeps — scheduling would break the sharing
+    # that makes them fast.
+    sched_folds = None
+    if (_scheduler.active() and not fast and not glm_search
+            and not p.get("checkpoint") and nfolds >= 2):
+        max_fold = int(np.max(np.bincount(folds, minlength=nfolds)))
+
+        def _cv_execute(f):
+            from h2o3_tpu.parallel import mesh as mesh_mod
+            with mesh_mod.local_mesh_scope():
+                lf = frame.local_copy()
+                mask_tr = folds != f
+                tr = subset_frame(lf, mask_tr, pad_to=lf.nrows_padded)
+                te = subset_frame(lf, ~mask_tr,
+                                  pad_to=_pad_rows(max_fold, block=8))
+                sub = builder.__class__(**sub_params)
+                m = sub._fit(tr, list(x), y, job)
+                preds = {k: np.asarray(v)
+                         for k, v in m._score_raw(te).items()}
+                try:
+                    fm = m.model_performance(te)
+                    fmd = fm.to_dict() if hasattr(fm, "to_dict") else {}
+                except Exception:    # noqa: BLE001 - summary-only data
+                    fmd = {}
+                return _scheduler.lower_to_bytes(
+                    (_scheduler.detach_model(m), preds, fmd))
+
+        res = _scheduler.run(f"cv:{builder.algo}:{nfolds}f", nfolds,
+                             _cv_execute, job=job)
+        sched_folds = {}
+        for f in sorted(res):
+            rec = res[f]
+            if not rec["ok"]:
+                # the owning host's training error — sequential CV
+                # would have raised the same error out of its fold loop
+                raise RuntimeError(rec["error"])
+            m, preds_f, fmd = _scheduler.from_bytes(rec["data"])
+            sched_folds[f] = (_scheduler.install_model(m), preds_f, fmd)
+
     for f in range(nfolds):
         mask_tr = folds != f
         idx = np.where(~mask_tr)[0]
@@ -298,6 +345,10 @@ def train_with_cv(builder, frame: Frame, x: Sequence[str], y: str,
                         fm.to_dict() if hasattr(fm, "to_dict") else {})
                 except Exception:
                     fold_metric_dicts.append({})
+        elif sched_folds is not None:
+            m, preds, fmd = sched_folds.pop(f)
+            cv_models.append(m)
+            fold_metric_dicts.append(fmd)
         else:
             tr = subset_frame(frame, mask_tr, pad_to=frame.nrows_padded)
             # holdouts share one padded shape too (all ~n/nfolds rows;

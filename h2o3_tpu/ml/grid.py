@@ -25,6 +25,7 @@ from h2o3_tpu import telemetry
 from h2o3_tpu.core.job import Job
 from h2o3_tpu.core.kv import DKV, make_key
 from h2o3_tpu.parallel import model_batch
+from h2o3_tpu.parallel import scheduler as _scheduler
 from h2o3_tpu.utils.log import get_logger
 
 log = get_logger("h2o3_tpu.grid")
@@ -203,61 +204,83 @@ class GridSearch:
         # inside the combo on the next resume_grid(), not at combo start
         fit_dir = (os.path.join(self.recovery_dir, "fit_state")
                    if self.recovery_dir else None)
-        # ---- model-batched pre-training (parallel/model_batch.py) ----
-        # eligible shape buckets train as ONE vmapped program up front;
-        # the walk below then consumes the pre-trained models in combo
-        # order, so budgets, max_models, asymptotic stopping, recovery
-        # snapshots and leaderboard order behave exactly as sequential
-        # (models trained past a stop/budget point are discarded).
-        pre = self._train_batched(combos, training_frame, y, x,
-                                  validation_frame, job,
-                                  budget_s=budget_s, t0=t0,
-                                  max_models=max_models,
-                                  prior=len(models))
-        for i, combo in enumerate(combos):
-            if budget_s and time.time() - t0 > budget_s:
-                log.info("grid budget exhausted after %d models", len(models))
-                break
-            if max_models and len(models) >= max_models:
-                break
-            params = {**self.fixed, **combo}
-            try:
-                m = pre.pop(i, None)
-                if m is None:
-                    from h2o3_tpu.core import recovery as _recovery
-                    b = self.builder_cls(**params)
-                    with _recovery.fit_checkpoint_scope(fit_dir):
-                        m = b.train(training_frame, y=y, x=x,
-                                    validation_frame=validation_frame)
-                telemetry.counter("grid_models_total",
-                                  algo=self.builder_cls.algo).inc()
-                m.output["grid_params"] = combo
-                models.append(m)
-                if self.recovery_dir:
-                    self._snapshot(m, combo, done, y, x)
-                if stop_rounds > 0:
-                    # asymptotic stopping over the walk's metric history
-                    # (HyperSpaceWalker → ScoreKeeper.stopEarly windows)
-                    sm = (self.criteria.get("sort_metric")
-                          or default_sort_metric(m))
-                    v = sort_value(m, sm)
-                    if v is not None:
-                        stop_scores.append(float(v))
-                        if stop_early_windowed(stop_scores, stop_rounds,
-                                               stop_tol,
-                                               sm.lower() in _ASC):
-                            log.info("grid stopping criteria met after "
-                                     "%d models", len(models))
-                            break
-            except Exception as e:   # failed combos recorded, walk continues
-                log.warning("grid combo %s failed: %s", combo, e)
-                failures.append({"params": combo, "error": str(e)})
-            job.update(1.0, f"model {i + 1}/{len(combos)}")
+        # ---- cluster-scheduled / model-batched pre-training ----------
+        # eligible combos pre-train ahead of the walk: on a multi-host
+        # cloud the work scheduler (parallel/scheduler.py) fans vmap
+        # buckets + singleton combos ACROSS hosts (each bucket still
+        # vmaps WITHIN its host); otherwise eligible shape buckets train
+        # as ONE vmapped program locally. The walk below then consumes
+        # the pre-trained models in combo order, so budgets, max_models,
+        # asymptotic stopping, recovery snapshots and leaderboard order
+        # behave exactly as sequential (models trained past a
+        # stop/budget point are discarded).
+        pre, sched_all = self._train_scheduled(
+            combos, training_frame, y, x, validation_frame, job,
+            budget_s=budget_s, t0=t0, max_models=max_models,
+            prior=len(models), fit_dir=fit_dir)
+        if pre is None:
+            pre = self._train_batched(combos, training_frame, y, x,
+                                      validation_frame, job,
+                                      budget_s=budget_s, t0=t0,
+                                      max_models=max_models,
+                                      prior=len(models))
+        # a fully-scheduled walk only consumes pre-computed results —
+        # it must keep draining after a peer death (the whole point of
+        # reassignment), so the cloud-health fail-fast stands down
+        from h2o3_tpu.core import heartbeat as _hb
+        import contextlib as _ctl
+        with _hb.local_work_scope() if sched_all else _ctl.nullcontext():
+            for i, combo in enumerate(combos):
+                if budget_s and time.time() - t0 > budget_s:
+                    log.info("grid budget exhausted after %d models",
+                             len(models))
+                    break
+                if max_models and len(models) >= max_models:
+                    break
+                params = {**self.fixed, **combo}
+                try:
+                    m = pre.pop(i, None)
+                    if isinstance(m, _scheduler.ScheduledFailure):
+                        # the owning host's training error, re-raised
+                        # here so failure recording matches sequential
+                        raise RuntimeError(m.error)
+                    if m is None:
+                        from h2o3_tpu.core import recovery as _recovery
+                        b = self.builder_cls(**params)
+                        with _recovery.fit_checkpoint_scope(fit_dir):
+                            m = b.train(training_frame, y=y, x=x,
+                                        validation_frame=validation_frame)
+                    telemetry.counter("grid_models_total",
+                                      algo=self.builder_cls.algo).inc()
+                    m.output["grid_params"] = combo
+                    models.append(m)
+                    if self.recovery_dir:
+                        self._snapshot(m, combo, done, y, x)
+                    if stop_rounds > 0:
+                        # asymptotic stopping over the walk's metric
+                        # history (HyperSpaceWalker → ScoreKeeper
+                        # stopEarly windows)
+                        sm = (self.criteria.get("sort_metric")
+                              or default_sort_metric(m))
+                        v = sort_value(m, sm)
+                        if v is not None:
+                            stop_scores.append(float(v))
+                            if stop_early_windowed(stop_scores,
+                                                   stop_rounds, stop_tol,
+                                                   sm.lower() in _ASC):
+                                log.info("grid stopping criteria met "
+                                         "after %d models", len(models))
+                                break
+                except Exception as e:   # failed combos recorded
+                    log.warning("grid combo %s failed: %s", combo, e)
+                    failures.append({"params": combo, "error": str(e)})
+                job.update(1.0, f"model {i + 1}/{len(combos)}")
         # pre-trained models the walk never consumed (budget/max_models/
         # stopping fired first) are discarded — sequential never trained
         # them, so they must not linger in the store either
         for m in pre.values():
-            DKV.remove(m.key)
+            if not isinstance(m, _scheduler.ScheduledFailure):
+                DKV.remove(m.key)
         if fit_dir:
             # the walk completed: unconsumed in-fit snapshots (e.g. a
             # combo that got batch-trained on resume) must not leak
@@ -303,6 +326,106 @@ class GridSearch:
                             "fallback", algo, e)
             job.update(0.0, "batched buckets")   # cancellation checkpoint
         return pre
+
+    def _train_scheduled(self, combos: List[dict], training_frame, y, x,
+                         validation_frame, job, *, budget_s: float,
+                         t0: float, max_models: int, prior: int,
+                         fit_dir: Optional[str]):
+        """Fan combos across cloud hosts (parallel/scheduler.py work
+        items): vmap-eligible shape buckets stay bucketed WITHIN a host
+        (model batching unchanged) while the scheduler spreads buckets
+        + singleton combos ACROSS hosts. Items train on the LOCAL mesh
+        against host frame copies and return device-independent model
+        bytes; every process then installs the identical result set.
+
+        Returns (pre, covered_all): pre maps combo index → Model |
+        ScheduledFailure; (None, False) when the scheduler is off."""
+        if not _scheduler.active() or len(combos) < 2:
+            return None, False
+        # successes cap: combos past max_models can never enter the
+        # grid (same planning window as _train_batched)
+        planned = combos if not max_models \
+            else combos[: max(max_models - prior, 0)]
+        if not planned:
+            return None, False
+        algo = self.builder_cls.algo
+        # deterministic item plan — identical on every process (SPMD)
+        items: List[tuple] = []
+        in_bucket: set = set()
+        if model_batch.enabled():
+            try:
+                for bucket in model_batch.plan_buckets(algo, planned):
+                    if bucket.width < 2:
+                        continue
+                    items.append(("bucket", list(bucket.indices)))
+                    in_bucket.update(bucket.indices)
+            except Exception as e:   # noqa: BLE001 - plan is best-effort
+                log.debug("bucket planning failed (%s); singleton "
+                          "items", e)
+                items, in_bucket = [], set()
+        items.extend(("combo", [i]) for i in range(len(planned))
+                     if i not in in_bucket)
+        items.sort(key=lambda it: it[1][0])
+
+        def _train_one(ci, lf, lv):
+            params = {**self.fixed, **planned[ci]}
+            try:
+                m = self.builder_cls(**params).train(
+                    lf, y=y, x=x, validation_frame=lv)
+                return ("model", _scheduler.detach_model(m))
+            except Exception as e:   # noqa: BLE001 - travels as failure
+                return ("error", str(e))
+
+        def execute(k):
+            from h2o3_tpu.parallel import mesh as mesh_mod
+            kind, idxs = items[k]
+            with mesh_mod.local_mesh_scope():
+                lf = training_frame.local_copy()
+                lv = (validation_frame.local_copy()
+                      if validation_frame is not None else None)
+                out = []
+                if kind == "bucket":
+                    bcombos = [planned[ci] for ci in idxs]
+                    bmodels = None
+                    try:
+                        bmodels = model_batch.train_bucket(
+                            self.builder_cls, self.fixed, bcombos, lf,
+                            y=y, x=x, validation_frame=lv)
+                    except model_batch.BatchIneligible:
+                        pass
+                    except Exception as e:   # noqa: BLE001 - fallback
+                        log.warning("scheduled %s bucket failed (%s); "
+                                    "per-combo fallback", algo, e)
+                    if bmodels is not None:
+                        out.extend(
+                            (ci, "model", _scheduler.detach_model(m))
+                            for ci, m in zip(idxs, bmodels))
+                    else:
+                        out.extend((ci,) + _train_one(ci, lf, lv)
+                                   for ci in idxs)
+                else:
+                    out.extend((ci,) + _train_one(ci, lf, lv)
+                               for ci in idxs)
+            return _scheduler.lower_to_bytes(out)
+
+        deadline = (t0 + budget_s) if budget_s else None
+        results = _scheduler.run(f"grid:{algo}:{self.grid_id}",
+                                 len(items), execute, job=job,
+                                 fit_dir=fit_dir, deadline=deadline)
+        pre: Dict[int, object] = {}
+        for k in sorted(results):
+            rec = results[k]
+            if not rec["ok"]:
+                for ci in items[k][1]:
+                    pre[ci] = _scheduler.ScheduledFailure(rec["error"])
+                continue
+            for ci, kind, obj in _scheduler.from_bytes(rec["data"]):
+                if kind == "error":
+                    pre[ci] = _scheduler.ScheduledFailure(obj)
+                else:
+                    pre[ci] = _scheduler.install_model(obj)
+        covered = set(pre) >= set(range(len(combos)))
+        return pre, covered
 
     # -- fault tolerance (hex/faulttolerance/Recovery onModel snapshots) --
     def _snapshot(self, model, combo: dict, done: List[dict],

@@ -177,7 +177,11 @@ class Word2VecEstimator(ModelBuilder):
     DEFAULTS = dict(
         vec_size=100, window_size=5, sent_sample_rate=1e-3, epochs=5,
         min_word_freq=5, init_learning_rate=0.025, seed=-1,
-        batch_size=4096, ignored_columns=None,
+        # small mini-batches: the reference's WordVectorTrainer applies one
+        # HOGWILD update per (center, context) pair, so embedding quality
+        # tracks sequential update count — large batches collapse a small
+        # corpus into too few SGD steps for topics to separate
+        batch_size=64, ignored_columns=None,
     )
 
     def __init__(self, **params):

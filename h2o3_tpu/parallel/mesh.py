@@ -20,6 +20,8 @@ first (innermost axis varies fastest across a slice).
 
 from __future__ import annotations
 
+import contextlib
+import contextvars
 from typing import Optional, Sequence
 
 import jax
@@ -69,12 +71,38 @@ def set_global_mesh(mesh: Optional[Mesh]) -> None:
     _GLOBAL_MESH = mesh
 
 
+# per-thread mesh override (parallel/scheduler.py worker loops): scheduled
+# work items train against a mesh over the process's LOCAL devices so a fit
+# never issues a cross-process collective — a dead peer then cannot wedge
+# it, and a single local device matches the single-process reference mesh
+# bit-for-bit (the scheduler's determinism contract)
+_MESH_OVERRIDE: contextvars.ContextVar[Optional[Mesh]] = \
+    contextvars.ContextVar("h2o3tpu_mesh_override", default=None)
+
+
 def get_mesh() -> Mesh:
     """The process mesh (analogue of the static H2O.CLOUD, water/H2O.java)."""
     global _GLOBAL_MESH
+    override = _MESH_OVERRIDE.get()
+    if override is not None:
+        return override
     if _GLOBAL_MESH is None:
         _GLOBAL_MESH = make_mesh()
     return _GLOBAL_MESH
+
+
+@contextlib.contextmanager
+def local_mesh_scope(model_axis: int = 1):
+    """Route every ``get_mesh()`` in this thread to a mesh over
+    ``jax.local_devices()`` — the execution context for scheduled work
+    items (each host trains its leased combos on its own chips while the
+    global mesh stays reserved for collective-plane work)."""
+    mesh = make_mesh(jax.local_devices(), model_axis=model_axis)
+    token = _MESH_OVERRIDE.set(mesh)
+    try:
+        yield mesh
+    finally:
+        _MESH_OVERRIDE.reset(token)
 
 
 def data_size(mesh: Optional[Mesh] = None) -> int:

@@ -1,0 +1,655 @@
+"""Cluster work scheduler — fan independent fits across pod hosts.
+
+Reference: H2O's design point that any node can drive a job and
+independent model builds run wherever capacity exists (water/Job.java +
+hex/ModelBuilder distributed dispatch); DrJAX (arxiv 2403.07128) shows
+the same coordinator-plus-workers MapReduce decomposition over a JAX
+mesh. Here the independent units are grid-search combos, AutoML steps
+and CV fold models.
+
+Execution model
+---------------
+The cloud is SPMD: every process runs the same driver program (the
+tests/mp_worker.py contract), so ``run()`` is entered by every process
+at the same program point with the same arguments. Work items therefore
+never serialize their work DESCRIPTION — each process already holds the
+closures; only three things ride the coordination-service KV store (the
+same out-of-band channel as the heartbeat and cluster telemetry, NEVER
+a device collective):
+
+- ``ctl/assign/<pid>`` — the coordinator-owned lease table: item index
+  → generation. Publication IS the lease (the KV store has no CAS, so a
+  competitive-pull queue cannot be made race-free; a coordinator-push
+  assignment can).
+- ``rmeta/`` + ``rblob/`` — the executing host's device-independent
+  result bytes (io/persist ``_DeviceLoweringPickler`` payloads),
+  chunked + base64 like telemetry/cluster.py snapshots. Metas live in
+  their own subtree so the coordinator's poll (``key_value_dir_get``)
+  never drags blob parts over the wire.
+- ``smeta/`` + ``sblob/`` — the item's traveling PR 9 fit snapshots:
+  every ``FitCheckpointer.save`` under a scheduled item republishes the
+  blob, and a reassigned item's new owner restores them into its local
+  fit dir BEFORE training, so the fingerprint-addressed resume
+  (core/recovery.py ``_fit_fingerprint`` is cross-process stable) picks
+  up mid-fit.
+
+Items execute on a LOCAL device mesh (parallel/mesh.local_mesh_scope)
+against the host copy of the frame (frame.local_copy), so a scheduled
+fit issues no cross-process collectives — a dead peer cannot wedge it,
+which is why the whole run sits inside heartbeat.local_work_scope().
+A lease whose owner's heartbeat goes stale past interval*miss_budget is
+reassigned with a bumped generation; stale-generation results are
+ignored. The coordinator freezes the authoritative result set in the
+``ctl/done`` manifest so every process installs EXACTLY the same
+results in the same order (the SPMD walk after the run must agree
+bit-for-bit).
+
+Determinism contract: item identity, ordering and assignment derive
+from the item LIST (content), never from placement; per-item PRNG state
+rides in the params (canonical combo key → same seed resolution
+everywhere), and local frames rebuild through the same from_numpy
+narrowing/padding a single-process ingest runs — so scheduler-on output
+is bit-identical to the scheduler-off single-process run.
+"""
+
+from __future__ import annotations
+
+import base64
+import contextlib
+import hashlib
+import itertools
+import json
+import os
+import threading
+import time
+import zlib
+from typing import Any, Callable, Dict, List, Optional
+
+from h2o3_tpu.core import config as _config
+from h2o3_tpu.utils.log import get_logger
+
+log = get_logger("h2o3_tpu.scheduler")
+
+KV_PREFIX = "h2o3tpu/sched/"
+_B64_CHUNK = 131072          # base64 chars per KV part (bounded values)
+_BLOB_TIMEOUT_MS = 120_000   # blocking fetch bound for published blobs
+
+_RUN_SEQ = itertools.count()  # SPMD-deterministic: every process enters
+#                               run() at the same program points
+_PAST_RUNS: List[str] = []    # coordinator's GC ring of run subtrees
+
+# process-local observability block (telemetry/cluster.py sched block +
+# cluster_info() node leases)
+_lock = threading.Lock()
+_STATE = {"runs": 0, "leases_held": 0, "items_done": 0,
+          "items_reassigned": 0}
+
+# process-global nesting guard: work executing INSIDE a scheduled item
+# runs on one host only, so any nested scheduler.run() would violate
+# the SPMD entry contract (the other processes never reach it) and
+# deadlock — nested scheduling degrades to local execution instead.
+# A global (not a contextvar) because builder.train may hop threads.
+_IN_ITEM_DEPTH = 0
+
+
+class ScheduledFailure:
+    """A work item whose execution raised on its owner — travels in
+    place of a result so the consuming walk re-raises the SAME error
+    (grid failure recording stays bit-compatible with the sequential
+    walk, which would have hit the identical deterministic error)."""
+
+    def __init__(self, error: str):
+        self.error = str(error)
+
+    def __repr__(self) -> str:
+        return f"<ScheduledFailure {self.error!r}>"
+
+
+# ------------------------------------------------------------------ gating
+
+def mode() -> str:
+    return str(getattr(_config.ARGS, "scheduler", "auto") or "auto").lower()
+
+
+def in_item() -> bool:
+    """True while this process is executing a scheduled work item."""
+    return _IN_ITEM_DEPTH > 0
+
+
+def active() -> bool:
+    """Scheduler gate: H2O3TPU_SCHEDULER=auto|on|off; auto = on for
+    multi-process clouds only. Always False inside a scheduled item
+    (nested fan-out would break the SPMD run() entry contract)."""
+    if in_item():
+        return False
+    m = mode()
+    if m in ("off", "0", "false"):
+        return False
+    if m in ("on", "1", "true"):
+        return True
+    try:
+        import jax
+        return jax.process_count() > 1
+    except Exception:        # noqa: BLE001 - no backend → nothing to fan
+        return False
+
+
+def snapshot() -> dict:
+    """Per-host scheduler observability block (cluster telemetry +
+    GET /3/Cloud node leases)."""
+    with _lock:
+        return dict(_STATE)
+
+
+def leases_held() -> int:
+    with _lock:
+        return int(_STATE["leases_held"])
+
+
+def _set_leases(n: int) -> None:
+    from h2o3_tpu import telemetry
+    with _lock:
+        _STATE["leases_held"] = int(n)
+    telemetry.gauge("sched_leases_held").set(int(n))
+
+
+# ------------------------------------------------------------------ KV I/O
+
+def _kv():
+    """The coordination-service KV client, or None off-cloud (the same
+    control plane heartbeat._kv_round rides)."""
+    try:
+        from jax._src import distributed
+        return distributed.global_state.client
+    except Exception:        # noqa: BLE001 - no jax / no distributed
+        return None
+
+
+def _encode(data: bytes) -> str:
+    return base64.b64encode(zlib.compress(data, 6)).decode("ascii")
+
+
+def _decode(text: str) -> bytes:
+    return zlib.decompress(base64.b64decode(text.encode("ascii")))
+
+
+def _dir(client, prefix: str) -> Dict[str, str]:
+    """Snapshot a KV subtree as {full key: value}; {} when absent."""
+    try:
+        return dict(client.key_value_dir_get(prefix))
+    except Exception:        # noqa: BLE001 - nothing published yet
+        return {}
+
+
+def _publish(client, meta_key: str, blob_prefix: str,
+             data: Optional[bytes], meta: Dict[str, Any]) -> None:
+    """Chunked blob publish: parts first, meta LAST (pollers watch the
+    meta subtree, so a half-written blob is never observed)."""
+    b64 = _encode(data) if data is not None else ""
+    nparts = (len(b64) + _B64_CHUNK - 1) // _B64_CHUNK if b64 else 0
+    for j in range(nparts):
+        client.key_value_set(f"{blob_prefix}p{j}",
+                             b64[j * _B64_CHUNK:(j + 1) * _B64_CHUNK],
+                             allow_overwrite=True)
+    client.key_value_set(meta_key, json.dumps({**meta, "parts": nparts}),
+                         allow_overwrite=True)
+
+
+def _fetch_parts(client, blob_prefix: str, nparts: int,
+                 timeout_ms: int = _BLOB_TIMEOUT_MS) -> Optional[bytes]:
+    """Fetch + decode a published blob. Parts are written before their
+    meta, so once a meta is visible every part is a bounded wait."""
+    if nparts <= 0:
+        return b""
+    parts = []
+    for j in range(nparts):
+        try:
+            parts.append(client.blocking_key_value_get(
+                f"{blob_prefix}p{j}", timeout_ms))
+        except Exception:    # noqa: BLE001 - lost part: caller decides
+            return None
+    try:
+        return _decode("".join(parts))
+    except Exception:        # noqa: BLE001 - corrupt transport
+        return None
+
+
+# ------------------------------------------------------------------ board
+
+class RunBoard:
+    """Pure lease/complete/reassign state machine — one scheduled run's
+    truth, owned by the coordinator. Deliberately jax- and KV-free so
+    the bench ``_stub_sched`` leg and unit tests drive it dry.
+
+    Invariants:
+    - every item always has exactly one owner (assignment IS the lease);
+    - generations only grow, and only via reassignment;
+    - a result is accepted only at the item's CURRENT generation
+      (stale results from a slow-but-alive ex-owner are ignored);
+    - reassignment targets rotate round-robin over the alive hosts.
+    """
+
+    def __init__(self, n_items: int, procs: List[int], offset: int = 0):
+        if n_items <= 0:
+            raise ValueError("RunBoard needs >= 1 item")
+        if not procs:
+            raise ValueError("RunBoard needs >= 1 process")
+        self.n_items = int(n_items)
+        self.procs = list(procs)
+        self.dead: set = set()
+        # idx -> (owner pid, generation)
+        self.leases: Dict[int, tuple] = {
+            i: (self.procs[(i + offset) % len(self.procs)], 1)
+            for i in range(self.n_items)}
+        # idx -> (pid, gen) of the ACCEPTED result
+        self.results: Dict[int, tuple] = {}
+        self._rr = 0
+
+    # -- views ---------------------------------------------------------
+    def owner(self, idx: int) -> int:
+        return self.leases[idx][0]
+
+    def generation(self, idx: int) -> int:
+        return self.leases[idx][1]
+
+    def assignments(self, pid: int) -> Dict[int, int]:
+        """{item idx: generation} currently leased to ``pid``."""
+        return {i: g for i, (p, g) in self.leases.items() if p == pid}
+
+    def pending(self) -> List[int]:
+        return [i for i in range(self.n_items) if i not in self.results]
+
+    def held(self, pid: int) -> List[int]:
+        """Leases held = assigned and not yet resulted (queue-drain
+        visibility for GET /3/Cloud)."""
+        return [i for i, (p, _) in self.leases.items()
+                if p == pid and i not in self.results]
+
+    def complete(self) -> bool:
+        return len(self.results) == self.n_items
+
+    def alive(self) -> List[int]:
+        return [p for p in self.procs if p not in self.dead]
+
+    # -- transitions ---------------------------------------------------
+    def on_result(self, idx: int, pid: int, gen: int) -> bool:
+        """Accept a published result iff it matches the item's current
+        lease generation; stale generations are dropped."""
+        if idx in self.results:
+            return False
+        owner, cur = self.leases[idx]
+        if gen != cur:
+            return False
+        self.results[idx] = (pid, gen)
+        return True
+
+    def on_dead(self, pid: int) -> List[tuple]:
+        """Reassign every unresulted lease the dead host held; returns
+        [(idx, new_pid, new_gen)]. Idempotent per host."""
+        if pid in self.dead:
+            return []
+        self.dead.add(pid)
+        alive = self.alive()
+        if not alive:
+            raise RuntimeError("no alive hosts left to reassign to")
+        moved = []
+        for idx in sorted(self.held(pid)):
+            new = alive[self._rr % len(alive)]
+            self._rr += 1
+            gen = self.leases[idx][1] + 1
+            self.leases[idx] = (new, gen)
+            moved.append((idx, new, gen))
+        return moved
+
+
+# ------------------------------------------------------------------ run
+
+def _restore_snapshots(client, R: str, idx: int, fit_dir: str) -> int:
+    """Write an item's traveling fit snapshots into the local fit dir —
+    the reassigned owner's mid-fit resume input. Returns count."""
+    metas = _dir(client, f"{R}smeta/{idx}/")
+    n = 0
+    os.makedirs(fit_dir, exist_ok=True)
+    for key, raw in sorted(metas.items()):
+        try:
+            meta = json.loads(raw)
+        except ValueError:
+            continue
+        tag = key.rsplit("/", 1)[-1]
+        blob = _fetch_parts(client, f"{R}sblob/{idx}/{tag}/",
+                            int(meta.get("parts", 0)))
+        name = os.path.basename(str(meta.get("name", "")))
+        if blob is None or not name:
+            continue
+        path = os.path.join(fit_dir, name)
+        tmp = path + ".travel"
+        with open(tmp, "wb") as f:
+            f.write(blob)
+        os.replace(tmp, path)
+        n += 1
+    if n:
+        log.info("sched item %d: restored %d traveling fit snapshot(s) "
+                 "into %s", idx, n, fit_dir)
+    return n
+
+
+@contextlib.contextmanager
+def _noop_ctx():
+    yield
+
+
+def _execute_one(idx: int, gen: int, execute: Callable[[int], bytes],
+                 client, R: str, fit_dir: Optional[str],
+                 pid: int) -> Dict[str, Any]:
+    """Run one work item locally; returns the result record (the caller
+    publishes it). Snapshot-travel hooks + fit scope wrap the
+    execution; all exceptions become ok=False results (the consuming
+    walk decides failure semantics, exactly like the sequential walk's
+    try/except)."""
+    from h2o3_tpu import telemetry
+    from h2o3_tpu.core import recovery as _recovery
+
+    def _publish_snap(path: str, blob: bytes) -> None:
+        if client is None:
+            return
+        name = os.path.basename(path)
+        tag = hashlib.blake2b(name.encode(), digest_size=6).hexdigest()
+        _publish(client, f"{R}smeta/{idx}/{tag}",
+                 f"{R}sblob/{idx}/{tag}/", blob, {"name": name})
+
+    if client is not None and gen > 1 and fit_dir:
+        _restore_snapshots(client, R, idx, fit_dir)
+    t0 = time.time()
+    ok, err, data = True, "", None
+    global _IN_ITEM_DEPTH
+    with telemetry.span("sched.item", item=idx, gen=gen, host=pid):
+        with (_recovery.fit_checkpoint_scope(fit_dir)
+              if fit_dir else _noop_ctx()), \
+                _recovery.post_save_scope(_publish_snap):
+            _IN_ITEM_DEPTH += 1
+            try:
+                data = execute(idx)
+            except Exception as e:   # noqa: BLE001 - travels as failure
+                ok, err = False, str(e) or type(e).__name__
+                log.warning("sched item %d failed on host %d: %s",
+                            idx, pid, e)
+            finally:
+                _IN_ITEM_DEPTH -= 1
+    telemetry.histogram("sched_item_seconds").observe(time.time() - t0)
+    telemetry.counter("sched_items_completed_total",
+                      host=str(pid)).inc()
+    with _lock:
+        _STATE["items_done"] += 1
+    return {"gen": gen, "pid": pid, "ok": ok, "error": err, "data": data}
+
+
+def _run_inline(n_items: int, execute: Callable[[int], bytes],
+                fit_dir: Optional[str]) -> Dict[int, dict]:
+    """Degenerate run: single process or no coordination client — every
+    item leases to this host, executes in order. Exercises the same
+    item-execution path (local mesh, local frame, fit scope) so
+    H2O3TPU_SCHEDULER=on tests the plumbing on one process."""
+    out = {}
+    for idx in range(n_items):
+        _set_leases(n_items - idx)
+        r = _execute_one(idx, 1, execute, None, "", fit_dir, 0)
+        out[idx] = {"ok": r["ok"], "error": r["error"], "data": r["data"]}
+    _set_leases(0)
+    return out
+
+
+def run(tag: str, n_items: int, execute: Callable[[int], bytes], *,
+        job=None, fit_dir: Optional[str] = None,
+        deadline: Optional[float] = None) -> Dict[int, dict]:
+    """Schedule ``n_items`` independent work items across the cloud.
+
+    SPMD entry point: EVERY process calls run() with identical
+    arguments at the same program point. Returns {item idx →
+    {"ok", "error", "data"(bytes)}} — identical on every process (the
+    ``ctl/done`` manifest freezes the authoritative result set). Items
+    missing from the dict were cancelled by the deadline; the caller's
+    walk handles them exactly like budget-stopped sequential work.
+
+    ``execute(idx) -> bytes`` must be a pure-local computation (local
+    mesh + host frame copies) — it runs on whichever host holds the
+    item's lease.
+    """
+    from h2o3_tpu import telemetry
+    from h2o3_tpu.core import heartbeat as _hb
+
+    args = _config.ARGS
+    seq = next(_RUN_SEQ)
+    with _lock:
+        _STATE["runs"] += 1
+    telemetry.counter("sched_runs_total",
+                      kind=tag.split(":", 1)[0]).inc()
+    telemetry.counter("sched_items_total").inc(n_items)
+
+    client = _kv()
+    try:
+        import jax
+        pid, nproc = jax.process_index(), jax.process_count()
+    except Exception:        # noqa: BLE001 - no backend
+        pid, nproc = 0, 1
+    if client is None or nproc <= 1:
+        with _hb.local_work_scope(), \
+                telemetry.span("sched.run", tag=tag, items=n_items,
+                               hosts=1):
+            return _run_inline(n_items, execute, fit_dir)
+
+    digest = hashlib.blake2b(
+        f"{tag}:{n_items}".encode(), digest_size=5).hexdigest()
+    run_id = f"{seq:04d}-{digest}"
+    R = f"{KV_PREFIX}{run_id}/"
+    poll_s = float(getattr(args, "scheduler_poll_s", 0.2) or 0.2)
+    grace = float(getattr(args, "scheduler_reassign_grace_s", 0.0) or 0.0)
+    wall = float(getattr(args, "scheduler_timeout_s", 0.0) or 0.0)
+    hard_deadline = deadline
+    if wall > 0:
+        hard_deadline = min(deadline or float("inf"), time.time() + wall)
+
+    coordinator = pid == 0
+    board: Optional[RunBoard] = None
+    suspects: Dict[int, float] = {}     # dead-candidate pid -> first seen
+    if coordinator:
+        # garbage-collect the run-before-last: a process entering run
+        # seq N has provably finished INSTALLING run N-1 (install gates
+        # its return), so only the immediately-previous subtree can
+        # still have readers — anything older is safe to delete
+        with _lock:
+            _PAST_RUNS.append(R)
+            stale = _PAST_RUNS[:-2]
+            del _PAST_RUNS[:-2]
+        for old in stale:
+            try:
+                client.key_value_delete(old)
+            except Exception:    # noqa: BLE001 - hygiene is best-effort
+                pass
+        # hosts already heartbeat-dead at run start never get leases;
+        # run-sequence rotation spreads successive small runs (AutoML
+        # single-model steps) across different hosts
+        dead0 = set(_hb.dead_peers())
+        procs = [p for p in range(nproc) if p not in dead0 or p == 0]
+        board = RunBoard(n_items, procs, offset=seq % len(procs))
+        for p in procs:
+            client.key_value_set(
+                f"{R}ctl/assign/{p}", json.dumps(board.assignments(p)),
+                allow_overwrite=True)
+        counts = {p: len(board.assignments(p)) for p in procs}
+        log.info("sched run %s (%s): %d items over hosts %s", run_id,
+                 tag, n_items, counts)
+        if job is not None:
+            job.update(0.0, f"sched {run_id}: {n_items} items "
+                            f"across hosts {counts}")
+
+    my_done: Dict[int, int] = {}        # idx -> gen executed locally
+    manifest: Optional[dict] = None
+    log_every = max(1, int(5.0 / poll_s))
+    tick = 0
+    with _hb.local_work_scope(), \
+            telemetry.span("sched.run", tag=tag, run=run_id,
+                           items=n_items, hosts=nproc):
+        while True:
+            # -- lease intake + local execution (every process) --------
+            ctl = _dir(client, f"{R}ctl/")
+            done_raw = ctl.get(f"{R}ctl/done")
+            if done_raw and not coordinator:
+                manifest = json.loads(done_raw)
+                _set_leases(0)
+                break
+            raw = ctl.get(f"{R}ctl/assign/{pid}")
+            items = ({int(k): int(v) for k, v in json.loads(raw).items()}
+                     if raw else {})
+            todo = sorted((i, g) for i, g in items.items()
+                          if my_done.get(i) != g)
+            for n_left, (idx, gen) in enumerate(todo):
+                _set_leases(len(todo) - n_left)
+                r = _execute_one(idx, gen, execute, client, R, fit_dir,
+                                 pid)
+                data = r.pop("data")
+                _publish(client, f"{R}rmeta/{idx}/{r['gen']}",
+                         f"{R}rblob/{idx}/{r['gen']}/", data, r)
+                my_done[idx] = gen
+            _set_leases(0)
+
+            if coordinator:
+                # -- result intake (one cheap subtree poll) ------------
+                rmeta = _dir(client, f"{R}rmeta/")
+                for idx in board.pending():
+                    gen = board.generation(idx)
+                    v = rmeta.get(f"{R}rmeta/{idx}/{gen}")
+                    if v:
+                        meta = json.loads(v)
+                        board.on_result(idx, int(meta["pid"]),
+                                        int(meta["gen"]))
+                # -- dead-peer reassignment ----------------------------
+                now = time.time()
+                for d in _hb.dead_peers():
+                    if d in board.dead or d not in board.procs:
+                        continue
+                    first = suspects.setdefault(d, now)
+                    if now - first < grace:
+                        continue
+                    moved = board.on_dead(d)
+                    if moved:
+                        telemetry.counter(
+                            "sched_items_reassigned_total").inc(
+                                len(moved))
+                        with _lock:
+                            _STATE["items_reassigned"] += len(moved)
+                        log.warning(
+                            "sched run %s: host %d heartbeat-dead, "
+                            "reassigned items %s", run_id, d,
+                            [(i, p) for i, p, _ in moved])
+                        for p in board.alive():
+                            client.key_value_set(
+                                f"{R}ctl/assign/{p}",
+                                json.dumps(board.assignments(p)),
+                                allow_overwrite=True)
+                done_n = len(board.results)
+                if job is not None and tick % log_every == 0:
+                    held = {p: len(board.held(p)) for p in board.alive()}
+                    job.update(0.0, f"sched {run_id}: {done_n}/"
+                                    f"{n_items} done, leases {held}")
+                expired = (hard_deadline is not None
+                           and time.time() > hard_deadline)
+                if board.complete() or expired:
+                    manifest = {"results": {
+                        str(i): g for i, (_, g) in
+                        sorted(board.results.items())}}
+                    if expired and not board.complete():
+                        manifest["cancelled"] = True
+                        log.warning(
+                            "sched run %s: deadline hit with %d/%d "
+                            "items", run_id, done_n, n_items)
+                    client.key_value_set(f"{R}ctl/done",
+                                         json.dumps(manifest),
+                                         allow_overwrite=True)
+                    break
+            elif hard_deadline is not None and \
+                    time.time() > hard_deadline + 60.0:
+                # coordinator never published done (it died): the
+                # driver is gone, return what we have
+                log.error("sched run %s: no done manifest past "
+                          "deadline; abandoning", run_id)
+                manifest = {"results": {}}
+                break
+            tick += 1
+            time.sleep(poll_s)
+
+        # -- install phase: identical on every process -----------------
+        out: Dict[int, dict] = {}
+        for sidx, gen in sorted(manifest.get("results", {}).items(),
+                                key=lambda kv: int(kv[0])):
+            idx = int(sidx)
+            # the manifest only lists accepted results, whose meta was
+            # published before acceptance — a bounded wait, not a poll
+            meta = json.loads(client.blocking_key_value_get(
+                f"{R}rmeta/{idx}/{int(gen)}", _BLOB_TIMEOUT_MS))
+            blob = None
+            if meta.get("ok"):
+                blob = _fetch_parts(client, f"{R}rblob/{idx}/{gen}/",
+                                    int(meta.get("parts", 0)))
+                if blob is None:
+                    raise RuntimeError(
+                        f"UNAVAILABLE: sched run {run_id} result {idx} "
+                        "blob never became readable")
+            out[idx] = {"ok": bool(meta.get("ok")),
+                        "error": str(meta.get("error") or ""),
+                        "data": blob}
+    return out
+
+
+# ---------------------------------------------------------------- helpers
+
+def lower_to_bytes(obj) -> bytes:
+    """Device-independent pickle (io/persist _DeviceLoweringPickler) —
+    the result-payload encoder every scheduled producer uses."""
+    import io as _io
+    import pickle
+    from h2o3_tpu.io.persist import _DeviceLoweringPickler
+    buf = _io.BytesIO()
+    _DeviceLoweringPickler(buf, protocol=pickle.HIGHEST_PROTOCOL).dump(obj)
+    return buf.getvalue()
+
+
+def from_bytes(data: bytes):
+    import pickle
+    return pickle.loads(data)
+
+
+def detach_model(m):
+    """Drop a freshly-trained model (and its CV submodels) from the
+    trainer's local DKV — every process re-installs from the
+    round-tripped result bytes so DKV state is identical cloud-wide."""
+    from h2o3_tpu.core.kv import DKV
+    for cm in getattr(m, "_cv_models", None) or []:
+        DKV.remove(cm.key)
+    DKV.remove(m.key)
+    return m
+
+
+def install_model(m):
+    """Install a round-tripped model under a fresh process-local key
+    (model keys are process-local counters, never part of the parity
+    contract); CV submodels re-key relative to it like ml/cv.py does."""
+    from h2o3_tpu.core.kv import DKV, make_key
+    new_key = make_key(f"model_{m.algo}")
+    for j, cm in enumerate(getattr(m, "_cv_models", None) or []):
+        cm.key = f"{new_key}_cv_{j + 1}"
+        DKV.put(cm.key, cm)
+    m.key = new_key
+    DKV.put(new_key, m)
+    return m
+
+
+def sweep_keys() -> None:
+    """Delete every scheduler KV key (cloud shutdown sweep — a re-formed
+    cloud must not observe a previous run's leases)."""
+    client = _kv()
+    if client is None:
+        return
+    try:
+        client.key_value_delete(KV_PREFIX)
+    except Exception:        # noqa: BLE001 - best-effort sweep
+        pass

@@ -154,7 +154,34 @@ def local_snapshot() -> Dict:
         "jobs_inflight": int(REGISTRY.value("jobs_inflight")),
         "peak_hbm": peak_hbm,
         "hbm": _hbm_snapshot(),
+        "jobs": _jobs_snapshot(),
+        "sched": _sched_snapshot(),
     }
+
+
+MAX_JOBS = 64
+
+
+def _jobs_snapshot() -> List[Dict]:
+    """This node's job list (JobV3 dicts), newest first, bounded — the
+    GET /3/Jobs?cluster=1 merge input."""
+    try:
+        from h2o3_tpu.core.job import list_jobs
+        jobs = list_jobs()
+        jobs.sort(key=lambda j: j.get("start_time", 0), reverse=True)
+        return jobs[:MAX_JOBS]
+    except Exception:   # noqa: BLE001 - snapshot is best-effort
+        return []
+
+
+def _sched_snapshot() -> Dict:
+    """This node's work-scheduler block (parallel/scheduler.py): leases
+    held, items executed/reassigned — per-host queue-drain visibility."""
+    try:
+        from h2o3_tpu.parallel import scheduler
+        return scheduler.snapshot()
+    except Exception:   # noqa: BLE001 - snapshot is best-effort
+        return {}
 
 
 def _hbm_snapshot() -> Dict:
@@ -310,6 +337,7 @@ def node_summaries(col: Optional[Dict] = None) -> Dict[int, Dict]:
             "last_publish_age_s": round(col["ages"].get(int(n), 0.0), 3),
             "peak_hbm": int(snap.get("peak_hbm", 0) or 0),
             "hbm": snap.get("hbm") or {},
+            "sched": snap.get("sched") or {},
             "stale": int(n) in col["stale_nodes"],
         }
     return out
@@ -437,6 +465,26 @@ def merged_trace(col: Optional[Dict] = None) -> Dict:
         nodes, extra={"cluster": True,
                       "process_count": col["process_count"],
                       "stale_nodes": col["stale_nodes"]})
+
+
+def merged_jobs(col: Optional[Dict] = None) -> Dict:
+    """Cluster job view for GET /3/Jobs?cluster=1: every node's job
+    list with a ``node`` id stamped on each entry, newest first. Job
+    keys are process-local counters, so same-key entries on different
+    nodes are different jobs (an SPMD driver job legitimately appears
+    once per process — the per-host progress messages differ). Peers
+    past the staleness window contribute their LAST list, labeled
+    stale."""
+    col = col or collect()
+    jobs: List[Dict] = []
+    for n in sorted(col["nodes"]):
+        for j in col["nodes"][n].get("jobs", []) or []:
+            jj = dict(j)
+            jj["node"] = int(n)
+            jobs.append(jj)
+    jobs.sort(key=lambda j: j.get("start_time", 0), reverse=True)
+    return {"jobs": jobs, "stale_nodes": col["stale_nodes"],
+            "process_count": col["process_count"]}
 
 
 def merged_logs(col: Optional[Dict] = None,

@@ -1,0 +1,120 @@
+"""Worker for the cluster work-scheduler multiprocess tests (ISSUE 15,
+parallel/scheduler.py).
+
+Every process runs this same script (the SPMD contract): forms a
+jax.distributed CPU cloud, then trains an 8-combo GBM grid that the
+scheduler fans across the hosts. Modes (argv[5]):
+
+- ``ref``  — single process, scheduler OFF: the bit-parity reference.
+- ``run``  — N processes, scheduler auto (on): the fan-out leg.
+- ``kill`` — like ``run``, but process 1 SIGKILLs itself after
+  completing its first scheduled item; the coordinator must detect the
+  dead peer, reassign its remaining leases, and finish bit-identical.
+
+Each surviving process writes ``outfile.<pid>`` with the grid result
+(full-precision metrics), its scheduler counters, and its job statuses.
+"""
+
+import json
+import os
+import signal
+import sys
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
+# singleton items (one per combo) so an 8-combo grid provably spreads
+# across BOTH hosts; the batched path is covered by single-process tier-1
+os.environ["H2O3TPU_BATCH_MODELS"] = "off"
+# fast dead-peer detection for the kill leg (staleness = interval * 3)
+os.environ["H2O3TPU_HEARTBEAT_INTERVAL_S"] = "0.25"
+os.environ["H2O3TPU_SCHEDULER_POLL_S"] = "0.05"
+# all five worker processes (ref + run×2 + kill×2) compile the SAME
+# GBM kernel shapes — share the executables across the sequential legs
+# (identical binaries, so bit-parity is unaffected by who compiled)
+os.environ.setdefault(
+    "JAX_COMPILATION_CACHE_DIR",
+    os.path.join(os.environ.get("TMPDIR", "/tmp"), "h2o3tpu-test-xlacache"))
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+coord, nproc, pid, outfile, mode = sys.argv[1:6]
+nproc, pid = int(nproc), int(pid)
+
+os.environ["H2O3TPU_SCHEDULER"] = "off" if mode == "ref" else "auto"
+
+import jax                                    # noqa: E402
+jax.config.update("jax_default_device", None)
+
+import h2o3_tpu                               # noqa: E402
+if nproc > 1:
+    h2o3_tpu.init(backend="cpu", coordinator_address=coord,
+                  num_processes=nproc, process_id=pid)
+else:
+    h2o3_tpu.init(backend="cpu")
+
+import numpy as np                            # noqa: E402
+
+from h2o3_tpu.parallel import scheduler       # noqa: E402
+
+if mode == "kill" and pid == 1:
+    # publish exactly one result, then die without warning — the
+    # coordinator must reassign this host's remaining leases
+    _orig_execute = scheduler._execute_one
+
+    def _execute_then_die(*args, **kwargs):
+        res = _orig_execute(*args, **kwargs)
+        os.kill(os.getpid(), signal.SIGKILL)
+        return res
+
+    scheduler._execute_one = _execute_then_die
+
+
+def build_data():
+    """MUST match tests/test_scheduler.py expectations (same rows as
+    tests/mp_worker.py build_data)."""
+    r = np.random.RandomState(5)
+    n = 4000
+    a = r.randn(n)
+    b = r.randn(n)
+    g = r.choice(["u", "v", "w"], n)
+    y = 2.0 * a - b + (g == "u") * 1.5 + r.randn(n) * 0.3
+    return h2o3_tpu.Frame.from_numpy(
+        {"a": a, "b": b, "g": g, "y": y}, categorical=["g"])
+
+
+fr = build_data()
+
+from h2o3_tpu.ml.grid import GridSearch       # noqa: E402
+from h2o3_tpu.models.gbm import GBMEstimator  # noqa: E402
+
+HYPER = {"learn_rate": [0.05, 0.1],
+         "sample_rate": [0.7, 1.0],
+         "min_rows": [5.0, 10.0]}             # 8 combos, one shape
+grid = GridSearch(GBMEstimator, HYPER, ntrees=3, max_depth=3,
+                  seed=3).train(fr, y="y")
+
+# full-precision walk-order leaderboard: the bit-parity payload (repr
+# round-trips exactly through json)
+rows = [[json.dumps(m.output.get("grid_params"), sort_keys=True),
+         float(m.training_metrics["MSE"])] for m in grid.models]
+
+from h2o3_tpu import telemetry                # noqa: E402
+from h2o3_tpu.core.job import list_jobs      # noqa: E402
+
+result = {
+    "pid": pid,
+    "grid": rows,
+    "sched": scheduler.snapshot(),
+    "items_completed_here": telemetry.REGISTRY.value(
+        "sched_items_completed_total", host=str(pid)),
+    "job_statuses": sorted(j["status"] for j in list_jobs()),
+}
+with open(f"{outfile}.{pid}", "w") as f:
+    json.dump(result, f)
+print(f"SCHED-WORKER-{pid}-DONE", flush=True)
+
+if mode == "kill":
+    # peer 1 is dead: a collective or the distributed-shutdown barrier
+    # would wait on it forever — results are on disk, leave hard
+    os._exit(0)
+h2o3_tpu.shutdown()

@@ -258,7 +258,9 @@ def test_bench_wedged_config_costs_one_line(tmp_path):
     and the recorded budget never goes below 0."""
     p, lines = _run_bench(tmp_path, {
         "H2O3TPU_BENCH_BUDGET_S": "90",
-        "H2O3TPU_BENCH_CONFIG_TIMEOUT_S": "10",
+        # cap >> any healthy stub config (~1s) but small: the wedged
+        # child burns the full cap before the kill, straight wall time
+        "H2O3TPU_BENCH_CONFIG_TIMEOUT_S": "5",
         "H2O3TPU_BENCH_TRACE_DIR": str(tmp_path / "traces")})
     assert p.returncode == 0, p.stderr[-2000:]
     by_metric = {}
@@ -314,8 +316,8 @@ def test_bench_dead_backend_fails_fast_per_config(tmp_path):
     assert p.returncode == 0, p.stderr[-2000:]
     errors = [ln for ln in lines if "error" in ln]
     # one per stub config (incl. grid, treekernel, cloud, roofline,
-    # checkpoint, memgov, ingest, serving)
-    assert len(errors) == 11
+    # checkpoint, memgov, ingest, serving, sched)
+    assert len(errors) == 12
     assert all("backend dead" in ln["error"] for ln in errors)
     budget = [ln for ln in lines if ln["metric"] == "budget"][0]
     assert budget["left_s"] >= 0.0

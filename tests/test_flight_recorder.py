@@ -382,6 +382,13 @@ def test_metric_names_documented_in_readme():
                      "scorer_cache_evictions_total",
                      "scorer_cache_bytes"):
         assert required in section, required
+    # the ISSUE 15 cluster work-scheduler surface
+    # (parallel/scheduler.py) is part of the stable contract too
+    for required in ("sched_runs_total", "sched_items_total",
+                     "sched_items_completed_total",
+                     "sched_items_reassigned_total",
+                     "sched_leases_held", "sched_item_seconds"):
+        assert required in section, required
 
 
 # ----------------------------------------------------------- REST tier
