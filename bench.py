@@ -1011,12 +1011,62 @@ def bench_serving():
               "scorer_cache_misses_total")))
 
 
+def bench_tracing():
+    """Distributed-tracing overhead (ISSUE 16): the SAME GBM fit with
+    and without a trace context installed (the REST ingress condition —
+    every span additionally stamps/propagates the request's trace id;
+    telemetry/trace_context.py). The overhead %% is the acceptance
+    number (< 2%% of fit wall time)."""
+    import h2o3_tpu
+    from h2o3_tpu import telemetry
+    from h2o3_tpu.core.kv import DKV
+    from h2o3_tpu.models.gbm import GBMEstimator
+    from h2o3_tpu.telemetry import trace_context
+    n = 200_000 if FAST else 1_000_000
+    r = np.random.RandomState(16)
+    X = r.randn(n, 8).astype(np.float32)
+    yv = (X[:, 0] + 0.5 * X[:, 1] + 0.5 * r.randn(n) > 0).astype(int)
+    cols = {f"x{i}": X[:, i] for i in range(8)}
+    cols["y"] = np.array(["N", "Y"], object)[yv]
+    fr = h2o3_tpu.Frame.from_numpy(cols, categorical=["y"])
+    del X
+    kw = dict(ntrees=100, max_depth=6, seed=1)
+    wm = GBMEstimator(**{**kw, "ntrees": 25}).train(fr, y="y")  # warmup
+    DKV.remove(wm.key)
+    t0 = time.time()
+    GBMEstimator(**kw).train(fr, y="y")
+    t_plain = time.time() - t0
+    with trace_context.trace_scope(trace_context.new_context()), \
+            telemetry.span("rest", route="/99/bench"):
+        t0 = time.time()
+        m = GBMEstimator(**kw).train(fr, y="y")
+        t_traced = time.time() - t0
+    # every span of the traced fit carries the request's trace id
+    stamped = sum(1 for s in telemetry.spans_snapshot(2048)
+                  if s.get("trace_id"))
+    assert stamped > 0, "traced fit produced no trace-stamped spans"
+    DKV.remove(m.key)
+    overhead_pct = 100.0 * (t_traced - t_plain) / max(t_plain, 1e-9)
+    assert overhead_pct < 2.0, \
+        f"tracing overhead {overhead_pct:.2f}% >= 2% acceptance bound"
+    _emit(
+        f"tracing GBM-100trees-d6 {n/1e3:.0f}K rows (trace context "
+        f"installed + ingress span vs bare fit)",
+        overhead_pct, "overhead_pct",
+        t_plain / max(t_traced, 1e-9), "same fit without tracing",
+        plain_seconds=round(t_plain, 2),
+        traced_seconds=round(t_traced, 2),
+        trace_stamped_spans=stamped,
+        peak_hbm_gb=round(_hbm_peak() / 1e9, 2))
+
+
 CONFIGS = [("gbm", bench_gbm), ("glm", bench_glm), ("dl", bench_dl),
            ("xgb", bench_xgb), ("sort", bench_sort),
            ("grid", bench_grid), ("treekernel", bench_treekernel),
            ("cloud", bench_cloud), ("checkpoint", bench_checkpoint),
            ("memgov", bench_memgov), ("ingest", bench_ingest),
            ("serving", bench_serving), ("sched", bench_sched),
+           ("tracing", bench_tracing),
            ("automl", bench_automl), ("gbm-full", bench_gbm_full)]
 
 # minimum seconds a config plausibly needs; skipped (with a JSON note)
@@ -1024,14 +1074,16 @@ CONFIGS = [("gbm", bench_gbm), ("glm", bench_glm), ("dl", bench_dl),
 _MIN_NEED = {"gbm": 60, "glm": 90, "dl": 60, "xgb": 60, "sort": 60,
              "grid": 120, "treekernel": 60, "cloud": 30, "automl": 180,
              "checkpoint": 90, "memgov": 90, "ingest": 90,
-             "serving": 60, "sched": 120, "gbm-full": 600}
+             "serving": 60, "sched": 120, "tracing": 90,
+             "gbm-full": 600}
 
 # hard per-config wallclock cap (child process killed past it): a
 # wedged worker costs one line, never the scoreboard
 _HARD_CAP = {"gbm": 900, "glm": 600, "dl": 600, "xgb": 600, "sort": 400,
              "grid": 600, "treekernel": 400, "cloud": 300, "automl": 900,
              "checkpoint": 600, "memgov": 600, "ingest": 600,
-             "serving": 600, "sched": 600, "gbm-full": 1200}
+             "serving": 600, "sched": 600, "tracing": 600,
+             "gbm-full": 1200}
 
 
 def _stub_ok(name):
@@ -1375,6 +1427,74 @@ def _stub_sched():
           blob_parts=nparts)
 
 
+def _stub_slo():
+    """`slo` line without a backend (ISSUE 16): drives the burn-rate
+    state machine (telemetry/slo.py SLOEngine) dry on a private
+    registry with a fake clock — healthy → burning → alert → recovery
+    → healthy, with burn-rate gauges published along the way; no jax,
+    no server."""
+    from h2o3_tpu.telemetry import slo
+    from h2o3_tpu.telemetry.registry import MetricsRegistry
+    reg = MetricsRegistry()
+    clock = [1000.0]
+    h = reg.histogram("predict_seconds", buckets=(0.1, 0.5, 1.0),
+                      phase="device")
+
+    rule = slo.RatioRule(
+        "predict_p99_latency", objective=0.99,
+        counts_fn=slo._predict_latency_counts,
+        description="stub p99 rule")
+    eng = slo.SLOEngine(registry=reg, rules=[rule],
+                        now=lambda: clock[0])
+    t0 = time.time()
+    evals = 0
+
+    def tick(dt=30.0):
+        nonlocal evals
+        clock[0] += dt
+        evals += 1
+        return eng.evaluate()
+
+    # healthy: fast predictions only
+    for _ in range(50):
+        h.observe(0.01)
+    out = tick()
+    states = {r["slo"]: r["state"] for r in out["rules"]}
+    assert states["predict_p99_latency"] == "healthy", states
+    # fault-injected latency: a burst of slow predictions torches the
+    # short AND long windows → burning → alert
+    for _ in range(200):
+        h.observe(2.0)
+    saw = []
+    for _ in range(12):
+        out = tick()
+        saw.append(out["rules"][0]["state"])
+        if out["rules"][0]["state"] == "alert":
+            break
+    assert "alert" in saw, saw
+    assert out["alerts"], "alerting rule missing from alerts list"
+    # recovery: the error budget refills as fast traffic displaces the
+    # burst beyond both windows
+    for _ in range(80):
+        for _ in range(500):
+            h.observe(0.01)
+        out = tick(120.0)
+        if out["rules"][0]["state"] == "healthy":
+            break
+    assert out["rules"][0]["state"] == "healthy", out["rules"][0]
+    assert not out["alerts"]
+    burn = reg.find("slo_burn_rate")
+    assert burn, "burn-rate gauges never published"
+    trans = sum(int(c.value) for c
+                in reg.find("slo_alert_transitions_total"))
+    assert trans >= 2, trans  # at least alert entry + exit
+    dt = max(time.time() - t0, 1e-6)
+    _emit("slo burn-rate engine (stub; healthy->burning->alert->"
+          "recovery on a fake clock, no backend)", evals / dt,
+          "evals/sec", 1.0, "stub", transitions=trans,
+          evaluations=evals)
+
+
 if STUB:
     CONFIGS = [("stub_a", _stub_ok("stub_a")),
                ("stub_wedge", _stub_wedge),
@@ -1387,6 +1507,7 @@ if STUB:
                ("ingest", _stub_ingest),
                ("serving", _stub_serving),
                ("sched", _stub_sched),
+               ("slo", _stub_slo),
                ("stub_b", _stub_ok("stub_b"))]
     _MIN_NEED = {n: 1 for n, _ in CONFIGS}
     _HARD_CAP = {n: 30 for n, _ in CONFIGS}

@@ -1603,6 +1603,14 @@ def _metrics(params, body):
     gauges/histograms per-node with a ``node=`` label, peers past their
     publish window served stale-but-labeled (``stale_nodes``)."""
     from h2o3_tpu import telemetry
+    # refresh the slo_* burn-rate gauges so every scrape carries the
+    # current objective health (telemetry/slo.py — best-effort: the
+    # scrape must survive a broken rule)
+    try:
+        from h2o3_tpu.telemetry import slo as _slo
+        _slo.evaluate()
+    except Exception:   # noqa: BLE001 - scrape over alerting
+        pass
     fmt = str(params.get("format") or "").lower()
     if _cluster_requested(params):
         from h2o3_tpu.telemetry import cluster
@@ -1630,6 +1638,21 @@ def _metrics(params, body):
     return {"metrics": telemetry.snapshot(),
             "spans": telemetry.spans_snapshot(nspans),
             "span_aggregate": telemetry.spans_aggregate()}
+
+
+@route("GET", "/3/Alerts")
+def _alerts(params, body):
+    """SLO burn-rate evaluation (telemetry/slo.py): every declarative
+    objective's state (healthy/burning/alert/recovery), 5m/1h burn
+    rates, and the currently-firing alerts. ``?cluster=1`` on a
+    multi-process cloud merges every peer's published alert view
+    (telemetry/cluster.py fan-in), each entry stamped with its
+    ``node``."""
+    from h2o3_tpu.telemetry import slo
+    if _cluster_requested(params):
+        from h2o3_tpu.telemetry import cluster
+        return cluster.merged_alerts()
+    return slo.evaluate()
 
 
 @route("GET", "/3/WaterMeterCpuTicks")
@@ -1800,8 +1823,16 @@ def _process_trace(params, body):
     trace JSON — the zoomed-out view when no single job is suspect.
     ``?cluster=1`` on a multi-process cloud merges every peer's
     published ring tails into ONE trace with ``pid`` = process_index,
-    so Perfetto renders one track group per host."""
+    so Perfetto renders one track group per host.
+    ``?trace_id=`` instead stitches ONE request's spans — from every
+    host that published them — into a single causal trace (cross-
+    process parent links, not pid-grouped tracks): the distributed-
+    tracing read side (ISSUE 16)."""
     from h2o3_tpu.telemetry import trace_export
+    trace_id = params.get("trace_id")
+    if trace_id:
+        from h2o3_tpu.telemetry import cluster
+        return cluster.stitched_trace(trace_id)
     if _cluster_requested(params):
         from h2o3_tpu.telemetry import cluster
         return cluster.merged_trace()
@@ -2062,6 +2093,11 @@ class _Handler(BaseHTTPRequestHandler):
         self.send_response(code)
         self.send_header("Content-Type", ctype)
         self.send_header("Content-Length", str(len(payload)))
+        tc = getattr(self, "_trace_ctx", None)
+        if tc is not None:
+            # every response names its trace — the client's handle into
+            # GET /3/Trace?trace_id= (ISSUE 16)
+            self.send_header("X-H2O-Trace-Id", tc.trace_id)
         for hk, hv in (extra_headers or {}).items():
             self.send_header(hk, hv)
         if close:
@@ -2074,10 +2110,20 @@ class _Handler(BaseHTTPRequestHandler):
 
     def _dispatch_inner(self, method: str):
         from h2o3_tpu import telemetry
+        from h2o3_tpu.telemetry import trace_context
         parsed = urllib.parse.urlparse(self.path)
         path = parsed.path
         params: Dict[str, str] = {
             k: v[0] for k, v in urllib.parse.parse_qs(parsed.query).items()}
+
+        # -- distributed trace ingress (traceparent header) ------------
+        # an incoming W3C-style traceparent joins the client's trace
+        # (malformed → fresh trace, never a 4xx: tracing is telemetry);
+        # _respond echoes the id as X-H2O-Trace-Id on EVERY response
+        tc = trace_context.parse_traceparent(
+            self.headers.get("traceparent"))
+        self._trace_ctx = tc if tc is not None \
+            else trace_context.new_context()
 
         # -- request deadline (?_timeout_ms= / X-H2O-Deadline-Ms) ------
         deadline = None
@@ -2212,10 +2258,15 @@ class _Handler(BaseHTTPRequestHandler):
                                   endpoint=endpoint).inc()
                 t_req = time.monotonic()
                 try:
-                    # the deadline rides a contextvar: any Job the
-                    # handler creates captures it (core/job.py) and the
-                    # cooperative checks enforce it at chunk boundaries
+                    # the deadline and trace context ride contextvars:
+                    # any Job the handler creates captures both
+                    # (core/job.py), the cooperative checks enforce the
+                    # deadline at chunk boundaries, and every span the
+                    # handler opens is stamped with the request's trace
+                    from h2o3_tpu.telemetry import trace_context
                     with request_ctx.deadline_scope(deadline), \
+                            trace_context.trace_scope(
+                                getattr(self, "_trace_ctx", None)), \
                             telemetry.span("rest", method=method,
                                            endpoint=endpoint):
                         # recorded INSIDE the span so the Timeline event

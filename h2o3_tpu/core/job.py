@@ -82,6 +82,18 @@ class Job:
         # run on a fresh thread whose context would not inherit it, so
         # Job.start re-installs it via request_ctx.job_scope
         self.deadline: Optional[float] = request_ctx.current_deadline()
+        # distributed trace context captured at SUBMISSION time, same
+        # discipline as the deadline above: re-parented under the
+        # submitting thread's active span (the REST request span) so
+        # the job's root span stitches causally under the request that
+        # created it, then re-installed on the worker thread by
+        # job_scope (telemetry/trace_context.py)
+        from h2o3_tpu.telemetry import spans as _spans
+        from h2o3_tpu.telemetry import trace_context as _trace
+        tc = _trace.current()
+        self.trace = tc.child(_spans.current_span_id()
+                              or tc.parent_id) if tc is not None else None
+        self.trace_id: Optional[str] = tc.trace_id if tc else None
         DKV.put(self.key, self)
 
     # -- lifecycle (Job.start / Job.update, water/Job.java:206-225) ------
@@ -254,7 +266,8 @@ class Job:
                 # nests — background threads start with a fresh
                 # contextvar context, so this re-install is what carries
                 # the request deadline across the thread hop
-                with request_ctx.job_scope(self, deadline=self.deadline), \
+                with request_ctx.job_scope(self, deadline=self.deadline,
+                                           trace=self.trace), \
                         telemetry.span("job", key=self.key,
                                        desc=self.description):
                     _body()
@@ -348,6 +361,9 @@ class Job:
             "dest": {"name": self.dest or "", "type": dest_type},
             "exception": self.exception,
             "stacktrace": self.exception,
+            # the whole job's cross-host trace is one
+            # GET /3/Trace?trace_id= fetch away (ISSUE 16)
+            "trace_id": self.trace_id,
             "warnings": [],
             "auto_recoverable": False,
             "ready_for_view": True,

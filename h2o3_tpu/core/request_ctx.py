@@ -72,15 +72,26 @@ def deadline_scope(deadline: Optional[float]) -> Iterator[None]:
 
 
 @contextlib.contextmanager
-def job_scope(job, deadline: Optional[float] = None) -> Iterator[None]:
-    """Install ``job`` (and its captured deadline) as the thread's
-    current work unit — Job.start wraps the worker body in this so
-    cancel_point() deep inside map/reduce loops can observe both."""
+def job_scope(job, deadline: Optional[float] = None,
+              trace=None) -> Iterator[None]:
+    """Install ``job`` (and its captured deadline and trace context) as
+    the thread's current work unit — Job.start wraps the worker body in
+    this so cancel_point() deep inside map/reduce loops can observe the
+    job + deadline, and so the job's spans stay stitched to the
+    originating request's trace across the thread hop
+    (telemetry/trace_context.py)."""
     tok_j = _JOB.set(job)
     tok_d = _DEADLINE.set(deadline)
+    tok_t = None
+    if trace is not None:
+        from h2o3_tpu.telemetry import trace_context
+        tok_t = trace_context.install(trace)
     try:
         yield
     finally:
+        if tok_t is not None:
+            from h2o3_tpu.telemetry import trace_context
+            trace_context.uninstall(tok_t)
         _DEADLINE.reset(tok_d)
         _JOB.reset(tok_j)
 

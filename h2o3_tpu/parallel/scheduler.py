@@ -63,7 +63,7 @@ import os
 import threading
 import time
 import zlib
-from typing import Any, Callable, Dict, List, Optional
+from typing import Any, Callable, Dict, List, Optional, Tuple
 
 from h2o3_tpu.core import config as _config
 from h2o3_tpu.utils.log import get_logger
@@ -338,6 +338,30 @@ def _noop_ctx():
     yield
 
 
+def _lease_payload(assignments: Dict[int, int],
+                   traceparent: Optional[str]) -> str:
+    """Serialize a lease record for ``ctl/assign/<pid>``. With a
+    coordinator traceparent the record wraps to ``{"items": ...,
+    "traceparent": ...}`` so the holder's item spans stitch under the
+    coordinator's sched.run span; without one it stays the legacy bare
+    ``{idx: gen}`` dict."""
+    if not traceparent:
+        return json.dumps(assignments)
+    return json.dumps({"items": assignments, "traceparent": traceparent})
+
+
+def _parse_lease(raw: Optional[str]) -> Tuple[Dict[int, int],
+                                              Optional[str]]:
+    """Inverse of :func:`_lease_payload`; accepts both shapes."""
+    if not raw:
+        return {}, None
+    d = json.loads(raw)
+    if isinstance(d.get("items"), dict):
+        return ({int(k): int(v) for k, v in d["items"].items()},
+                d.get("traceparent") or None)
+    return {int(k): int(v) for k, v in d.items()}, None
+
+
 def _execute_one(idx: int, gen: int, execute: Callable[[int], bytes],
                  client, R: str, fit_dir: Optional[str],
                  pid: int) -> Dict[str, Any]:
@@ -451,44 +475,54 @@ def run(tag: str, n_items: int, execute: Callable[[int], bytes], *,
     coordinator = pid == 0
     board: Optional[RunBoard] = None
     suspects: Dict[int, float] = {}     # dead-candidate pid -> first seen
-    if coordinator:
-        # garbage-collect the run-before-last: a process entering run
-        # seq N has provably finished INSTALLING run N-1 (install gates
-        # its return), so only the immediately-previous subtree can
-        # still have readers — anything older is safe to delete
-        with _lock:
-            _PAST_RUNS.append(R)
-            stale = _PAST_RUNS[:-2]
-            del _PAST_RUNS[:-2]
-        for old in stale:
-            try:
-                client.key_value_delete(old)
-            except Exception:    # noqa: BLE001 - hygiene is best-effort
-                pass
-        # hosts already heartbeat-dead at run start never get leases;
-        # run-sequence rotation spreads successive small runs (AutoML
-        # single-model steps) across different hosts
-        dead0 = set(_hb.dead_peers())
-        procs = [p for p in range(nproc) if p not in dead0 or p == 0]
-        board = RunBoard(n_items, procs, offset=seq % len(procs))
-        for p in procs:
-            client.key_value_set(
-                f"{R}ctl/assign/{p}", json.dumps(board.assignments(p)),
-                allow_overwrite=True)
-        counts = {p: len(board.assignments(p)) for p in procs}
-        log.info("sched run %s (%s): %d items over hosts %s", run_id,
-                 tag, n_items, counts)
-        if job is not None:
-            job.update(0.0, f"sched {run_id}: {n_items} items "
-                            f"across hosts {counts}")
-
     my_done: Dict[int, int] = {}        # idx -> gen executed locally
     manifest: Optional[dict] = None
     log_every = max(1, int(5.0 / poll_s))
     tick = 0
+    run_tp: Optional[str] = None
+    from h2o3_tpu.telemetry import spans as _spans
+    from h2o3_tpu.telemetry import trace_context as _trace
     with _hb.local_work_scope(), \
             telemetry.span("sched.run", tag=tag, run=run_id,
                            items=n_items, hosts=nproc):
+        if coordinator:
+            # the coordinator's traceparent rides every lease record:
+            # a leased item executes under the COORDINATOR's causality,
+            # so a remote host's sched.item spans parent under this
+            # sched.run span in the stitched GET /3/Trace?trace_id=
+            run_tp = _trace.format_traceparent(
+                parent_id=_spans.current_span_id())
+            # garbage-collect the run-before-last: a process entering
+            # run seq N has provably finished INSTALLING run N-1
+            # (install gates its return), so only the immediately-
+            # previous subtree can still have readers — anything older
+            # is safe to delete
+            with _lock:
+                _PAST_RUNS.append(R)
+                stale = _PAST_RUNS[:-2]
+                del _PAST_RUNS[:-2]
+            for old in stale:
+                try:
+                    client.key_value_delete(old)
+                except Exception:   # noqa: BLE001 - best-effort hygiene
+                    pass
+            # hosts already heartbeat-dead at run start never get
+            # leases; run-sequence rotation spreads successive small
+            # runs (AutoML single-model steps) across different hosts
+            dead0 = set(_hb.dead_peers())
+            procs = [p for p in range(nproc) if p not in dead0 or p == 0]
+            board = RunBoard(n_items, procs, offset=seq % len(procs))
+            for p in procs:
+                client.key_value_set(
+                    f"{R}ctl/assign/{p}",
+                    _lease_payload(board.assignments(p), run_tp),
+                    allow_overwrite=True)
+            counts = {p: len(board.assignments(p)) for p in procs}
+            log.info("sched run %s (%s): %d items over hosts %s", run_id,
+                     tag, n_items, counts)
+            if job is not None:
+                job.update(0.0, f"sched {run_id}: {n_items} items "
+                                f"across hosts {counts}")
         while True:
             # -- lease intake + local execution (every process) --------
             ctl = _dir(client, f"{R}ctl/")
@@ -498,14 +532,23 @@ def run(tag: str, n_items: int, execute: Callable[[int], bytes], *,
                 _set_leases(0)
                 break
             raw = ctl.get(f"{R}ctl/assign/{pid}")
-            items = ({int(k): int(v) for k, v in json.loads(raw).items()}
-                     if raw else {})
+            items, lease_tp = _parse_lease(raw)
+            lease_tc = _trace.parse_traceparent(lease_tp) \
+                if lease_tp else None
             todo = sorted((i, g) for i, g in items.items()
                           if my_done.get(i) != g)
             for n_left, (idx, gen) in enumerate(todo):
                 _set_leases(len(todo) - n_left)
-                r = _execute_one(idx, gen, execute, client, R, fit_dir,
-                                 pid)
+                if lease_tc is not None:
+                    # execute under the LEASE's causality: detach from
+                    # the local polling loop's span stack so sched.item
+                    # roots under the coordinator's sched.run
+                    with _trace.trace_scope(lease_tc), _spans.detach():
+                        r = _execute_one(idx, gen, execute, client, R,
+                                         fit_dir, pid)
+                else:
+                    r = _execute_one(idx, gen, execute, client, R,
+                                     fit_dir, pid)
                 data = r.pop("data")
                 _publish(client, f"{R}rmeta/{idx}/{r['gen']}",
                          f"{R}rblob/{idx}/{r['gen']}/", data, r)
@@ -544,7 +587,8 @@ def run(tag: str, n_items: int, execute: Callable[[int], bytes], *,
                         for p in board.alive():
                             client.key_value_set(
                                 f"{R}ctl/assign/{p}",
-                                json.dumps(board.assignments(p)),
+                                _lease_payload(board.assignments(p),
+                                               run_tp),
                                 allow_overwrite=True)
                 done_n = len(board.results)
                 if job is not None and tick % log_every == 0:

@@ -68,14 +68,15 @@ class PendingScore:
     """One request's seat in the micro-batch: parsed columns in, a
     per-request result slice (or error) out."""
 
-    __slots__ = ("cols", "n", "deadline", "enqueue_t", "result", "error",
-                 "meta", "_event")
+    __slots__ = ("cols", "n", "deadline", "trace", "enqueue_t", "result",
+                 "error", "meta", "_event")
 
     def __init__(self, cols: Dict, n: int,
-                 deadline: Optional[float] = None):
+                 deadline: Optional[float] = None, trace=None):
         self.cols = cols
         self.n = int(n)
         self.deadline = deadline          # absolute time.monotonic()
+        self.trace = trace                # TraceContext of the submitter
         self.enqueue_t = time.monotonic()
         self.result = None
         self.error: Optional[BaseException] = None

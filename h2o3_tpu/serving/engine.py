@@ -209,7 +209,17 @@ class ScoringEngine:
         cols = rows_mod.parse_rows(sc.schema, rows)
         if deadline is None:
             deadline = request_ctx.current_deadline()
-        pending = PendingScore(cols, len(rows), deadline=deadline)
+        # the submitter's trace rides its queue seat: the dispatcher
+        # thread attributes retroactive queue/device/scatter sub-spans
+        # back to each member request's OWN trace (parent = the span
+        # submitting here, typically the rest ingress span)
+        from h2o3_tpu.telemetry import spans as _spans
+        from h2o3_tpu.telemetry import trace_context as _trace
+        tc = _trace.current()
+        trace = tc.child(_spans.current_span_id() or tc.parent_id) \
+            if tc is not None else None
+        pending = PendingScore(cols, len(rows), deadline=deadline,
+                               trace=trace)
         self._batchers[model.key].submit(pending)
         timeout = wait_timeout_s
         if deadline is not None:
@@ -240,32 +250,70 @@ class ScoringEngine:
                 p.finish(error=KeyError(
                     f"serving scorer for {model_key} was evicted"))
             return
-        now = time.monotonic()
-        q_hist = telemetry.histogram("predict_seconds",
-                                     buckets=_LATENCY_BUCKETS,
-                                     phase="queue")
-        for p in batch:
-            q_hist.observe(now - p.enqueue_t)
-        telemetry.histogram("predict_batch_width",
-                            buckets=_WIDTH_BUCKETS).observe(
-            float(len(batch)))
-        cols = rows_mod.concat_columns([p.cols for p in batch])
-        n = sum(p.n for p in batch)
-        t_dev = time.monotonic()
-        out, domains = self._score_cols(sc.model, sc, cols, n)
-        telemetry.histogram("predict_seconds", buckets=_LATENCY_BUCKETS,
-                            phase="device").observe(
-            time.monotonic() - t_dev)
-        t_sc = time.monotonic()
-        off = 0
-        for p in batch:
-            sl = {nm: arr[off:off + p.n] for nm, arr in out.items()}
-            p.finish(result=(sl, domains), batch_requests=len(batch),
-                     batch_rows=n)
-            off += p.n
-        telemetry.histogram("predict_seconds", buckets=_LATENCY_BUCKETS,
-                            phase="scatter").observe(
-            time.monotonic() - t_sc)
+        from h2o3_tpu.telemetry import spans as spans_mod
+        traced = [p for p in batch if p.trace is not None]
+        with telemetry.span("predict.dispatch", model=model_key,
+                            requests=len(batch)) as dsp:
+            if traced:
+                # the coalesced dispatch is ONE device program serving
+                # many traces — link them all on the dispatch span
+                dsp.annotate(member_traces=sorted(
+                    {p.trace.trace_id for p in traced}))
+            now = time.monotonic()
+            wall = time.time()
+            q_hist = telemetry.histogram("predict_seconds",
+                                         buckets=_LATENCY_BUCKETS,
+                                         phase="queue")
+            for p in batch:
+                q_wait = now - p.enqueue_t
+                q_hist.observe(q_wait)
+            telemetry.histogram("predict_batch_width",
+                                buckets=_WIDTH_BUCKETS).observe(
+                float(len(batch)))
+            cols = rows_mod.concat_columns([p.cols for p in batch])
+            n = sum(p.n for p in batch)
+            t_dev = time.monotonic()
+            w_dev = time.time()
+            out, domains = self._score_cols(sc.model, sc, cols, n)
+            telemetry.histogram("predict_seconds",
+                                buckets=_LATENCY_BUCKETS,
+                                phase="device").observe(
+                time.monotonic() - t_dev)
+            t_sc = time.monotonic()
+            w_sc = time.time()
+            off = 0
+            for p in batch:
+                sl = {nm: arr[off:off + p.n] for nm, arr in out.items()}
+                p.finish(result=(sl, domains), batch_requests=len(batch),
+                         batch_rows=n)
+                off += p.n
+            telemetry.histogram("predict_seconds",
+                                buckets=_LATENCY_BUCKETS,
+                                phase="scatter").observe(
+                time.monotonic() - t_sc)
+            w_end = time.time()
+            # retroactive per-member phase spans, each under its OWN
+            # request's trace (parent = the submitting span): the
+            # stitched trace shows every member's queue wait + its
+            # share of the coalesced device/scatter work
+            for p in traced:
+                q_wait = max(now - p.enqueue_t, 0.0)
+                spans_mod.record_finished(
+                    "predict.queue", wall - q_wait, wall,
+                    trace_id=p.trace.trace_id,
+                    parent_id=p.trace.parent_id,
+                    model=model_key, dispatch_span=dsp.id)
+                spans_mod.record_finished(
+                    "predict.device", w_dev, w_sc,
+                    trace_id=p.trace.trace_id,
+                    parent_id=p.trace.parent_id,
+                    model=model_key, dispatch_span=dsp.id,
+                    batch_requests=len(batch), batch_rows=n)
+                spans_mod.record_finished(
+                    "predict.scatter", w_sc, w_end,
+                    trace_id=p.trace.trace_id,
+                    parent_id=p.trace.parent_id,
+                    model=model_key, dispatch_span=dsp.id)
 
     # -- the compiled pipeline -----------------------------------------
     def _score_cols(self, model, sc: CompiledScorer,

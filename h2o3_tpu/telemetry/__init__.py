@@ -40,6 +40,8 @@ from h2o3_tpu.telemetry.spans import aggregate as spans_aggregate
 from h2o3_tpu.telemetry.compile_observer import (compiles_snapshot, install,
                                                  observed_jit)
 from h2o3_tpu.telemetry import trace_export
+from h2o3_tpu.telemetry import trace_context
+from h2o3_tpu.telemetry import slo
 from h2o3_tpu.telemetry import cluster
 from h2o3_tpu.telemetry import roofline
 
@@ -58,5 +60,5 @@ __all__ = [
     "add_collective_bytes", "spans_snapshot", "spans_aggregate",
     "install", "observed_jit", "snapshot", "to_prometheus",
     "compiles_snapshot", "flight_recorder", "trace_export",
-    "cluster", "roofline",
+    "trace_context", "slo", "cluster", "roofline",
 ]

@@ -156,6 +156,7 @@ def local_snapshot() -> Dict:
         "hbm": _hbm_snapshot(),
         "jobs": _jobs_snapshot(),
         "sched": _sched_snapshot(),
+        "alerts": _alerts_snapshot(),
     }
 
 
@@ -180,6 +181,19 @@ def _sched_snapshot() -> Dict:
     try:
         from h2o3_tpu.parallel import scheduler
         return scheduler.snapshot()
+    except Exception:   # noqa: BLE001 - snapshot is best-effort
+        return {}
+
+
+def _alerts_snapshot() -> Dict:
+    """This node's SLO evaluation (telemetry/slo.py) — the
+    GET /3/Alerts?cluster=1 merge input. Evaluating here keeps the
+    published burn rates fresh at the publish cadence."""
+    try:
+        from h2o3_tpu.telemetry import slo
+        out = slo.evaluate()
+        # the fan-in only needs states + burns, not rule prose
+        return {"alerts": out["alerts"], "rules": out["rules"]}
     except Exception:   # noqa: BLE001 - snapshot is best-effort
         return {}
 
@@ -226,27 +240,32 @@ def publish(force: bool = False) -> bool:
     if nproc <= 1 and enabled_mode() != "on":
         return False
     now = time.time()
+    # snapshot AND KV write stay under the lock: concurrent publishers
+    # (the heartbeat cadence racing a forced publish) must commit in
+    # snapshot order, or a snapshot captured BEFORE a counter bump can
+    # overwrite the forced post-bump publish and roll the cluster view
+    # back behind live values until the next cadence tick
     with _lock:
         if not force and now - _last_publish < interval_s():
             return False
         _last_publish = now
         _seq += 1
-    try:
-        client = _client()
-        if client is None:
+        try:
+            client = _client()
+            if client is None:
+                return False
+            payload = _encode(local_snapshot())
+            client.key_value_set(f"{KV_PREFIX}{node}", payload,
+                                 allow_overwrite=True)
+            counter("cluster_publish_total").inc()
+            histogram("cluster_publish_bytes",
+                      buckets=BYTES_BUCKETS).observe(len(payload))
+            return True
+        except Exception as e:   # noqa: BLE001 - publishing best-effort
+            counter("cluster_publish_failures_total").inc()
+            from h2o3_tpu.utils.log import get_logger
+            get_logger("cluster").debug("snapshot publish failed: %s", e)
             return False
-        payload = _encode(local_snapshot())
-        client.key_value_set(f"{KV_PREFIX}{node}", payload,
-                             allow_overwrite=True)
-        counter("cluster_publish_total").inc()
-        histogram("cluster_publish_bytes",
-                  buckets=BYTES_BUCKETS).observe(len(payload))
-        return True
-    except Exception as e:   # noqa: BLE001 - publishing is best-effort
-        counter("cluster_publish_failures_total").inc()
-        from h2o3_tpu.utils.log import get_logger
-        get_logger("cluster").debug("snapshot publish failed: %s", e)
-        return False
 
 
 def maybe_publish() -> bool:
@@ -465,6 +484,42 @@ def merged_trace(col: Optional[Dict] = None) -> Dict:
         nodes, extra={"cluster": True,
                       "process_count": col["process_count"],
                       "stale_nodes": col["stale_nodes"]})
+
+
+def stitched_trace(trace_id: str, col: Optional[Dict] = None) -> Dict:
+    """ONE request's causal trace across every host that published
+    spans for it (``GET /3/Trace?trace_id=`` — trace_export
+    .stitched_trace over the same fan-in snapshots merged_trace uses).
+    On a single-process cloud this degrades to filtering the local
+    ring."""
+    from h2o3_tpu.telemetry import trace_export
+    col = col or collect()
+    nodes = {int(n): {"spans": snap.get("spans", []),
+                      "events": snap.get("events", [])}
+             for n, snap in col["nodes"].items()}
+    return trace_export.stitched_trace(
+        trace_id, nodes,
+        extra={"process_count": col["process_count"],
+               "stale_nodes": col["stale_nodes"]})
+
+
+def merged_alerts(col: Optional[Dict] = None) -> Dict:
+    """Cluster SLO view for GET /3/Alerts?cluster=1: every node's
+    published evaluation, each alert/rule stamped with its ``node``.
+    Objectives are evaluated per process (a burn on ANY host is a
+    page), so entries merge side by side — never averaged."""
+    col = col or collect()
+    alerts: List[Dict] = []
+    rules: List[Dict] = []
+    for n in sorted(col["nodes"]):
+        a = col["nodes"][n].get("alerts") or {}
+        for e in a.get("alerts", []) or []:
+            alerts.append({**e, "node": int(n)})
+        for e in a.get("rules", []) or []:
+            rules.append({**e, "node": int(n)})
+    return {"alerts": alerts, "rules": rules,
+            "stale_nodes": col["stale_nodes"],
+            "process_count": col["process_count"]}
 
 
 def merged_jobs(col: Optional[Dict] = None) -> Dict:

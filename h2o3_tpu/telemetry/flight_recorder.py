@@ -85,7 +85,7 @@ class JobTelemetry:
 
     __slots__ = ("job_key", "description", "start_ms", "end_ms", "status",
                  "spans", "events", "compiles", "logs", "metric_deltas",
-                 "dropped", "node", "_counters0", "_lock")
+                 "dropped", "node", "slo_alerts", "_counters0", "_lock")
 
     def __init__(self, job_key: str, description: str):
         self.job_key = job_key
@@ -106,6 +106,7 @@ class JobTelemetry:
         self.logs: List[Dict] = []
         self.metric_deltas: Dict[str, float] = {}
         self.dropped: Dict[str, int] = {}
+        self.slo_alerts: List[Dict] = []
         self._counters0 = _counter_totals()
         self._lock = threading.Lock()
 
@@ -138,6 +139,13 @@ class JobTelemetry:
             name: round(now[name] - self._counters0.get(name, 0.0), 6)
             for name in now
             if now[name] != self._counters0.get(name, 0.0)}
+        # SLO alerts firing as this job ended — a capsule pulled for a
+        # slow job should say whether an objective was already burning
+        try:
+            from h2o3_tpu.telemetry import slo as _slo
+            self.slo_alerts = _slo.active_alerts()
+        except Exception:   # noqa: BLE001 - capture is best-effort
+            self.slo_alerts = []
 
     def to_dict(self) -> Dict:
         with self._lock:
@@ -156,6 +164,7 @@ class JobTelemetry:
                 "logs": list(self.logs),
                 "metric_deltas": dict(self.metric_deltas),
                 "dropped": dict(self.dropped),
+                "slo_alerts": list(self.slo_alerts),
             }
 
 

@@ -143,6 +143,65 @@ class Histogram:
             out.append(acc)
         return out
 
+    def counts_snapshot(self) -> Tuple[List[int], int]:
+        """(per-bucket counts, total count) under one lock hold — the
+        consistent view quantile math and the SLO engine need."""
+        with self._lock:
+            return list(self._counts), self._count
+
+    def quantile(self, q: float) -> Optional[float]:
+        """Bucket-interpolated quantile (the histogram_quantile
+        estimator): linear interpolation inside the bucket the rank
+        falls in, each bucket's lower edge being the previous bound (0
+        for the first). Observations beyond the last bound live in the
+        implicit +Inf bucket and clamp to the last finite bound — the
+        same conservative answer PromQL gives. None with no
+        observations."""
+        counts, total = self.counts_snapshot()
+        return _quantile_from_counts(self.bounds, counts, total, q)
+
+
+def _quantile_from_counts(bounds: Sequence[float], counts: Sequence[int],
+                          total: int, q: float) -> Optional[float]:
+    if total <= 0:
+        return None
+    q = min(max(float(q), 0.0), 1.0)
+    rank = q * total
+    seen = 0
+    for i, c in enumerate(counts):
+        if c and seen + c >= rank:
+            lo = bounds[i - 1] if i > 0 else 0.0
+            hi = bounds[i]
+            return lo + (hi - lo) * ((rank - seen) / c)
+        seen += c
+    return float(bounds[-1])   # rank fell in the +Inf overflow
+
+
+def merged_quantile(hists: Sequence["Histogram"],
+                    q: float) -> Optional[float]:
+    """Quantile over the UNION of several histograms' observations.
+    Only meaningful when every histogram shares one bucket grid —
+    asserted, because silently merging mismatched grids produced
+    garbage p99s (the predict_seconds{phase} audit)."""
+    hists = [h for h in hists if h is not None]
+    if not hists:
+        return None
+    bounds = hists[0].bounds
+    for h in hists[1:]:
+        if h.bounds != bounds:
+            raise ValueError(
+                f"merged_quantile over mismatched bucket grids: "
+                f"{h.name}{h.labels} has {len(h.bounds)} bounds vs "
+                f"{len(bounds)}")
+    counts = [0] * len(bounds)
+    total = 0
+    for h in hists:
+        cs, n = h.counts_snapshot()
+        total += n
+        for i, c in enumerate(cs):
+            counts[i] += c
+    return _quantile_from_counts(bounds, counts, total, q)
+
 
 _OPS = 0   # total registry ops since boot (overhead accounting)
 
@@ -184,6 +243,14 @@ class MetricsRegistry:
         if m is None:
             return 0.0
         return float(m.count if isinstance(m, Histogram) else m.value)
+
+    def find(self, name: str) -> List[object]:
+        """Every metric object registered under ``name`` (any label
+        set) — the SLO engine's read surface."""
+        name = _full_name(name)
+        with self._lock:
+            items = list(self._metrics.items())
+        return [m for (n, _), m in items if n == name]
 
     def total(self, name: str) -> float:
         """Sum of a counter across all label sets."""

@@ -44,14 +44,16 @@ _current: contextvars.ContextVar[Optional["Span"]] = \
 
 
 class Span:
-    __slots__ = ("id", "name", "parent_id", "start", "end", "meta",
-                 "device_peak_bytes", "collective_bytes", "_token",
-                 "_peak_base")
+    __slots__ = ("id", "name", "parent_id", "trace_id", "start", "end",
+                 "meta", "device_peak_bytes", "collective_bytes",
+                 "_token", "_peak_base")
 
-    def __init__(self, name: str, parent_id: Optional[str], **meta):
+    def __init__(self, name: str, parent_id: Optional[str],
+                 trace_id: Optional[str] = None, **meta):
         self.id = f"sp-{next(_ids):08d}"
         self.name = name
         self.parent_id = parent_id
+        self.trace_id = trace_id
         self.start = time.time()
         self.end = 0.0
         self.meta = meta
@@ -69,6 +71,7 @@ class Span:
 
     def to_dict(self) -> Dict:
         return {"id": self.id, "parent_id": self.parent_id,
+                "trace_id": self.trace_id,
                 "name": self.name,
                 "start_ms": int(self.start * 1000),
                 "duration_ms": round(self.duration * 1000, 3),
@@ -102,8 +105,16 @@ def span(name: str, **meta):
     high-water reports 0 (pre-fix every span after the global peak
     reported the same global max). Backends without ``memory_stats``
     report 0 throughout."""
+    from h2o3_tpu.telemetry import trace_context
     parent = _current.get()
-    sp = Span(name, parent.id if parent is not None else None, **meta)
+    tc = trace_context.current()
+    # cross-process/cross-thread stitch: a ROOT span (no in-process
+    # parent) parents under the installed trace context's parent id —
+    # the submitting request's span on the other side of the hop
+    parent_id = parent.id if parent is not None \
+        else (tc.parent_id if tc is not None else None)
+    sp = Span(name, parent_id,
+              trace_id=tc.trace_id if tc is not None else None, **meta)
     sp._peak_base = _device_peak()
     sp._token = _current.set(sp)
     try:
@@ -130,6 +141,45 @@ def span(name: str, **meta):
         from h2o3_tpu.utils.timeline import record as _tl
         _tl("span", f"{name} {sp.duration * 1000:.1f}ms",
             span_id=sp.id, parent_id=sp.parent_id)
+
+
+@contextmanager
+def detach():
+    """Detach from the in-process span stack for the with-block: the
+    next span opened becomes a ROOT, parenting under the installed
+    trace context (if any) instead of the local ancestor. A leased
+    scheduler item executes under the LEASE's causality — the
+    coordinator's sched.run — not the local polling loop's."""
+    token = _current.set(None)
+    try:
+        yield
+    finally:
+        _current.reset(token)
+
+
+def record_finished(name: str, start: float, end: float, *,
+                    trace_id: Optional[str] = None,
+                    parent_id: Optional[str] = None, **meta) -> Span:
+    """Record a span whose interval was measured AFTER the fact — the
+    serving batcher's queue/device/scatter phases are timed inside the
+    coalesced dispatch, then attributed back to each member request's
+    own trace. Skips the device-peak baseline (the interval is already
+    closed) but otherwise lands in the same ring/metrics/flight
+    recorder as a live span."""
+    sp = Span(name, parent_id, trace_id=trace_id, **meta)
+    sp.start = float(start)
+    sp.end = float(end)
+    with _finished_lock:
+        _finished.append(sp)
+    counter("spans_total", name=name).inc()
+    histogram("span_seconds", name=name).observe(max(sp.end - sp.start,
+                                                     0.0))
+    try:
+        from h2o3_tpu.telemetry import flight_recorder
+        flight_recorder.record_span(sp)
+    except Exception:   # noqa: BLE001 - capture is best-effort
+        pass
+    return sp
 
 
 def current_span() -> Optional[Span]:

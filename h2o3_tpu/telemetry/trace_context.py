@@ -1,0 +1,163 @@
+"""Request-scoped distributed trace context (W3C traceparent style).
+
+The span tracer (spans.py) is process-local: it answers "where did the
+time go" inside ONE process, but a request that fans out — REST handler
+→ background job thread → scheduler leases on remote hosts → coalesced
+predict dispatches — loses its identity at every hop. This module is
+the identity that survives those hops: a (trace_id, parent span id,
+sampled) triple carried in a contextvar BESIDE ``request_ctx``'s
+deadline, following the exact same propagation discipline (captured at
+ingress, re-installed across thread hops, serialized across process
+boundaries).
+
+Wire format: a ``traceparent`` header/string shaped like the W3C
+recommendation, ``00-<32 hex trace id>-<parent id>-<2 hex flags>``.
+The parent-id field is deliberately looser than W3C's 16-hex: spans.py
+ids are ``sp-NNNNNNNN`` strings and the whole point of propagation is
+that a remote child parents under the ORIGINATING span id, so the
+parser accepts either form.
+
+Propagation sites:
+- REST ingress (api/server.py): incoming ``traceparent`` accepted (or
+  a fresh context generated), echoed as ``X-H2O-Trace-Id`` on every
+  response, installed around the handler.
+- REST → job thread (core/job.py): the Job captures the context at
+  ``__init__`` on the submitting thread and re-installs it in ``_run``
+  on the worker thread, exactly like the request deadline.
+- Scheduler leases (parallel/scheduler.py): the coordinator stamps its
+  traceparent (parent = its ``sched.run`` span) into every
+  ``ctl/assign/<pid>`` record so a remote host's ``sched.item`` spans
+  parent under the coordinator's run.
+- Serving batcher (serving/engine.py): each queued predict request
+  carries its submitter's context so the coalesced dispatch can emit
+  queue/device/scatter sub-spans under each member's OWN trace.
+
+spans.py consumes the installed context: every span is stamped with
+``trace_id``, and a ROOT span (no in-process parent) takes the
+context's ``parent_id`` as its parent — that single rule is the
+cross-process stitch ``GET /3/Trace?trace_id=`` renders.
+"""
+
+from __future__ import annotations
+
+import contextvars
+import re
+import uuid
+from contextlib import contextmanager
+from typing import Optional
+
+# trace id: 32 lowercase hex (uuid4().hex); parent: W3C 16-hex OR a
+# spans.py "sp-NNNNNNNN" id OR the all-zero "none yet" placeholder
+_TRACEPARENT_RE = re.compile(
+    r"^([0-9a-f]{2})-([0-9a-f]{32})-([0-9a-zA-Z._\-]{1,64})-"
+    r"([0-9a-f]{2})$")
+_NO_PARENT = "0" * 16
+
+
+class TraceContext:
+    __slots__ = ("trace_id", "parent_id", "sampled")
+
+    def __init__(self, trace_id: str, parent_id: Optional[str] = None,
+                 sampled: bool = True):
+        self.trace_id = trace_id
+        self.parent_id = parent_id
+        self.sampled = sampled
+
+    def child(self, parent_id: Optional[str]) -> "TraceContext":
+        """Same trace, re-parented — the hop primitive: capture the
+        submitting side's active span id as the new parent."""
+        return TraceContext(self.trace_id, parent_id, self.sampled)
+
+    def to_traceparent(self,
+                       parent_id: Optional[str] = None) -> str:
+        pid = parent_id or self.parent_id or _NO_PARENT
+        return f"00-{self.trace_id}-{pid}-" \
+               f"{'01' if self.sampled else '00'}"
+
+    def to_dict(self) -> dict:
+        return {"trace_id": self.trace_id, "parent_id": self.parent_id,
+                "sampled": self.sampled}
+
+    def __repr__(self) -> str:   # pragma: no cover - debug aid
+        return f"TraceContext({self.to_traceparent()})"
+
+
+_CTX: contextvars.ContextVar[Optional[TraceContext]] = \
+    contextvars.ContextVar("h2o3tpu_trace", default=None)
+
+
+def new_trace_id() -> str:
+    return uuid.uuid4().hex
+
+
+def new_context(sampled: bool = True) -> TraceContext:
+    """Fresh root context — REST ingress with no ``traceparent``."""
+    return TraceContext(new_trace_id(), None, sampled)
+
+
+def current() -> Optional[TraceContext]:
+    return _CTX.get()
+
+
+def current_trace_id() -> Optional[str]:
+    tc = _CTX.get()
+    return tc.trace_id if tc is not None else None
+
+
+@contextmanager
+def trace_scope(ctx: Optional[TraceContext]):
+    """Install ``ctx`` for the with-block (None uninstalls — a worker
+    deliberately detaching from its submitter's trace)."""
+    token = _CTX.set(ctx)
+    try:
+        yield ctx
+    finally:
+        _CTX.reset(token)
+
+
+def install(ctx: Optional[TraceContext]):
+    """Non-contextmanager install — returns the reset token. For hosts
+    that manage several contextvars in one scope (request_ctx.job_scope
+    carries job + deadline + trace across the worker-thread hop)."""
+    return _CTX.set(ctx)
+
+
+def uninstall(token) -> None:
+    _CTX.reset(token)
+
+
+def parse_traceparent(header: Optional[str]) -> Optional[TraceContext]:
+    """Parse a traceparent string; malformed/absent → None (ingress
+    then generates a fresh context — never a 4xx, tracing is telemetry
+    not a contract)."""
+    if not header:
+        return None
+    m = _TRACEPARENT_RE.match(header.strip())
+    if m is None:
+        return None
+    _version, trace_id, parent, flags = m.groups()
+    if trace_id == "0" * 32:
+        return None
+    if parent == _NO_PARENT:
+        parent = None
+    try:
+        sampled = bool(int(flags, 16) & 1)
+    except ValueError:   # pragma: no cover - regex guarantees hex
+        sampled = True
+    return TraceContext(trace_id, parent, sampled)
+
+
+def format_traceparent(ctx: Optional[TraceContext] = None,
+                       parent_id: Optional[str] = None) -> Optional[str]:
+    """Serialize the given (default: installed) context for a process
+    hop, optionally re-parenting under ``parent_id`` (the sender's
+    active span). None when no context is installed."""
+    tc = ctx if ctx is not None else _CTX.get()
+    if tc is None:
+        return None
+    return tc.to_traceparent(parent_id=parent_id)
+
+
+def _reset() -> None:
+    """Tests only — hard-clear the contextvar (conftest leak sweep)."""
+    _CTX.set(None)

@@ -148,6 +148,74 @@ def cluster_trace(nodes: Dict[int, Dict],
     return trace
 
 
+def stitched_trace(trace_id: str, nodes: Dict[int, Dict],
+                   extra: Optional[Dict] = None) -> Dict:
+    """ONE request's causal trace across hosts (``GET
+    /3/Trace?trace_id=``): each node's span ring is filtered to
+    ``trace_id`` and the survivors merge into a SINGLE track group
+    (``pid`` 1 — the trace is the unit, not the host), with tids
+    assigned per causal tree ACROSS processes: a remote root whose
+    ``parent_id`` names a span on another host (the traceparent
+    propagated through a scheduler lease or job hop) joins that span's
+    flame stack instead of starting a pid-grouped track of its own.
+
+    Span ids are per-process counters, so merged ids are node-qualified
+    (``n<node>:sp-…``); a ``parent_id`` is resolved to the node that
+    owns it — same node first, else the unique other owner (the
+    cross-process link), else left dangling as its own root. Each
+    span's originating ``node`` rides its args."""
+    node_spans: Dict[int, List[Dict]] = {}
+    node_events: Dict[int, List[Dict]] = {}
+    for n in nodes:
+        d = nodes[n]
+        node_spans[int(n)] = [s for s in d.get("spans", ())
+                              if s.get("trace_id") == trace_id]
+        node_events[int(n)] = list(d.get("events", ()))
+    node_ids = {n: {s["id"] for s in ss} for n, ss in node_spans.items()}
+
+    def qual(n: int, sid: Optional[str]) -> Optional[str]:
+        if sid is None:
+            return None
+        if sid in node_ids[n]:
+            return f"n{n}:{sid}"
+        owners = [m for m, ids in node_ids.items() if sid in ids]
+        if len(owners) == 1:
+            return f"n{owners[0]}:{sid}"
+        return sid      # unknown (off-ring) or ambiguous → dangles
+    spans: List[Dict] = []
+    for n, ss in sorted(node_spans.items()):
+        for s in ss:
+            s2 = dict(s)
+            s2["id"] = f"n{n}:{s['id']}"
+            s2["parent_id"] = qual(n, s.get("parent_id"))
+            s2["meta"] = {**(s.get("meta") or {}), "node": n}
+            spans.append(s2)
+    tids, tid_labels = _span_tids(spans)
+    pid = 1
+    out: List[Dict] = [_meta_event(pid, None, "process_name",
+                                   f"h2o3-tpu trace {trace_id}")]
+    for t in sorted(tid_labels):
+        out.append(_meta_event(pid, t, "thread_name", tid_labels[t]))
+    for s in spans:
+        out.append(_span_event(s, pid, tids[s["id"]]))
+    # timeline instants only when they attribute to a span OF THIS
+    # trace (events carry no trace id of their own)
+    for n, evs in sorted(node_events.items()):
+        for e in evs:
+            tid = tids.get(qual(n, e.get("span_id")))
+            if tid is not None:
+                out.append(_instant_event({**e, "node": n}, pid, tid))
+    trace = {"traceEvents": out, "displayTimeUnit": "ms",
+             "otherData": {"source": "h2o3_tpu.telemetry.trace_export",
+                           "trace_id": trace_id,
+                           "span_count": len(spans),
+                           "nodes": sorted(n for n, ss
+                                           in node_spans.items() if ss)}}
+    if extra:
+        trace["otherData"].update(extra)
+    return trace
+
+
 def capsule_trace(capsule) -> Dict:
     """One job's flight-recorder capsule → Chrome trace JSON."""
     d = capsule.to_dict()

@@ -111,6 +111,21 @@ def _check_keys(request):
         f"{len(leaked)} DKV key(s) leaked: {sorted(leaked)[:10]}"
 
 
+@pytest.fixture(autouse=True)
+def _check_trace_context():
+    """Trace-context leak check (ISSUE 16): a test that installs a
+    TraceContext (trace_scope / install) must uninstall it — a leaked
+    context would silently stamp every later test's spans with a stale
+    trace id. Mirrors the DKV/Scope sweep: defensively reset, then
+    fail the test that leaked."""
+    from h2o3_tpu.telemetry import trace_context
+    yield
+    leaked = trace_context.current()
+    trace_context._reset()
+    assert leaked is None, \
+        f"TraceContext leaked across test boundary: {leaked.to_dict()}"
+
+
 def _sweep_orphan_spills(baseline) -> None:
     """Delete spill npz files in the ice dir that no in-DKV stub still
     references (hex://spill/* — io/persist.py _IceDriver layout)."""

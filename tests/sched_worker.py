@@ -10,6 +10,11 @@ scheduler fans across the hosts. Modes (argv[5]):
 - ``kill`` — like ``run``, but process 1 SIGKILLs itself after
   completing its first scheduled item; the coordinator must detect the
   dead peer, reassign its remaining leases, and finish bit-identical.
+- ``trace`` — the ISSUE 16 distributed-tracing leg: process 0 drives
+  the SAME grid through REST with a ``traceparent`` header and fetches
+  ``GET /3/Trace?trace_id=``; process 1 trains directly (the SPMD
+  partner). The stitched trace must hold causally-parented spans from
+  BOTH hosts under the client's trace id.
 
 Each surviving process writes ``outfile.<pid>`` with the grid result
 (full-precision metrics), its scheduler counters, and its job statuses.
@@ -90,6 +95,95 @@ from h2o3_tpu.models.gbm import GBMEstimator  # noqa: E402
 HYPER = {"learn_rate": [0.05, 0.1],
          "sample_rate": [0.7, 1.0],
          "min_rows": [5.0, 10.0]}             # 8 combos, one shape
+
+if mode == "trace":
+    import time
+    import urllib.parse
+    import urllib.request
+
+    from h2o3_tpu import telemetry
+    from h2o3_tpu.telemetry import cluster
+
+    TRACE_ID = "ab" * 16
+
+    if pid == 0:
+        # REST-initiated leg: the handler launches a background job
+        # whose grid train enters scheduler.run — the same SPMD point
+        # process 1 reaches directly below
+        from h2o3_tpu.api.server import start_server
+        port = start_server(port=0, background=True)
+        tp = f"00-{TRACE_ID}-{'0' * 16}-01"
+        url = (f"http://127.0.0.1:{port}/99/Grid/gbm"
+               f"?training_frame={urllib.parse.quote(str(fr.key))}"
+               f"&response_column=y&ntrees=3&max_depth=3&seed=3"
+               f"&hyper_parameters="
+               f"{urllib.parse.quote(json.dumps(HYPER))}")
+        req = urllib.request.Request(url, data=b"", method="POST",
+                                     headers={"traceparent": tp})
+        with urllib.request.urlopen(req) as r:
+            echoed = r.headers.get("X-H2O-Trace-Id")
+            jk = json.loads(r.read())["job"]["key"]["name"]
+        status = "?"
+        for _ in range(1200):
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/3/Jobs/{jk}") as r:
+                jd = json.loads(r.read())["jobs"][0]
+            status = jd["status"]
+            if status not in ("CREATED", "RUNNING"):
+                break
+            time.sleep(0.1)
+        # the stitched trace needs BOTH hosts' span rings: poll until
+        # process 1's published snapshot carries its leased items
+        trace = {}
+        for _ in range(100):
+            cluster.publish(force=True)
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/3/Trace"
+                    f"?trace_id={TRACE_ID}") as r:
+                trace = json.loads(r.read())
+            if sorted(trace.get("otherData", {})
+                      .get("nodes", [])) == [0, 1]:
+                break
+            time.sleep(0.2)
+        result = {"pid": pid, "status": status, "echoed": echoed,
+                  "job_trace_id": jd.get("trace_id"),
+                  "trace": trace}
+    else:
+        # offset this process's span-id counter so its span ids cannot
+        # collide with the COORDINATOR's sched.run id — cross-node
+        # parent resolution in trace_export prefers a same-node owner
+        for _ in range(512):
+            with telemetry.span("trace_test.pad"):
+                pass
+        GridSearch(GBMEstimator, HYPER, ntrees=3, max_depth=3,
+                   seed=3).train(fr, y="y")
+        # keep publishing until process 0 banked its stitched trace
+        for _ in range(300):
+            cluster.publish(force=True)
+            if os.path.exists(f"{outfile}.0"):
+                break
+            time.sleep(0.2)
+        result = {"pid": pid,
+                  "sched": scheduler.snapshot(),
+                  "spans_with_trace": sum(
+                      1 for s in telemetry.spans_snapshot(2048)
+                      if s.get("trace_id") == TRACE_ID)}
+    with open(f"{outfile}.{pid}", "w") as f:
+        json.dump(result, f)
+    print(f"SCHED-WORKER-{pid}-DONE", flush=True)
+    if pid == 0:
+        # the coordination service lives in THIS process: exiting while
+        # peer 1 still polls it turns the socket close into a fatal
+        # UNAVAILABLE in that process (pjrt distributed client CHECK) —
+        # hold on until the peer has banked its result
+        for _ in range(600):
+            if os.path.exists(f"{outfile}.1"):
+                break
+            time.sleep(0.1)
+    # skip the distributed-shutdown barrier: results are on disk, and
+    # the processes finish at different times by design
+    os._exit(0)
+
 grid = GridSearch(GBMEstimator, HYPER, ntrees=3, max_depth=3,
                   seed=3).train(fr, y="y")
 

@@ -389,6 +389,13 @@ def test_metric_names_documented_in_readme():
                      "sched_items_reassigned_total",
                      "sched_leases_held", "sched_item_seconds"):
         assert required in section, required
+    # the ISSUE 16 tracing + SLO surface (telemetry/trace_context.py,
+    # telemetry/slo.py) is part of the stable contract too
+    for required in ("slo_burn_rate", "slo_alert_active",
+                     "slo_alert_transitions_total",
+                     "X-H2O-Trace-Id", "traceparent",
+                     "/3/Alerts", "trace_id="):
+        assert required in section, required
 
 
 # ----------------------------------------------------------- REST tier
