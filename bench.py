@@ -1060,13 +1060,198 @@ def bench_tracing():
         peak_hbm_gb=round(_hbm_peak() / 1e9, 2))
 
 
+_FLEET_WORKER_SRC = '''
+"""bench fleet worker: one pod process (generated by bench.py)."""
+import json, os, signal, sys, threading, time
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
+os.environ["H2O3TPU_HEARTBEAT_INTERVAL_S"] = "0.25"
+os.environ["H2O3TPU_FLEET_LOAD_TTL_S"] = "0.2"
+sys.path.insert(0, os.environ["H2O3TPU_BENCH_REPO"])
+coord, nproc, pid, outfile = sys.argv[1:5]
+nproc, pid = int(nproc), int(pid)
+import jax
+jax.config.update("jax_default_device", None)
+import h2o3_tpu
+h2o3_tpu.init(backend="cpu", coordinator_address=coord,
+              num_processes=nproc, process_id=pid)
+import numpy as np
+from h2o3_tpu.core.kv import DKV
+from h2o3_tpu.serving import fleet
+
+r = np.random.RandomState(31)
+n = 1500
+fr = h2o3_tpu.Frame.from_numpy(
+    {"a": r.randn(n), "b": r.randn(n),
+     "y": r.randn(n) + 0.5})
+from h2o3_tpu.models.gbm import GBMEstimator
+model = GBMEstimator(ntrees=3, max_depth=3, seed=9).train(fr, y="y")
+MKEY = str(model.key)
+ROWS = [{"a": float(i) * 0.1, "b": 1.0 - float(i) * 0.05}
+        for i in range(8)]
+from h2o3_tpu.api.server import start_server
+port = start_server(port=0, background=True)
+killflag = outfile + ".killflag"
+
+# publish is an SPMD point on a live cloud (the lowering pickle
+# allgathers cross-process sharded arrays): both processes call it here
+fleet.publish(model)
+
+if pid == 1:
+    DKV.remove(MKEY)
+    fleet.install_published(MKEY)
+    while not os.path.exists(killflag):
+        time.sleep(0.05)
+    os.kill(os.getpid(), signal.SIGKILL)
+
+DKV.remove(MKEY)
+deadline = time.monotonic() + 60
+while not (1 in fleet.replicas(MKEY) and 1 in fleet.endpoints()):
+    if time.monotonic() > deadline:
+        raise RuntimeError("replica never registered")
+    time.sleep(0.05)
+
+import urllib.request
+
+
+def predict_once(timeout=20.0):
+    req = urllib.request.Request(
+        "http://127.0.0.1:%d/3/Predictions/models/%s" % (port, MKEY),
+        data=json.dumps({"rows": ROWS}).encode(),
+        headers={"Content-Type": "application/json"}, method="POST")
+    t = time.monotonic()
+    with urllib.request.urlopen(req, timeout=timeout) as resp:
+        out = json.loads(resp.read())
+    return time.monotonic() - t, out["predictions"]["predict"]
+
+
+def drive(n_req, clients):
+    lats, preds, lock = [], [], threading.Lock()
+
+    def one():
+        lat, p = predict_once()
+        with lock:
+            lats.append(lat)
+            preds.append(p)
+
+    t0 = time.monotonic()
+    for lo in range(0, n_req, clients):
+        ts = [threading.Thread(target=one)
+              for _ in range(min(clients, n_req - lo))]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+    wall = max(time.monotonic() - t0, 1e-9)
+    lats.sort()
+    return {"qps": len(lats) / wall,
+            "p99_ms": lats[min(len(lats) - 1,
+                               int(len(lats) * 0.99))] * 1e3,
+            "pred": preds[0]}
+
+
+n_req = int(os.environ.get("H2O3TPU_BENCH_FLEET_REQS", "30"))
+predict_once()                                   # warm the route
+routed = {c: drive(n_req, c) for c in (1, 4)}
+
+with open(killflag, "w") as f:
+    f.write("die")
+t0 = time.monotonic()
+recovery_s, pred_after = None, None
+while time.monotonic() - t0 < 90:
+    try:
+        _lat, pred_after = predict_once()
+        recovery_s = time.monotonic() - t0
+        break
+    except Exception:
+        time.sleep(0.05)
+
+local = {c: drive(n_req, c) for c in (1, 4)}
+
+with open(outfile + ".0", "w") as f:
+    json.dump({"routed": routed, "local": local,
+               "recovery_s": recovery_s, "pred_after": pred_after,
+               "installed": MKEY in fleet.stats()["local_replicas"]},
+              f)
+print("FLEET-BENCH-0-DONE", flush=True)
+os._exit(0)
+'''
+
+
+def bench_fleet():
+    """Fleet serving resilience (ISSUE 17, serving/fleet.py): a REAL
+    2-process CPU cloud — one replica node, one routing-only node. The
+    router node's REST edge answers row-payload predicts by proxying to
+    the replica (routed leg), then the replica is SIGKILLed and the line
+    prices the RECOVERY: hedged failover installs the published binary
+    locally and the first successful answer stamps recovery_seconds.
+    The local leg (post-recovery) is the single-node baseline — routed
+    p99 carries one 127.0.0.1 HTTP hop over it, and the answers must
+    match exactly (the bit-parity contract's cheap proxy here; asserted
+    in full by tests/test_fleet.py)."""
+    import socket
+    import subprocess
+    import tempfile
+
+    with tempfile.TemporaryDirectory() as tmp:
+        worker = os.path.join(tmp, "fleet_bench_worker.py")
+        with open(worker, "w") as f:
+            f.write(_FLEET_WORKER_SRC)
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        coord = f"127.0.0.1:{s.getsockname()[1]}"
+        s.close()
+        out = os.path.join(tmp, "fleet.json")
+        env = dict(os.environ)
+        env.pop("JAX_PLATFORMS", None)
+        env["H2O3TPU_BENCH_REPO"] = os.path.dirname(
+            os.path.abspath(__file__))
+        env["H2O3TPU_BENCH_FLEET_REQS"] = "20" if FAST else "40"
+        procs = [subprocess.Popen(
+            [sys.executable, worker, coord, "2", str(i), out],
+            env=env, stdout=subprocess.DEVNULL,
+            stderr=subprocess.STDOUT) for i in range(2)]
+        deadline = time.time() + 420
+        for p in procs:
+            try:
+                p.wait(timeout=max(deadline - time.time(), 1.0))
+            except subprocess.TimeoutExpired:
+                for q in procs:
+                    if q.poll() is None:
+                        q.kill()
+        assert procs[0].returncode == 0, "fleet driver process failed"
+        with open(out + ".0") as f:
+            res = json.load(f)
+
+    assert res["recovery_s"] is not None, "never recovered from kill"
+    assert res["installed"], "failover never installed the binary"
+    # bit-parity proxy: routed, post-kill, and local answers identical
+    assert (res["routed"][  "4"]["pred"] == res["local"]["4"]["pred"]
+            == res["pred_after"])
+    qps_r1, qps_r4 = res["routed"]["1"]["qps"], res["routed"]["4"]["qps"]
+    qps_l4 = res["local"]["4"]["qps"]
+    _emit(
+        "fleet routed row-payload predict, 2-process cloud "
+        "(proxy to replica; SIGKILL replica -> hedged local install)",
+        qps_r4, "requests/sec",
+        qps_r4 / max(qps_l4, 1e-9), "same predicts served locally "
+        "(single node, post-recovery)",
+        routed_qps_1client=round(qps_r1, 1),
+        routed_qps_4clients=round(qps_r4, 1),
+        client_scaling=round(qps_r4 / max(qps_r1, 1e-9), 2),
+        routed_p99_ms=round(res["routed"]["4"]["p99_ms"], 2),
+        local_p99_ms=round(res["local"]["4"]["p99_ms"], 2),
+        local_qps_4clients=round(qps_l4, 1),
+        kill_recovery_seconds=round(res["recovery_s"], 3))
+
+
 CONFIGS = [("gbm", bench_gbm), ("glm", bench_glm), ("dl", bench_dl),
            ("xgb", bench_xgb), ("sort", bench_sort),
            ("grid", bench_grid), ("treekernel", bench_treekernel),
            ("cloud", bench_cloud), ("checkpoint", bench_checkpoint),
            ("memgov", bench_memgov), ("ingest", bench_ingest),
            ("serving", bench_serving), ("sched", bench_sched),
-           ("tracing", bench_tracing),
+           ("tracing", bench_tracing), ("fleet", bench_fleet),
            ("automl", bench_automl), ("gbm-full", bench_gbm_full)]
 
 # minimum seconds a config plausibly needs; skipped (with a JSON note)
@@ -1074,7 +1259,7 @@ CONFIGS = [("gbm", bench_gbm), ("glm", bench_glm), ("dl", bench_dl),
 _MIN_NEED = {"gbm": 60, "glm": 90, "dl": 60, "xgb": 60, "sort": 60,
              "grid": 120, "treekernel": 60, "cloud": 30, "automl": 180,
              "checkpoint": 90, "memgov": 90, "ingest": 90,
-             "serving": 60, "sched": 120, "tracing": 90,
+             "serving": 60, "sched": 120, "tracing": 90, "fleet": 120,
              "gbm-full": 600}
 
 # hard per-config wallclock cap (child process killed past it): a
@@ -1082,7 +1267,7 @@ _MIN_NEED = {"gbm": 60, "glm": 90, "dl": 60, "xgb": 60, "sort": 60,
 _HARD_CAP = {"gbm": 900, "glm": 600, "dl": 600, "xgb": 600, "sort": 400,
              "grid": 600, "treekernel": 400, "cloud": 300, "automl": 900,
              "checkpoint": 600, "memgov": 600, "ingest": 600,
-             "serving": 600, "sched": 600, "tracing": 600,
+             "serving": 600, "sched": 600, "tracing": 600, "fleet": 600,
              "gbm-full": 1200}
 
 
@@ -1495,6 +1680,74 @@ def _stub_slo():
           evaluations=evals)
 
 
+def _stub_fleet():
+    """`fleet` line without a backend (ISSUE 17): drives the replica
+    router's routing/failover state machine (serving/fleet.py
+    ReplicaRouter) dry on injected providers — least-loaded pick, local
+    bias, heartbeat exclusion, bounded hedged failover, drain — plus
+    the degradation contract (FleetUnavailable carries Retry-After);
+    no jax, no sockets."""
+    from h2o3_tpu.serving.fleet import (FleetUnavailable, ReplicaRouter,
+                                        SERVE_LOCALLY)
+    reps = {"m": {1: {}, 2: {}, 3: {}}}
+    eps = {1: ("h", 1), 2: ("h", 2), 3: ("h", 3)}
+    loads = {0: 0.0, 1: 5.0, 2: 1.0, 3: 9.0}
+    dead, draining = set(), [False]
+    r = ReplicaRouter(
+        self_pid=0,
+        replicas_fn=lambda mk: dict(reps.get(mk, {})),
+        endpoints_fn=lambda: dict(eps),
+        dead_fn=lambda: set(dead),
+        loads_fn=lambda: dict(loads),
+        draining_fn=lambda: draining[0],
+        published_fn=lambda mk: mk == "m",
+        local_bias=2.0)
+    t0 = time.time()
+    n_plans = 3000
+    # steady state: least-loaded healthy replica wins every plan
+    for _ in range(n_plans):
+        p = r.plan("m", have_local=False)
+        assert p.decision == "proxy" and p.pid == 2, vars(p)
+    # the local bias: a swamped local replica routes away, a marginal
+    # win stays local
+    reps["m"][0] = {}
+    loads[0] = 9.0
+    assert r.plan("m", have_local=True).pid == 2
+    loads[0] = 2.5
+    assert r.plan("m", have_local=True).decision == "local"
+    del reps["m"][0]
+    # heartbeat exclusion: the best replica dies -> next-best, no probe
+    dead.add(2)
+    assert r.plan("m", have_local=False).pid == 1
+    # bounded hedged failover: every hop down -> the fallback sentinel
+    # (the caller installs the published binary), never a hang
+    calls = []
+
+    def down(pid, ep):
+        calls.append(pid)
+        raise ConnectionRefusedError("down")
+
+    assert r.hedged("m", down, local_fallback=True) is SERVE_LOCALLY
+    n_hedges = len(calls)
+    assert n_hedges == 2            # 1 and 3 tried; 2 is dead
+    # explicit degradation: no fallback -> retryable FleetUnavailable
+    try:
+        r.hedged("m", down)
+        raise AssertionError("hedged never degraded")
+    except FleetUnavailable as e:
+        assert e.retry_after_s > 0
+    # drain: the peer leaves routing, the published binary still
+    # resolves for anyone else (install), a held copy still serves
+    draining[0] = True
+    assert r.plan("m", have_local=False).decision in ("proxy", "install")
+    reps["m"].clear()
+    assert r.plan("m", have_local=False).decision == "install"
+    dt = max(time.time() - t0, 1e-6)
+    _emit("fleet replica router (stub; route->bias->exclude->hedge->"
+          "drain state machine, no backend)", n_plans / dt,
+          "plans/sec", 1.0, "stub", hedged_hops=n_hedges)
+
+
 if STUB:
     CONFIGS = [("stub_a", _stub_ok("stub_a")),
                ("stub_wedge", _stub_wedge),
@@ -1508,6 +1761,7 @@ if STUB:
                ("serving", _stub_serving),
                ("sched", _stub_sched),
                ("slo", _stub_slo),
+               ("fleet", _stub_fleet),
                ("stub_b", _stub_ok("stub_b"))]
     _MIN_NEED = {n: 1 for n, _ in CONFIGS}
     _HARD_CAP = {n: 30 for n, _ in CONFIGS}

@@ -34,7 +34,8 @@ from h2o3_tpu.core.kv import DKV
 from h2o3_tpu.frame.frame import Frame
 from h2o3_tpu.models import all_algos, get_builder
 from h2o3_tpu.models.model import Model
-from h2o3_tpu.serving.batcher import QueueSaturated
+from h2o3_tpu.serving.batcher import BatcherDraining, QueueSaturated
+from h2o3_tpu.serving.fleet import FleetUnavailable
 from h2o3_tpu.utils.log import get_logger
 
 log = get_logger("h2o3_tpu.api")
@@ -869,7 +870,28 @@ def _predict(params, body, mid=None, fid=None):
     m = DKV.get(mid)
     fr = DKV.get(fid)
     if not isinstance(m, Model):
-        raise KeyError(f"model {mid} not found")
+        # bulk predicts route through the fleet too (ISSUE 17): a model
+        # this node never trained can still be answered here — proxy or
+        # 307 to a healthy replica, or install the published binary
+        from h2o3_tpu.serving import fleet
+        hop = str(params.pop("_fleet_hop", "")).lower() in ("1", "true")
+        plan = fleet.plan_route(mid, have_local=False, hop=hop)
+        bulk_path = (f"/3/Predictions/models/"
+                     f"{urllib.parse.quote(str(mid), safe='')}/frames/"
+                     f"{urllib.parse.quote(str(fid), safe='')}")
+        if plan.decision == "redirect":
+            return {"__redirect__": fleet.redirect_url(plan, bulk_path)}
+        if plan.decision == "proxy":
+            payload = {k: v for k, v in params.items()
+                       if not str(k).startswith("_")}
+            res = fleet.proxy_predict(
+                plan, bulk_path, payload, mid,
+                local_fallback=fleet.published(mid) is not None)
+            if res is not fleet.SERVE_LOCALLY:
+                return res
+        if plan.decision == "none":
+            raise KeyError(f"model {mid} not found")
+        m = fleet.install_published(mid)
     if not isinstance(fr, Frame):
         raise KeyError(f"frame {fid} not found")
     dest = params.get("predictions_frame") or f"predictions_{mid}_{fid}"
@@ -943,10 +965,15 @@ def _predict_rows(params, body, mid=None):
     rows — no DKV frame round trip — scored through the serving tier's
     compiled-scorer cache and continuous micro-batcher, bit-identical
     to ``Model.predict`` on the same rows. Body:
-    ``{"rows": [{"col": value, ...}, ...]}``; missing keys are NAs."""
-    m = DKV.get(mid)
-    if not isinstance(m, Model):
-        raise KeyError(f"model {mid} not found")
+    ``{"rows": [{"col": value, ...}, ...]}``; missing keys are NAs.
+
+    Fleet-routed (ISSUE 17): the request resolves against the replica
+    registry — heartbeat-dead peers excluded, least-loaded healthy
+    replica wins — and either serves locally, proxies (with hedged
+    failover within the deadline budget), or 307-redirects.
+    ``_fleet_hop=1`` marks an already-routed request (never re-routed)."""
+    from h2o3_tpu.serving import fleet
+    hop = str(params.pop("_fleet_hop", "")).lower() in ("1", "true")
     rows = params.get("rows")
     if isinstance(rows, str):
         try:
@@ -956,6 +983,27 @@ def _predict_rows(params, body, mid=None):
     if rows is None:
         raise ValueError("missing 'rows': POST a JSON body "
                          '{"rows": [{"col": value, ...}, ...]}')
+    m = DKV.get(mid)
+    have_local = isinstance(m, Model)
+    plan = fleet.plan_route(mid, have_local=have_local, hop=hop)
+    if plan.decision == "none":
+        raise KeyError(f"model {mid} not found")
+    if plan.decision == "redirect":
+        return {"__redirect__": plan.url}
+    if plan.decision == "proxy":
+        res = fleet.proxy_predict(
+            plan,
+            f"/3/Predictions/models/"
+            f"{urllib.parse.quote(str(mid), safe='')}",
+            {"rows": rows}, mid,
+            local_fallback=(have_local
+                            or fleet.published(mid) is not None))
+        if res is not fleet.SERVE_LOCALLY:
+            return res
+    if not have_local:
+        # routed here (or every remote hop failed) without a local
+        # copy: install + pre-warm from the published binary
+        m = fleet.install_published(mid)
     from h2o3_tpu.serving import ServingUnsupported
     from h2o3_tpu.serving.engine import engine
     try:
@@ -2257,6 +2305,8 @@ class _Handler(BaseHTTPRequestHandler):
                 telemetry.counter("rest_requests_total", method=method,
                                   endpoint=endpoint).inc()
                 t_req = time.monotonic()
+                retry_after = "1"
+                redirect_loc = None
                 try:
                     # the deadline and trace context ride contextvars:
                     # any Job the handler creates captures both
@@ -2300,10 +2350,35 @@ class _Handler(BaseHTTPRequestHandler):
                                       reason="predict_queue_full").inc()
                     out = _error_json(path, e, 503)
                     code = 503
+                except BatcherDraining as e:
+                    # serving tier shutting down: queued/new predicts
+                    # fail fast 503 instead of hanging on a closing
+                    # dispatcher (ISSUE 17 graceful drain)
+                    telemetry.counter("rest_rejected_total",
+                                      reason="draining").inc()
+                    out = _error_json(path, e, 503)
+                    code = 503
+                except FleetUnavailable as e:
+                    # every replica unhealthy: explicit degradation —
+                    # 503 + Retry-After in H2OErrorV3 shape, never a
+                    # hang (serving/fleet.py routing contract)
+                    telemetry.counter("rest_rejected_total",
+                                      reason="fleet_unavailable").inc()
+                    retry_after = str(max(
+                        1, int(round(e.retry_after_s))))
+                    out = _error_json(path, e, 503)
+                    code = 503
                 except Exception as e:   # noqa: BLE001 - request boundary
                     log.exception("handler error on %s %s", method, path)
                     out = _error_json(path, e, 500)
                     code = 500
+                if code == 200 and isinstance(out, dict) \
+                        and "__redirect__" in out:
+                    # fleet 307: same-method redirect at the chosen
+                    # replica (serving/fleet.py routing contract)
+                    redirect_loc = out["__redirect__"]
+                    out = {"location": redirect_loc}
+                    code = 307
                 if code == 200 and deadline is not None:
                     out, code = _await_job_deadline(out, deadline, path)
                 # RED per-route latency: the duration leg next to the
@@ -2313,10 +2388,12 @@ class _Handler(BaseHTTPRequestHandler):
                                     route=endpoint,
                                     status=str(code)).observe(
                     time.monotonic() - t_req)
-                return self._respond(
-                    code, out,
-                    extra_headers={"Retry-After": "1"}
-                    if code == 503 else None)
+                extra = None
+                if code == 503:
+                    extra = {"Retry-After": retry_after}
+                elif code == 307:
+                    extra = {"Location": redirect_loc}
+                return self._respond(code, out, extra_headers=extra)
         _tl_record("rest", f"{method} {path}", status=404)
         telemetry.counter("rest_requests_total", method=method,
                           endpoint="(no_route)").inc()
@@ -2414,6 +2491,13 @@ def start_server(port: int = 54321, background: bool = True) -> int:
     _SERVER = ThreadingHTTPServer(("127.0.0.1", port), _Handler)
     actual = _SERVER.server_address[1]
     log.info("REST server on http://127.0.0.1:%d (/3, /99)", actual)
+    # publish this node's REST edge in the fleet registry: peers route
+    # predictions here by ACTUAL bound port (ephemeral binds included)
+    try:
+        from h2o3_tpu.serving import fleet
+        fleet.set_local_endpoint(actual)
+    except Exception as e:   # noqa: BLE001 - registry is best-effort
+        log.debug("fleet endpoint publish failed: %s", e)
     if background:
         _THREAD = threading.Thread(target=_SERVER.serve_forever, daemon=True)
         _THREAD.start()
@@ -2424,6 +2508,11 @@ def start_server(port: int = 54321, background: bool = True) -> int:
 
 def stop_server():
     global _SERVER
+    try:
+        from h2o3_tpu.serving import fleet
+        fleet.clear_local_endpoint()
+    except Exception:        # noqa: BLE001
+        pass
     if _SERVER is not None:
         _SERVER.shutdown()
         _SERVER = None

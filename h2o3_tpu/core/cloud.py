@@ -180,6 +180,12 @@ def init(backend: Optional[str] = None,
         if int(process_id) == 0:
             from h2o3_tpu.parallel import scheduler as _scheduler_mod
             _scheduler_mod.sweep_keys()
+            # same reasoning for the serving-fleet registry: stale
+            # replica/endpoint entries and published binaries from the
+            # previous incarnation are swept once the roll-call barrier
+            # proves nobody is still routing against them
+            from h2o3_tpu.serving import fleet as _fleet_mod
+            _fleet_mod.sweep_keys()
         # stamp this process's cloud identity on every log record and
         # flight-recorder capsule (utils/log.py ContextFilter) so merged
         # cluster views stay attributable — set here, NOT read from
@@ -267,6 +273,14 @@ def _sweep_coordination_keys() -> None:
             client.key_value_delete(f"{prefix}{pidx}")
         except Exception:   # noqa: BLE001 - absent key / service down
             pass
+    try:
+        # fleet endpoint + replica rows are per-process too; published
+        # model binaries stay (a lagging peer may still be installing
+        # one) and are garbage-collected by the init-time prefix sweep
+        from h2o3_tpu.serving import fleet as _fleet_mod
+        _fleet_mod.sweep_local_keys(client, pidx)
+    except Exception:       # noqa: BLE001 - fleet tier is optional
+        pass
     # scheduler run subtrees are NOT swept here: processes reach
     # shutdown at different times, and deleting h2o3tpu/sched/ while a
     # lagging peer still polls its last run's done manifest wedges that
@@ -285,6 +299,14 @@ def shutdown() -> None:
     jax.distributed client — so a subsequent ``init()`` reforms the
     cloud instead of attaching to stale state."""
     global _STARTED, _CLOUD_START_MS, _DISTRIBUTED
+    try:
+        # fleet drain FIRST, while the heartbeat still marks us healthy:
+        # deregister local replicas and pull the REST endpoint so peers
+        # stop routing predictions here before anything else tears down
+        from h2o3_tpu.serving import fleet as _fleet_mod
+        _fleet_mod.drain()
+    except Exception:       # noqa: BLE001 - fleet tier is optional
+        pass
     heartbeat_mod.monitor.stop()
     try:
         from h2o3_tpu.core.cleaner import cleaner

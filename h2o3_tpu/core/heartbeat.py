@@ -316,6 +316,14 @@ class HeartbeatMonitor:
             cluster.maybe_publish()
         except Exception as e:      # noqa: BLE001 - publish is best-effort
             log.debug("cluster telemetry publish skipped: %s", e)
+        # fleet re-warm piggybacks here too: when a replica's last host
+        # dies, the least-loaded healthy peer adopts the published model
+        # (rate-limited inside maybe_adopt; install runs off-thread)
+        try:
+            from h2o3_tpu.serving import fleet
+            fleet.maybe_adopt()
+        except Exception as e:      # noqa: BLE001 - adopt is best-effort
+            log.debug("fleet adopt check skipped: %s", e)
         beats = {}
         for key, val in client.key_value_dir_get(KV_PREFIX):
             try:
@@ -374,6 +382,21 @@ def dead_peers() -> List[int]:
         return [p for p, st in monitor.peers.items()
                 if p != monitor._pid
                 and now - st["last_seen"] > stale_after]
+
+
+def healthy_peers() -> List[int]:
+    """Process ids (self included) whose beat is fresh — the complement
+    of :func:`dead_peers` over the known peer set. The fleet router uses
+    this to build its candidate pool before consulting load."""
+    now = time.time()
+    stale_after = monitor.interval_s * monitor.miss_budget
+    with monitor._lock:
+        fresh = [p for p, st in monitor.peers.items()
+                 if p == monitor._pid
+                 or now - st["last_seen"] <= stale_after]
+        if monitor._pid not in fresh:
+            fresh.append(monitor._pid)   # single-process / monitor off
+        return sorted(fresh)
 
 
 def check_healthy(site: str = "") -> None:

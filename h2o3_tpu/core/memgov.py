@@ -378,6 +378,17 @@ class MemoryGovernor:
                 f"fit.", projected=nbytes, available=available,
                 budget=limit)
 
+    def admit_replica(self, model_key: str, nbytes: int) -> Reservation:
+        """Serving-replica admission (ISSUE 17): reserve the replica's
+        projected device bytes NOW or raise ``MemoryBudgetExceeded`` —
+        no bounded wait, because a fleet peer that cannot take the
+        replica must DECLINE registration immediately so the registry
+        offers it to the next healthy peer instead of queueing behind
+        fits. The reservation lives as long as the replica; the fleet
+        releases it on deregistration/eviction."""
+        return self.reserve(f"replica:{model_key}", nbytes,
+                            timeout_s=0.0)
+
     def release(self, rsv: Optional[Reservation]) -> None:
         if rsv is None or rsv.released:
             return

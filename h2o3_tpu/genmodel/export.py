@@ -61,6 +61,13 @@ def mojo_artifacts(model) -> Tuple[dict, Dict[str, np.ndarray]]:
             meta["tweedie_power"] = float(model.params.get("tweedie_power", 1.5))
         elif algo == "isolationforest":
             meta["c_norm"] = float(model.c_norm)
+            # training-frame path-length extrema: the in-cluster scorer
+            # normalizes with (max - total) / (max - min) when these are
+            # present (models/isofor.py _score_raw) — the MOJO must ship
+            # them or its reader falls back to 2^(-ml/c) and diverges
+            for stat in ("min_path_length", "max_path_length"):
+                if model.output.get(stat) is not None:
+                    meta[stat] = int(model.output[stat])
         return meta, arrays
     if algo == "glm":
         meta["link"] = model.family.link

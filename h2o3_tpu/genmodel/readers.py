@@ -188,8 +188,18 @@ class IsoForMojoModel(SharedTreeMojoModel):
         bins = bin_raw(self.meta, self.arrays, data)
         per_tree = walk_forest_pathlen(self.arrays, bins, B)
         ml = per_tree.mean(axis=0)
-        c = max(float(self.meta["c_norm"]), 1e-12)
-        return {"predict": 2.0 ** (-ml / c), "mean_length": ml}
+        mn = self.meta.get("min_path_length")
+        mx = self.meta.get("max_path_length")
+        if mn is not None and mx is not None and float(mx) > float(mn):
+            # reference normalization against the training frame's
+            # path-length extrema — the exact math of the in-cluster
+            # scorer (models/isofor.py _score_raw)
+            ntrees = per_tree.shape[0]
+            score = (float(mx) - ml * ntrees) / (float(mx) - float(mn))
+        else:
+            c = max(float(self.meta["c_norm"]), 1e-12)
+            score = 2.0 ** (-ml / c)
+        return {"predict": score, "mean_length": ml}
 
 
 class GlmMojoModel(MojoModel):

@@ -325,6 +325,19 @@ class _DeviceLoweringPickler(pickle.Pickler):
     def reducer_override(self, obj):
         import jax
         if isinstance(obj, jax.Array):
+            if not obj.is_fully_addressable:
+                if obj.is_fully_replicated:
+                    # Replicated: the local shard IS the global value
+                    # (np.asarray on the global array would raise).
+                    return (np.asarray,
+                            (np.asarray(obj.addressable_shards[0].data),))
+                # Cross-process sharded: allgather like
+                # mesh.fetch_replicated. COLLECTIVE — dumping an object
+                # holding such arrays is an SPMD point (every process
+                # must dump the same object graph), which save paths on
+                # a multi-process cloud already are.
+                from h2o3_tpu.parallel.mesh import fetch_replicated
+                return (np.asarray, (np.asarray(fetch_replicated(obj)),))
             return (np.asarray, (np.asarray(obj),))
         return NotImplemented
 

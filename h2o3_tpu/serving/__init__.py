@@ -5,16 +5,17 @@ stub leg runs them with no backend in the process); the compiled-scorer
 engine pulls in jax and loads lazily via :func:`get_engine`.
 """
 
-from h2o3_tpu.serving.batcher import (MicroBatcher, PendingScore,
-                                      QueueSaturated, batch_knobs)
+from h2o3_tpu.serving.batcher import (BatcherDraining, MicroBatcher,
+                                      PendingScore, QueueSaturated,
+                                      batch_knobs)
 from h2o3_tpu.serving.rows import (Schema, ServingUnsupported,
                                    concat_columns, domains_of,
                                    parse_rows, serving_schema)
 
 __all__ = [
-    "MicroBatcher", "PendingScore", "QueueSaturated", "batch_knobs",
-    "Schema", "ServingUnsupported", "concat_columns", "domains_of",
-    "parse_rows", "serving_schema", "get_engine",
+    "BatcherDraining", "MicroBatcher", "PendingScore", "QueueSaturated",
+    "batch_knobs", "Schema", "ServingUnsupported", "concat_columns",
+    "domains_of", "parse_rows", "serving_schema", "get_engine",
 ]
 
 

@@ -48,6 +48,13 @@ class QueueSaturated(RuntimeError):
     the scoring queue)."""
 
 
+class BatcherDraining(RuntimeError):
+    """The batcher is shutting down: new submissions and entries still
+    queued at close() fail with THIS class so the REST tier can answer
+    503 + Retry-After with ``rest_rejected_total{reason=draining}``
+    instead of leaving futures hanging (ISSUE 17 graceful drain)."""
+
+
 def batch_knobs() -> Dict[str, float]:
     """Resolved micro-batch knobs, env-at-call-time (the
     policy_from_config pattern: tests and bench children set
@@ -136,7 +143,8 @@ class MicroBatcher:
         bounded queue is full (→ 503 at the REST tier)."""
         with self._cond:
             if self._closed:
-                raise RuntimeError(f"batcher {self.name} is closed")
+                raise BatcherDraining(
+                    f"batcher {self.name} is draining; retry later")
             if len(self._q) >= self.queue_depth:
                 raise QueueSaturated(
                     f"predict queue for {self.name} is full "
@@ -173,6 +181,20 @@ class MicroBatcher:
                 self._cond.wait(left)
         return batch
 
+    @staticmethod
+    def _local_work_scope():
+        """Serving dispatch is process-local work (the engine scores on
+        the local mesh; the fleet ROUTER owns dead-peer exclusion), so
+        the heartbeat fail-fast must not kill local scoring when a PEER
+        dies. Lazy + best-effort so the module stays backend-free for
+        the stub bench leg."""
+        try:
+            from h2o3_tpu.core import heartbeat
+            return heartbeat.local_work_scope()
+        except Exception:    # noqa: BLE001 - stub/jax-free process
+            import contextlib
+            return contextlib.nullcontext()
+
     def _loop(self) -> None:
         while True:
             batch = self._collect()
@@ -180,12 +202,14 @@ class MicroBatcher:
                 if self._closed:
                     return
                 continue
-            # chunk-boundary cancellation: cloud health fails queued
-            # predictions fast (no job/deadline context rides on the
+            # chunk-boundary cancellation: job cancel / deadline fails
+            # queued predictions fast (no job context rides on the
             # dispatcher thread — per-request deadlines are checked
-            # individually below)
+            # individually below). Runs as LOCAL work: a dead peer
+            # degrades routing, never this host's own scoring.
             try:
-                request_ctx.cancel_point(self.cancel_site)
+                with self._local_work_scope():
+                    request_ctx.cancel_point(self.cancel_site)
             except BaseException as e:   # noqa: BLE001 - fan the failure out
                 for p in batch:
                     p.finish(error=e)
@@ -203,7 +227,8 @@ class MicroBatcher:
                 continue
             self.dispatches += 1
             try:
-                self.dispatch_fn(live)
+                with self._local_work_scope():
+                    self.dispatch_fn(live)
             except BaseException as e:   # noqa: BLE001 - request boundary
                 log.warning("micro-batch dispatch failed for %s: %s",
                             self.name, e, exc_info=True)
@@ -212,16 +237,19 @@ class MicroBatcher:
                         p.finish(error=e)
 
     def close(self, join: bool = True) -> None:
+        """Graceful drain: stop accepting, let the dispatcher finish its
+        in-flight batch (``join``), then fail anything still queued with
+        :class:`BatcherDraining` — callers must never hang on a closed
+        batcher, and the REST tier turns the drain into a clean 503."""
         with self._cond:
             self._closed = True
             self._cond.notify_all()
         if join:
             self._thread.join(timeout=2.0)
-        # fail anything still queued — callers must never hang on a
-        # closed batcher
         with self._cond:
             drained = list(self._q)
             self._q.clear()
         for p in drained:
-            p.finish(error=RuntimeError(
-                f"batcher {self.name} closed while request was queued"))
+            p.finish(error=BatcherDraining(
+                f"batcher {self.name} closed while request was queued; "
+                f"retry later"))

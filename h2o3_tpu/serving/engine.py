@@ -52,6 +52,25 @@ _LATENCY_BUCKETS = (0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1,
 _WIDTH_BUCKETS = (1, 2, 4, 8, 16, 32, 64, 128, 256)
 
 
+def _serve_mesh_scope():
+    """Serving is strictly process-local work: on a multi-process cloud
+    it must run on THIS host's devices (the scheduler's local-mesh
+    idiom), never the global mesh — a single-sided dispatch onto a
+    cross-process sharding either fails or produces a result no one
+    process can read — and under the heartbeat's local-work exemption,
+    so a DEAD peer degrades fleet routing without killing this host's
+    own scoring. Single-process: no-op."""
+    import contextlib
+    import jax
+    stack = contextlib.ExitStack()
+    if jax.process_count() > 1:
+        from h2o3_tpu.core import heartbeat
+        from h2o3_tpu.parallel import mesh as mesh_mod
+        stack.enter_context(mesh_mod.local_mesh_scope())
+        stack.enter_context(heartbeat.local_work_scope())
+    return stack
+
+
 def _const_nbytes(model) -> int:
     """Device bytes pinned by the model's own parameters (closure
     constants of its compiled scorers)."""
@@ -325,15 +344,17 @@ class ScoringEngine:
         ``Model._finish_predict`` tail."""
         max_rows = int(batch_knobs()["max_rows"])
         parts = []
-        for lo in range(0, n, max_rows):
-            hi = min(lo + max_rows, n)
-            win = cols if (lo == 0 and hi == n) else \
-                {nm: a[lo:hi] for nm, a in cols.items()}
-            parts.append(self._score_window(model, sc, win, hi - lo, warm))
-        merged = parts[0] if len(parts) == 1 else {
-            nm: np.concatenate([p[nm] for p in parts])
-            for nm in parts[0]}
-        return model._finish_predict(merged)
+        with _serve_mesh_scope():
+            for lo in range(0, n, max_rows):
+                hi = min(lo + max_rows, n)
+                win = cols if (lo == 0 and hi == n) else \
+                    {nm: a[lo:hi] for nm, a in cols.items()}
+                parts.append(
+                    self._score_window(model, sc, win, hi - lo, warm))
+            merged = parts[0] if len(parts) == 1 else {
+                nm: np.concatenate([p[nm] for p in parts])
+                for nm in parts[0]}
+            return model._finish_predict(merged)
 
     def _score_window(self, model, sc: CompiledScorer,
                       cols: Dict[str, np.ndarray], n: int,
@@ -380,17 +401,29 @@ class ScoringEngine:
         re-registers and re-warms its model."""
         from h2o3_tpu import telemetry
         freed = 0
+        evicted = []
         with self._lock:
             for key in list(self._scorers):
                 if exclude and key in exclude:
                     continue
                 sc = self._scorers.pop(key)
                 freed += sc.nbytes()
+                evicted.append(key)
                 telemetry.counter("scorer_cache_evictions_total",
                                   algo=sc.algo).inc()
         if freed:
             log.info("evicted %d compiled scorers (%.1f MB est.)",
-                     len(self._batchers), freed / 1e6)
+                     len(evicted), freed / 1e6)
+        if evicted:
+            # a replica whose scorer was evicted is no longer warm:
+            # deregister it from the fleet registry so routing stops
+            # sending here and the least-loaded healthy peer re-warms it
+            # (serving/fleet.py maybe_adopt)
+            try:
+                from h2o3_tpu.serving import fleet
+                fleet.on_scorers_evicted(evicted)
+            except Exception:   # noqa: BLE001 - registry is best-effort
+                pass
         self._refresh_gauge()
         return freed
 
@@ -402,6 +435,35 @@ class ScoringEngine:
             pass
 
     # -- lifecycle -----------------------------------------------------
+    def queue_depth(self, model_key: Optional[str] = None) -> int:
+        """Pending predict requests (one model, or every batcher) — the
+        per-peer load signal the fleet router and the telemetry fan-in
+        serving block report."""
+        with self._lock:
+            if model_key is not None:
+                b = self._batchers.get(model_key)
+                return b.depth() if b is not None else 0
+            return sum(b.depth() for b in self._batchers.values())
+
+    def warm_models(self) -> List[str]:
+        """Model keys with a warm compiled scorer in this process."""
+        with self._lock:
+            return sorted(self._scorers)
+
+    def drain(self) -> None:
+        """Graceful shutdown (ISSUE 17): deregister this process's
+        replicas from the fleet registry FIRST (routing stops sending
+        here), then close every batcher — the dispatcher thread joins,
+        its in-flight batch finishes, and queued requests fail fast with
+        :class:`BatcherDraining` (→ 503 + Retry-After) instead of
+        hanging on abandoned futures."""
+        try:
+            from h2o3_tpu.serving import fleet
+            fleet.deregister_local(reason="draining")
+        except Exception:   # noqa: BLE001 - registry is best-effort
+            pass
+        self.reset()
+
     def stats(self) -> Dict:
         with self._lock:
             return {

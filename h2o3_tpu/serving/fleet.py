@@ -1,0 +1,970 @@
+"""Fleet serving resilience — replica registry + health-routed predicts.
+
+H2O's core deployment property is node symmetry: ANY node answers REST
+and scores any model. The PR 14 scoring tier broke that for multi-host
+clouds — a model lived where it was trained, and that host was a single
+point of failure for every ``POST /3/Predictions`` against it. This
+module closes the gap (ISSUE 17):
+
+- **Replica registry** over the coordination-service KV store (never a
+  device collective — the same out-of-band rule the scheduler and the
+  telemetry fan-in follow). A model's device-independent binary
+  (``io/persist._DeviceLoweringPickler``) is published ONCE under
+  ``h2o3tpu/fleet/bin/<model>/`` (chunked, parts-before-meta, the
+  scheduler's blob transport ordering); any healthy peer can
+  ``install_published`` it — unpickle, DKV.put, pre-warm into the
+  ``ScoringEngine`` bucket cache — and register its warm replica under
+  ``h2o3tpu/fleet/rep/<model>/<pid>``.
+- **Governor-aware registration**: a replica reserves its projected
+  device bytes through the PR 11 admission ledger
+  (``memgov.admit_replica``); a peer over its HBM budget DECLINES
+  instead of warming into an OOM. Scorer eviction deregisters the
+  replica (routing stops sending here) and the heartbeat-piggybacked
+  ``maybe_adopt`` re-warms it on the least-loaded healthy peer.
+- **Health-routed predictions**: the REST tier resolves every predict
+  against the registry — heartbeat staleness excludes dead peers
+  BEFORE their requests fail, the PR 8 telemetry fan-in supplies the
+  load signal (inflight jobs + predict queue depth + REST inflight),
+  and the least-loaded healthy replica wins (with a local bias so a
+  healthy local replica is never abandoned for a marginal win). The
+  node either proxies (default) or 307-redirects
+  (``H2O3TPU_FLEET_REDIRECT=1``); proxied predicts are idempotent, so
+  a replica dying mid-request gets its call HEDGED to the next healthy
+  replica within the request's deadline budget
+  (``H2O3TPU_FLEET_MAX_HOPS``, per-hop ``H2O3TPU_FLEET_HOP_TIMEOUT_S``).
+- **Explicit degradation**: all replicas unhealthy →
+  :class:`FleetUnavailable` → 503 + Retry-After in H2OErrorV3 shape,
+  never a hang; ``drain()`` (cloud shutdown) deregisters the local
+  replicas FIRST, lets in-flight dispatches finish, and fails queued
+  requests 503 immediately (``serving/batcher.BatcherDraining``).
+
+Fault sites: ``replica_register`` (registration path) and
+``replica_dispatch`` (the proxy hop), so every failover path runs
+deterministically on CPU under ``core/watchdog.inject_fault``.
+
+Metrics (README §Observability): ``fleet_replicas_healthy{model}``,
+``predict_routed_total{decision}``, ``predict_failovers_total{reason}``,
+``replica_warm_seconds``.
+
+The module is deliberately jax-free at import: the routing/failover
+state machine (:class:`ReplicaRouter`) runs on injected providers, so
+the bench ``_stub_fleet`` leg and the router unit tests drive it with
+no backend in the process. Single-process clouds (no coordination
+client) degrade to an in-process KV shim — same code paths, local-only
+registry.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import socket
+import threading
+import time
+import urllib.error
+import urllib.parse
+import urllib.request
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from h2o3_tpu.core import request_ctx, watchdog
+from h2o3_tpu.utils.log import get_logger
+
+log = get_logger("h2o3_tpu.serving.fleet")
+
+KV_PREFIX = "h2o3tpu/fleet/"
+_B64_CHUNK = 131072              # base64 chars per KV part (bounded values)
+
+_WARM_BUCKETS = (0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0)
+
+
+class FleetUnavailable(RuntimeError):
+    """No healthy replica can take this predict — the REST tier answers
+    503 + Retry-After in H2OErrorV3 shape (explicit degradation, never
+    a hang)."""
+
+    def __init__(self, msg: str, retry_after_s: float = 1.0):
+        super().__init__(msg)
+        self.retry_after_s = float(retry_after_s)
+
+
+class RoutePlan:
+    """One routing decision: ``local`` (serve here), ``install`` (pull
+    the published binary, then serve here), ``proxy``/``redirect``
+    (target pid + URL), or ``none`` (unknown model — caller 404s)."""
+
+    __slots__ = ("decision", "pid", "url")
+
+    def __init__(self, decision: str, pid: Optional[int] = None,
+                 url: Optional[str] = None):
+        self.decision = decision
+        self.pid = pid
+        self.url = url
+
+    def __repr__(self):
+        return f"<RoutePlan {self.decision} pid={self.pid}>"
+
+
+# ------------------------------------------------------------- knobs
+
+
+def _knob_f(name: str, default: float) -> float:
+    try:
+        return float(os.environ.get(name, default))
+    except (TypeError, ValueError):
+        return float(default)
+
+
+def fleet_knobs() -> Dict[str, float]:
+    """Resolved routing knobs, env-at-call-time (the batch_knobs
+    pattern: tests and bench children flip env without a re-init)."""
+    return {
+        "redirect": _knob_f("H2O3TPU_FLEET_REDIRECT", 0.0),
+        "max_hops": max(1, int(_knob_f("H2O3TPU_FLEET_MAX_HOPS", 3))),
+        "hop_timeout_s": _knob_f("H2O3TPU_FLEET_HOP_TIMEOUT_S", 10.0),
+        "local_bias": _knob_f("H2O3TPU_FLEET_LOCAL_BIAS", 2.0),
+        "retry_after_s": _knob_f("H2O3TPU_FLEET_RETRY_AFTER_S", 1.0),
+        "load_ttl_s": _knob_f("H2O3TPU_FLEET_LOAD_TTL_S", 0.5),
+        "adopt_s": _knob_f("H2O3TPU_FLEET_ADOPT_S", 2.0),
+        "adopt_grace_s": _knob_f("H2O3TPU_FLEET_ADOPT_GRACE_S", 10.0),
+    }
+
+
+# ----------------------------------------------------- KV transport
+
+
+class _LocalKV:
+    """In-process stand-in for the coordination-service KV client:
+    single-process clouds (and jax-free tests) run the SAME registry
+    code against it — local-only, but identical semantics."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._store: Dict[str, str] = {}
+
+    def key_value_set(self, key, val, allow_overwrite=True):
+        with self._lock:
+            self._store[key] = val
+
+    def key_value_dir_get(self, prefix):
+        with self._lock:
+            return [(k, v) for k, v in self._store.items()
+                    if k.startswith(prefix)]
+
+    def key_value_delete(self, key):
+        with self._lock:
+            for k in [k for k in self._store if k.startswith(key)]:
+                del self._store[k]
+
+    def blocking_key_value_get(self, key, timeout_ms):
+        with self._lock:
+            if key not in self._store:
+                raise KeyError(key)
+            return self._store[key]
+
+
+_local_kv = _LocalKV()
+
+
+def _kv():
+    """The coordination-service client, or the in-process shim when the
+    cloud is single-process / the distributed runtime is absent."""
+    try:
+        from jax._src import distributed
+        client = distributed.global_state.client
+        if client is not None:
+            return client
+    except Exception:        # noqa: BLE001 - no jax / no distributed
+        pass
+    return _local_kv
+
+
+def _encode(data: bytes) -> str:
+    import base64
+    import zlib
+    return base64.b64encode(zlib.compress(data, 6)).decode("ascii")
+
+
+def _decode(text: str) -> bytes:
+    import base64
+    import zlib
+    return zlib.decompress(base64.b64decode(text.encode("ascii")))
+
+
+def _self_pid() -> int:
+    from h2o3_tpu.telemetry.cluster import _identity
+    return _identity()[0]
+
+
+# ------------------------------------------------------ module state
+
+_lock = threading.RLock()
+_endpoint: Optional[Tuple[str, int]] = None      # this process's REST edge
+_local_replicas: Dict[str, Dict[str, Any]] = {}  # model_key -> info
+_reservations: Dict[str, Any] = {}               # model_key -> Reservation
+_draining = False
+_last_adopt = 0.0
+_adopt_thread: Optional[threading.Thread] = None
+_loads_cache: Dict[str, Any] = {"ts": 0.0, "loads": {}}
+
+
+# -------------------------------------------------------- endpoints
+
+
+def set_local_endpoint(port: int, host: str = "127.0.0.1") -> None:
+    """Publish this process's REST edge (called by ``start_server``
+    with the ACTUAL bound port — ``port=0`` ephemeral binds included),
+    so peers can proxy/redirect predictions here."""
+    global _endpoint, _draining
+    with _lock:
+        _endpoint = (host, int(port))
+        _draining = False
+    try:
+        _kv().key_value_set(
+            f"{KV_PREFIX}ep/{_self_pid()}",
+            json.dumps({"host": host, "port": int(port),
+                        "ts": time.time(), "ospid": os.getpid()}),
+            allow_overwrite=True)
+    except Exception as e:   # noqa: BLE001 - endpoint publish best-effort
+        log.debug("fleet endpoint publish failed: %s", e)
+
+
+def clear_local_endpoint() -> None:
+    global _endpoint
+    with _lock:
+        _endpoint = None
+    try:
+        _kv().key_value_delete(f"{KV_PREFIX}ep/{_self_pid()}")
+    except Exception:        # noqa: BLE001
+        pass
+
+
+def endpoints() -> Dict[int, Tuple[str, int]]:
+    """pid -> (host, port) for every peer that published a REST edge."""
+    out: Dict[int, Tuple[str, int]] = {}
+    try:
+        for key, val in _kv().key_value_dir_get(f"{KV_PREFIX}ep/"):
+            try:
+                pid = int(key.rsplit("/", 1)[-1])
+                d = json.loads(val)
+                out[pid] = (str(d["host"]), int(d["port"]))
+            except (ValueError, KeyError, TypeError):
+                continue
+    except Exception:        # noqa: BLE001 - KV down: no remote edges
+        pass
+    return out
+
+
+# ----------------------------------------------------- binary plane
+
+
+def published(model_key: str) -> Optional[Dict]:
+    """The published binary's meta (or None)."""
+    try:
+        for key, val in _kv().key_value_dir_get(
+                f"{KV_PREFIX}bin/{model_key}/"):
+            if key.endswith("/meta"):
+                return json.loads(val)
+    except Exception:        # noqa: BLE001
+        pass
+    return None
+
+
+def publish(model) -> bool:
+    """Publish the model's device-independent binary once (idempotent).
+
+    Pickled with ``io/persist._DeviceLoweringPickler`` — every
+    jax.Array lowers to numpy, so ANY peer (any backend) can install.
+    Chunked parts are written before the meta (the scheduler's blob
+    ordering: a half-written blob is never observed).
+
+    SPMD contract: when the model holds cross-process sharded arrays
+    (trained on the global mesh of a multi-process cloud), the lowering
+    pickle allgathers them — EVERY process must call publish at the
+    same program point, exactly like ``Model.predict``. Local-mesh and
+    single-process models publish single-sided."""
+    if published(model.key) is not None:
+        return False
+    import io as _io
+    import pickle
+    from h2o3_tpu.io.persist import _DeviceLoweringPickler
+    buf = _io.BytesIO()
+    _DeviceLoweringPickler(buf, protocol=pickle.HIGHEST_PROTOCOL
+                           ).dump(model)
+    b64 = _encode(buf.getvalue())
+    client = _kv()
+    prefix = f"{KV_PREFIX}bin/{model.key}/"
+    nparts = (len(b64) + _B64_CHUNK - 1) // _B64_CHUNK if b64 else 0
+    for j in range(nparts):
+        client.key_value_set(f"{prefix}p{j}",
+                             b64[j * _B64_CHUNK:(j + 1) * _B64_CHUNK],
+                             allow_overwrite=True)
+    client.key_value_set(
+        f"{prefix}meta",
+        json.dumps({"parts": nparts, "algo": model.algo,
+                    "nbytes": len(buf.getvalue()), "ts": time.time()}),
+        allow_overwrite=True)
+    log.info("published fleet binary for %s (%d parts, %.1f KB)",
+             model.key, nparts, len(buf.getvalue()) / 1e3)
+    return True
+
+
+def install_published(model_key: str):
+    """Pull a published binary, land the model in the local DKV, and
+    pre-warm it into the scoring engine + registry. Returns the model.
+    Raises KeyError when nothing is published under that key."""
+    meta = published(model_key)
+    if meta is None:
+        raise KeyError(f"model {model_key} not found")
+    client = _kv()
+    parts = []
+    for j in range(int(meta.get("parts", 0))):
+        parts.append(client.blocking_key_value_get(
+            f"{KV_PREFIX}bin/{model_key}/p{j}", 10_000))
+    import pickle
+    model = pickle.loads(_decode("".join(parts)))
+    from h2o3_tpu.core.kv import DKV
+    DKV.put(model.key, model)
+    register_local(model)
+    return model
+
+
+# -------------------------------------------------------- registry
+
+
+def register_local(model) -> bool:
+    """Register a warm local replica: governor admission first (a peer
+    over its HBM reservation DECLINES — returns False), then warm the
+    scoring engine, then announce the replica in the KV registry.
+    Idempotent per model."""
+    watchdog.maybe_fail("replica_register")
+    from h2o3_tpu import telemetry
+    with _lock:
+        if _draining:
+            return False
+        if model.key in _local_replicas:
+            return True
+    from h2o3_tpu.serving.engine import _const_nbytes, engine
+    nbytes = _const_nbytes(model)
+    rsv = None
+    try:
+        from h2o3_tpu.core import memgov
+        rsv = memgov.governor.admit_replica(model.key, nbytes)
+    except ValueError as e:      # MemoryBudgetExceeded — decline
+        log.warning("replica registration DECLINED for %s: %s",
+                    model.key, e)
+        return False
+    t0 = time.monotonic()
+    try:
+        engine.register(model)
+    except Exception:
+        try:
+            from h2o3_tpu.core import memgov
+            memgov.governor.release(rsv)
+        except Exception:    # noqa: BLE001
+            pass
+        raise
+    warm_s = time.monotonic() - t0
+    telemetry.histogram("replica_warm_seconds",
+                        buckets=_WARM_BUCKETS).observe(warm_s)
+    info = {"pid": _self_pid(), "algo": model.algo, "nbytes": nbytes,
+            "warm_s": warm_s, "ts": time.time()}
+    with _lock:
+        _local_replicas[model.key] = info
+        if rsv is not None:
+            _reservations[model.key] = rsv
+    try:
+        _kv().key_value_set(f"{KV_PREFIX}rep/{model.key}/{info['pid']}",
+                            json.dumps(info), allow_overwrite=True)
+    except Exception as e:   # noqa: BLE001 - registry write best-effort
+        log.debug("fleet replica announce failed: %s", e)
+    _refresh_gauges(model.key)
+    log.info("fleet replica registered: %s on pid %d (warm %.3fs)",
+             model.key, info["pid"], warm_s)
+    return True
+
+
+def replicate(model) -> bool:
+    """Publish the binary once + register a warm local replica — the
+    one-call surface a trained model uses to join the fleet."""
+    publish(model)
+    return register_local(model)
+
+
+def deregister_local(model_key: Optional[str] = None,
+                     reason: str = "") -> None:
+    """Remove local replica(s) from the registry (all when
+    ``model_key`` is None) and release their governor reservations.
+    Routing stops offering this peer immediately."""
+    pid = _self_pid()
+    with _lock:
+        keys = ([model_key] if model_key is not None
+                else list(_local_replicas))
+        for k in keys:
+            _local_replicas.pop(k, None)
+    for k in keys:
+        rsv = _reservations.pop(k, None)
+        if rsv is not None:
+            try:
+                from h2o3_tpu.core import memgov
+                memgov.governor.release(rsv)
+            except Exception:    # noqa: BLE001
+                pass
+        try:
+            _kv().key_value_delete(f"{KV_PREFIX}rep/{k}/{pid}")
+        except Exception:        # noqa: BLE001
+            pass
+        _refresh_gauges(k)
+    if keys:
+        log.info("fleet deregistered %d replica(s) on pid %d%s",
+                 len(keys), pid, f" ({reason})" if reason else "")
+
+
+def on_scorers_evicted(model_keys: List[str]) -> None:
+    """Engine eviction hook: an evicted scorer is no longer warm —
+    deregister so routing stops here and ``maybe_adopt`` re-warms the
+    replica on the least-loaded healthy peer."""
+    with _lock:
+        mine = [k for k in model_keys if k in _local_replicas]
+    for k in mine:
+        deregister_local(k, reason="scorer evicted")
+
+
+def replicas(model_key: str) -> Dict[int, Dict]:
+    """pid -> replica info for every registered replica of a model."""
+    out: Dict[int, Dict] = {}
+    try:
+        for key, val in _kv().key_value_dir_get(
+                f"{KV_PREFIX}rep/{model_key}/"):
+            try:
+                out[int(key.rsplit("/", 1)[-1])] = json.loads(val)
+            except (ValueError, TypeError):
+                continue
+    except Exception:        # noqa: BLE001
+        pass
+    return out
+
+
+def registered_models() -> List[str]:
+    """Model keys with at least one registered replica (any peer)."""
+    seen = set()
+    try:
+        for key, _val in _kv().key_value_dir_get(f"{KV_PREFIX}rep/"):
+            # key = <prefix>rep/<model_key>/<pid>
+            tail = key[len(f"{KV_PREFIX}rep/"):]
+            mk = tail.rsplit("/", 1)[0]
+            if mk:
+                seen.add(mk)
+    except Exception:        # noqa: BLE001
+        pass
+    return sorted(seen)
+
+
+def published_models() -> List[str]:
+    out = []
+    try:
+        for key, _val in _kv().key_value_dir_get(f"{KV_PREFIX}bin/"):
+            if key.endswith("/meta"):
+                out.append(key[len(f"{KV_PREFIX}bin/"):-len("/meta")])
+    except Exception:        # noqa: BLE001
+        pass
+    return sorted(out)
+
+
+# ---------------------------------------------------- health + load
+
+
+def _dead_set() -> set:
+    """Heartbeat's verdict: pids whose beat staleness exceeded the
+    miss budget — excluded from routing BEFORE their requests fail."""
+    try:
+        from h2o3_tpu.core import heartbeat
+        return set(heartbeat.dead_peers())
+    except Exception:        # noqa: BLE001
+        return set()
+
+
+def local_load() -> float:
+    """This process's live load: inflight jobs + predict queue depth +
+    inflight REST handlers (the same composition peers publish)."""
+    load = 0.0
+    try:
+        from h2o3_tpu.telemetry import REGISTRY
+        load += float(REGISTRY.value("jobs_inflight"))
+        load += float(REGISTRY.value("rest_inflight_requests"))
+    except Exception:        # noqa: BLE001
+        pass
+    try:
+        import sys
+        eng = sys.modules.get("h2o3_tpu.serving.engine")
+        if eng is not None:
+            load += float(eng.engine.queue_depth())
+    except Exception:        # noqa: BLE001
+        pass
+    return load
+
+
+def peer_loads() -> Dict[int, float]:
+    """pid -> load from the PR 8 telemetry fan-in ``serving`` block,
+    TTL-cached (``H2O3TPU_FLEET_LOAD_TTL_S``); stale peers excluded.
+    The local pid's entry is always live."""
+    ttl = fleet_knobs()["load_ttl_s"]
+    now = time.monotonic()
+    with _lock:
+        if now - _loads_cache["ts"] < ttl:
+            loads = dict(_loads_cache["loads"])
+            loads[_self_pid()] = local_load()
+            return loads
+    loads: Dict[int, float] = {}
+    try:
+        from h2o3_tpu.telemetry import cluster
+        col = cluster.collect()
+        stale = set(col["stale_nodes"])
+        for n, snap in col["nodes"].items():
+            if int(n) in stale:
+                continue
+            srv = snap.get("serving") or {}
+            loads[int(n)] = (float(snap.get("jobs_inflight", 0) or 0)
+                             + float(srv.get("queue_depth", 0) or 0)
+                             + float(srv.get("rest_inflight", 0) or 0))
+    except Exception:        # noqa: BLE001 - fan-in down: loads unknown
+        loads = {}
+    with _lock:
+        _loads_cache["ts"] = now
+        _loads_cache["loads"] = dict(loads)
+    loads[_self_pid()] = local_load()
+    return loads
+
+
+# ---------------------------------------------------------- router
+
+
+class ReplicaRouter:
+    """The pure routing/failover state machine — providers injected so
+    the bench ``_stub_fleet`` leg and unit tests drive it jax-free.
+
+    ``replicas_fn(model_key) -> {pid: info}``;
+    ``endpoints_fn() -> {pid: (host, port)}``;
+    ``dead_fn() -> set of pids``; ``loads_fn() -> {pid: load}``;
+    ``draining_fn() -> bool`` (is the LOCAL peer draining)."""
+
+    def __init__(self, self_pid: int,
+                 replicas_fn: Callable[[str], Dict[int, Dict]],
+                 endpoints_fn: Callable[[], Dict[int, Tuple[str, int]]],
+                 dead_fn: Callable[[], set],
+                 loads_fn: Callable[[], Dict[int, float]],
+                 draining_fn: Callable[[], bool] = lambda: False,
+                 published_fn: Callable[[str], bool] = lambda _mk: False,
+                 local_bias: Optional[float] = None):
+        self.self_pid = self_pid
+        self.replicas_fn = replicas_fn
+        self.endpoints_fn = endpoints_fn
+        self.dead_fn = dead_fn
+        self.loads_fn = loads_fn
+        self.draining_fn = draining_fn
+        self.published_fn = published_fn
+        self.local_bias = local_bias
+
+    def _bias(self) -> float:
+        return (self.local_bias if self.local_bias is not None
+                else fleet_knobs()["local_bias"])
+
+    def healthy_remote(self, model_key: str,
+                       exclude: Optional[set] = None
+                       ) -> Dict[int, Tuple[str, int]]:
+        """Remote replicas that are routable NOW: registered, not
+        heartbeat-dead, with a published REST edge."""
+        dead = self.dead_fn()
+        eps = self.endpoints_fn()
+        out = {}
+        for pid in self.replicas_fn(model_key):
+            if pid == self.self_pid or pid in dead:
+                continue
+            if exclude and pid in exclude:
+                continue
+            ep = eps.get(pid)
+            if ep is not None:
+                out[pid] = ep
+        return out
+
+    def pick(self, model_key: str, exclude: Optional[set] = None
+             ) -> Optional[Tuple[int, Tuple[str, int]]]:
+        """The least-loaded healthy remote replica, or None."""
+        cands = self.healthy_remote(model_key, exclude)
+        if not cands:
+            return None
+        loads = self.loads_fn()
+        pid = min(cands, key=lambda p: (loads.get(p, float("inf")), p))
+        return pid, cands[pid]
+
+    def plan(self, model_key: str, have_local: bool,
+             hop: bool = False, redirect: Optional[bool] = None
+             ) -> RoutePlan:
+        """Resolve one predict. ``have_local``: the model object is in
+        this process's DKV; ``hop``: the request already took one fleet
+        hop (NEVER re-routed — loop prevention)."""
+        local_ok = ((have_local or
+                     self.self_pid in self.replicas_fn(model_key))
+                    and not self.draining_fn())
+        if hop:
+            return RoutePlan("local" if local_ok else "install")
+        best = self.pick(model_key)
+        if local_ok:
+            if best is not None:
+                loads = self.loads_fn()
+                remote_load = loads.get(best[0], float("inf"))
+                if remote_load + self._bias() < loads.get(
+                        self.self_pid, 0.0):
+                    return self._remote_plan(model_key, best, redirect)
+            return RoutePlan("local")
+        if best is not None:
+            return self._remote_plan(model_key, best, redirect)
+        if have_local:
+            # a draining local peer with no healthy remote still serves
+            # (or 503s through the batcher's draining contract) rather
+            # than 404ing a model it demonstrably holds
+            return RoutePlan("local")
+        if self.published_fn(model_key):
+            return RoutePlan("install")
+        return RoutePlan("none")
+
+    def _remote_plan(self, model_key: str,
+                     best: Tuple[int, Tuple[str, int]],
+                     redirect: Optional[bool]) -> RoutePlan:
+        pid, (host, port) = best
+        if redirect is None:
+            redirect = bool(fleet_knobs()["redirect"])
+        url = (f"http://{host}:{port}/3/Predictions/models/"
+               f"{urllib.parse.quote(model_key, safe='')}?_fleet_hop=1")
+        return RoutePlan("redirect" if redirect else "proxy",
+                         pid=pid, url=url)
+
+    def hedged(self, model_key: str,
+               attempt_fn: Callable[[int, Tuple[str, int]], Any],
+               first: Optional[Tuple[int, Tuple[str, int]]] = None,
+               deadline: Optional[float] = None,
+               max_hops: Optional[int] = None,
+               local_fallback: bool = False):
+        """Run ``attempt_fn(pid, endpoint)`` against the best replica,
+        hedging each infrastructure failure to the NEXT healthy replica
+        within the deadline budget. Returns the first success, the
+        :data:`SERVE_LOCALLY` sentinel when ``local_fallback`` and every
+        remote failed, or raises :class:`FleetUnavailable`."""
+        from h2o3_tpu import telemetry
+        hops = max_hops if max_hops is not None \
+            else int(fleet_knobs()["max_hops"])
+        tried: set = set()
+        target = first if first is not None else self.pick(model_key)
+        last_err: Optional[BaseException] = None
+        while target is not None and len(tried) < hops:
+            pid, ep = target
+            if deadline is not None and time.monotonic() >= deadline:
+                raise request_ctx.DeadlineExceeded(
+                    f"predict for {model_key} ran out of deadline "
+                    f"budget after {len(tried)} fleet hop(s)")
+            try:
+                return attempt_fn(pid, ep)
+            except (request_ctx.DeadlineExceeded, _Passthrough):
+                raise
+            except Exception as e:   # noqa: BLE001 - hedge the hop
+                reason = _failure_reason(e)
+                telemetry.counter("predict_failovers_total",
+                                  reason=reason).inc()
+                log.warning("fleet hop to pid %d failed (%s): %s — "
+                            "hedging", pid, reason, e)
+                last_err = e
+                tried.add(pid)
+                target = self.pick(model_key, exclude=tried)
+        if local_fallback:
+            return SERVE_LOCALLY
+        raise FleetUnavailable(
+            f"no healthy replica for {model_key}: "
+            f"{len(tried)} hop(s) failed"
+            + (f" (last: {last_err})" if last_err else ""),
+            retry_after_s=fleet_knobs()["retry_after_s"])
+
+
+# sentinel: every remote hop failed but the caller can score locally
+SERVE_LOCALLY = object()
+
+
+class _Passthrough(Exception):
+    """Wraps a client-caused remote error (4xx) so the hedging loop
+    re-raises the ORIGINAL instead of hedging a request that would fail
+    identically everywhere."""
+
+    def __init__(self, original: BaseException):
+        super().__init__(str(original))
+        self.original = original
+
+
+def _failure_reason(e: BaseException) -> str:
+    if isinstance(e, (socket.timeout, TimeoutError)):
+        return "timeout"
+    if isinstance(e, urllib.error.HTTPError):
+        return "http_5xx" if e.code >= 500 else "not_found"
+    if isinstance(e, urllib.error.URLError):
+        if isinstance(getattr(e, "reason", None),
+                      (socket.timeout, TimeoutError)):
+            return "timeout"
+        return "connection"
+    if isinstance(e, (ConnectionError, OSError)):
+        return "connection"
+    return "error"
+
+
+def router() -> ReplicaRouter:
+    """The live router over the KV registry + heartbeat + telemetry
+    fan-in providers."""
+    return ReplicaRouter(
+        self_pid=_self_pid(),
+        replicas_fn=replicas,
+        endpoints_fn=endpoints,
+        dead_fn=_dead_set,
+        loads_fn=peer_loads,
+        draining_fn=lambda: _draining,
+        published_fn=lambda mk: published(mk) is not None)
+
+
+def redirect_url(plan: RoutePlan, path: str) -> str:
+    """Location for a 307 at ``plan``'s replica (hop-marked so the
+    peer never re-routes — loop prevention)."""
+    eps = endpoints()
+    if plan.pid not in eps:
+        raise FleetUnavailable(
+            f"replica pid {plan.pid} lost its REST edge",
+            retry_after_s=fleet_knobs()["retry_after_s"])
+    host, port = eps[plan.pid]
+    return f"http://{host}:{port}{path}?_fleet_hop=1"
+
+
+def plan_route(model_key: str, have_local: bool,
+               hop: bool = False) -> RoutePlan:
+    """REST entry: resolve a predict against the fleet, counting the
+    decision in ``predict_routed_total{decision}``. Models with no
+    fleet registration resolve ``local``/``none`` with no KV reads
+    beyond the replica-dir lookup."""
+    from h2o3_tpu import telemetry
+    plan = router().plan(model_key, have_local, hop=hop)
+    telemetry.counter("predict_routed_total",
+                      decision=plan.decision).inc()
+    return plan
+
+
+def proxy_predict(plan: RoutePlan, path: str, payload: Dict,
+                  model_key: str, deadline: Optional[float] = None,
+                  local_fallback: bool = False):
+    """Forward a predict to ``plan``'s replica with bounded, hedged
+    failover. Returns the peer's decoded JSON response, or
+    :data:`SERVE_LOCALLY` when every remote hop failed and the caller
+    holds (or can install) the model."""
+    knobs = fleet_knobs()
+    if deadline is None:
+        deadline = request_ctx.current_deadline()
+
+    def _attempt(pid: int, ep: Tuple[str, int]):
+        watchdog.maybe_fail("replica_dispatch")
+        timeout = knobs["hop_timeout_s"]
+        if deadline is not None:
+            timeout = min(timeout,
+                          max(deadline - time.monotonic(), 0.05))
+        host, port = ep
+        url = (f"http://{host}:{port}{path}"
+               f"?_fleet_hop=1&_timeout_ms={int(timeout * 1000)}")
+        req = urllib.request.Request(
+            url, data=json.dumps(payload).encode(),
+            headers={"Content-Type": "application/json"},
+            method="POST")
+        try:
+            with urllib.request.urlopen(req, timeout=timeout) as r:
+                return json.loads(r.read())
+        except urllib.error.HTTPError as e:
+            body = e.read()
+            try:
+                msg = json.loads(body).get("msg", "")
+            except Exception:    # noqa: BLE001
+                msg = body.decode("utf-8", "replace")[:200]
+            if e.code == 408:
+                raise _Passthrough(request_ctx.DeadlineExceeded(
+                    f"replica pid {pid}: {msg}")) from None
+            if e.code in (400, 412):
+                # the request itself is bad — identical everywhere,
+                # never hedge it
+                raise _Passthrough(ValueError(msg)) from None
+            raise
+
+    from h2o3_tpu import telemetry
+    first = None
+    if plan.pid is not None:
+        eps = endpoints()
+        if plan.pid in eps:
+            first = (plan.pid, eps[plan.pid])
+    try:
+        return router().hedged(model_key, _attempt, first=first,
+                               deadline=deadline,
+                               local_fallback=local_fallback)
+    except _Passthrough as p:
+        raise p.original
+    finally:
+        telemetry.gauge("fleet_replicas_healthy", model=model_key).set(
+            len(router().healthy_remote(model_key))
+            + (1 if model_key in _local_replicas else 0))
+
+
+# --------------------------------------------------------- adoption
+
+
+def maybe_adopt(now: Optional[float] = None) -> bool:
+    """Heartbeat-piggybacked re-warm: when a registered model has NO
+    healthy replica left (eviction, peer death), the least-loaded
+    healthy peer pulls the published binary and re-warms it. Rate
+    limited (``H2O3TPU_FLEET_ADOPT_S``); the install runs on a
+    background thread so the heartbeat round stays bounded."""
+    global _last_adopt, _adopt_thread
+    if os.environ.get("H2O3TPU_FLEET_ADOPT", "1").lower() in ("0", "off"):
+        return False
+    now = time.monotonic() if now is None else now
+    with _lock:
+        if _draining or now - _last_adopt < fleet_knobs()["adopt_s"]:
+            return False
+        if _adopt_thread is not None and _adopt_thread.is_alive():
+            return False
+        _last_adopt = now
+    orphans = _orphaned_models()
+    if not orphans:
+        return False
+
+    def _adopt():
+        for mk in orphans:
+            try:
+                log.info("fleet adopting orphaned replica %s", mk)
+                install_published(mk)
+            except Exception as e:   # noqa: BLE001 - next round retries
+                log.warning("fleet adopt of %s failed: %s", mk, e)
+
+    with _lock:
+        _adopt_thread = threading.Thread(
+            target=_adopt, name="fleet-adopt", daemon=True)
+        _adopt_thread.start()
+    return True
+
+
+def _orphaned_models() -> List[str]:
+    """Published models with zero healthy replicas, for which THIS peer
+    is the least-loaded healthy candidate. A freshly published binary
+    gets a grace window (``H2O3TPU_FLEET_ADOPT_GRACE_S``) before it
+    counts as orphaned — its publisher is normally still warming the
+    first replica, and adopting in that gap double-registers."""
+    dead = _dead_set()
+    self_pid = _self_pid()
+    loads = peer_loads()
+    grace = fleet_knobs()["adopt_grace_s"]
+    out = []
+    for mk in published_models():
+        reps = replicas(mk)
+        healthy = [p for p in reps if p not in dead]
+        if healthy:
+            continue
+        meta = published(mk)
+        if meta is None or time.time() - float(meta.get("ts", 0)) < grace:
+            continue
+        # candidates: peers with a live REST edge + self
+        cands = {p for p in endpoints() if p not in dead}
+        cands.add(self_pid)
+        best = min(cands,
+                   key=lambda p: (loads.get(p, float("inf")), p))
+        if best == self_pid:
+            out.append(mk)
+    return out
+
+
+# ------------------------------------------------ lifecycle + sweep
+
+
+def drain() -> None:
+    """Cloud-shutdown drain ordering (ISSUE 17): flip this peer out of
+    routing, deregister its replicas and REST edge, then drain the
+    scoring engine — in-flight dispatches finish, queued requests fail
+    503 immediately. Called by ``core/cloud.shutdown`` BEFORE the
+    heartbeat stops."""
+    global _draining
+    with _lock:
+        _draining = True
+    deregister_local(reason="draining")
+    clear_local_endpoint()
+    import sys
+    eng = sys.modules.get("h2o3_tpu.serving.engine")
+    if eng is not None:
+        eng.engine.reset()
+
+
+def sweep_local_keys(client=None, pid: Optional[int] = None) -> None:
+    """Delete THIS process's fleet keys (endpoint + replica entries)
+    from the coordination KV — the per-process half of the
+    ``core/cloud._sweep_coordination_keys`` contract. Binary blobs are
+    per-MODEL, not per-process: like the scheduler's run subtrees they
+    are garbage-collected at the next init-time sweep, never at
+    shutdown where a lagging peer may still be installing from them."""
+    client = client if client is not None else _kv()
+    pid = _self_pid() if pid is None else pid
+    try:
+        client.key_value_delete(f"{KV_PREFIX}ep/{pid}")
+    except Exception:        # noqa: BLE001
+        pass
+    try:
+        for key, _val in client.key_value_dir_get(f"{KV_PREFIX}rep/"):
+            if key.endswith(f"/{pid}"):
+                try:
+                    client.key_value_delete(key)
+                except Exception:    # noqa: BLE001
+                    pass
+    except Exception:        # noqa: BLE001
+        pass
+
+
+def sweep_keys() -> None:
+    """Delete the ENTIRE fleet subtree (init-time, after the roll-call
+    barrier proves no process is mid-install — the scheduler
+    ``sweep_keys`` precedent): a re-formed cloud must never route to a
+    previous incarnation's replicas or install its binaries."""
+    try:
+        _kv().key_value_delete(KV_PREFIX)
+    except Exception:        # noqa: BLE001
+        pass
+
+
+def reset() -> None:
+    """Test hook: forget all local fleet state + the in-process KV."""
+    global _draining, _last_adopt, _endpoint
+    deregister_local(reason="reset")
+    with _lock:
+        _local_replicas.clear()
+        _reservations.clear()
+        _draining = False
+        _last_adopt = 0.0
+        _endpoint = None
+        _loads_cache["ts"] = 0.0
+        _loads_cache["loads"] = {}
+    _local_kv._store.clear()
+
+
+def _refresh_gauges(model_key: str) -> None:
+    try:
+        from h2o3_tpu import telemetry
+        dead = _dead_set()
+        healthy = [p for p in replicas(model_key) if p not in dead]
+        telemetry.gauge("fleet_replicas_healthy",
+                        model=model_key).set(len(healthy))
+    except Exception:        # noqa: BLE001 - gauges are best-effort
+        pass
+
+
+def stats() -> Dict:
+    """Fleet block for the telemetry ``serving`` snapshot + tests."""
+    with _lock:
+        local = sorted(_local_replicas)
+        ep = _endpoint
+        draining = _draining
+    return {"local_replicas": local,
+            "endpoint": {"host": ep[0], "port": ep[1]} if ep else None,
+            "draining": draining,
+            "registered_models": registered_models()}

@@ -157,6 +157,7 @@ def local_snapshot() -> Dict:
         "jobs": _jobs_snapshot(),
         "sched": _sched_snapshot(),
         "alerts": _alerts_snapshot(),
+        "serving": _serving_snapshot(),
     }
 
 
@@ -183,6 +184,37 @@ def _sched_snapshot() -> Dict:
         return scheduler.snapshot()
     except Exception:   # noqa: BLE001 - snapshot is best-effort
         return {}
+
+
+def _serving_snapshot() -> Dict:
+    """This node's serving-tier load block — the fleet router's fan-in
+    input (serving/fleet.py peer_loads): REST edge, predict queue depth
+    and warm scorer set. Engine state is read via sys.modules so a node
+    that never served stays jax-lazy."""
+    import sys as _sys
+    out: Dict = {"rest_port": None, "queue_depth": 0,
+                 "rest_inflight": 0, "warm_models": []}
+    try:
+        out["rest_inflight"] = int(
+            REGISTRY.value("rest_inflight_requests"))
+    except Exception:   # noqa: BLE001 - gauge may not exist yet
+        pass
+    try:
+        fleet = _sys.modules.get("h2o3_tpu.serving.fleet")
+        if fleet is not None:
+            ep = fleet.stats().get("endpoint")
+            if ep:
+                out["rest_port"] = int(ep["port"])
+    except Exception:   # noqa: BLE001 - snapshot is best-effort
+        pass
+    try:
+        eng_mod = _sys.modules.get("h2o3_tpu.serving.engine")
+        if eng_mod is not None:
+            out["queue_depth"] = int(eng_mod.engine.queue_depth())
+            out["warm_models"] = list(eng_mod.engine.warm_models())
+    except Exception:   # noqa: BLE001 - snapshot is best-effort
+        pass
+    return out
 
 
 def _alerts_snapshot() -> Dict:

@@ -181,6 +181,30 @@ def _heartbeat_counts(reg: MetricsRegistry) -> Tuple[float, float]:
     return float(bad), float(max(total, bad))
 
 
+def _fleet_routing_counts(reg: MetricsRegistry) -> Tuple[float, float]:
+    """Each routed prediction is one trial; each failover hop is a bad
+    trial (serving/fleet.py). Failovers CAN outnumber routes when every
+    hop in a hedge chain fails, so clamp total like heartbeat does."""
+    bad = sum(c.value for c in reg.find("predict_failovers_total")
+              if isinstance(c, Counter))
+    total = sum(c.value for c in reg.find("predict_routed_total")
+                if isinstance(c, Counter))
+    return float(bad), float(max(total, bad))
+
+
+def _fleet_replicas_check(reg: MetricsRegistry) -> Tuple[bool, Dict]:
+    """Every model the fleet registry tracks keeps at least one healthy
+    replica; a model at zero is one heartbeat window from 503s."""
+    vals = {str(g.labels.get("model", "?")): g.value
+            for g in reg.find("fleet_replicas_healthy")}
+    if not vals:
+        return True, {"models": 0, "min_replicas": None}
+    worst = min(vals, key=vals.get)
+    return vals[worst] >= 1.0, {"models": len(vals),
+                                "min_replicas": vals[worst],
+                                "worst_model": worst}
+
+
 def _mfu_floor() -> float:
     try:
         return float(os.environ.get("H2O3TPU_SLO_MFU_FLOOR", "0"))
@@ -226,6 +250,16 @@ def default_rules() -> List[object]:
             "fit_mfu_floor", check_fn=_mfu_check,
             description="every model_fit_mfu{algo} gauge stays above "
                         "H2O3TPU_SLO_MFU_FLOOR (0 disables)"),
+        RatioRule(
+            "fleet_routing_availability", objective=0.99,
+            counts_fn=_fleet_routing_counts,
+            description="99% of fleet-routed predictions land without "
+                        "a failover hop (predict_failovers_total / "
+                        "predict_routed_total)"),
+        GaugeRule(
+            "fleet_replica_floor", check_fn=_fleet_replicas_check,
+            description="every fleet-registered model keeps at least "
+                        "one healthy replica (fleet_replicas_healthy)"),
     ]
 
 

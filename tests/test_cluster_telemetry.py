@@ -277,8 +277,10 @@ def test_shutdown_sweeps_own_coordination_keys(monkeypatch):
     fake = _FakeKV()
     monkeypatch.setattr(distributed.global_state, "client", fake)
     cloud_mod._sweep_coordination_keys()
+    # the serving fleet sweeps its per-process keys here too (ISSUE 17)
     assert set(fake.deleted) == {"h2o3tpu/hb/0", "h2o3tpu/boot/0",
-                                 "h2o3tpu/telemetry/0"}
+                                 "h2o3tpu/telemetry/0",
+                                 "h2o3tpu/fleet/ep/0"}
 
 
 # ------------------------------------------------------ node stamping

@@ -1,0 +1,554 @@
+"""Fleet serving resilience (ISSUE 17, serving/fleet.py).
+
+Three layers:
+
+- :class:`ReplicaRouter` unit tests — the pure routing/failover state
+  machine on injected providers (jax-free, the bench ``_stub_fleet``
+  contract): decision table, heartbeat exclusion, local bias, bounded
+  hedging, explicit degradation.
+- Single-process registry + REST tests — publish/install round trip,
+  governor declines, eviction deregistration, drain semantics, and the
+  degraded REST answers (503 + Retry-After, 307 redirect, draining).
+- ``multiprocess`` acceptance — a REAL 2-process jax.distributed CPU
+  cloud (tests/fleet_worker.py): cross-node routed predictions are
+  bit-identical to ``Model.predict``; SIGKILLing the only replica is
+  excluded within one heartbeat window, the error burst is bounded by
+  hedged failover onto a local install, and the survivor drains clean.
+"""
+
+import json
+import os
+import signal
+import socket
+import subprocess
+import sys
+import threading
+import time
+import urllib.error
+import urllib.parse
+import urllib.request
+
+import numpy as np
+import pytest
+
+import h2o3_tpu
+from h2o3_tpu.core import request_ctx, watchdog
+from h2o3_tpu.core.kv import DKV
+from h2o3_tpu.serving import fleet
+from h2o3_tpu.serving.fleet import (FleetUnavailable, ReplicaRouter,
+                                    RoutePlan, SERVE_LOCALLY)
+from h2o3_tpu.telemetry import REGISTRY
+
+# the fleet registry and scoring engine are process-global by design;
+# REST handler threads create keys the thread-local Scope cannot track
+pytestmark = pytest.mark.allow_key_leak
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+WORKER = os.path.join(REPO, "tests", "fleet_worker.py")
+WORKER_TIMEOUT_S = 300.0
+
+
+# ------------------------------------------------------ router units
+
+
+def _router(self_pid=0, reps=None, eps=None, dead=(), loads=None,
+            draining=False, published=(), bias=2.0):
+    reps = reps if reps is not None else {}
+    eps = eps if eps is not None else {}
+    loads = loads if loads is not None else {}
+    return ReplicaRouter(
+        self_pid=self_pid,
+        replicas_fn=lambda mk: dict(reps.get(mk, {})),
+        endpoints_fn=lambda: dict(eps),
+        dead_fn=lambda: set(dead),
+        loads_fn=lambda: dict(loads),
+        draining_fn=lambda: draining,
+        published_fn=lambda mk: mk in published,
+        local_bias=bias)
+
+
+def test_plan_local_when_replica_is_local():
+    r = _router(reps={"m": {0: {}}}, eps={0: ("h", 1)})
+    assert r.plan("m", have_local=True).decision == "local"
+    # a bare DKV copy (never registered) also serves locally
+    assert _router().plan("m", have_local=True).decision == "local"
+
+
+def test_plan_proxies_to_least_loaded_remote():
+    r = _router(reps={"m": {1: {}, 2: {}}},
+                eps={1: ("h", 1), 2: ("h", 2)},
+                loads={1: 5.0, 2: 1.0})
+    p = r.plan("m", have_local=False)
+    assert p.decision == "proxy" and p.pid == 2
+    assert "_fleet_hop=1" in p.url
+
+
+def test_plan_excludes_heartbeat_dead_peers():
+    r = _router(reps={"m": {1: {}, 2: {}}},
+                eps={1: ("h", 1), 2: ("h", 2)},
+                loads={1: 0.0, 2: 9.0}, dead={1})
+    assert r.plan("m", have_local=False).pid == 2
+    # every replica dead, nothing local or published -> none (404)
+    r = _router(reps={"m": {1: {}}}, eps={1: ("h", 1)}, dead={1})
+    assert r.plan("m", have_local=False).decision == "none"
+
+
+def test_plan_hop_never_reroutes():
+    """Loop prevention: an already-routed request either serves here or
+    installs here — it NEVER bounces to a third node."""
+    r = _router(reps={"m": {1: {}}}, eps={1: ("h", 1)})
+    assert r.plan("m", have_local=True, hop=True).decision == "local"
+    assert r.plan("m", have_local=False, hop=True).decision == "install"
+
+
+def test_plan_local_bias_keeps_marginal_wins_local():
+    reps = {"m": {0: {}, 1: {}}}
+    eps = {1: ("h", 1)}
+    # remote barely less loaded: the bias keeps the request local
+    r = _router(reps=reps, eps=eps, loads={0: 3.0, 1: 2.0}, bias=2.0)
+    assert r.plan("m", have_local=True).decision == "local"
+    # remote idle, local swamped: route away
+    r = _router(reps=reps, eps=eps, loads={0: 9.0, 1: 0.0}, bias=2.0)
+    p = r.plan("m", have_local=True)
+    assert p.decision == "proxy" and p.pid == 1
+
+
+def test_plan_install_when_only_published():
+    r = _router(published={"m"})
+    assert r.plan("m", have_local=False).decision == "install"
+    assert _router().plan("m", have_local=False).decision == "none"
+
+
+def test_plan_draining_routes_away_but_still_serves_sole_copy():
+    reps = {"m": {0: {}, 1: {}}}
+    r = _router(reps=reps, eps={1: ("h", 1)}, draining=True)
+    assert r.plan("m", have_local=True).decision == "proxy"
+    # draining with NO healthy remote: a held model still answers
+    # (the batcher's draining contract turns queued work into 503s)
+    r = _router(reps={"m": {0: {}}}, draining=True)
+    assert r.plan("m", have_local=True).decision == "local"
+
+
+def test_plan_redirect_carries_hop_marked_url():
+    r = _router(reps={"m": {1: {}}}, eps={1: ("hh", 8080)})
+    p = r.plan("m", have_local=False, redirect=True)
+    assert p.decision == "redirect"
+    assert p.url.startswith("http://hh:8080/3/Predictions/models/")
+    assert "_fleet_hop=1" in p.url
+
+
+def test_hedged_fails_over_to_next_replica():
+    r = _router(reps={"m": {1: {}, 2: {}}},
+                eps={1: ("h", 1), 2: ("h", 2)},
+                loads={1: 0.0, 2: 1.0})
+    before = REGISTRY.value("predict_failovers_total",
+                            reason="connection")
+    calls = []
+
+    def attempt(pid, ep):
+        calls.append(pid)
+        if pid == 1:
+            raise ConnectionRefusedError("replica died")
+        return {"ok": pid}
+
+    assert r.hedged("m", attempt) == {"ok": 2}
+    assert calls == [1, 2]
+    assert REGISTRY.value("predict_failovers_total",
+                          reason="connection") == before + 1
+
+
+def test_hedged_exhaustion_raises_retryable_unavailable():
+    r = _router(reps={"m": {1: {}}}, eps={1: ("h", 1)})
+
+    def attempt(pid, ep):
+        raise ConnectionRefusedError("down")
+
+    with pytest.raises(FleetUnavailable) as ei:
+        r.hedged("m", attempt)
+    assert ei.value.retry_after_s > 0
+
+
+def test_hedged_local_fallback_sentinel():
+    r = _router(reps={"m": {1: {}}}, eps={1: ("h", 1)})
+    out = r.hedged("m", lambda pid, ep: 1 / 0, local_fallback=True)
+    assert out is SERVE_LOCALLY
+    # no candidates at all + fallback: straight to the sentinel
+    assert _router().hedged("m", lambda pid, ep: 1 / 0,
+                            local_fallback=True) is SERVE_LOCALLY
+
+
+def test_hedged_never_hedges_client_errors():
+    """A 4xx-shaped failure (bad rows) would fail identically on every
+    replica — it must surface once, not burn the hop budget."""
+    r = _router(reps={"m": {1: {}, 2: {}}},
+                eps={1: ("h", 1), 2: ("h", 2)})
+    calls = []
+
+    def attempt(pid, ep):
+        calls.append(pid)
+        raise fleet._Passthrough(ValueError("bad rows"))
+
+    with pytest.raises(fleet._Passthrough):
+        r.hedged("m", attempt)
+    assert len(calls) == 1
+
+
+def test_hedged_respects_deadline_budget():
+    r = _router(reps={"m": {1: {}}}, eps={1: ("h", 1)})
+    with pytest.raises(request_ctx.DeadlineExceeded):
+        r.hedged("m", lambda pid, ep: {"ok": 1},
+                 deadline=time.monotonic() - 0.1)
+
+
+def test_hedged_bounded_by_max_hops():
+    reps = {"m": {p: {} for p in range(1, 9)}}
+    eps = {p: ("h", p) for p in range(1, 9)}
+    r = _router(reps=reps, eps=eps,
+                loads={p: float(p) for p in range(1, 9)})
+    calls = []
+
+    def attempt(pid, ep):
+        calls.append(pid)
+        raise ConnectionRefusedError("down")
+
+    with pytest.raises(FleetUnavailable):
+        r.hedged("m", attempt, max_hops=3)
+    assert len(calls) == 3
+
+
+# ------------------------------------------- registry (single process)
+
+
+def _train_gbm():
+    r = np.random.RandomState(21)
+    n = 300
+    fr = h2o3_tpu.Frame.from_numpy({
+        "a": r.randn(n), "b": r.randn(n),
+        "y": r.randn(n)})
+    from h2o3_tpu.models.gbm import GBMEstimator
+    return GBMEstimator(ntrees=3, max_depth=3, seed=2).train(fr, y="y"), fr
+
+
+@pytest.fixture(scope="module")
+def gbm():
+    m, fr = _train_gbm()
+    yield m, fr
+    fleet.reset()
+
+
+@pytest.fixture(autouse=True)
+def _fleet_clean():
+    fleet.reset()
+    yield
+    fleet.reset()
+
+
+def test_replicate_publish_install_roundtrip(gbm):
+    """The tentpole data plane: publish once (idempotent), install on a
+    'peer' (same process, DKV copy dropped), predictions unchanged."""
+    m, fr = gbm
+    base = m.predict(fr).col("predict").to_numpy()
+    assert fleet.replicate(m) is True
+    assert fleet.publish(m) is False                 # idempotent
+    meta = fleet.published(m.key)
+    assert meta and meta["parts"] >= 1 and meta["algo"] == m.algo
+    assert m.key in fleet.registered_models()
+    assert str(m.key) in fleet.stats()["local_replicas"]
+
+    DKV.remove(m.key)
+    m2 = fleet.install_published(m.key)
+    assert DKV.get(m.key) is m2
+    out = m2.predict(fr).col("predict").to_numpy()
+    assert np.array_equal(base, out)
+
+    with pytest.raises(KeyError):
+        fleet.install_published("no-such-model")
+
+
+def test_register_declines_over_hbm_reservation(gbm, monkeypatch):
+    """Governor-aware registration: a peer over its HBM budget DECLINES
+    (returns False, registry untouched) instead of warming into an OOM."""
+    m, _fr = gbm
+    from h2o3_tpu.core import memgov
+
+    def _no_room(model_key, nbytes):
+        raise memgov.MemoryBudgetExceeded(
+            f"no room for {model_key} ({nbytes}B)")
+
+    monkeypatch.setattr(memgov.governor, "admit_replica", _no_room)
+    assert fleet.register_local(m) is False
+    assert str(m.key) not in fleet.stats()["local_replicas"]
+    assert m.key not in fleet.registered_models()
+
+
+def test_scorer_eviction_deregisters_replica(gbm):
+    """Engine eviction is a registry event: the evicted scorer's
+    replica leaves the routing table (maybe_adopt re-warms elsewhere)."""
+    m, _fr = gbm
+    from h2o3_tpu.serving.engine import engine
+    assert fleet.replicate(m) is True
+    assert engine.evict() >= 1
+    assert str(m.key) not in fleet.stats()["local_replicas"]
+    assert m.key not in fleet.registered_models()
+
+
+def test_drain_deregisters_and_blocks_new_registrations(gbm):
+    m, _fr = gbm
+    assert fleet.replicate(m) is True
+    fleet.drain()
+    st = fleet.stats()
+    assert st["draining"] is True
+    assert st["local_replicas"] == []
+    assert st["endpoint"] is None
+    # a draining peer never takes NEW replicas
+    assert fleet.register_local(m) is False
+    from h2o3_tpu.serving.engine import engine
+    assert engine.warm_models() == []
+
+
+def test_register_fault_site_is_injectable(gbm):
+    m, _fr = gbm
+    watchdog.inject_fault("replica_register", times=1)
+    try:
+        with pytest.raises(Exception) as ei:
+            fleet.register_local(m)
+        assert watchdog.is_infra_error(ei.value)
+    finally:
+        watchdog.clear_faults()
+    assert fleet.register_local(m) is True
+
+
+def test_batcher_draining_rejects_with_its_own_class():
+    from h2o3_tpu.serving.batcher import (BatcherDraining, MicroBatcher,
+                                          PendingScore)
+    mb = MicroBatcher("fleet-drain-test", lambda b: None,
+                      max_rows=4, wait_ms=0.0, queue_depth=4)
+    mb.close()
+    with pytest.raises(BatcherDraining):
+        mb.submit(PendingScore({"x": np.zeros(1)}, 1))
+
+
+# --------------------------------------------------- degraded REST
+
+
+@pytest.fixture(scope="module")
+def port():
+    from h2o3_tpu.api.server import start_server, stop_server
+    p = start_server(port=0, background=True)
+    yield p
+    stop_server()
+
+
+def _dead_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    p = s.getsockname()[1]
+    s.close()
+    return p
+
+
+def _fake_remote_replica(mkey, pid=9):
+    """Registry rows for a 'peer' whose REST edge is a closed port."""
+    kv = fleet._local_kv
+    kv.key_value_set(f"{fleet.KV_PREFIX}rep/{mkey}/{pid}",
+                     json.dumps({"pid": pid, "algo": "gbm"}))
+    kv.key_value_set(f"{fleet.KV_PREFIX}ep/{pid}",
+                     json.dumps({"host": "127.0.0.1",
+                                 "port": _dead_port()}))
+
+
+def _post_rows(port, mkey, opener=None):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/3/Predictions/models/"
+        f"{urllib.parse.quote(str(mkey), safe='')}",
+        data=json.dumps({"rows": [{"a": 1.0, "b": 2.0}]}).encode(),
+        headers={"Content-Type": "application/json"}, method="POST")
+    open_fn = opener.open if opener else urllib.request.urlopen
+    try:
+        with open_fn(req, timeout=30) as r:
+            return r.status, dict(r.headers), json.loads(r.read())
+    except urllib.error.HTTPError as e:
+        return e.code, dict(e.headers), json.loads(e.read())
+
+
+def test_unknown_model_is_404_not_a_hang(port):
+    code, _hdrs, body = _post_rows(port, "fleet-no-such-model")
+    assert code == 404
+    assert "fleet-no-such-model" in body["msg"]
+
+
+def test_all_replicas_unreachable_503_with_retry_after(port, monkeypatch):
+    """Acceptance: every replica down → 503 + Retry-After in H2OErrorV3
+    shape, never a hang. The only replica's edge refuses connections and
+    this node holds neither a copy nor the published binary."""
+    monkeypatch.setenv("H2O3TPU_FLEET_RETRY_AFTER_S", "2")
+    _fake_remote_replica("fleet-unreachable-m")
+    t0 = time.monotonic()
+    code, hdrs, body = _post_rows(port, "fleet-unreachable-m")
+    assert code == 503
+    assert hdrs.get("Retry-After") == "2"
+    assert body["http_status"] == 503          # H2OErrorV3 shape
+    assert "no healthy replica" in body["msg"]
+    assert time.monotonic() - t0 < 20.0        # bounded, not a hang
+    assert REGISTRY.value("rest_rejected_total",
+                          reason="fleet_unavailable") >= 1
+    assert REGISTRY.value("predict_failovers_total",
+                          reason="connection") >= 1
+
+
+def test_redirect_mode_returns_307_with_location(port, monkeypatch):
+    """H2O3TPU_FLEET_REDIRECT=1 turns proxying into a 307 whose
+    Location is the replica's hop-marked predict URL."""
+    monkeypatch.setenv("H2O3TPU_FLEET_REDIRECT", "1")
+    _fake_remote_replica("fleet-redirect-m")
+
+    class _NoRedirect(urllib.request.HTTPRedirectHandler):
+        def redirect_request(self, *a, **k):
+            return None
+
+    code, hdrs, body = _post_rows(
+        port, "fleet-redirect-m",
+        opener=urllib.request.build_opener(_NoRedirect))
+    assert code == 307
+    loc = hdrs.get("Location")
+    assert loc and "/3/Predictions/models/fleet-redirect-m" in loc
+    assert "_fleet_hop=1" in loc
+    assert body["location"] == loc
+
+
+def test_rest_serves_via_install_when_only_published(port, gbm):
+    """A node holding neither the model nor a healthy remote replica
+    installs the published binary and answers — node symmetry."""
+    m, fr = gbm
+    base = m.predict(fr).col("predict").to_numpy()
+    fleet.publish(m)
+    DKV.remove(m.key)
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/3/Predictions/models/"
+        f"{urllib.parse.quote(str(m.key), safe='')}",
+        data=json.dumps({"rows": [{"a": float(fr.col('a').to_numpy()[0]),
+                                   "b": float(fr.col('b').to_numpy()[0])}
+                                  ]}).encode(),
+        headers={"Content-Type": "application/json"}, method="POST")
+    with urllib.request.urlopen(req, timeout=60) as r:
+        out = json.loads(r.read())
+    assert out["rows_scored"] == 1
+    assert out["predictions"]["predict"][0] == float(base[0])
+    assert str(m.key) in fleet.stats()["local_replicas"]
+
+
+# ------------------------------------------------- real 2-process cloud
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _launch(mode, nproc, out):
+    """Run one worker pod; returns (returncodes, logs)."""
+    coord = f"127.0.0.1:{_free_port()}"
+    env = dict(os.environ)
+    env.pop("JAX_PLATFORMS", None)
+    procs = [
+        subprocess.Popen(
+            [sys.executable, WORKER, coord, str(nproc), str(i), out, mode],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            text=True)
+        for i in range(nproc)
+    ]
+    logs = []
+    deadline = time.time() + WORKER_TIMEOUT_S
+    for p in procs:
+        try:
+            stdout, _ = p.communicate(
+                timeout=max(deadline - time.time(), 1.0))
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                if q.poll() is None:
+                    q.kill()
+            stdout, _ = p.communicate()
+            stdout = (stdout or "") + \
+                f"\n[TIMEOUT after {WORKER_TIMEOUT_S:.0f}s]"
+        logs.append(stdout)
+    return [p.returncode for p in procs], logs
+
+
+def _read(out, pid):
+    with open(f"{out}.{pid}") as f:
+        return json.load(f)
+
+
+@pytest.fixture(scope="module")
+def fleet_results(tmp_path_factory):
+    tmp = tmp_path_factory.mktemp("fleet")
+    legs = {}
+    for mode in ("serve", "kill"):
+        out = str(tmp / f"{mode}.json")
+        rcs, logs = _launch(mode, 2, out)
+        legs[mode] = {"rcs": rcs, "logs": logs, "out": out}
+    return legs
+
+
+def _logs(leg):
+    return "\n".join(f"--- worker {i} log ---\n{lg[-3000:]}"
+                     for i, lg in enumerate(leg["logs"]))
+
+
+@pytest.mark.slow
+@pytest.mark.multiprocess
+def test_fleet_cross_node_predicts_bit_identical(fleet_results):
+    """Node symmetry: the node WITHOUT the model answers predicts via
+    the fleet (proxied to the replica), bit-identical to Model.predict,
+    under concurrent load, with zero client-visible errors."""
+    leg = fleet_results["serve"]
+    assert all(rc == 0 for rc in leg["rcs"]), _logs(leg)
+    r1 = _read(leg["out"], 1)
+    assert r1["errors"] == []
+    assert r1["n_ok"] == 32
+    assert r1["all_identical"], (r1["preds"], r1["ref"])
+    assert r1["routed"]["proxy"] >= 32
+    r0 = _read(leg["out"], 0)
+    assert r0["replicas"] == [0]
+    assert str(r0["stats"]["local_replicas"])  # replica stayed warm
+
+
+@pytest.mark.slow
+@pytest.mark.multiprocess
+def test_fleet_sigkill_failover_and_drain(fleet_results):
+    """Acceptance: SIGKILL the only replica mid-load. The dead peer is
+    excluded within one heartbeat staleness window (+ scheduling slack),
+    hedged failover onto a local install bounds the error burst, every
+    successful answer stays bit-identical, and the survivor drains."""
+    leg = fleet_results["kill"]
+    assert leg["rcs"][0] == 0, _logs(leg)
+    assert leg["rcs"][1] == -signal.SIGKILL
+    r0 = _read(leg["out"], 0)
+
+    # steady state before the kill: all proxied, all correct
+    assert r0["phase_a"]["errors"] == [], r0["phase_a"]
+    assert r0["phase_a"]["identical"]
+
+    # the burst: bounded errors, correct answers, hedging visible
+    pb = r0["phase_b"]
+    assert pb["n_ok"] + len(pb["errors"]) == 40
+    assert len(pb["errors"]) <= 8, pb["errors"]
+    assert pb["identical"]
+    assert sum(r0["failovers"].values()) >= 1, r0["failovers"]
+    assert r0["local_replica_after"] is True
+
+    # exclusion within one heartbeat window (staleness = interval*3),
+    # plus generous CI scheduling slack
+    assert r0["detect_s"] < r0["hb_window_s"] + 4.0, r0["detect_s"]
+
+    # post-exclusion: clean, local, correct
+    assert r0["phase_c"]["errors"] == [], r0["phase_c"]
+    assert r0["phase_c"]["identical"]
+
+    # survivor drained: registry empty + marked, engine cold
+    assert r0["stats_after_drain"]["draining"] is True
+    assert r0["stats_after_drain"]["local_replicas"] == []
+    assert r0["engine_warm_after_drain"] == []

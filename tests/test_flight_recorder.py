@@ -396,6 +396,11 @@ def test_metric_names_documented_in_readme():
                      "X-H2O-Trace-Id", "traceparent",
                      "/3/Alerts", "trace_id="):
         assert required in section, required
+    # the ISSUE 17 fleet serving-resilience surface (serving/fleet.py)
+    # is part of the stable contract too
+    for required in ("fleet_replicas_healthy", "predict_routed_total",
+                     "predict_failovers_total", "replica_warm_seconds"):
+        assert required in section, required
 
 
 # ----------------------------------------------------------- REST tier

@@ -356,12 +356,13 @@ def test_slo_gauge_rule_mfu_floor(monkeypatch):
 
 
 def test_slo_default_rules_evaluate_on_live_registry():
-    """The process-wide engine must evaluate the four default rules on
+    """The process-wide engine must evaluate the default rules on
     whatever the live registry holds — never raise, always report."""
     out = slo.evaluate()
     names = {r["slo"] for r in out["rules"]}
     assert names == {"predict_p99_latency", "rest_availability",
-                     "heartbeat_health", "fit_mfu_floor"}
+                     "heartbeat_health", "fit_mfu_floor",
+                     "fleet_routing_availability", "fleet_replica_floor"}
     assert out["windows_s"] == [300.0, 3600.0]
     for r in out["rules"]:
         assert r["state"] in slo.STATES
