@@ -1245,6 +1245,161 @@ def bench_fleet():
         kill_recovery_seconds=round(res["recovery_s"], 3))
 
 
+_DUR_WORKER_SRC = '''
+"""bench durability worker: one pod process (generated by bench.py)."""
+import json, os, signal, sys, time
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
+os.environ["H2O3TPU_HEARTBEAT_INTERVAL_S"] = "0.25"
+os.environ["H2O3TPU_DATA_DURABILITY"] = "mirror"
+os.environ["H2O3TPU_DUR_REBUILD_S"] = "0.05"
+sys.path.insert(0, os.environ["H2O3TPU_BENCH_REPO"])
+coord, nproc, pid, outfile = sys.argv[1:5]
+nproc, pid = int(nproc), int(pid)
+os.environ["H2O3TPU_DUR_DIR"] = outfile + ".mirror"
+import jax
+jax.config.update("jax_default_device", None)
+import h2o3_tpu
+h2o3_tpu.init(backend="cpu", coordinator_address=coord,
+              num_processes=nproc, process_id=pid)
+import numpy as np
+from h2o3_tpu.core import durability
+from h2o3_tpu.core.kv import DKV
+from h2o3_tpu.parallel import mesh as mesh_mod
+
+killflag = outfile + ".killflag"
+if pid == 1:
+    # victim: mirror one frame, announce it, wait for the kill order
+    with mesh_mod.local_mesh_scope():
+        r = np.random.RandomState(7)
+        n = 100_000
+        fr = h2o3_tpu.Frame.from_numpy(
+            {"a": r.randn(n), "b": r.randn(n), "y": r.randn(n)})
+    assert fr.key in durability.stats()["mirrored"]
+    with open(killflag + ".ready", "w") as f:
+        f.write(fr.key)
+    while not os.path.exists(killflag):
+        time.sleep(0.02)
+    os.kill(os.getpid(), signal.SIGKILL)
+
+# pid 0: wait for the victim's mirrored frame, order the kill, and
+# time kill -> frame re-homed locally (staleness detection included)
+deadline = time.monotonic() + 60
+while not os.path.exists(killflag + ".ready"):
+    if time.monotonic() > deadline:
+        raise RuntimeError("victim never mirrored its frame")
+    time.sleep(0.02)
+with open(killflag + ".ready") as f:
+    fkey = f.read().strip()
+nbytes = durability.registry(1)[fkey]["nbytes"]
+with open(killflag, "w") as f:
+    f.write("die")
+t0 = time.monotonic()
+rebuilt_s = None
+while time.monotonic() - t0 < 90:
+    durability.maybe_rebuild()
+    if fkey in DKV:
+        rebuilt_s = time.monotonic() - t0
+        break
+    time.sleep(0.02)
+from h2o3_tpu import telemetry
+with open(outfile + ".0", "w") as f:
+    json.dump({"kill_to_rebuild_s": rebuilt_s,
+               "rebuilds": telemetry.counter(
+                   "frame_rebuilds_total", source="mirror").value,
+               "mirror_nbytes": nbytes}, f)
+print("DUR-BENCH-0-DONE", flush=True)
+os._exit(0)
+'''
+
+
+def bench_durability():
+    """Durable data plane (ISSUE 18, core/durability.py): write-through
+    mirror overhead on ingest — ``durability=off`` is the zero-overhead
+    default (hook sites gate on the raw env knob before importing
+    anything) — plus kill-to-rebuild wall time on a REAL 2-process
+    cloud: a peer mirrors a frame, is SIGKILLed, and the survivor's
+    recovery supervisor re-homes the frame from its mirror."""
+    import shutil
+    import socket
+    import subprocess
+    import tempfile
+
+    import h2o3_tpu
+    from h2o3_tpu.core import durability
+    from h2o3_tpu.core.kv import DKV
+    n = 200_000 if FAST else 2_000_000
+    r = np.random.RandomState(11)
+    cols = {"a": r.randn(n), "b": r.randn(n), "y": r.randn(n)}
+    nbytes = sum(v.nbytes for v in cols.values())
+
+    def _ingest():
+        fr = h2o3_tpu.Frame.from_numpy(cols)
+        DKV.remove(fr.key)
+
+    _ingest()                                # warmup/compile
+    t0 = time.time()
+    _ingest()
+    t_off = max(time.time() - t0, 1e-9)
+    dur_dir = tempfile.mkdtemp(prefix="h2o3tpu-bench-mirror-")
+    os.environ["H2O3TPU_DATA_DURABILITY"] = "mirror"
+    os.environ["H2O3TPU_DUR_DIR"] = dur_dir
+    try:
+        _ingest()                            # warmup the mirror path
+        t0 = time.time()
+        _ingest()
+        t_mir = max(time.time() - t0, 1e-9)
+    finally:
+        os.environ.pop("H2O3TPU_DATA_DURABILITY", None)
+        os.environ.pop("H2O3TPU_DUR_DIR", None)
+        durability.reset()
+        shutil.rmtree(dur_dir, ignore_errors=True)
+    _emit(f"durability mirror write-through, {n/1e6:.1f}M-row ingest "
+          "(blocks persisted + digested + registered per frame)",
+          (t_mir / t_off - 1.0) * 100.0, "percent overhead",
+          t_mir / t_off, "durability=off (zero-overhead default)",
+          off_seconds=round(t_off, 3), mirror_seconds=round(t_mir, 3),
+          frame_mb=round(nbytes / 1e6, 1))
+
+    with tempfile.TemporaryDirectory() as tmp:
+        worker = os.path.join(tmp, "dur_bench_worker.py")
+        with open(worker, "w") as f:
+            f.write(_DUR_WORKER_SRC)
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        coord = f"127.0.0.1:{s.getsockname()[1]}"
+        s.close()
+        out = os.path.join(tmp, "dur.json")
+        env = dict(os.environ)
+        env.pop("JAX_PLATFORMS", None)
+        env["H2O3TPU_BENCH_REPO"] = os.path.dirname(
+            os.path.abspath(__file__))
+        procs = [subprocess.Popen(
+            [sys.executable, worker, coord, "2", str(i), out],
+            env=env, stdout=subprocess.DEVNULL,
+            stderr=subprocess.STDOUT) for i in range(2)]
+        deadline = time.time() + 420
+        for p in procs:
+            try:
+                p.wait(timeout=max(deadline - time.time(), 1.0))
+            except subprocess.TimeoutExpired:
+                for q in procs:
+                    if q.poll() is None:
+                        q.kill()
+        assert procs[0].returncode == 0, "durability driver failed"
+        with open(out + ".0") as f:
+            res = json.load(f)
+
+    assert res["kill_to_rebuild_s"] is not None, "never rebuilt"
+    assert res["rebuilds"] >= 1, "rebuild not visible in telemetry"
+    _emit("durability kill-to-rebuild, 2-process cloud (SIGKILL the "
+          "frame's home; survivor re-homes it from the mirror)",
+          res["kill_to_rebuild_s"], "seconds", 1.0,
+          "includes heartbeat staleness detection",
+          mirror_nbytes=res["mirror_nbytes"],
+          rebuilds=res["rebuilds"])
+
+
 CONFIGS = [("gbm", bench_gbm), ("glm", bench_glm), ("dl", bench_dl),
            ("xgb", bench_xgb), ("sort", bench_sort),
            ("grid", bench_grid), ("treekernel", bench_treekernel),
@@ -1252,6 +1407,7 @@ CONFIGS = [("gbm", bench_gbm), ("glm", bench_glm), ("dl", bench_dl),
            ("memgov", bench_memgov), ("ingest", bench_ingest),
            ("serving", bench_serving), ("sched", bench_sched),
            ("tracing", bench_tracing), ("fleet", bench_fleet),
+           ("durability", bench_durability),
            ("automl", bench_automl), ("gbm-full", bench_gbm_full)]
 
 # minimum seconds a config plausibly needs; skipped (with a JSON note)
@@ -1260,7 +1416,7 @@ _MIN_NEED = {"gbm": 60, "glm": 90, "dl": 60, "xgb": 60, "sort": 60,
              "grid": 120, "treekernel": 60, "cloud": 30, "automl": 180,
              "checkpoint": 90, "memgov": 90, "ingest": 90,
              "serving": 60, "sched": 120, "tracing": 90, "fleet": 120,
-             "gbm-full": 600}
+             "durability": 120, "gbm-full": 600}
 
 # hard per-config wallclock cap (child process killed past it): a
 # wedged worker costs one line, never the scoreboard
@@ -1268,7 +1424,7 @@ _HARD_CAP = {"gbm": 900, "glm": 600, "dl": 600, "xgb": 600, "sort": 400,
              "grid": 600, "treekernel": 400, "cloud": 300, "automl": 900,
              "checkpoint": 600, "memgov": 600, "ingest": 600,
              "serving": 600, "sched": 600, "tracing": 600, "fleet": 600,
-             "gbm-full": 1200}
+             "durability": 600, "gbm-full": 1200}
 
 
 def _stub_ok(name):
@@ -1748,6 +1904,54 @@ def _stub_fleet():
           "plans/sec", 1.0, "stub", hedged_hops=n_hedges)
 
 
+def _stub_durability():
+    """`durability` line without a backend (ISSUE 18): drives the
+    registry/rebuild state machine (core/durability.py DurabilityBoard)
+    dry — register → peer death → mirror-over-lineage rebuild plan on
+    the least-loaded survivor → re-home acks → terminal LOST path for
+    keys with neither leg — plus the chunked zlib+base64 blob transport
+    mirrored frames ride over the coordination KV; no jax, no KV
+    server."""
+    from h2o3_tpu.core.durability import (DurabilityBoard, _B64_CHUNK,
+                                          _decode, _encode)
+    n_keys, procs = 64, [0, 1, 2, 3]
+    t0 = time.time()
+    board = DurabilityBoard(procs)
+    for i in range(n_keys):
+        board.register(f"frame_{i:03d}", pid=i % 4,
+                       mirrored=(i % 3 != 0), lineage=(i % 3 == 0))
+    # host 2 dies: every key it homed gets a rebuild plan — mirror
+    # preferred over lineage, homed on the least-loaded survivor
+    plan = board.on_dead(2, loads={0: 2.0, 1: 0.5, 3: 1.0})
+    assert plan and all(t == 1 for _k, t, _s in plan)
+    assert {s for _k, _t, s in plan} == {"mirror", "lineage"}
+    assert board.on_dead(2) == []              # idempotent per host
+    assert not board.complete()
+    for k, t, _s in plan:
+        board.on_rebuilt(k, t)
+    assert board.complete()
+    # a key with neither mirror nor lineage is terminally LOST on its
+    # home's death — never under-replicated-forever, never a hang
+    board.register("doomed", pid=3)
+    plan2 = board.on_dead(3, loads={0: 0.1, 1: 9.0})
+    assert all(k != "doomed" for k, _t, _s in plan2)
+    assert board.lost() == ["doomed"]
+    for k, t, _s in plan2:
+        board.on_rebuilt(k, t)
+    assert board.complete() and board.alive() == [0, 1]
+    # chunked mirror-blob transport round-trips losslessly
+    blob = os.urandom(300_000)
+    b64 = _encode(blob)
+    nparts = (len(b64) + _B64_CHUNK - 1) // _B64_CHUNK
+    assert _decode(b64) == blob
+    dt = max(time.time() - t0, 1e-6)
+    _emit("durability board 64 frames 4 hosts (stub; register->dead->"
+          "rebuild-plan->re-home state machine, no backend)",
+          n_keys / dt, "frames/sec", 1.0, "stub",
+          replanned=len(plan) + len(plan2), lost=len(board.lost()),
+          blob_parts=nparts)
+
+
 if STUB:
     CONFIGS = [("stub_a", _stub_ok("stub_a")),
                ("stub_wedge", _stub_wedge),
@@ -1762,6 +1966,7 @@ if STUB:
                ("sched", _stub_sched),
                ("slo", _stub_slo),
                ("fleet", _stub_fleet),
+               ("durability", _stub_durability),
                ("stub_b", _stub_ok("stub_b"))]
     _MIN_NEED = {n: 1 for n, _ in CONFIGS}
     _HARD_CAP = {n: 30 for n, _ in CONFIGS}

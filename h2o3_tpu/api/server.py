@@ -34,6 +34,7 @@ from h2o3_tpu.core.kv import DKV
 from h2o3_tpu.frame.frame import Frame
 from h2o3_tpu.models import all_algos, get_builder
 from h2o3_tpu.models.model import Model
+from h2o3_tpu.core.durability import DataLostError
 from h2o3_tpu.serving.batcher import BatcherDraining, QueueSaturated
 from h2o3_tpu.serving.fleet import FleetUnavailable
 from h2o3_tpu.utils.log import get_logger
@@ -697,7 +698,13 @@ def _frame_one(params, body, fid=None):
     if rows < 0:
         rows = fr.nrows
     offset = int(float(params.get("row_offset") or 0))
-    return {"frames": [_frame_json(fr, rows=rows, row_offset=offset)]}
+    j = _frame_json(fr, rows=rows, row_offset=offset)
+    # provenance surface (ISSUE 18): source paths + parse plan, derived
+    # op chains, mirror status — what the durability layer would replay
+    # to re-materialize this frame after a peer loss
+    from h2o3_tpu.core import durability as _durability
+    j["lineage"] = _durability.lineage_of(fr)
+    return {"frames": [j]}
 
 
 @route("DELETE", r"/3/Frames/(?P<fid>[^/]+)")
@@ -1932,6 +1939,22 @@ def _profiler_capture(params, body):
             "duration_ms": dur_s * 1000.0, "files": sorted(files)[:100]}
 
 
+@route("POST", "/3/CloudCheckpoint")
+def _cloud_checkpoint(params, body):
+    """Whole-cloud checkpoint (ISSUE 18): quiesce RUNNING jobs
+    (bounded), persist the DKV — frames as device-independent blocks,
+    models as device-lowered binaries — under ``dir``, manifest written
+    last. ``init(restore_dir=<dir>)`` reforms the cloud bit-identically
+    (core/durability.py)."""
+    d = params.get("dir") or params.get("directory") or \
+        (body.get("dir") if isinstance(body, dict) else None)
+    if not d:
+        raise ValueError("CloudCheckpoint requires a 'dir' parameter")
+    quiesce_s = float(params.get("quiesce_s") or 30.0)
+    from h2o3_tpu.core import durability as _durability
+    return _durability.cloud_checkpoint(str(d), quiesce_s=quiesce_s)
+
+
 @route("POST", "/3/Shutdown")
 def _shutdown(params, body):
     threading.Thread(target=lambda: _SERVER and _SERVER.shutdown(),
@@ -2358,6 +2381,15 @@ class _Handler(BaseHTTPRequestHandler):
                                       reason="draining").inc()
                     out = _error_json(path, e, 503)
                     code = 503
+                except DataLostError as e:
+                    # a frame proven unrecoverable (peer death, no
+                    # mirror or replayable lineage): 410 Gone in
+                    # H2OErrorV3 shape — typed and terminal, a retry
+                    # cannot bring the data back (core/durability.py)
+                    telemetry.counter("rest_rejected_total",
+                                      reason="data_lost").inc()
+                    out = _error_json(path, e, 410)
+                    code = 410
                 except FleetUnavailable as e:
                     # every replica unhealthy: explicit degradation —
                     # 503 + Retry-After in H2OErrorV3 shape, never a

@@ -111,6 +111,7 @@ def init(backend: Optional[str] = None,
          coordinator_address: Optional[str] = None,
          num_processes: Optional[int] = None,
          process_id: Optional[int] = None,
+         restore_dir: Optional[str] = None,
          **kwargs) -> dict:
     """Start (or attach to) the cloud. Analogue of h2o.init (h2o.py:49,138).
 
@@ -120,12 +121,17 @@ def init(backend: Optional[str] = None,
     the clouding protocol (replaces multicast/flatfile discovery,
     water/init/NetworkInit.java:62-174), retried under the shared
     watchdog policy and bounded by ``H2O3TPU_CLOUD_TIMEOUT_S``.
+
+    ``restore_dir`` reforms the cloud's DKV from a ``cloud_checkpoint``
+    directory (POST /3/CloudCheckpoint) — frames land bit-identically
+    (digest-verified) and models re-register (core/durability.py, the
+    rolling-restart / disaster-recovery path).
     """
     global _STARTED, _CLOUD_START_MS
     if (_STARTED and backend is None and coordinator_address is None
             and data_axis is None and model_axis is None
             and num_processes is None and process_id is None
-            and not kwargs):
+            and restore_dir is None and not kwargs):
         # cloud already formed and no explicit backend/mesh re-shape
         # requested: attach, don't reform (h2o.init attaches to a
         # running cluster; silently re-detecting devices here could
@@ -186,6 +192,11 @@ def init(backend: Optional[str] = None,
             # proves nobody is still routing against them
             from h2o3_tpu.serving import fleet as _fleet_mod
             _fleet_mod.sweep_keys()
+            # and the durability registry/blob subtree: a reformed
+            # cloud must never rebuild the previous incarnation's
+            # frames from its ghost registry entries
+            from h2o3_tpu.core import durability as _durability_mod
+            _durability_mod.sweep_keys()
         # stamp this process's cloud identity on every log record and
         # flight-recorder capsule (utils/log.py ContextFilter) so merged
         # cluster views stay attributable — set here, NOT read from
@@ -212,6 +223,10 @@ def init(backend: Optional[str] = None,
         cleaner.start()
         log.info("cleaner started (threshold %.0f%%)",
                  cleaner.threshold * 100)
+    if restore_dir:
+        from h2o3_tpu.core import durability as _durability_mod
+        restored = _durability_mod.cloud_restore(restore_dir)
+        info["restored"] = restored
     return info
 
 
@@ -281,6 +296,14 @@ def _sweep_coordination_keys() -> None:
         _fleet_mod.sweep_local_keys(client, pidx)
     except Exception:       # noqa: BLE001 - fleet tier is optional
         pass
+    try:
+        # durability registry rows + mirror blobs this process homes:
+        # a clean shutdown is not a peer death — survivors must not
+        # "rebuild" frames the operator deliberately took down
+        from h2o3_tpu.core import durability as _durability_mod
+        _durability_mod.sweep_local_keys(client, pidx)
+    except Exception:       # noqa: BLE001 - durability tier is optional
+        pass
     # scheduler run subtrees are NOT swept here: processes reach
     # shutdown at different times, and deleting h2o3tpu/sched/ while a
     # lagging peer still polls its last run's done manifest wedges that
@@ -321,6 +344,14 @@ def shutdown() -> None:
         from h2o3_tpu.core import recovery as _recovery
         _recovery.sweep_fit_checkpoints()
     except Exception:       # noqa: BLE001 - sweep is best-effort
+        pass
+    try:
+        # clear this process's durability state (registry keys, mirror
+        # blobs, framesnap.tmp debris) — the ISSUE 18 shutdown contract
+        from h2o3_tpu.core import durability as _durability_mod
+        _durability_mod.reset()
+        _durability_mod.sweep_debris()
+    except Exception:       # noqa: BLE001 - durability is optional
         pass
     try:
         # the admission ledger and bytes-on-ice accounting die with the

@@ -324,6 +324,16 @@ class HeartbeatMonitor:
             fleet.maybe_adopt()
         except Exception as e:      # noqa: BLE001 - adopt is best-effort
             log.debug("fleet adopt check skipped: %s", e)
+        # frame recovery supervisor piggybacks last: once a peer's beat
+        # is declared stale, the least-loaded survivor rebuilds the
+        # dead peer's registered frames from mirror-or-lineage and
+        # re-homes them (rate-limited inside maybe_rebuild; KV-only —
+        # never a device collective)
+        try:
+            from h2o3_tpu.core import durability
+            durability.maybe_rebuild_async()
+        except Exception as e:      # noqa: BLE001 - rebuild best-effort
+            log.debug("durability rebuild check skipped: %s", e)
         beats = {}
         for key, val in client.key_value_dir_get(KV_PREFIX):
             try:

@@ -16,6 +16,7 @@ equivalent work to do.
 
 from __future__ import annotations
 
+import os
 import threading
 import itertools
 from typing import Any, Dict, Iterator, Optional
@@ -92,6 +93,14 @@ class _DKV:
                 v.discard()     # our restore won: reclaim the ice file
                 return fr
             v = cur             # retry until we hold a live value
+        if v is None and \
+                os.environ.get("H2O3TPU_DATA_DURABILITY", "off") != "off":
+            # a key proven unrecoverable (peer died, no mirror or
+            # replayable lineage) fails typed here — the data-access
+            # chokepoint — instead of surfacing as a hang or a late
+            # AttributeError somewhere in a fit
+            from h2o3_tpu.core import durability
+            durability.check_lost(key)
         return v
 
     def get_raw(self, key: str) -> Optional[Any]:
@@ -119,6 +128,13 @@ class _DKV:
             self._atime.pop(key, None)
         if v is not None and getattr(v, "_is_lazy_stub", False):
             v.discard()     # drop the orphaned ice file with the key
+        # durability write-through (ISSUE 18): a deliberately removed
+        # frame takes its mirror blob + registry row with it. One env
+        # read when the knob is off — the zero-overhead contract.
+        if v is not None and \
+                os.environ.get("H2O3TPU_DATA_DURABILITY", "off") != "off":
+            from h2o3_tpu.core import durability
+            durability.on_remove(key, v)
 
     def keys(self, prefix: str = "") -> Iterator[str]:
         with self._lock:

@@ -187,6 +187,7 @@ class MemoryGovernor:
         self._cond = threading.Condition(threading.Lock())
         self._reservations: List[Reservation] = []
         self._spilled_bytes = 0      # live bytes on ice (npz spills)
+        self._mirror_bytes = 0       # live mirror blobs (durability)
 
     # -- budget truth --------------------------------------------------
     def device_limit_bytes(self) -> int:
@@ -275,6 +276,19 @@ class MemoryGovernor:
             self._spilled_bytes = max(
                 self._spilled_bytes - max(int(nbytes), 0), 0)
             self._cond.notify_all()
+        self.refresh_gauges()
+
+    def mirror_bytes(self) -> int:
+        with self._cond:
+            return self._mirror_bytes
+
+    def account_mirror(self, delta: int) -> None:
+        """Durability mirror blobs flow through the governor's ledger
+        like spills do (core/durability.py write-through), so
+        ``frames_mirrored_bytes`` publishes from the same memory-truth
+        surface as the other byte gauges."""
+        with self._cond:
+            self._mirror_bytes = max(self._mirror_bytes + int(delta), 0)
         self.refresh_gauges()
 
     def reserved_bytes(self) -> int:
@@ -436,6 +450,8 @@ class MemoryGovernor:
             telemetry.gauge("hbm_bytes_in_use").set(self.bytes_in_use())
             telemetry.gauge("frames_spilled_bytes").set(
                 self.spilled_bytes())
+            telemetry.gauge("frames_mirrored_bytes").set(
+                self.mirror_bytes())
         except Exception:   # noqa: BLE001 - gauges are best-effort
             pass
 
@@ -444,6 +460,7 @@ class MemoryGovernor:
         with self._cond:
             self._reservations.clear()
             self._spilled_bytes = 0
+            self._mirror_bytes = 0
             self._cond.notify_all()
 
 

@@ -437,4 +437,12 @@ def sweep_fit_checkpoints(extra_dir: Optional[str] = None) -> int:
                     _fit_dirs_used.discard(d)
         except OSError:
             pass
+    try:
+        # mirror-blob debris rides the same sweep cadence: orphaned
+        # *.framesnap.tmp from a kill mid-write plus unregistered
+        # *.framesnap blobs (core/durability.py, ISSUE 18)
+        from h2o3_tpu.core import durability as _durability
+        removed += _durability.sweep_debris()
+    except Exception:       # noqa: BLE001 - durability is optional
+        pass
     return removed

@@ -12,6 +12,7 @@ h2o-py/h2o/expr.py) maps to the eager-but-jitted ops in
 
 from __future__ import annotations
 
+import os
 from typing import Dict, List, Optional, Sequence, Union
 
 import jax
@@ -23,6 +24,12 @@ from h2o3_tpu.core.kv import DKV, make_key
 from h2o3_tpu.frame.column import Column, T_CAT, T_NUM, column_from_numpy
 from h2o3_tpu.frame.rollups import rollups
 from h2o3_tpu.parallel import mesh as mesh_mod
+
+
+def _durability_on() -> bool:
+    """One env read — the ``H2O3TPU_DATA_DURABILITY=off`` fast path
+    stays a zero-overhead no-op (core/durability.py)."""
+    return os.environ.get("H2O3TPU_DATA_DURABILITY", "off") != "off"
 
 
 class Frame:
@@ -40,6 +47,11 @@ class Frame:
         self.nrows = nrows
         self.key = key or make_key("frame")
         DKV.put(self.key, self)
+        if _durability_on():
+            # lineage registration + mirror write-through (ISSUE 18);
+            # transient frames construct under durability.suspended()
+            from h2o3_tpu.core import durability
+            durability.on_frame_put(self)
 
     # ---- construction ------------------------------------------------
     @staticmethod
@@ -172,6 +184,16 @@ class Frame:
         if isinstance(sel, (str, int)):
             sel = [sel]
         cols = [self.col(s) for s in sel]
+        if _durability_on():
+            from h2o3_tpu.core import durability
+            with durability.suspended():
+                fr = Frame(cols, self.nrows)
+            # stamp the op chain BEFORE registering, so the registry
+            # entry carries replayable lineage (core/durability.py)
+            durability.record_derived(fr, "select", self,
+                                      {"columns": [c.name for c in cols]})
+            durability.on_frame_put(fr)
+            return fr
         return Frame(cols, self.nrows)
 
     def __contains__(self, name: str) -> bool:
@@ -187,6 +209,14 @@ class Frame:
 
     def drop(self, names: Sequence[str]) -> "Frame":
         keep = [self.col(n) for n in self._order if n not in set(names)]
+        if _durability_on():
+            from h2o3_tpu.core import durability
+            with durability.suspended():
+                fr = Frame(keep, self.nrows)
+            durability.record_derived(fr, "drop", self,
+                                      {"columns": sorted(set(names))})
+            durability.on_frame_put(fr)
+            return fr
         return Frame(keep, self.nrows)
 
     def row_slice(self, lo: int, hi: int) -> "Frame":
@@ -216,8 +246,17 @@ class Frame:
                 arrays[n] = v
                 if c.type == T_TIME:
                     times.append(n)
-        fr = Frame.from_numpy(arrays, domains=domains, strings=strings,
-                              uuids=uuids, times=times)
+        if _durability_on():
+            # transient view: suspend the write-through hook — scoring
+            # chunks must not pay (or churn) the mirror
+            from h2o3_tpu.core import durability
+            with durability.suspended():
+                fr = Frame.from_numpy(arrays, domains=domains,
+                                      strings=strings, uuids=uuids,
+                                      times=times)
+        else:
+            fr = Frame.from_numpy(arrays, domains=domains, strings=strings,
+                                  uuids=uuids, times=times)
         DKV.remove(fr.key)     # transient view, never store-resident
         return fr
 

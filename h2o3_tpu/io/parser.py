@@ -176,12 +176,21 @@ def import_file(path: str, destination_frame: Optional[str] = None,
         log.info("registered lazy frame %s -> %s (unparsed, %.1f MB on "
                  "disk)", key, path, (nbytes or 0) / 1e6)
         return stub
+    import contextlib
     import time as _time
     from h2o3_tpu import telemetry
+    durability = None
+    if os.environ.get("H2O3TPU_DATA_DURABILITY", "off") != "off":
+        from h2o3_tpu.core import durability
     t0 = _time.time()
     with telemetry.span("parse.import", path=str(path)):
-        fr = _import_file_eager(path, destination_frame, col_types, header,
-                                na_strings)
+        # durability: hold registration until the lineage stamp below,
+        # so one registry entry (with replayable provenance) publishes
+        # per ingest instead of an anonymous one being re-homed
+        with (durability.suspended() if durability is not None
+              else contextlib.nullcontext()):
+            fr = _import_file_eager(path, destination_frame, col_types,
+                                    header, na_strings)
     telemetry.histogram("parse_seconds").observe(_time.time() - t0)
     _ingest_counters(path, fr)
     # provenance for the Cleaner's cheap eviction path: an unmutated
@@ -190,6 +199,15 @@ def import_file(path: str, destination_frame: Optional[str] = None,
     fr._source_paths = [path] if not isinstance(path, list) else path
     fr._source_kwargs = {"col_types": col_types, "header": header,
                          "na_strings": na_strings}
+    if durability is not None:
+        # formal ingest lineage: paths + parse plan + format digest —
+        # the deterministic re-materialization contract (ISSUE 18)
+        durability.record_source(
+            fr, fr._source_paths, fr._source_kwargs,
+            parse_plan={"format": os.path.splitext(
+                str(fr._source_paths[0]))[1].lstrip(".") or "csv",
+                "nfiles": len(fr._source_paths)})
+        durability.on_frame_put(fr)
     return fr
 
 

@@ -256,8 +256,10 @@ persist_manager = PersistManager()
 _FRAME_MAGIC = "h2o3tpu-frame-v1"
 
 
-def save_frame(frame, uri: str) -> str:
-    """Binary frame export (water/fvec/persist/FramePersist.saveTo)."""
+def frame_to_bytes(frame) -> bytes:
+    """Device-independent frame blocks as one byte blob — the codec
+    under :func:`save_frame`, the durability mirror, and the cloud
+    checkpoint (all three share the bit-parity round-trip contract)."""
     header = {"magic": _FRAME_MAGIC, "nrows": frame.nrows,
               "names": list(frame.names), "types": {}, "domains": {}}
     arrays = {}
@@ -277,17 +279,23 @@ def save_frame(frame, uri: str) -> str:
     buf = io.BytesIO()
     np.savez_compressed(buf, __header__=np.frombuffer(
         json.dumps(header).encode(), dtype=np.uint8), **arrays)
-    persist_manager.write(uri, buf.getvalue())
+    return buf.getvalue()
+
+
+def save_frame(frame, uri: str) -> str:
+    """Binary frame export (water/fvec/persist/FramePersist.saveTo)."""
+    persist_manager.write(uri, frame_to_bytes(frame))
     return uri
 
 
-def load_frame(uri: str, key: Optional[str] = None):
-    """Binary frame import (FramePersist.loadFrom)."""
+def frame_from_bytes(data: bytes, key: Optional[str] = None):
+    """Inverse of :func:`frame_to_bytes`; round-trips through
+    Frame.from_numpy so the mesh rebuilds the chunk layout."""
     from h2o3_tpu.frame.frame import Frame
-    npz = np.load(io.BytesIO(persist_manager.read(uri)), allow_pickle=False)
+    npz = np.load(io.BytesIO(data), allow_pickle=False)
     header = json.loads(bytes(npz["__header__"]).decode())
     if header.get("magic") != _FRAME_MAGIC:
-        raise IOError(f"{uri} is not an h2o3-tpu frame export")
+        raise IOError("blob is not an h2o3-tpu frame export")
     cols: Dict[str, np.ndarray] = {}
     domains: Dict[str, List[str]] = {}
     cats: List[str] = []
@@ -316,6 +324,11 @@ def load_frame(uri: str, key: Optional[str] = None):
                             strings=strs, key=key)
 
 
+def load_frame(uri: str, key: Optional[str] = None):
+    """Binary frame import (FramePersist.loadFrom)."""
+    return frame_from_bytes(persist_manager.read(uri), key=key)
+
+
 # ------------------------------------------------------------------ models
 
 class _DeviceLoweringPickler(pickle.Pickler):
@@ -342,18 +355,29 @@ class _DeviceLoweringPickler(pickle.Pickler):
         return NotImplemented
 
 
+def model_to_bytes(model) -> bytes:
+    """Device-lowered model binary — the codec under
+    :func:`save_model` and the cloud checkpoint."""
+    buf = io.BytesIO()
+    _DeviceLoweringPickler(buf, protocol=pickle.HIGHEST_PROTOCOL).dump(model)
+    return buf.getvalue()
+
+
 def save_model(model, uri: str) -> str:
     """Full binary model save (REST SaveModel role) — unlike MOJO export
     this keeps params/metrics/output and is re-trainable via checkpoint."""
-    buf = io.BytesIO()
-    _DeviceLoweringPickler(buf, protocol=pickle.HIGHEST_PROTOCOL).dump(model)
-    persist_manager.write(uri, buf.getvalue())
+    persist_manager.write(uri, model_to_bytes(model))
     return uri
+
+
+def model_from_bytes(data: bytes):
+    """Inverse of :func:`model_to_bytes`; re-registers in DKV."""
+    from h2o3_tpu.core.kv import DKV
+    model = pickle.loads(data)
+    DKV.put(model.key, model)
+    return model
 
 
 def load_model(uri: str):
     """Binary model load (REST LoadModel role); re-registers in DKV."""
-    from h2o3_tpu.core.kv import DKV
-    model = pickle.loads(persist_manager.read(uri))
-    DKV.put(model.key, model)
-    return model
+    return model_from_bytes(persist_manager.read(uri))

@@ -538,6 +538,13 @@ class DeepLearningEstimator(ModelBuilder):
             # slice size <= array dim — without the clamp any fit on a
             # frame below ~224 rows fails at trace time.
             batch = min(16384, max(256, n // 64), N)
+            # small fits get at least ~16 optimizer steps per epoch:
+            # ADADELTA ramps its per-parameter rates from ex2=0, so a
+            # 1500-row fit at the 256 floor ran only ~3 steps/epoch and
+            # never left the warmup regime (the reference's HOGWILD
+            # loop updates per ROW). Only fits under ~4096 rows shrink;
+            # the 32 floor keeps the fused step off degenerate slices.
+            batch = min(batch, max(32, n // 16))
             batch = 1 << (batch.bit_length() - 1)
         ndata = mesh.shape["data"]
         batch = ((batch + ndata - 1) // ndata) * ndata

@@ -584,6 +584,17 @@ def run(tag: str, n_items: int, execute: Callable[[int], bytes], *,
                             "sched run %s: host %d heartbeat-dead, "
                             "reassigned items %s", run_id, d,
                             [(i, p) for i, p, _ in moved])
+                        # re-home the dead peer's frames BEFORE its
+                        # items re-run: a reassigned item whose input
+                        # frame died with its host either rebuilds
+                        # (mirror/lineage) or fails typed with
+                        # DataLostError — never hangs on absent data
+                        try:
+                            from h2o3_tpu.core import durability
+                            durability.maybe_rebuild()
+                        except Exception as e:  # noqa: BLE001
+                            log.debug("durability rebuild skipped: %s",
+                                      e)
                         for p in board.alive():
                             client.key_value_set(
                                 f"{R}ctl/assign/{p}",

@@ -205,6 +205,17 @@ def _fleet_replicas_check(reg: MetricsRegistry) -> Tuple[bool, Dict]:
                                 "worst_model": worst}
 
 
+def _data_durability_check(reg: MetricsRegistry) -> Tuple[bool, Dict]:
+    """Every durability-registered frame keeps at least one live
+    replica: ``frames_under_replicated`` counts frames whose home peer
+    is heartbeat-dead and which no survivor has rebuilt yet
+    (core/durability.py). Non-zero means the rebuild supervisor is
+    behind — or the data is one more failure from gone."""
+    under = max((g.value for g in reg.find("frames_under_replicated")),
+                default=0.0)
+    return under == 0.0, {"under_replicated": int(under)}
+
+
 def _mfu_floor() -> float:
     try:
         return float(os.environ.get("H2O3TPU_SLO_MFU_FLOOR", "0"))
@@ -260,6 +271,11 @@ def default_rules() -> List[object]:
             "fleet_replica_floor", check_fn=_fleet_replicas_check,
             description="every fleet-registered model keeps at least "
                         "one healthy replica (fleet_replicas_healthy)"),
+        GaugeRule(
+            "data_durability_floor", check_fn=_data_durability_check,
+            description="every durability-registered frame keeps at "
+                        "least one live replica "
+                        "(frames_under_replicated stays 0)"),
     ]
 
 

@@ -91,6 +91,13 @@ def _check_keys(request):
         # live stub references so spills cannot accumulate across the
         # suite (mirrors the *.fitsnap.tmp sweep above)
         _sweep_orphan_spills(baseline)
+        # orphaned mirror blobs (ISSUE 18): a durability-mode test that
+        # crashed mid-write leaves *.framesnap.tmp debris, and a test
+        # that dropped keys without the remove hook leaves unregistered
+        # *.framesnap blobs — sweep both (mirrors the fitsnap.tmp and
+        # spill-npz sweeps above)
+        from h2o3_tpu.core import durability as _durability
+        _durability.sweep_debris()
         for k in leaked:    # sweep so one leak cannot cascade
             # a leaked RUNNING job is a live worker thread that would
             # keep writing keys after the sweep — cancel it (observed

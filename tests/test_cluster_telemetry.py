@@ -277,10 +277,12 @@ def test_shutdown_sweeps_own_coordination_keys(monkeypatch):
     fake = _FakeKV()
     monkeypatch.setattr(distributed.global_state, "client", fake)
     cloud_mod._sweep_coordination_keys()
-    # the serving fleet sweeps its per-process keys here too (ISSUE 17)
+    # the serving fleet (ISSUE 17) and the durable data plane's frame
+    # registry (ISSUE 18) sweep their per-process keys here too
     assert set(fake.deleted) == {"h2o3tpu/hb/0", "h2o3tpu/boot/0",
                                  "h2o3tpu/telemetry/0",
-                                 "h2o3tpu/fleet/ep/0"}
+                                 "h2o3tpu/fleet/ep/0",
+                                 "h2o3tpu/dur/reg/0/"}
 
 
 # ------------------------------------------------------ node stamping

@@ -316,8 +316,9 @@ def test_bench_dead_backend_fails_fast_per_config(tmp_path):
     assert p.returncode == 0, p.stderr[-2000:]
     errors = [ln for ln in lines if "error" in ln]
     # one per stub config (incl. grid, treekernel, cloud, roofline,
-    # checkpoint, memgov, ingest, serving, sched, slo, fleet)
-    assert len(errors) == 14
+    # checkpoint, memgov, ingest, serving, sched, slo, fleet,
+    # durability)
+    assert len(errors) == 15
     assert all("backend dead" in ln["error"] for ln in errors)
     budget = [ln for ln in lines if ln["metric"] == "budget"][0]
     assert budget["left_s"] >= 0.0

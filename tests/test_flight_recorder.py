@@ -401,6 +401,12 @@ def test_metric_names_documented_in_readme():
     for required in ("fleet_replicas_healthy", "predict_routed_total",
                      "predict_failovers_total", "replica_warm_seconds"):
         assert required in section, required
+    # the ISSUE 18 durable-data-plane surface (core/durability.py)
+    # is part of the stable contract too
+    for required in ("frames_mirrored_bytes", "frame_rebuilds_total",
+                     "frame_rebuild_seconds", "cloud_restore_seconds",
+                     "frames_under_replicated"):
+        assert required in section, required
 
 
 # ----------------------------------------------------------- REST tier
