@@ -150,8 +150,13 @@ class _LocalKV:
                     if k.startswith(prefix)]
 
     def key_value_delete(self, key):
+        # coordination-service directory semantics: the exact key plus
+        # the subtree under ``key/`` — never bare-prefix matches, which
+        # would take ``reg/0/iris_test`` down with ``reg/0/iris``
+        sub = key if key.endswith("/") else key + "/"
         with self._lock:
-            for k in [k for k in self._store if k.startswith(key)]:
+            self._store.pop(key, None)
+            for k in [k for k in self._store if k.startswith(sub)]:
                 del self._store[k]
 
     def blocking_key_value_get(self, key, timeout_ms):
@@ -330,17 +335,38 @@ def rebuild_from_lineage(key: str, lineage: Dict):
         if lin.get("root") != "source" or not root_lin:
             raise DataLostError(key, "derived from an upload frame with "
                                      "no mirror")
-        base = rebuild_from_lineage(lin["parent"], root_lin)
-        fr = base
-        for step in lin.get("ops") or []:
-            fr = _replay_op(fr, step)
+        if not lin.get("ops"):
+            raise DataLostError(key, "derived lineage with no op chain")
         from h2o3_tpu.core.kv import DKV
-        if fr.key != key:
-            DKV.remove(fr.key)
-            fr.key = key
-            DKV.put(key, fr)
-        if base.key != key:
-            DKV.remove(base.key)
+        parent_key = lin["parent"]
+        # Replay over the DKV-resident parent when it is alive — a
+        # sorted maybe_rebuild walk recovers 'train' before
+        # 'train_sub', and re-importing + removing it here would
+        # destroy the just-recovered frame (mirror, registry row and
+        # all). Re-import only a genuinely absent parent, and under
+        # suspended() so the temporary (and every replay intermediate)
+        # never registers/mirrors and its removal has no side effects.
+        base_is_temp = parent_key not in DKV
+        with suspended():
+            base = (rebuild_from_lineage(parent_key, root_lin)
+                    if base_is_temp else DKV.get(parent_key))
+            fr = base
+            for step in lin["ops"]:
+                nxt = _replay_op(fr, step)
+                if fr is not base:
+                    DKV.remove(fr.key)       # replay intermediate
+                fr = nxt
+            if fr.key != key:
+                DKV.remove(fr.key)
+                fr.key = key
+                DKV.put(key, fr)
+            if base_is_temp and base.key != key:
+                DKV.remove(base.key)
+        # the suspended re-key skipped the write-through hook: re-stamp
+        # the recorded lineage and register the final frame so it
+        # regains mirror + registry coverage on its new home
+        fr._lineage = dict(lin)
+        on_frame_put(fr)
         return fr
     if lin.get("kind") != "source":
         raise DataLostError(key, "no mirror and no source lineage "
@@ -398,7 +424,10 @@ def on_frame_put(frame) -> None:
     _publish_registry(key, entry)
     with _lock:
         _registered.add(key)
+        was_lost = key in _lost
         _lost.discard(key)
+    if was_lost:             # re-registered: the loss verdict is void
+        _clear_lost_marker(key)
     # materialize the under-replication gauge from the first tracked
     # frame on — a scrape must see the healthy 0, not an absent series
     try:
@@ -494,12 +523,28 @@ def on_remove(key: str, value=None) -> None:
     if mode() == "off":
         return
     with _lock:
-        if key not in _registered:
-            _lost.discard(key)
-            return
+        registered = key in _registered
         _registered.discard(key)
+        # deliberate removal of a known-lost key retires the
+        # cluster-wide verdict too; plain transient keys keep the
+        # documented no-KV-round-trip fast path
+        was_lost = key in _lost
+        _lost.discard(key)
+    if was_lost:
+        _clear_lost_marker(key)
+        # retire the loss record too: the dead peer's ``lost: true``
+        # registry row would otherwise resurrect the verdict on the
+        # next supervisor round
+        ent = registry().get(key)
+        if ent is not None and ent.get("lost"):
+            try:
+                _kv().key_value_delete(
+                    f"{KV_PREFIX}reg/{ent['pid']}/{key}")
+            except Exception:    # noqa: BLE001
+                pass
+    if not registered:
+        return
     _drop_mirror(key)
-    _lost.discard(key)
     try:
         _kv().key_value_delete(f"{KV_PREFIX}reg/{_self_pid()}/{key}")
     except Exception:        # noqa: BLE001 - registry is best-effort
@@ -530,38 +575,97 @@ def _publish_registry(key: str, entry: Dict[str, Any]) -> None:
         log.debug("durability registry publish failed: %s", e)
 
 
-def registry(pid: Optional[int] = None) -> Dict[str, Dict]:
+def registry(pid: Optional[int] = None,
+             strict: bool = False) -> Dict[str, Dict]:
     """key -> entry for one peer's registered frames (every peer when
-    ``pid`` is None; entries carry their ``key`` and ``pid``)."""
+    ``pid`` is None; entries carry their ``key`` and ``pid``). A KV
+    transport failure yields the empty view — except under ``strict``,
+    where it re-raises so callers can tell "unreadable" from "empty"
+    (the debris sweep must not treat a flaky KV as zero live blobs)."""
     out: Dict[str, Dict] = {}
     prefix = (f"{KV_PREFIX}reg/{pid}/" if pid is not None
               else f"{KV_PREFIX}reg/")
     try:
-        for k, v in _kv().key_value_dir_get(prefix):
-            try:
-                d = json.loads(v)
-                tail = k[len(f"{KV_PREFIX}reg/"):]
-                owner, fk = tail.split("/", 1)
-                d.setdefault("pid", int(owner))
-                d["key"] = fk
-                out[fk] = d
-            except (ValueError, KeyError, TypeError):
-                continue
+        items = _kv().key_value_dir_get(prefix)
     except Exception:        # noqa: BLE001 - KV down: empty view
-        pass
+        if strict:
+            raise
+        return out
+    for k, v in items:
+        try:
+            d = json.loads(v)
+            tail = k[len(f"{KV_PREFIX}reg/"):]
+            owner, fk = tail.split("/", 1)
+            d.setdefault("pid", int(owner))
+            d["key"] = fk
+            out[fk] = d
+        except (ValueError, KeyError, TypeError):
+            continue
     return out
 
 
-def lost_keys() -> List[str]:
+def _lost_marker_key(key: str) -> str:
+    return f"{KV_PREFIX}lost/{key}"
+
+
+def _publish_lost(key: str, detail: str = "") -> None:
+    """A rebuild proved the key unrecoverable: record it locally AND
+    publish a ``lost/`` marker through the KV, so every peer's
+    ``check_lost`` sees the same terminal verdict — not a silent
+    ``DKV.get(...) is None`` on the peers that never ran the rebuild."""
     with _lock:
-        return sorted(_lost)
+        _lost.add(key)
+    try:
+        _kv().key_value_set(_lost_marker_key(key),
+                            json.dumps({"ts": time.time(),
+                                        "detail": detail}),
+                            allow_overwrite=True)
+    except Exception:        # noqa: BLE001 - marker is best-effort
+        pass
+
+
+def _clear_lost_marker(key: str) -> None:
+    try:
+        _kv().key_value_delete(_lost_marker_key(key))
+    except Exception:        # noqa: BLE001
+        pass
+
+
+def _kv_lost(key: str) -> bool:
+    """Cluster-wide lost check against the published ``lost/`` markers
+    (exact-key match — the dir scan may return sibling keys sharing the
+    prefix)."""
+    want = _lost_marker_key(key)
+    try:
+        return any(k == want for k, _ in _kv().key_value_dir_get(want))
+    except Exception:        # noqa: BLE001 - KV down: unknown, not lost
+        return False
+
+
+def lost_keys() -> List[str]:
+    out = set()
+    plen = len(f"{KV_PREFIX}lost/")
+    try:
+        for k, _ in _kv().key_value_dir_get(f"{KV_PREFIX}lost/"):
+            out.add(k[plen:])
+    except Exception:        # noqa: BLE001 - KV down: local view only
+        pass
+    with _lock:
+        out |= _lost
+    return sorted(out)
 
 
 def check_lost(key: str) -> None:
     """Raise :class:`DataLostError` when a key is proven gone — the
-    fail-fast jobs and REST handlers call before touching a frame."""
+    fail-fast jobs and REST handlers call before touching a frame.
+    Consults the local LOST set first, then the cluster-wide ``lost/``
+    markers (cached locally on a hit)."""
     with _lock:
         gone = key in _lost
+    if not gone and _kv_lost(key):
+        with _lock:
+            _lost.add(key)
+        gone = True
     if gone:
         raise DataLostError(key, "peer died; no mirror or replayable "
                                  "lineage survived")
@@ -626,14 +730,42 @@ def maybe_rebuild(now: Optional[float] = None) -> int:
             target = _pick_target(dead, loads)
             if target != self_pid:
                 continue         # another survivor owns this rebuild
-            if rebuild_frame(key, entry):
+            if entry.get("lost"):
+                with _lock:      # terminal verdict from an earlier round
+                    _lost.add(key)
+                continue
+            ok = rebuild_frame(key, entry)
+            with _lock:
+                now_lost = key in _lost
+            if now_lost:
+                # keep the dead peer's row as the loss record —
+                # rewritten with a ``lost`` marker so later rounds skip
+                # it but frames_under_replicated (the
+                # data_durability_floor SLO input) still counts it
+                _mark_lost_row(dpid, key, entry)
+            else:
+                try:
+                    _kv().key_value_delete(
+                        f"{KV_PREFIX}reg/{dpid}/{key}")
+                except Exception:    # noqa: BLE001
+                    pass
+            if ok:
                 rebuilt += 1
-            try:
-                _kv().key_value_delete(f"{KV_PREFIX}reg/{dpid}/{key}")
-            except Exception:    # noqa: BLE001
-                pass
     _refresh_gauges(dead)
     return rebuilt
+
+
+def _mark_lost_row(dpid: int, key: str, entry: Dict[str, Any]) -> None:
+    """Rewrite a dead peer's registry row with ``lost: true`` — the
+    permanent loss record (the ``lost/`` marker itself was published by
+    :func:`rebuild_frame`)."""
+    try:
+        e = dict(entry)
+        e["lost"] = True
+        _kv().key_value_set(f"{KV_PREFIX}reg/{dpid}/{key}",
+                            json.dumps(e), allow_overwrite=True)
+    except Exception:        # noqa: BLE001 - registry is best-effort
+        pass
 
 
 def _peer_loads() -> Dict[int, float]:
@@ -705,8 +837,7 @@ def rebuild_frame(key: str, entry: Dict[str, Any]) -> bool:
             except Exception as e:  # noqa: BLE001 - replay failed
                 err = e
     if source is None:
-        with _lock:
-            _lost.add(key)
+        _publish_lost(key, str(err) if err else "no mirror or lineage")
         log.error("frame %s is LOST (no rebuildable mirror/lineage): %s",
                   key, err)
         return False
@@ -967,16 +1098,25 @@ def sweep_debris() -> int:
     d = mirror_dir()
     if not os.path.isdir(d):
         return 0
+    try:
+        reg = registry(strict=True)
+    except Exception:        # noqa: BLE001 - KV unreachable
+        # blob liveness is unknowable without the registry: a sweep now
+        # would delete other live peers' mirrors out from under the
+        # rebuild path — only the always-safe half-written .tmp debris
+        # goes
+        reg = None
     with _lock:
         live = {_fname(k, i.get("gen", 1)) for k, i in _mirrored.items()}
-    for ent in registry().values():
+    for ent in (reg or {}).values():
         if ent.get("uri"):
             live.add(os.path.basename(ent["uri"]))
     removed = 0
     for f in list(os.listdir(d)):
         p = os.path.join(d, f)
         orphan_tmp = f.endswith(FRAME_SUFFIX + ".tmp")
-        orphan_blob = f.endswith(FRAME_SUFFIX) and f not in live
+        orphan_blob = (reg is not None and f.endswith(FRAME_SUFFIX)
+                       and f not in live)
         if orphan_tmp or orphan_blob:
             try:
                 os.remove(p)

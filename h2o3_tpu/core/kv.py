@@ -129,10 +129,11 @@ class _DKV:
         if v is not None and getattr(v, "_is_lazy_stub", False):
             v.discard()     # drop the orphaned ice file with the key
         # durability write-through (ISSUE 18): a deliberately removed
-        # frame takes its mirror blob + registry row with it. One env
-        # read when the knob is off — the zero-overhead contract.
-        if v is not None and \
-                os.environ.get("H2O3TPU_DATA_DURABILITY", "off") != "off":
+        # frame takes its mirror blob + registry row with it — and a
+        # key with NO value may still carry a LOST verdict to retire,
+        # so the hook runs even for absent keys. One env read when the
+        # knob is off — the zero-overhead contract.
+        if os.environ.get("H2O3TPU_DATA_DURABILITY", "off") != "off":
             from h2o3_tpu.core import durability
             durability.on_remove(key, v)
 

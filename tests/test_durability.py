@@ -273,6 +273,181 @@ def test_sweep_debris_and_local_keys(dur_env):
     DKV.remove(fr.key)
 
 
+def test_sweep_debris_skips_blobs_when_registry_unreadable(
+        dur_env, monkeypatch):
+    """A flaky/unreachable KV must read as 'liveness unknowable', not
+    'no live blobs' — a sweep then would delete other peers' mirrors
+    out from under the rebuild path. Only .tmp debris goes."""
+    fr = _small_frame(seed=18)
+    d = durability.mirror_dir()
+    live_uri = durability.registry()[fr.key]["uri"]
+    peer_blob = os.path.join(d, "other_peer_g1.framesnap")
+    half_tmp = os.path.join(d, "half.framesnap.tmp")
+    for p in (peer_blob, half_tmp):
+        with open(p, "wb") as f:
+            f.write(b"x")
+
+    class _DownKV:
+        def key_value_dir_get(self, prefix):
+            raise IOError("kv unreachable")
+
+        def key_value_set(self, *a, **k):
+            raise IOError("kv unreachable")
+
+        def key_value_delete(self, *a):
+            raise IOError("kv unreachable")
+
+    monkeypatch.setattr(durability, "_kv", lambda: _DownKV())
+    assert durability.sweep_debris() == 1        # only the tmp
+    assert not os.path.exists(half_tmp)
+    assert os.path.exists(peer_blob)             # spared: unknowable
+    assert os.path.exists(live_uri)
+    monkeypatch.undo()
+    DKV.remove(fr.key)
+
+
+def test_local_kv_delete_is_exact_plus_subtree():
+    """Coordination-service directory semantics: deleting 'reg/0/iris'
+    must not take 'reg/0/iris_test' (destination_frame keys commonly
+    share prefixes) — only the exact key and its 'iris/' subtree."""
+    kv = durability._LocalKV()
+    kv.key_value_set("reg/0/iris", "a")
+    kv.key_value_set("reg/0/iris_test", "b")
+    kv.key_value_set("reg/0/iris/child", "c")
+    kv.key_value_delete("reg/0/iris")
+    assert dict(kv.key_value_dir_get("reg/0/")) == {"reg/0/iris_test": "b"}
+    kv.key_value_delete("reg/0/")                # dir form still sweeps
+    assert kv.key_value_dir_get("reg/0/") == []
+
+
+def test_remove_spares_prefix_sharing_registrations(dur_env):
+    r = np.random.RandomState(19)
+    h2o3_tpu.Frame.from_numpy({"a": r.randn(50)}, key="iris")
+    fr2 = h2o3_tpu.Frame.from_numpy({"a": r.randn(50)}, key="iris_test")
+    uri2 = durability.registry()["iris_test"]["uri"]
+    DKV.remove("iris")
+    reg = durability.registry()
+    assert "iris" not in reg
+    assert "iris_test" in reg                    # registration survives
+    assert os.path.exists(uri2)                  # mirror survives
+    DKV.remove("iris_test")
+
+
+def test_derived_lineage_rebuild_spares_recovered_parent(
+        dur_env, tmp_path):
+    """The maybe_rebuild walk recovers 'train' before 'train_sub'; the
+    child's lineage replay must reuse the resident parent — not
+    re-import and then delete it (mirror, registry row and all) — and
+    the rebuilt child must re-register so it regains durability
+    coverage on its new home."""
+    csv = tmp_path / "par.csv"
+    r = np.random.RandomState(7)
+    with open(csv, "w") as f:
+        f.write("a,b,y\n")
+        for _ in range(80):
+            f.write(f"{r.randn():.9f},{r.randn():.9f},{r.randn():.9f}\n")
+    fr = h2o3_tpu.import_file(str(csv), destination_frame="train")
+    sub = fr[["a", "y"]]
+    sub_key = sub.key
+    want_parent = durability.frame_digest(fr)
+    want_child = durability.frame_digest(sub)
+    child_entry = dict(durability.registry()[sub_key])
+    # peer-loss style drop of the child (no deliberate-delete hooks),
+    # then force the lineage leg: no mirror generation in the entry
+    with durability._lock:
+        durability._registered.discard(sub_key)
+    DKV.remove(sub_key)
+    for k in ("gen", "uri", "where", "nbytes", "digest"):
+        child_entry.pop(k, None)
+    assert durability.rebuild_frame(sub_key, child_entry)
+    # the recovered parent survived the child's replay
+    assert "train" in DKV
+    assert durability.frame_digest(DKV.get("train")) == want_parent
+    assert "train" in durability.registry()
+    assert "train" in durability.stats()["mirrored"]
+    # the child is digest-identical AND regained registry + mirror
+    assert durability.frame_digest(DKV.get(sub_key)) == want_child
+    assert sub_key in durability.registry()
+    assert sub_key in durability.stats()["mirrored"]
+    from h2o3_tpu import telemetry
+    assert telemetry.counter("frame_rebuilds_total",
+                             source="lineage").value >= 1
+    DKV.remove(sub_key)
+    DKV.remove("train")
+
+
+def test_derived_lineage_rebuild_with_absent_parent(dur_env, tmp_path):
+    """When the parent is genuinely gone the replay re-imports it as a
+    suspended temporary: the child comes back digest-identical and the
+    temporary leaves no DKV entry, registration, or mirror behind."""
+    csv = tmp_path / "par2.csv"
+    r = np.random.RandomState(8)
+    with open(csv, "w") as f:
+        f.write("a,y\n")
+        for _ in range(60):
+            f.write(f"{r.randn():.9f},{r.randn():.9f}\n")
+    fr = h2o3_tpu.import_file(str(csv), destination_frame="train2")
+    sub = fr.drop(["a"])
+    sub_key = sub.key
+    want_child = durability.frame_digest(sub)
+    child_entry = dict(durability.registry()[sub_key])
+    for key in (sub_key, "train2"):
+        with durability._lock:
+            durability._registered.discard(key)
+            durability._mirrored.pop(key, None)
+        durability._kv().key_value_delete(
+            f"{durability.KV_PREFIX}reg/0/{key}")
+        DKV.remove(key)
+    for k in ("gen", "uri", "where", "nbytes", "digest"):
+        child_entry.pop(k, None)
+    assert durability.rebuild_frame(sub_key, child_entry)
+    assert durability.frame_digest(DKV.get(sub_key)) == want_child
+    assert sub_key in durability.registry()
+    assert "train2" not in DKV                   # temp base removed
+    assert "train2" not in durability.registry()
+    DKV.remove(sub_key)
+
+
+def test_lost_verdict_is_cluster_wide_and_registry_survives(
+        dur_env, monkeypatch):
+    """An unrecoverable key's verdict travels: the LOST marker is
+    published through the KV (a peer with a cold local set still fails
+    typed), and the dead peer's registry row is kept — rewritten
+    ``lost: true`` — so frames_under_replicated keeps counting the
+    loss instead of the cloud reporting healthy."""
+    from h2o3_tpu import telemetry
+    from h2o3_tpu.core import heartbeat
+    key = "frame_lost_cluster"
+    dead_pid = 7
+    entry = {"pid": dead_pid, "nrows": 1, "ncols": 1}
+    durability._kv().key_value_set(
+        f"{durability.KV_PREFIX}reg/{dead_pid}/{key}", json.dumps(entry))
+    monkeypatch.setattr(heartbeat, "dead_peers", lambda: [dead_pid])
+    monkeypatch.setattr(heartbeat, "healthy_peers", lambda: [0])
+    durability._last_rebuild = 0.0
+    assert durability.maybe_rebuild() == 0
+    # verdict is cluster-wide: wipe the local cache, check_lost still
+    # fails typed off the published marker
+    with durability._lock:
+        durability._lost.discard(key)
+    with pytest.raises(DataLostError):
+        durability.check_lost(key)
+    assert key in durability.lost_keys()
+    # the loss record survives in the registry and feeds the SLO gauge
+    reg = durability.registry()
+    assert reg[key].get("lost") is True
+    assert telemetry.gauge("frames_under_replicated").value >= 1
+    # later rounds skip the terminal row instead of retrying forever
+    durability._last_rebuild = 0.0
+    assert durability.maybe_rebuild() == 0
+    assert durability.registry()[key].get("lost") is True
+    # deliberate removal retires the verdict everywhere
+    DKV.remove(key)
+    assert key not in durability.lost_keys()
+    durability.check_lost(key)                   # no longer raises
+    telemetry.gauge("frames_under_replicated").set(0)
+
+
 # ----------------------------------------------------- SLO + metrics
 
 
