@@ -297,6 +297,15 @@ def _sweep_coordination_keys() -> None:
     except Exception:       # noqa: BLE001 - fleet tier is optional
         pass
     try:
+        # partitioned-ingest metadata this process published (codec
+        # facts, off-mode gather blobs) — per-exchange keys are dead
+        # the moment the frame exists, but a reformed cloud reuses
+        # exchange counters from zero and must never read ghosts
+        from h2o3_tpu.frame import partition as _partition_mod
+        _partition_mod.sweep_local_keys(client)
+    except Exception:       # noqa: BLE001 - ingest tier is optional
+        pass
+    try:
         # durability registry rows + mirror blobs this process homes:
         # a clean shutdown is not a peer death — survivors must not
         # "rebuild" frames the operator deliberately took down

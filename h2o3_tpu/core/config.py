@@ -153,6 +153,13 @@ class Config:
     # hard wall on one scheduled run's completion wait; 0 = no deadline
     # (budget enforcement lives in grid/AutoML, not the scheduler)
     scheduler_timeout_s: float = 0.0
+    # -- pod-global sharded training (parallel/mesh.py, frame/frame.py)
+    # host-partitioned frame placement for data-parallel fits across the
+    # whole pod: "auto"/"on" let partitioned ingest home each process's
+    # row shards locally (ONE fit spans every host); "off" devolves
+    # partitioned ingest to the legacy fully-replicated layout. The
+    # single-process path is bit-identical in every mode.
+    global_fit: str = "auto"
     # -- performance kernels (ops/pallas/) -----------------------------
     # fused Pallas tree kernels (histogram+split+partition per level):
     # "auto" = Pallas on TPU backends, XLA elsewhere; "off" = always the

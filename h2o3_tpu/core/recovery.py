@@ -247,6 +247,31 @@ def _fit_fingerprint(algo: str, params: Dict, y, x, nrows: int) -> str:
     return hashlib.blake2b(payload.encode(), digest_size=10).hexdigest()
 
 
+def snapshot_host(x):
+    """Device-independent host snapshot of (possibly cross-process
+    sharded) fit state — what every ``FitCheckpointer.maybe_save``
+    state_fn must use for device arrays. ``np.asarray`` raises on a
+    row-sharded array of a multi-process cloud (it spans non-addressable
+    devices); this lowers through the same ladder as model persistence
+    (io/persist.py): fully-addressable → device_get, cross-process
+    replicated → read the local replica, cross-process sharded →
+    allgather to the GLOBAL array, so a reformed cloud of any size can
+    re-shard the snapshot and resume. On multi-process clouds the
+    allgather is an SPMD collective: every process must call at the
+    same program point (the shared snapshot cadence guarantees it)."""
+    import jax
+    import numpy as np
+
+    def _snap(v):
+        if isinstance(v, jax.Array) and not v.is_fully_addressable:
+            if v.sharding.is_fully_replicated:
+                return np.asarray(v.addressable_shards[0].data)
+            from h2o3_tpu.parallel.mesh import fetch_replicated
+            return np.asarray(fetch_replicated(v))
+        return np.asarray(v)
+    return jax.tree_util.tree_map(_snap, x)
+
+
 def fit_checkpointer(algo: str, params: Dict, y, x, nrows: int,
                      default_every: int) -> Optional["FitCheckpointer"]:
     """The builder-facing entry point: returns a checkpointer when

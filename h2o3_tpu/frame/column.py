@@ -80,11 +80,20 @@ class Column:
             return self.strings[: self.nrows]
         host = getattr(self, "_host_cache", None)
         if host is None:
-            from h2o3_tpu.parallel.mesh import fetch_replicated
-            data, mask = fetch_replicated((self.data, self.na_mask))
-            x = data[: self.nrows].astype(np.float64)
-            x[mask[: self.nrows]] = np.nan
-            host = x
+            part = getattr(self, "_part_cache", None)
+            if part is not None:
+                # host-partitioned column (column_from_partitioned):
+                # assemble the full exact-f64 view from the per-process
+                # slabs — ONE control-plane allgather, then cached like
+                # every other host view
+                host = np.asarray(
+                    gather_partitioned_host(part))[: self.nrows]
+            else:
+                from h2o3_tpu.parallel.mesh import fetch_replicated
+                data, mask = fetch_replicated((self.data, self.na_mask))
+                x = data[: self.nrows].astype(np.float64)
+                x[mask[: self.nrows]] = np.nan
+                host = x
             object.__setattr__(self, "_host_cache", host)
         return host
 
@@ -107,6 +116,20 @@ def prefetch_host(cols: List["Column"]) -> None:
             and getattr(c, "_host_cache", None) is None]
     if not todo:
         return
+    part_todo = [c for c in todo
+                 if getattr(c, "_part_cache", None) is not None]
+    if part_todo:
+        # host-partitioned columns: one batched slab allgather (exact
+        # f64 — the device arrays may be narrowed to f32)
+        gathered = gather_partitioned_host(
+            [c._part_cache for c in part_todo])
+        for c, full in zip(part_todo, gathered):
+            object.__setattr__(c, "_host_cache",
+                               np.asarray(full)[: c.nrows])
+        todo = [c for c in todo
+                if getattr(c, "_host_cache", None) is None]
+        if not todo:
+            return
     from h2o3_tpu.parallel.mesh import fetch_replicated
     fetched = fetch_replicated([(c.data, c.na_mask) for c in todo])
     for c, (data, mask) in zip(todo, fetched):
@@ -196,6 +219,88 @@ def column_from_numpy(name: str, values: np.ndarray, nrows_padded: int,
         host64 = data[:n].astype(np.float64)
         host64[na[:n]] = np.nan
         object.__setattr__(col, "_host_cache", host64)
+    return col
+
+
+def gather_partitioned_host(slabs):
+    """Assemble full host arrays from per-process partitioned slabs
+    (pytree in, matching pytree of full arrays out). Process order IS
+    row order — asserted by Frame.from_numpy_partitioned at ingest.
+    Single process: the slab already covers every row."""
+    import jax
+    if jax.process_count() == 1:
+        return slabs
+    from jax.experimental import multihost_utils
+    return jax.device_get(multihost_utils.process_allgather(
+        slabs, tiled=True))
+
+
+def column_from_partitioned(name: str, values: np.ndarray, *,
+                            span, nrows: int, npad: int, sharding,
+                            domain: Optional[List[str]] = None,
+                            facts: Optional[dict] = None,
+                            time: bool = False) -> Column:
+    """Host-partitioned complement of ``column_from_numpy``: ``values``
+    holds ONLY this process's logical rows (global rows ``[span[0],
+    min(span[1], nrows))``), every codec decision comes from the
+    globally-merged ``facts``/``domain`` (frame/partition.py) — never
+    from local data, or peers would pick divergent dtypes — and
+    placement goes through ``put_partitioned`` so no process ever
+    materializes a peer's rows. Bit-identical to ``column_from_numpy``
+    on a single process, where the local slab is the whole column.
+    """
+    from h2o3_tpu.parallel.mesh import put_partitioned
+    values = np.asarray(values)
+    lo, hi = span
+    local_n = values.shape[0]
+    pad = (hi - lo) - local_n        # mesh-padding rows homed here
+    vals64 = None
+
+    if values.dtype == object or values.dtype.kind in "US":
+        assert domain is not None, (
+            "partitioned string-typed ingest requires the merged domain")
+        lut = {lvl: i for i, lvl in enumerate(domain)}
+        codes = np.asarray([lut.get(v, -1) if v is not None else -1
+                            for v in values], np.int32)
+        na = codes < 0
+        data = np.where(na, 0, codes).astype(np.int32)
+        ctype = T_CAT
+    elif domain is not None:
+        na = (values < 0) | ~np.isfinite(values.astype(np.float64))
+        data = np.where(na, 0, values).astype(np.int32)
+        ctype = T_CAT
+    else:
+        vals64 = values.astype(np.float64)
+        na = ~np.isfinite(vals64)
+        clean = np.where(na, 0.0, vals64)
+        if facts is None:
+            from h2o3_tpu.frame.partition import (local_numeric_facts,
+                                                  merge_numeric_facts)
+            facts = merge_numeric_facts([local_numeric_facts(values)])
+        if facts["integral"]:
+            data = clean.astype(block_int_dtype(facts["lo"], facts["hi"]))
+        else:
+            data = clean.astype(np.float32)
+        ctype = T_NUM
+
+    data = np.pad(data, (0, pad))
+    na = np.pad(na, (0, pad), constant_values=True)
+    if time and ctype == T_NUM:
+        ctype = T_TIME
+    col = Column(
+        name=name, type=ctype,
+        data=put_partitioned(data, sharding, (npad,)),
+        na_mask=put_partitioned(na, sharding, (npad,)),
+        nrows=nrows, domain=domain)
+    # exact-f64 host semantics: retain THIS process's padded f64 slab;
+    # the first host_view() allgathers the slabs (one control-plane
+    # collective, gather_partitioned_host) and caches the full view —
+    # the partitioned analogue of column_from_numpy's _host_cache seed
+    slab = data.astype(np.float64)
+    slab[na] = np.nan
+    if vals64 is not None and data.dtype == np.float32:
+        slab[:local_n] = np.where(na[:local_n], np.nan, vals64)
+    object.__setattr__(col, "_part_cache", slab)
     return col
 
 

@@ -101,6 +101,95 @@ class Frame:
         return Frame(cols, n, key=key)
 
     @staticmethod
+    def from_numpy_partitioned(arrays: Dict[str, np.ndarray],
+                               nrows: int,
+                               categorical: Sequence[str] = (),
+                               domains: Optional[Dict[str, List[str]]] = None,
+                               times: Sequence[str] = (),
+                               key: Optional[str] = None,
+                               block: int = 8,
+                               pad_to: Optional[int] = None) -> "Frame":
+        """Collective host-partitioned ingest (README §Distributed
+        training): every process calls this at the same program point
+        with ONLY its ``mesh.owned_rows(nrows, block=block)`` slice of
+        each column, and the frame's device data comes up host-
+        partitioned — no process materializes (or ships) peer rows. The
+        codec decisions the replicated path makes from the full host
+        array are agreed in one coordination-KV exchange
+        (frame/partition.py), so the resulting global device bytes are
+        identical to ``from_numpy`` over the concatenated rows.
+
+        ``H2O3TPU_GLOBAL_FIT=off`` devolves to the legacy replicated
+        layout (rows allgathered over the control plane, then
+        ``from_numpy``). Single process: bit-identical to ``from_numpy``
+        by construction. String/UUID columns are unsupported here — they
+        are host-side objects that never enter math paths; ingest them
+        replicated."""
+        from h2o3_tpu.frame import partition as part_mod
+        from h2o3_tpu.frame.column import column_from_partitioned
+        names = list(arrays.keys())
+        nrows = int(nrows)
+        nproc = jax.process_count()
+        if not mesh_mod.global_fit_enabled() and nproc > 1:
+            full = part_mod.allgather_rows(
+                {n: np.asarray(arrays[n]) for n in names})
+            return Frame.from_numpy(full, categorical=categorical,
+                                    domains=domains, times=times, key=key,
+                                    block=block, pad_to=pad_to)
+        npad = mesh_mod.padded_rows(nrows, block=block)
+        if pad_to is not None:
+            npad = max(npad, int(pad_to))
+        lo, hi = mesh_mod.partition_bounds(npad)
+        if nproc > 1 and lo != jax.process_index() * (hi - lo):
+            # gather_partitioned_host and owned_rows both assume process
+            # p homes rows [p*L, (p+1)*L) — the process-major device
+            # order every jax.distributed cloud builds
+            raise ValueError(
+                f"process {jax.process_index()} owns padded rows "
+                f"[{lo}, {hi}) — not process-major row order")
+        lo_c, hi_c = min(lo, nrows), min(hi, nrows)
+        meta: Dict[str, Optional[dict]] = {}
+        for name in names:
+            v = np.asarray(arrays[name])
+            if v.shape[0] != hi_c - lo_c:
+                raise ValueError(
+                    f"column {name!r}: got {v.shape[0]} rows; this "
+                    f"process owns logical rows [{lo_c}, {hi_c})")
+            if (domains or {}).get(name) is not None:
+                meta[name] = None          # pre-interned: nothing to agree
+            elif v.dtype == object or v.dtype.kind in "US":
+                meta[name] = {"kind": "cat_str",
+                              "levels": part_mod.local_str_levels(v)}
+            elif name in categorical:
+                meta[name] = part_mod.local_num_levels(v)
+            else:
+                meta[name] = part_mod.local_numeric_facts(v)
+        metas = part_mod.exchange_ingest_meta(meta) if nproc > 1 else [meta]
+        shard = mesh_mod.row_sharding()
+        cols = []
+        for name in names:
+            v = np.asarray(arrays[name])
+            dom = (domains or {}).get(name)
+            facts = None
+            per_col = [m[name] for m in metas]
+            kind = None if per_col[0] is None else per_col[0]["kind"]
+            if kind == "cat_str":
+                dom = part_mod.merge_str_levels(per_col)
+            elif kind == "cat_num":
+                levels = part_mod.merge_num_levels(per_col)
+                dom = [str(u) for u in levels]
+                v64 = v.astype(np.float64)
+                codes = np.searchsorted(levels, v.astype(levels.dtype))
+                v = np.where(np.isfinite(v64), codes, -1).astype(np.int32)
+            elif kind == "num":
+                facts = part_mod.merge_numeric_facts(per_col)
+            cols.append(column_from_partitioned(
+                name, v, span=(lo, hi), nrows=nrows, npad=npad,
+                sharding=shard, domain=dom, facts=facts,
+                time=name in times))
+        return Frame(cols, nrows, key=key)
+
+    @staticmethod
     def from_blocks(accs: Dict[str, "object"], names: List[str],
                     nrows: int, key: Optional[str] = None,
                     block: int = 1) -> "Frame":

@@ -1,0 +1,205 @@
+"""Host-partitioned ingest coordination — the control plane of
+pod-global sharded training (README §Distributed training).
+
+Reference: in H2O a parsed dataset's chunks home on the node that read
+them (water/parser/ParseDataset distributes chunks round-robin; a Vec
+never materializes fully on one node). Here each process ingests ONLY
+its ``mesh.owned_rows()`` slice of the source, and the codec decisions
+that the replicated path makes from the full host array (dtype
+narrowing, categorical interning — frame/column.py column_from_numpy)
+are instead agreed over the coordination-service KV store in one
+exchange round: every process publishes its local facts, reads every
+peer's, and applies the deterministic merge. The merged decision is
+bit-identical to what a single process would pick from the concatenated
+rows, which is what the global-fit bit-parity guarantee rests on.
+
+All entry points are COLLECTIVE: every process must call them at the
+same point in program order (like the SPMD fit itself). The KV exchange
+is out-of-band control-plane traffic — never a device collective — so a
+dead peer surfaces as a bounded barrier timeout, not a wedged psum.
+"""
+
+from __future__ import annotations
+
+import itertools
+import json
+import pickle
+from typing import Dict, List
+
+import numpy as np
+
+KV_PREFIX = "h2o3tpu_ingest/"
+
+# monotonic per-process exchange id: collective call order is identical
+# on every process, so equal counters name the same exchange (and
+# barrier ids never repeat within one coordination-service incarnation)
+_SEQ = itertools.count()
+
+# exact keys this process published — swept at cloud.shutdown() so a
+# reformed cloud never reads a previous incarnation's ingest metadata
+_PUBLISHED: List[str] = []
+
+
+def _client():
+    from jax._src import distributed
+    return distributed.global_state.client
+
+
+def _timeout_ms() -> int:
+    from h2o3_tpu.core.config import ARGS
+    return int(max(float(getattr(ARGS, "cloud_timeout_s", 120.0)), 1.0)
+               * 1000)
+
+
+def sweep_local_keys(client) -> None:
+    """Delete this process's published ingest keys (shutdown hook)."""
+    for key in _PUBLISHED:
+        try:
+            client.key_value_delete(key)
+        except Exception:   # noqa: BLE001 - absent key / service down
+            pass
+    _PUBLISHED.clear()
+
+
+def exchange_ingest_meta(local_meta: dict) -> List[dict]:
+    """One collective JSON exchange: publish this process's per-column
+    ingest facts, barrier, read every peer's. Returns the metas in
+    process order. Single process: no traffic, ``[local_meta]``."""
+    import jax
+    nproc = jax.process_count()
+    if nproc == 1:
+        return [local_meta]
+    client = _client()
+    seq = next(_SEQ)
+    pid = jax.process_index()
+    prefix = f"{KV_PREFIX}meta/{seq}/"
+    key = f"{prefix}{pid}"
+    client.key_value_set(key, json.dumps(local_meta), allow_overwrite=True)
+    _PUBLISHED.append(key)
+    client.wait_at_barrier(f"h2o3tpu_ingest_meta_{seq}", _timeout_ms())
+    metas: List[dict] = [None] * nproc  # type: ignore[list-item]
+    for k, v in client.key_value_dir_get(prefix):
+        metas[int(k.rsplit("/", 1)[-1])] = json.loads(v)
+    missing = [i for i, m in enumerate(metas) if m is None]
+    if missing:
+        raise RuntimeError(
+            f"partitioned ingest: no metadata from processes {missing}")
+    return metas
+
+
+def allgather_rows(arrays: Dict[str, np.ndarray]) -> Dict[str, np.ndarray]:
+    """Gather every process's row slices into full host columns, in
+    process (= row) order — the ``H2O3TPU_GLOBAL_FIT=off`` devolution
+    path back to the legacy fully-replicated ingest. Control-plane only
+    (pickled blobs over the KV store, chunked like the scheduler's
+    work-item blobs) so it works on clouds without device collectives
+    for host-object columns."""
+    import jax
+    nproc = jax.process_count()
+    if nproc == 1:
+        return {k: np.asarray(v) for k, v in arrays.items()}
+    from h2o3_tpu.parallel.scheduler import _B64_CHUNK, _decode, _encode
+    client = _client()
+    seq = next(_SEQ)
+    pid = jax.process_index()
+    prefix = f"{KV_PREFIX}gather/{seq}/"
+    b64 = _encode(pickle.dumps({k: np.asarray(v)
+                                for k, v in arrays.items()}))
+    nparts = (len(b64) + _B64_CHUNK - 1) // _B64_CHUNK
+    for j in range(nparts):
+        key = f"{prefix}{pid}/p{j}"
+        client.key_value_set(key, b64[j * _B64_CHUNK:(j + 1) * _B64_CHUNK],
+                             allow_overwrite=True)
+        _PUBLISHED.append(key)
+    meta_key = f"{prefix}{pid}/meta"
+    client.key_value_set(meta_key, json.dumps({"parts": nparts}),
+                         allow_overwrite=True)
+    _PUBLISHED.append(meta_key)
+    client.wait_at_barrier(f"h2o3tpu_ingest_gather_{seq}", _timeout_ms())
+    out: Dict[str, np.ndarray] = {}
+    for p in range(nproc):
+        meta = json.loads(client.blocking_key_value_get(
+            f"{prefix}{p}/meta", _timeout_ms()))
+        parts = [client.blocking_key_value_get(f"{prefix}{p}/p{j}",
+                                               _timeout_ms())
+                 for j in range(int(meta["parts"]))]
+        block = pickle.loads(_decode("".join(parts)))
+        if not out:
+            out = {k: [v] for k, v in block.items()}
+        else:
+            for k, v in block.items():
+                out[k].append(v)
+    return {k: np.concatenate(vs) if len(vs) > 1 else vs[0]
+            for k, vs in out.items()}
+
+
+# ------------------------------------------------------------------ facts
+
+def local_numeric_facts(values: np.ndarray) -> dict:
+    """The per-process half of the numeric codec decision
+    (column_from_numpy's narrowing), publishable as JSON. ``integral``
+    mirrors the replicated path's test exactly: every clean value
+    integral AND |v| < 2**31."""
+    vals64 = np.asarray(values).astype(np.float64)
+    clean = np.where(~np.isfinite(vals64), 0.0, vals64)
+    n = clean.size
+    return {
+        "kind": "num",
+        "integral": bool(np.all(clean == np.round(clean))
+                         and np.all(np.abs(clean) < 2 ** 31)),
+        "lo": float(clean.min()) if n else None,
+        "hi": float(clean.max()) if n else None,
+    }
+
+
+def merge_numeric_facts(metas: List[dict]) -> dict:
+    """Deterministic merge of per-process numeric facts — equals the
+    facts a single process computes from the concatenated rows (empty
+    local slices publish lo/hi None and drop out, matching numpy's
+    ``min() if n else 0`` convention on the replicated path)."""
+    los = [m["lo"] for m in metas if m["lo"] is not None]
+    his = [m["hi"] for m in metas if m["hi"] is not None]
+    return {"integral": all(m["integral"] for m in metas),
+            "lo": min(los) if los else 0.0,
+            "hi": max(his) if his else 0.0}
+
+
+def local_str_levels(values: np.ndarray) -> List[str]:
+    """Sorted unique string levels of this process's rows (None/NaN
+    excluded — pandas factorize drops them on the replicated path)."""
+    import pandas as pd
+    _, uniques = pd.factorize(np.asarray(values, dtype=object), sort=True)
+    return [str(u) for u in uniques]
+
+
+def merge_str_levels(metas: List[dict]) -> List[str]:
+    """Sorted union of per-process levels == pd.factorize(sort=True)
+    uniques over the concatenated rows."""
+    levels = set()
+    for m in metas:
+        levels.update(m["levels"])
+    return sorted(levels)
+
+
+def local_num_levels(values: np.ndarray) -> dict:
+    """Unique raw values of a numeric column forced categorical — kept
+    numeric (not stringified) so the merged union sorts numerically and
+    the final ``str(u)`` formatting reproduces the replicated path's
+    ``pd.factorize(sort=True)`` domain byte-for-byte."""
+    import pandas as pd
+    v = np.asarray(values)
+    _, uniques = pd.factorize(v, sort=True)
+    return {"kind": "cat_num", "levels": [u.item() for u in uniques],
+            "dtype": str(v.dtype)}
+
+
+def merge_num_levels(metas: List[dict]) -> np.ndarray:
+    """Sorted union of raw numeric levels in the source dtype."""
+    dtypes = {m["dtype"] for m in metas}
+    if len(dtypes) > 1:
+        raise ValueError(
+            f"partitioned ingest: peers disagree on column dtype {dtypes}")
+    levels = set()
+    for m in metas:
+        levels.update(m["levels"])
+    return np.asarray(sorted(levels), dtype=np.dtype(dtypes.pop()))

@@ -641,9 +641,9 @@ class DeepLearningEstimator(ModelBuilder):
                 _d = done
                 fc.maybe_save(done, lambda: {
                     "done": _d,
-                    "net": jax.tree_util.tree_map(np.asarray, params_net),
-                    "opt": jax.tree_util.tree_map(np.asarray, opt_state),
-                    "key": np.asarray(key),
+                    "net": _recovery.snapshot_host(params_net),
+                    "opt": _recovery.snapshot_host(opt_state),
+                    "key": _recovery.snapshot_host(key),
                     "next_score": next_score,
                     "stop_hist": list(stopper.history),
                     "scoring_history": list(scoring_history)})

@@ -1011,8 +1011,8 @@ class GBMEstimator(ModelBuilder):
                         "path": "multi", "done": _d,
                         "trees": (_tree_host(concat_forests(chunks_m))
                                   if chunks_m else None),
-                        "margins": np.asarray(_mg),
-                        "vm": np.asarray(_vm),
+                        "margins": _recovery.snapshot_host(_mg),
+                        "vm": _recovery.snapshot_host(_vm),
                         "gains_total": gains_total.copy(),
                         "stop_hist": list(stopper.history),
                         "scoring_history": list(scoring_history)})
@@ -1130,7 +1130,7 @@ class GBMEstimator(ModelBuilder):
                             "path": "plain", "done": _d,
                             "trees": (_tree_host(concat_forests(chunks))
                                       if chunks else None),
-                            "margin": np.asarray(_mg),
+                            "margin": _recovery.snapshot_host(_mg),
                             "gains_total": gains_total.copy()})
                     maybe_fail("fit_chunk")
                     maybe_fail("device_oom")
@@ -1197,8 +1197,8 @@ class GBMEstimator(ModelBuilder):
                             "path": "scored", "done": _d,
                             "trees": (_tree_host(concat_forests(chunks))
                                       if chunks else None),
-                            "margin": np.asarray(_mg),
-                            "vm": np.asarray(_vm),
+                            "margin": _recovery.snapshot_host(_mg),
+                            "vm": _recovery.snapshot_host(_vm),
                             "gains_total": gains_total.copy(),
                             "stop_hist": list(stopper.history),
                             "scoring_history": list(scoring_history)})

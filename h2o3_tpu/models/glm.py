@@ -1149,7 +1149,7 @@ class GLMEstimator(ModelBuilder):
                 if fc is not None:
                     _li, _c = li + 1, coef
                     fc.maybe_save(li + 1, lambda: {
-                        "li": _li, "coef": np.asarray(_c)})
+                        "li": _li, "coef": _recovery.snapshot_host(_c)})
                 maybe_fail("fit_chunk")
                 maybe_fail("device_oom")
             if fc is not None:

@@ -33,8 +33,15 @@ def _charge_reduce_payload(out, mesh) -> None:
     """MRTask telemetry: the reduce payload is the pytree the psum tree
     carries — the analogue of the reference's ack/ackack wire volume.
     Sizes come from avals (no device sync). A psum ring moves
-    ~2·(n-1)/n of the payload per device, so the collective estimate is
-    2·(n-1)·payload across the mesh."""
+    ~2·(n-1)/n of the payload over EACH of its n links, so the total
+    collective estimate is 2·(n-1)·payload along the data axis.
+
+    On a multi-host mesh the data-axis ring mixes link classes: a link
+    whose endpoints share a process rides ICI (intra-host), one that
+    crosses processes rides DCN. The counter is labeled by that scope —
+    ``collective_bytes_total{scope=host|pod}`` — so the roofline/MFU
+    gauges (fed the combined total via add_collective_bytes) and the
+    DCN-bandwidth view stay honest when ONE fit spans the pod."""
     try:
         payload = sum(getattr(leaf, "nbytes", 0) or 0
                       for leaf in jax.tree_util.tree_leaves(out))
@@ -42,8 +49,23 @@ def _charge_reduce_payload(out, mesh) -> None:
         return
     telemetry.histogram("frame_reduce_payload_bytes",
                         buckets=telemetry.BYTES_BUCKETS).observe(payload)
-    est = 2.0 * max(mesh.shape[DATA_AXIS] - 1, 0) * payload
-    telemetry.counter("collective_bytes_total").inc(est)
+    n = mesh.shape[DATA_AXIS]
+    est = 2.0 * max(n - 1, 0) * payload
+    pod = 0.0
+    if n > 1:
+        try:
+            # every model column rings over the same process layout —
+            # classify the first column's n links (uniform traffic each)
+            col = mesh.devices.reshape(mesh.shape[DATA_AXIS], -1)[:, 0]
+            cross = sum(
+                1 for i in range(n)
+                if getattr(col[i], "process_index", 0)
+                != getattr(col[(i + 1) % n], "process_index", 0))
+            pod = est * cross / n
+        except Exception:   # noqa: BLE001 - accounting must never fail
+            pod = 0.0
+    telemetry.counter("collective_bytes_total", scope="host").inc(est - pod)
+    telemetry.counter("collective_bytes_total", scope="pod").inc(pod)
     telemetry.add_collective_bytes(est)
 
 

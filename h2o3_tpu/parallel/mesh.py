@@ -22,7 +22,8 @@ from __future__ import annotations
 
 import contextlib
 import contextvars
-from typing import Optional, Sequence
+import os
+from typing import Optional, Sequence, Tuple
 
 import jax
 import numpy as np
@@ -148,6 +149,95 @@ def padded_rows(n: int, mesh: Optional[Mesh] = None, block: int = 1) -> int:
     return ((bucket + d - 1) // d) * d
 
 
+def global_fit_mode() -> str:
+    """The ``H2O3TPU_GLOBAL_FIT`` knob: ``auto`` (default) | ``on`` |
+    ``off``. Gates host-partitioned frame placement (each process homes
+    only its own row shards) vs the legacy fully-replicated ingest where
+    every process holds the complete host copy. ``auto`` and ``on`` are
+    equivalent today (partitioned placement whenever the caller uses the
+    partitioned ingest surface); ``off`` devolves partitioned ingest to
+    the legacy replicated layout. The single-process path is bit-identical
+    in every mode — partitioning one process's rows is the identity."""
+    mode = os.environ.get("H2O3TPU_GLOBAL_FIT")
+    if not mode:
+        from h2o3_tpu.core.config import ARGS
+        mode = getattr(ARGS, "global_fit", "auto") or "auto"
+    mode = str(mode).lower()
+    return mode if mode in ("auto", "on", "off") else "auto"
+
+
+def global_fit_enabled() -> bool:
+    """True when frames may keep host-partitioned device data."""
+    return global_fit_mode() != "off"
+
+
+def partition_bounds(npad: int, mesh: Optional[Mesh] = None) -> Tuple[int, int]:
+    """This process's contiguous padded row range ``[lo, hi)`` under
+    ``row_sharding(mesh)`` — the shard-homing contract: global row *i*
+    lives on the process whose bounds contain it (the analogue of
+    water/fvec/Vec.java chunk homing, ESPC layout). Raises if this
+    process's addressable shards do not tile one contiguous interval
+    (never the case for the process-major device order jax builds)."""
+    mesh = mesh or get_mesh()
+    sh = row_sharding(mesh)
+    spans = set()
+    for idx in sh.addressable_devices_indices_map((int(npad),)).values():
+        s = idx[0]
+        spans.add((s.start or 0, int(npad) if s.stop is None else s.stop))
+    spans = sorted(spans)
+    lo, hi = spans[0][0], spans[0][0]
+    for start, stop in spans:
+        if start > hi:
+            raise ValueError(
+                f"non-contiguous local row shards {spans} — partitioned "
+                "ingest requires process-major device order")
+        hi = max(hi, stop)
+    return lo, hi
+
+
+def owned_rows(nrows: int, mesh: Optional[Mesh] = None, block: int = 1,
+               pad_to: Optional[int] = None) -> Tuple[int, int]:
+    """The logical (unpadded) row range ``[lo, hi)`` this process must
+    supply to a partitioned ingest of an ``nrows``-row frame — what a
+    multi-host reader asks before loading its slice of the source (the
+    PR 12 ingest chunk-boundary contract, io/chunking.py). Clipped to
+    ``nrows``: a process whose shards are pure mesh padding gets an
+    empty range."""
+    npad = padded_rows(nrows, mesh, block)
+    if pad_to is not None:
+        npad = max(npad, int(pad_to))
+    lo, hi = partition_bounds(npad, mesh)
+    return min(lo, nrows), min(hi, nrows)
+
+
+def put_partitioned(local_block, sharding, global_shape):
+    """Assemble a global row-sharded array from ONLY this process's rows.
+
+    ``local_block`` is the padded local slab covering this process's
+    ``partition_bounds`` range; no process ever materializes (or ships)
+    another process's rows — the host-partitioned complement of
+    ``put_sharded``'s replicated-ingest contract. Single process: the
+    slab IS the full array, so this degenerates to device_put (bit-
+    identical to put_sharded)."""
+    import numpy as _np
+    local_block = _np.asarray(local_block)
+    global_shape = tuple(int(s) for s in global_shape)
+    if getattr(sharding, "is_fully_addressable", True):
+        assert local_block.shape[0] == global_shape[0], (
+            f"single-process slab {local_block.shape} != {global_shape}")
+        return jax.device_put(local_block, sharding)
+    imap = sharding.addressable_devices_indices_map(global_shape)
+    lo = min((idx[0].start or 0) for idx in imap.values())
+    shards = []
+    for dev, idx in imap.items():
+        s = idx[0]
+        start = (s.start or 0) - lo
+        stop = (global_shape[0] if s.stop is None else s.stop) - lo
+        shards.append(jax.device_put(local_block[start:stop], dev))
+    return jax.make_array_from_single_device_arrays(
+        global_shape, sharding, shards)
+
+
 def put_sharded(host_array, sharding):
     """Place a host array onto a (possibly multi-process) sharding.
 
@@ -155,7 +245,9 @@ def put_sharded(host_array, sharding):
     cloud — the @CloudSize(n) tier): every process holds the SAME full
     host array (deterministic ingest), so each contributes its
     addressable shards via make_array_from_callback — the analogue of
-    chunks parsing on their home nodes (water/parser/ParseDataset)."""
+    chunks parsing on their home nodes (water/parser/ParseDataset).
+    When each process holds ONLY its own rows, use ``put_partitioned``
+    (the H2O3TPU_GLOBAL_FIT host-partitioned ingest path)."""
     import numpy as _np
     if getattr(sharding, "is_fully_addressable", True):
         return jax.device_put(host_array, sharding)

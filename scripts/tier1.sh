@@ -1,0 +1,59 @@
+#!/usr/bin/env bash
+# tier1.sh — the blessed tier-1 entry points.
+#
+# The full tier-1 suite does not fit the 870s per-invocation cap on the
+# ~1.8x-slow CI container, which used to force ad-hoc hand-picked
+# two-part runs. This script splits the suite DETERMINISTICALLY:
+# `tests/test_*.py` are sorted lexically and alternated by index, and
+# the `-m multiprocess` pod legs (real 2-process gloo clouds — minutes
+# each, clustered in a few files) are carved out into their own target
+# so neither half busts the cap as pods are added. The three targets
+# together cover exactly the whole suite.
+#
+#   scripts/tier1.sh part1        # even-indexed files, minus pod legs
+#   scripts/tier1.sh part2        # odd-indexed files, minus pod legs
+#   scripts/tier1.sh multiprocess # pod smoke: ONLY -m multiprocess legs
+#                                 # (cloud formation, durability, fleet,
+#                                 # tracing, global fit)
+#   scripts/tier1.sh full         # the ROADMAP.md one-shot (needs >870s)
+#
+# Every mode mirrors the ROADMAP.md tier-1 flags exactly; each capped
+# mode runs under `timeout -k 10 870`.
+set -u -o pipefail
+
+cd "$(dirname "$0")/.."
+MODE="${1:-full}"
+
+PYTEST=(env JAX_PLATFORMS=cpu python -m pytest -q \
+        --continue-on-collection-errors \
+        -p no:cacheprovider -p no:xdist -p no:randomly)
+
+mapfile -t ALL < <(ls tests/test_*.py | sort)
+
+half() {  # half <parity>: every 2nd file starting at index $1
+    local parity="$1" i
+    for i in "${!ALL[@]}"; do
+        if (( i % 2 == parity )); then printf '%s\n' "${ALL[$i]}"; fi
+    done
+}
+
+case "$MODE" in
+    part1|part2)
+        parity=0; [[ "$MODE" == part2 ]] && parity=1
+        mapfile -t FILES < <(half "$parity")
+        echo "# tier1 $MODE: ${#FILES[@]}/${#ALL[@]} test files" >&2
+        timeout -k 10 870 "${PYTEST[@]}" \
+            -m 'not slow and not multiprocess' "${FILES[@]}"
+        ;;
+    full)
+        timeout -k 10 870 "${PYTEST[@]}" -m 'not slow' tests/
+        ;;
+    multiprocess)
+        timeout -k 10 870 "${PYTEST[@]}" -m 'multiprocess and not slow' \
+            tests/
+        ;;
+    *)
+        echo "usage: $0 {part1|part2|full|multiprocess}" >&2
+        exit 2
+        ;;
+esac

@@ -1,0 +1,225 @@
+"""Worker for the pod-global sharded-training acceptance tests (ISSUE
+19 — ONE fit data-parallel across every host).
+
+Modes (``sys.argv[5]``):
+
+* ``fit`` — N processes form a cloud; each supplies ONLY its
+  ``mesh.owned_rows`` slice to ``Frame.from_numpy_partitioned`` and the
+  pod trains one GBM + one GLM over the host-partitioned frame. pid 0
+  writes bit-level artifacts (forest digest, float hexes) to `outfile`.
+* ``ref`` — ONE process with ``--xla_force_host_platform_device_count=2``
+  runs the SAME logical data=2 SPMD program over the legacy replicated
+  ingest: the bit-exact reference the ``fit`` pod must match (same mesh
+  shape ⇒ same psum tree ⇒ same float addition order).
+* ``sigkill`` — both processes start a long global fit; pid 1 SIGKILLs
+  itself mid-boost-loop. pid 0's job must FAIL with an infra-classified
+  error within one heartbeat window of the loss being observed — no
+  hang, no leaked RUNNING job.
+* ``bench`` — times the global GBM fit on the partitioned frame and
+  reports rows/sec (pid 0), for bench.py's ``globalfit`` config.
+
+Workers that outlive a dead peer exit via ``os._exit`` — the normal
+distributed teardown would barrier against the corpse.
+"""
+
+import hashlib
+import json
+import os
+import signal
+import sys
+import time
+
+coord, nproc, pid, outfile = sys.argv[1:5]
+mode = sys.argv[5] if len(sys.argv) > 5 else "fit"
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+# the reference run folds the pod's device count into one process so
+# both runs lower the SAME data=2 SPMD program (bit-parity by program
+# identity, not by luck)
+os.environ["XLA_FLAGS"] = ("--xla_force_host_platform_device_count=2"
+                           if mode == "ref"
+                           else "--xla_force_host_platform_device_count=1")
+os.environ.setdefault(
+    "JAX_COMPILATION_CACHE_DIR",
+    os.path.join(os.environ.get("TMPDIR", "/tmp"), "h2o3tpu-test-xlacache"))
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax                                    # noqa: E402
+jax.config.update("jax_default_device", None)
+
+import h2o3_tpu                               # noqa: E402
+if int(nproc) > 1:
+    h2o3_tpu.init(backend="cpu", coordinator_address=coord,
+                  num_processes=int(nproc), process_id=int(pid))
+else:
+    h2o3_tpu.init(backend="cpu")
+
+import numpy as np                            # noqa: E402
+
+from h2o3_tpu.core import recovery as _recovery   # noqa: E402
+from h2o3_tpu.models.gbm import GBMEstimator      # noqa: E402
+from h2o3_tpu.models.glm import GLMEstimator      # noqa: E402
+from h2o3_tpu.parallel import mesh as mesh_mod    # noqa: E402
+
+T0 = time.monotonic()
+# deliberately NOT a multiple of hosts*devices: the padded tail must be
+# invisible in every statistic (the ISSUE 19 padding-parity contract)
+N_ROWS = 4001
+# stopping_rounds enables the per-chunk scorer (scoring history is an
+# acceptance artifact); tolerance 0 never actually stops a 10-tree fit
+GBM_PARAMS = dict(ntrees=10, max_depth=4, seed=3, stopping_rounds=3,
+                  stopping_tolerance=0.0, score_tree_interval=5)
+
+
+def mark(stage):
+    print(f"WORKER-{pid}-STAGE {time.monotonic() - T0:7.2f}s {stage}",
+          flush=True)
+
+
+def build_arrays(n=N_ROWS):
+    r = np.random.RandomState(11)
+    a = r.randn(n)
+    b = r.randn(n)
+    g = r.choice(["u", "v", "w"], n)
+    y = 2.0 * a - b + (g == "u") * 1.5 + r.randn(n) * 0.3
+    return {"a": a, "b": b, "g": g, "y": y}
+
+
+def make_frame():
+    """Partitioned ingest from ONLY this process's owned rows (fit /
+    sigkill / bench modes) or legacy replicated ingest (ref mode)."""
+    full = build_arrays()
+    if mode == "ref":
+        return h2o3_tpu.Frame.from_numpy(full, categorical=["g"])
+    lo, hi = mesh_mod.owned_rows(N_ROWS, block=8)
+    local = {k: v[lo:hi] for k, v in full.items()}
+    mark(f"owned rows [{lo}, {hi})")
+    return h2o3_tpu.Frame.from_numpy_partitioned(
+        local, N_ROWS, categorical=["g"])
+
+
+def forest_digest(forest):
+    """blake2b over every stacked tree array — bit-exact forest id.
+    Snapshots via recovery.snapshot_host: forest leaves are replicated
+    global arrays on a multi-process mesh (not fully addressable)."""
+    h = hashlib.blake2b(digest_size=16)
+    for name, arr in zip(forest._fields, forest):
+        v = np.asarray(_recovery.snapshot_host(arr))
+        h.update(name.encode())
+        h.update(str(v.dtype).encode())
+        h.update(str(v.shape).encode())
+        h.update(np.ascontiguousarray(v).tobytes())
+    return h.hexdigest()
+
+
+def run_fit():
+    fr = make_frame()
+    part_cols = sum(1 for c in fr._cols.values()
+                    if getattr(c, "_part_cache", None) is not None)
+    mark(f"frame up ({part_cols} partitioned cols); training")
+    gbm = GBMEstimator(**GBM_PARAMS).train(fr, y="y")
+    glm = GLMEstimator(family="gaussian", lambda_=0.0).train(fr, y="y")
+    pred = gbm.predict(fr).col("predict").to_numpy()
+    result = {
+        "mode": mode,
+        "process_count": len({d.process_index for d in jax.devices("cpu")}),
+        "mesh_data": mesh_mod.get_mesh().shape[mesh_mod.DATA_AXIS],
+        "partitioned_cols": part_cols,
+        "forest_digest": forest_digest(gbm.forest),
+        "gbm_mse_hex": float(gbm.training_metrics["MSE"]).hex(),
+        "scoring_history": [
+            {"ntrees": int(e["ntrees"]),
+             "deviance_hex": float(e["deviance"]).hex()}
+            for e in gbm.output["scoring_history"]],
+        "gbm_pred_head_hex": [float(v).hex() for v in pred[:32]],
+        "glm_coefficients": {k: float(v)
+                             for k, v in glm.coefficients.items()},
+    }
+    if int(pid) == 0:
+        with open(outfile, "w") as f:
+            json.dump(result, f)
+    print(f"WORKER-{pid}-DONE", flush=True)
+    h2o3_tpu.shutdown()
+
+
+def run_bench():
+    fr = make_frame()
+    ntrees = int(os.environ.get("H2O3TPU_GLOBALFIT_BENCH_NTREES", "30"))
+    GBMEstimator(ntrees=5, max_depth=4, seed=3).train(fr, y="y")  # warmup
+    t0 = time.time()
+    GBMEstimator(ntrees=ntrees, max_depth=4, seed=3).train(fr, y="y")
+    dt = max(time.time() - t0, 1e-9)
+    if int(pid) == 0:
+        with open(outfile, "w") as f:
+            json.dump({"mode": mode, "rows_per_sec": N_ROWS * ntrees / dt,
+                       "seconds": dt, "ntrees": ntrees,
+                       "nrows": N_ROWS}, f)
+    print(f"WORKER-{pid}-DONE", flush=True)
+    h2o3_tpu.shutdown()
+
+
+def run_sigkill():
+    from h2o3_tpu.core import heartbeat, watchdog
+    from h2o3_tpu.core.job import RUNNING, list_jobs
+    fr = make_frame()
+    mark("frame up; starting long global fit")
+    est = GBMEstimator(ntrees=4000, max_depth=5, seed=1)
+    est.train(fr, y="y", background=True)
+    job = est._job
+    deadline = time.monotonic() + 120
+    while job.progress <= 0.0 and job.status == RUNNING \
+            and time.monotonic() < deadline:
+        time.sleep(0.02)
+    mark(f"fit in boost loop (progress={job.progress:.3f})")
+
+    if int(pid) == 1:
+        # victim: die mid-collective, the unclean way a host dies
+        print(f"WORKER-{pid}-KILLING-SELF", flush=True)
+        os.kill(os.getpid(), signal.SIGKILL)
+
+    # survivor (pid 0): the heartbeat monitor flags the dead peer; the
+    # fit must FAIL at the next chunk boundary (or the gloo collective
+    # errors out first — either way classified infra, never a hang)
+    window_s = heartbeat.monitor.interval_s * heartbeat.monitor.miss_budget
+    t_lost = None
+    while time.monotonic() < deadline:
+        if 1 in heartbeat.dead_peers() or not heartbeat.monitor.healthy():
+            t_lost = time.monotonic()
+            break
+        if job.status != RUNNING:
+            # gloo surfaced the death before the heartbeat did
+            t_lost = time.monotonic()
+            break
+        time.sleep(0.02)
+    mark("peer loss observed; waiting for the job to fail fast")
+    job.join(60)
+    fail_after_loss_s = (time.monotonic() - t_lost) if t_lost else None
+    running_leaks = [j["description"] for j in list_jobs()
+                     if j["status"] == RUNNING]
+    exc = job.exception or ""
+    result = {
+        "mode": mode,
+        "job_status": job.status,
+        "job_exception": exc[-800:],
+        "infra_classified": ("CloudUnhealthyError" in exc
+                             or any(s in exc
+                                    for s in watchdog.INFRA_SIGNS)),
+        "heartbeat_window_s": window_s,
+        "fail_after_loss_s": fail_after_loss_s,
+        "running_leaks": running_leaks,
+    }
+    with open(outfile, "w") as f:
+        json.dump(result, f)
+    print(f"WORKER-{pid}-DONE", flush=True)
+    os._exit(0)   # teardown would barrier against the dead peer
+
+
+if mode in ("fit", "ref"):
+    run_fit()
+elif mode == "bench":
+    run_bench()
+elif mode == "sigkill":
+    run_sigkill()
+else:
+    raise SystemExit(f"unknown mode {mode!r}")

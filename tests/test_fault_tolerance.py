@@ -318,7 +318,7 @@ def test_bench_dead_backend_fails_fast_per_config(tmp_path):
     # one per stub config (incl. grid, treekernel, cloud, roofline,
     # checkpoint, memgov, ingest, serving, sched, slo, fleet,
     # durability)
-    assert len(errors) == 15
+    assert len(errors) == 16
     assert all("backend dead" in ln["error"] for ln in errors)
     budget = [ln for ln in lines if ln["metric"] == "budget"][0]
     assert budget["left_s"] >= 0.0

@@ -1,0 +1,329 @@
+"""Pod-global sharded training (ISSUE 19): host-partitioned frames,
+the ``H2O3TPU_GLOBAL_FIT`` knob, padding parity on uneven row counts,
+and the true 2-process acceptance legs — a global GBM fit over a
+host-partitioned frame must bit-match the single-process reference,
+GLM coefficients within 1e-10, and a SIGKILLed peer mid-global-fit
+must fail the survivor's job fast with no RUNNING leak.
+
+Single-process tests run in the ordinary tier-1 cloud (8 CPU devices,
+conftest); the real pods are ``pytest.mark.multiprocess`` and spawn
+``tests/globalfit_worker.py``.
+"""
+
+import json
+import os
+import socket
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+import h2o3_tpu
+from h2o3_tpu.parallel import mesh as mesh_mod
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+WORKER = os.path.join(REPO, "tests", "globalfit_worker.py")
+WORKER_TIMEOUT_S = float(os.environ.get("H2O3TPU_MP_TIMEOUT_S", "300"))
+
+
+# ------------------------------------------------------- knob parsing
+
+
+def test_global_fit_mode_parsing(monkeypatch):
+    for raw, want in [("on", "on"), ("OFF", "off"), ("auto", "auto"),
+                      ("bogus", "auto"), ("", "auto")]:
+        monkeypatch.setenv("H2O3TPU_GLOBAL_FIT", raw)
+        assert mesh_mod.global_fit_mode() == want, raw
+    monkeypatch.delenv("H2O3TPU_GLOBAL_FIT")
+    assert mesh_mod.global_fit_mode() == "auto"      # config default
+    monkeypatch.setenv("H2O3TPU_GLOBAL_FIT", "off")
+    assert not mesh_mod.global_fit_enabled()
+    monkeypatch.setenv("H2O3TPU_GLOBAL_FIT", "on")
+    assert mesh_mod.global_fit_enabled()
+
+
+# ------------------------------------- shard-homing contract (1 proc)
+
+
+def test_partition_bounds_cover_all_rows_single_process():
+    n = 517                      # deliberately n % (devices*block) != 0
+    npad = mesh_mod.padded_rows(n, block=8)
+    lo, hi = mesh_mod.partition_bounds(npad)
+    assert (lo, hi) == (0, npad)
+    assert mesh_mod.owned_rows(n, block=8) == (0, n)
+
+
+def test_put_partitioned_matches_put_sharded_single_process():
+    n = 517
+    npad = mesh_mod.padded_rows(n, block=8)
+    x = np.zeros(npad, dtype=np.float32)
+    x[:n] = np.random.RandomState(0).randn(n)
+    sh = mesh_mod.row_sharding()
+    a = mesh_mod.put_sharded(x, sh)
+    b = mesh_mod.put_partitioned(x, sh, (npad,))
+    assert a.dtype == b.dtype and a.shape == b.shape
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+# --------------------------- padding parity on uneven row counts
+
+
+def _uneven_arrays(n=517):
+    r = np.random.RandomState(7)
+    a = r.randn(n)
+    a[::97] = np.nan                               # NA handling
+    b = (r.randint(-50, 50, n)).astype(np.float64)  # int-narrowed col
+    g = r.choice(["x", "y", "z"], n).astype(object)
+    g[5] = None                                    # categorical NA
+    y = np.nan_to_num(a) * 2.0 - b * 0.1 + r.randn(n) * 0.3
+    return {"a": a, "b": b, "g": g, "y": y}
+
+
+def _both_frames(n=517, pad_to=None):
+    arrays = _uneven_arrays(n)
+    legacy = h2o3_tpu.Frame.from_numpy(
+        arrays, categorical=["g"], pad_to=pad_to)
+    part = h2o3_tpu.Frame.from_numpy_partitioned(
+        dict(arrays), n, categorical=["g"], pad_to=pad_to)
+    return legacy, part
+
+
+def test_partitioned_ingest_bit_identical_uneven_rows():
+    """Single process, nrows not a multiple of devices*block: the
+    partitioned ingest must produce byte-identical device data, NA
+    masks, dtypes, domains and host views — pad rows included."""
+    legacy, part = _both_frames()
+    for name in legacy.names:
+        cl, cp = legacy.col(name), part.col(name)
+        assert cl.type == cp.type and cl.domain == cp.domain, name
+        assert cl.data.dtype == cp.data.dtype, name
+        np.testing.assert_array_equal(
+            np.asarray(cl.data), np.asarray(cp.data), err_msg=name)
+        np.testing.assert_array_equal(
+            np.asarray(cl.na_mask), np.asarray(cp.na_mask), err_msg=name)
+        np.testing.assert_array_equal(
+            cl.host_view(), cp.host_view(), err_msg=name)
+
+
+def test_partitioned_ingest_off_knob_is_identity_single_process(
+        monkeypatch):
+    monkeypatch.setenv("H2O3TPU_GLOBAL_FIT", "off")
+    legacy, part = _both_frames()
+    for name in legacy.names:
+        np.testing.assert_array_equal(
+            np.asarray(legacy.col(name).data),
+            np.asarray(part.col(name).data), err_msg=name)
+
+
+def test_weighted_mean_ignores_pad_rows():
+    """The masked rollup reduction (NA-masked sum + valid-row count):
+    pad rows must be invisible — exactly — on both ingest paths and
+    under extra ``pad_to`` padding."""
+    import jax.numpy as jnp
+
+    from h2o3_tpu.parallel.map_reduce import frame_reduce
+    n = 517
+    np_b = _uneven_arrays(n)["b"]
+    vals = {}
+    for tag, pad_to in [("tight", None), ("wide", 2048)]:
+        legacy, part = _both_frames(n, pad_to=pad_to)
+        for kind, fr in [("legacy", legacy), ("part", part)]:
+            col = fr.col("b")
+            w = (~col.na_mask).astype(jnp.float32)
+            xz = jnp.where(col.na_mask, 0.0,
+                           col.data.astype(jnp.float32))
+            sw, swx = frame_reduce(
+                lambda wl, xl: (jnp.sum(wl), jnp.sum(xl)), w, xz)
+            vals[(tag, kind)] = (float(sw), float(swx))
+            assert fr.mean("b") == pytest.approx(float(np_b.mean()),
+                                                 rel=1e-5)
+        assert vals[(tag, "legacy")] == vals[(tag, "part")], tag
+    # the NA-masked count sees exactly the n real rows in every layout
+    assert all(v[0] == float(n) for v in vals.values()), vals
+    want = float(np.asarray(_uneven_arrays(n)["b"],
+                            dtype=np.float32).sum(dtype=np.float64))
+    for v in vals.values():
+        assert abs(v[1] - want) < 1e-2 * max(abs(want), 1.0)
+
+
+def test_histogram_pad_parity_uneven_rows():
+    """GBM histogram: rows with w == 0 (mesh padding) contribute
+    nothing, regardless of how much padding the layout carries."""
+    from h2o3_tpu.ops.histogram import histogram
+    from h2o3_tpu.parallel.mesh import get_mesh, shard_rows
+    r = np.random.RandomState(3)
+    n, L, B = 517, 4, 16
+    mesh = get_mesh()
+    bins_r = r.randint(0, B, size=(n, 2)).astype(np.int32)
+    nid_r = r.randint(0, L, size=n).astype(np.int32)
+    w_r = np.ones(n, dtype=np.float32)
+    g_r = r.randn(n).astype(np.float32)
+    h_r = np.abs(r.randn(n)).astype(np.float32)
+
+    def _hist(npad, pad_fill):
+        pad = npad - n
+        rf = np.random.RandomState(pad_fill)
+        fills = (rf.randint(0, B, size=(pad, 2)).astype(np.int32),
+                 rf.randint(0, L, size=pad).astype(np.int32),
+                 np.zeros(pad, dtype=np.float32),          # w == 0 always
+                 rf.randn(pad).astype(np.float32),
+                 rf.randn(pad).astype(np.float32))
+        args = [np.concatenate([a, f])
+                for a, f in zip((bins_r, nid_r, w_r, g_r, h_r), fills)]
+        return np.asarray(histogram(
+            shard_rows(args[0]), shard_rows(args[1]), shard_rows(args[2]),
+            shard_rows(args[3]), shard_rows(args[4]),
+            n_nodes=L, n_bins=B, mesh=mesh))
+
+    npad = mesh_mod.padded_rows(n, block=8)
+    # same padded shape, different garbage under the w==0 pad rows:
+    # bit-exact — zero-weight rows contribute nothing at all
+    a = _hist(npad, pad_fill=1)
+    b = _hist(npad, pad_fill=2)
+    np.testing.assert_array_equal(a, b)
+    # a wider layout only re-blocks the scan (f32 reassociation), it
+    # never lets pad rows leak mass in: counts exact, moments tight
+    c = _hist(2048, pad_fill=3)
+    np.testing.assert_array_equal(a[..., 0], c[..., 0])
+    np.testing.assert_allclose(a, c, rtol=1e-4, atol=1e-5)
+    # the w-plane tallies exactly the n real rows
+    assert float(a[..., 0].sum()) == float(n) * bins_r.shape[1]
+
+
+def test_gbm_fit_uneven_rows_partitioned_matches_legacy():
+    from h2o3_tpu.models.gbm import GBMEstimator
+    legacy, part = _both_frames()
+    m1 = GBMEstimator(ntrees=5, max_depth=3, seed=3).train(legacy, y="y")
+    m2 = GBMEstimator(ntrees=5, max_depth=3, seed=3).train(part, y="y")
+    for f1, f2 in zip(m1.forest, m2.forest):
+        np.testing.assert_array_equal(np.asarray(f1), np.asarray(f2))
+    assert float(m1.training_metrics["MSE"]) \
+        == float(m2.training_metrics["MSE"])
+
+
+def test_glm_gram_uneven_rows_partitioned_matches_legacy():
+    from h2o3_tpu.models.glm import GLMEstimator
+    n = 517
+    r = np.random.RandomState(13)
+    arrays = {"a": r.randn(n), "b": r.randn(n)}
+    arrays["y"] = 2.0 * arrays["a"] - arrays["b"] + r.randn(n) * 0.1
+    legacy = h2o3_tpu.Frame.from_numpy(dict(arrays))
+    part = h2o3_tpu.Frame.from_numpy_partitioned(dict(arrays), n)
+    g1 = GLMEstimator(family="gaussian", lambda_=0.0).train(legacy, y="y")
+    g2 = GLMEstimator(family="gaussian", lambda_=0.0).train(part, y="y")
+    assert g1.coefficients == g2.coefficients     # same gram, same solve
+    # pads carry zero weight: the gram solve agrees with the dense
+    # normal-equations reference over ONLY the real rows
+    X = np.column_stack([arrays["a"], arrays["b"], np.ones(n)])
+    ref, *_ = np.linalg.lstsq(X, arrays["y"], rcond=None)
+    got = [g1.coefficients["a"], g1.coefficients["b"],
+           g1.coefficients["Intercept"]]
+    np.testing.assert_allclose(got, ref, atol=5e-4)
+
+
+# --------------------------------------------- the real 2-process legs
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _run_pod(tmp_path, mode, nproc, extra_env=None):
+    out = str(tmp_path / f"{mode}.json")
+    coord = f"127.0.0.1:{_free_port()}"
+    env = dict(os.environ)
+    env.pop("JAX_PLATFORMS", None)
+    env.update(extra_env or {})
+    procs = [
+        subprocess.Popen(
+            [sys.executable, WORKER, coord, str(nproc), str(i), out, mode],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            text=True)
+        for i in range(nproc)
+    ]
+    logs = []
+    deadline = time.time() + WORKER_TIMEOUT_S
+    for p in procs:
+        try:
+            stdout, _ = p.communicate(timeout=max(deadline - time.time(),
+                                                  1.0))
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                if q.poll() is None:
+                    q.kill()
+            stdout, _ = p.communicate()
+            stdout = (stdout or "") + \
+                f"\n[TIMEOUT after {WORKER_TIMEOUT_S:.0f}s]"
+        logs.append(stdout)
+    joined = "\n".join(f"--- worker {j} ({mode}) ---\n{lg[-3000:]}"
+                       for j, lg in enumerate(logs))
+    for i, p in enumerate(procs):
+        if mode == "sigkill" and i == 1:
+            assert p.returncode not in (0, None), \
+                f"victim survived its own SIGKILL:\n{joined}"
+            continue
+        assert p.returncode == 0, \
+            f"worker {i} ({mode}) failed rc={p.returncode}:\n{joined}"
+    with open(out) as f:
+        return json.load(f)
+
+
+@pytest.fixture(scope="module")
+def acceptance(tmp_path_factory):
+    """fit pod (2 procs, host-partitioned) + ref run (1 proc, 2
+    devices): the SAME data=2 SPMD program, so bit-parity is a program
+    identity, not a tolerance."""
+    tmp = tmp_path_factory.mktemp("globalfit")
+    fit = _run_pod(tmp, "fit", 2)
+    ref = _run_pod(tmp, "ref", 1)
+    return fit, ref
+
+
+@pytest.mark.multiprocess
+def test_global_fit_trains_on_host_partitioned_frame(acceptance):
+    fit, ref = acceptance
+    assert fit["process_count"] == 2
+    assert fit["mesh_data"] == ref["mesh_data"] == 2
+    # every column's device data is host-partitioned, none replicated
+    assert fit["partitioned_cols"] == 4
+    assert ref["partitioned_cols"] == 0
+
+
+@pytest.mark.multiprocess
+def test_global_gbm_bit_matches_single_process_reference(acceptance):
+    fit, ref = acceptance
+    assert fit["forest_digest"] == ref["forest_digest"]
+    assert fit["gbm_mse_hex"] == ref["gbm_mse_hex"]
+    assert fit["scoring_history"] == ref["scoring_history"]
+    assert fit["scoring_history"], "no scoring history recorded"
+    assert fit["gbm_pred_head_hex"] == ref["gbm_pred_head_hex"]
+
+
+@pytest.mark.multiprocess
+def test_global_glm_coefficients_match_reference(acceptance):
+    fit, ref = acceptance
+    assert set(fit["glm_coefficients"]) == set(ref["glm_coefficients"])
+    for k, v in ref["glm_coefficients"].items():
+        assert abs(fit["glm_coefficients"][k] - v) < 1e-10, k
+
+
+@pytest.mark.multiprocess
+def test_sigkill_mid_global_fit_fails_fast_no_running_leak(
+        tmp_path_factory):
+    res = _run_pod(tmp_path_factory.mktemp("globalfit_kill"), "sigkill", 2,
+                   extra_env={"H2O3TPU_HEARTBEAT_INTERVAL_S": "0.25",
+                              "H2O3TPU_HEARTBEAT_MISS_BUDGET": "2"})
+    assert res["job_status"] == "FAILED", res
+    assert res["infra_classified"], res["job_exception"]
+    # fail-fast: within one heartbeat window of observing the loss,
+    # plus one chunk dispatch (bounded generously for busy CI hosts)
+    assert res["fail_after_loss_s"] is not None
+    assert res["fail_after_loss_s"] < max(10.0,
+                                          4 * res["heartbeat_window_s"]), res
+    assert res["running_leaks"] == [], res

@@ -152,7 +152,12 @@ def test_collective_bytes_charged_to_span():
     assert telemetry.REGISTRY.value("frame_reduce_total") == before + 1
     # 8-device test mesh -> nonzero psum estimate, charged to the span
     assert sp.collective_bytes > 0
-    assert telemetry.REGISTRY.value("collective_bytes_total") > 0
+    # scope-labeled accounting (ISSUE 19): one process ⇒ every ring
+    # link is intra-host; the pod series exists but stays zero
+    assert telemetry.REGISTRY.value("collective_bytes_total",
+                                    scope="host") > 0
+    assert telemetry.REGISTRY.value("collective_bytes_total",
+                                    scope="pod") == 0
 
 
 # ---------------------------------------------------- compile observer
