@@ -1440,6 +1440,14 @@ def bench_globalfit():
         if mode != "sigkill":
             assert all(p.returncode == 0 for p in procs), \
                 f"globalfit {mode} pod failed"
+        else:
+            # pid 1 SIGKILLs itself by design, but the surviving pid 0
+            # must exit cleanly — a crashed/killed survivor would make
+            # any report on disk stale, not a valid result
+            assert procs[0].returncode == 0, \
+                "globalfit sigkill survivor (pid 0) did not exit cleanly"
+        assert os.path.exists(out), \
+            f"globalfit {mode} pod wrote no report (hung and killed?)"
         with open(out) as f:
             return json.load(f)
 

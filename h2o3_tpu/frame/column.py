@@ -80,20 +80,24 @@ class Column:
             return self.strings[: self.nrows]
         host = getattr(self, "_host_cache", None)
         if host is None:
-            part = getattr(self, "_part_cache", None)
-            if part is not None:
-                # host-partitioned column (column_from_partitioned):
-                # assemble the full exact-f64 view from the per-process
-                # slabs — ONE control-plane allgather, then cached like
-                # every other host view
-                host = np.asarray(
-                    gather_partitioned_host(part))[: self.nrows]
-            else:
-                from h2o3_tpu.parallel.mesh import fetch_replicated
-                data, mask = fetch_replicated((self.data, self.na_mask))
-                x = data[: self.nrows].astype(np.float64)
-                x[mask[: self.nrows]] = np.nan
-                host = x
+            if getattr(self, "_part_cache", None) is not None:
+                # host-partitioned columns get their host cache seeded
+                # eagerly at ingest (seed_partitioned_host_caches — a
+                # guaranteed collective point). Assembling it HERE would
+                # require a cross-process device collective, and
+                # host_view() runs in single-process contexts (REST
+                # handlers, scheduled work items) whose contract forbids
+                # collectives — so a missing cache is a bug, never
+                # something to gather lazily.
+                raise RuntimeError(
+                    f"partitioned column {self.name!r} has no host cache;"
+                    " it must be seeded at ingest"
+                    " (seed_partitioned_host_caches)")
+            from h2o3_tpu.parallel.mesh import fetch_replicated
+            data, mask = fetch_replicated((self.data, self.na_mask))
+            x = data[: self.nrows].astype(np.float64)
+            x[mask[: self.nrows]] = np.nan
+            host = x
             object.__setattr__(self, "_host_cache", host)
         return host
 
@@ -116,20 +120,15 @@ def prefetch_host(cols: List["Column"]) -> None:
             and getattr(c, "_host_cache", None) is None]
     if not todo:
         return
-    part_todo = [c for c in todo
-                 if getattr(c, "_part_cache", None) is not None]
-    if part_todo:
-        # host-partitioned columns: one batched slab allgather (exact
-        # f64 — the device arrays may be narrowed to f32)
-        gathered = gather_partitioned_host(
-            [c._part_cache for c in part_todo])
-        for c, full in zip(part_todo, gathered):
-            object.__setattr__(c, "_host_cache",
-                               np.asarray(full)[: c.nrows])
-        todo = [c for c in todo
-                if getattr(c, "_host_cache", None) is None]
-        if not todo:
-            return
+    stale = [c.name for c in todo
+             if getattr(c, "_part_cache", None) is not None]
+    if stale:
+        # see host_view(): partitioned host caches are seeded at ingest;
+        # prefetch_host may run in single-process contexts, so it must
+        # never assemble them here (that would take a collective)
+        raise RuntimeError(
+            f"partitioned columns {stale} have no host cache; they must "
+            "be seeded at ingest (seed_partitioned_host_caches)")
     from h2o3_tpu.parallel.mesh import fetch_replicated
     fetched = fetch_replicated([(c.data, c.na_mask) for c in todo])
     for c, (data, mask) in zip(todo, fetched):
@@ -226,13 +225,52 @@ def gather_partitioned_host(slabs):
     """Assemble full host arrays from per-process partitioned slabs
     (pytree in, matching pytree of full arrays out). Process order IS
     row order — asserted by Frame.from_numpy_partitioned at ingest.
-    Single process: the slab already covers every row."""
+    Single process: the slab already covers every row.
+
+    COLLECTIVE: multihost_utils.process_allgather is an SPMD *device*
+    collective — every process must reach this call at the same program
+    point, or the pod wedges until the cloud timeout. The only caller is
+    seed_partitioned_host_caches under Frame.from_numpy_partitioned,
+    which is collective by contract; never call this from a
+    single-process context (REST handlers, scheduled work items).
+
+    Slabs travel as raw BYTES (uint8 views, reinterpreted on arrival):
+    pushing the f64 host slabs through jax directly would silently
+    truncate them to f32 (x64 is off under jit), breaking the exact-f64
+    host-view contract every oracle test pins."""
     import jax
     if jax.process_count() == 1:
         return slabs
     from jax.experimental import multihost_utils
-    return jax.device_get(multihost_utils.process_allgather(
-        slabs, tiled=True))
+    leaves, treedef = jax.tree_util.tree_flatten(slabs)
+    as_bytes = [np.ascontiguousarray(v).view(np.uint8) for v in leaves]
+    gathered = jax.device_get(
+        multihost_utils.process_allgather(as_bytes, tiled=True))
+    out = [np.asarray(g).view(v.dtype)
+           for g, v in zip(gathered, leaves)]
+    return jax.tree_util.tree_unflatten(treedef, out)
+
+
+def seed_partitioned_host_caches(cols: List["Column"]) -> None:
+    """Fill the host caches of host-partitioned columns with ONE batched
+    slab allgather (exact f64 — the device arrays may be narrowed to
+    f32). Called by Frame.from_numpy_partitioned, a guaranteed
+    collective point, so later host_view()/prefetch_host() calls from a
+    SINGLE process (REST handlers, scheduled work items — contexts whose
+    contract forbids cross-process collectives) hit the cache and never
+    need peer participation — the partitioned analogue of
+    column_from_numpy's eager multi-process host-cache seed. Each
+    process ends up holding the full f64 host view (same host-memory
+    footprint as the replicated ingest); device data stays partitioned.
+    """
+    todo = [c for c in cols
+            if getattr(c, "_part_cache", None) is not None
+            and getattr(c, "_host_cache", None) is None]
+    if not todo:
+        return
+    gathered = gather_partitioned_host([c._part_cache for c in todo])
+    for c, full in zip(todo, gathered):
+        object.__setattr__(c, "_host_cache", np.asarray(full)[: c.nrows])
 
 
 def column_from_partitioned(name: str, values: np.ndarray, *,
@@ -260,8 +298,15 @@ def column_from_partitioned(name: str, values: np.ndarray, *,
         assert domain is not None, (
             "partitioned string-typed ingest requires the merged domain")
         lut = {lvl: i for i, lvl in enumerate(domain)}
-        codes = np.asarray([lut.get(v, -1) if v is not None else -1
-                            for v in values], np.int32)
+        # str-coerce before the lookup: the merged domain holds str(u)
+        # levels (partition.local_str_levels), so non-str objects in an
+        # object column (ints/floats mixed with strings) must code
+        # through their str form like the replicated auto-factorize
+        # path — not silently become NA
+        codes = np.asarray(
+            [lut.get(v if isinstance(v, str) else str(v), -1)
+             if v is not None else -1
+             for v in values], np.int32)
         na = codes < 0
         data = np.where(na, 0, codes).astype(np.int32)
         ctype = T_CAT
@@ -293,9 +338,11 @@ def column_from_partitioned(name: str, values: np.ndarray, *,
         na_mask=put_partitioned(na, sharding, (npad,)),
         nrows=nrows, domain=domain)
     # exact-f64 host semantics: retain THIS process's padded f64 slab;
-    # the first host_view() allgathers the slabs (one control-plane
-    # collective, gather_partitioned_host) and caches the full view —
-    # the partitioned analogue of column_from_numpy's _host_cache seed
+    # Frame.from_numpy_partitioned then assembles the full host view
+    # from every process's slabs in one batched device collective
+    # (seed_partitioned_host_caches) while all processes are still at
+    # the same program point — host_view() itself must stay
+    # collective-free
     slab = data.astype(np.float64)
     slab[na] = np.nan
     if vals64 is not None and data.dtype == np.float32:

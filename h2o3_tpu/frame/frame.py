@@ -113,7 +113,10 @@ class Frame:
         training): every process calls this at the same program point
         with ONLY its ``mesh.owned_rows(nrows, block=block)`` slice of
         each column, and the frame's device data comes up host-
-        partitioned — no process materializes (or ships) peer rows. The
+        partitioned — no process's *devices* ever hold peer rows. (Each
+        process does retain the full exact-f64 host-side view, seeded
+        here by one batched allgather, so the collective-free host
+        surface — REST handlers, scheduled items — works unchanged.) The
         codec decisions the replicated path makes from the full host
         array are agreed in one coordination-KV exchange
         (frame/partition.py), so the resulting global device bytes are
@@ -126,7 +129,8 @@ class Frame:
         are host-side objects that never enter math paths; ingest them
         replicated."""
         from h2o3_tpu.frame import partition as part_mod
-        from h2o3_tpu.frame.column import column_from_partitioned
+        from h2o3_tpu.frame.column import (column_from_partitioned,
+                                           seed_partitioned_host_caches)
         names = list(arrays.keys())
         nrows = int(nrows)
         nproc = jax.process_count()
@@ -187,6 +191,12 @@ class Frame:
                 name, v, span=(lo, hi), nrows=nrows, npad=npad,
                 sharding=shard, domain=dom, facts=facts,
                 time=name in times))
+        # seed every column's full f64 host view NOW, in one batched
+        # allgather, while every process is provably at this collective
+        # point — host_view()/prefetch_host() run in single-process
+        # contexts (REST handlers, scheduled work items) that must never
+        # issue a cross-process collective
+        seed_partitioned_host_caches(cols)
         return Frame(cols, nrows, key=key)
 
     @staticmethod

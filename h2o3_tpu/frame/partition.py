@@ -106,15 +106,18 @@ def allgather_rows(arrays: Dict[str, np.ndarray]) -> Dict[str, np.ndarray]:
     b64 = _encode(pickle.dumps({k: np.asarray(v)
                                 for k, v in arrays.items()}))
     nparts = (len(b64) + _B64_CHUNK - 1) // _B64_CHUNK
+    mine: List[str] = []        # this exchange's keys, deleted below
     for j in range(nparts):
         key = f"{prefix}{pid}/p{j}"
         client.key_value_set(key, b64[j * _B64_CHUNK:(j + 1) * _B64_CHUNK],
                              allow_overwrite=True)
         _PUBLISHED.append(key)
+        mine.append(key)
     meta_key = f"{prefix}{pid}/meta"
     client.key_value_set(meta_key, json.dumps({"parts": nparts}),
                          allow_overwrite=True)
     _PUBLISHED.append(meta_key)
+    mine.append(meta_key)
     client.wait_at_barrier(f"h2o3tpu_ingest_gather_{seq}", _timeout_ms())
     out: Dict[str, np.ndarray] = {}
     for p in range(nproc):
@@ -129,6 +132,21 @@ def allgather_rows(arrays: Dict[str, np.ndarray]) -> Dict[str, np.ndarray]:
         else:
             for k, v in block.items():
                 out[k].append(v)
+    # the blobs are dead the moment every peer has read them: second
+    # barrier (all reads done), then delete this exchange's keys NOW —
+    # otherwise each off-mode ingest leaves dataset-sized blobs (×nproc)
+    # resident in the coordination service until cloud shutdown, and
+    # _PUBLISHED grows without bound across ingests. The shutdown sweep
+    # stays as the backstop for exchanges that die between the barriers.
+    client.wait_at_barrier(f"h2o3tpu_ingest_gather_done_{seq}",
+                           _timeout_ms())
+    for key in mine:
+        try:
+            client.key_value_delete(key)
+        except Exception:   # noqa: BLE001 - absent key / service down
+            pass
+    done = set(mine)
+    _PUBLISHED[:] = [k for k in _PUBLISHED if k not in done]
     return {k: np.concatenate(vs) if len(vs) > 1 else vs[0]
             for k, vs in out.items()}
 

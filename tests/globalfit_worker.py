@@ -117,15 +117,35 @@ def run_fit():
     fr = make_frame()
     part_cols = sum(1 for c in fr._cols.values()
                     if getattr(c, "_part_cache", None) is not None)
+    if int(pid) == 1 or int(nproc) == 1:
+        # asymmetric single-process host access (the REST-handler /
+        # scheduled-item contract): ONLY this process reads the host
+        # view, so it must come from the ingest-seeded cache — a lazy
+        # cross-process gather here would wedge the pod (peers are not
+        # at this program point)
+        hv = fr.col("a").host_view()
+        assert hv.shape[0] == N_ROWS and \
+            np.array_equal(hv, build_arrays()["a"]), "host_view parity"
+        mark("asymmetric host_view ok")
     mark(f"frame up ({part_cols} partitioned cols); training")
     gbm = GBMEstimator(**GBM_PARAMS).train(fr, y="y")
     glm = GLMEstimator(family="gaussian", lambda_=0.0).train(fr, y="y")
     pred = gbm.predict(fr).col("predict").to_numpy()
+    gather_keys = 0
+    if int(nproc) > 1:
+        # the off-mode devolution must not leave dataset-sized gather
+        # blobs resident in the coordination service; queried AFTER
+        # training so the peer's post-barrier deletes (issued right
+        # after its allgather_rows read) have long landed
+        from h2o3_tpu.frame import partition as part_mod
+        gather_keys = len(list(part_mod._client().key_value_dir_get(
+            part_mod.KV_PREFIX + "gather/")))
     result = {
         "mode": mode,
         "process_count": len({d.process_index for d in jax.devices("cpu")}),
         "mesh_data": mesh_mod.get_mesh().shape[mesh_mod.DATA_AXIS],
         "partitioned_cols": part_cols,
+        "gather_keys_resident": gather_keys,
         "forest_digest": forest_digest(gbm.forest),
         "gbm_mse_hex": float(gbm.training_metrics["MSE"]).hex(),
         "scoring_history": [

@@ -107,6 +107,40 @@ def test_partitioned_ingest_bit_identical_uneven_rows():
             cl.host_view(), cp.host_view(), err_msg=name)
 
 
+def test_partitioned_ingest_codes_non_str_objects():
+    """Object columns holding non-str values (ints read back from a
+    python list, say) must code through their str() form like the
+    replicated auto-factorize path — not silently become NA because the
+    merged domain interned str(u) levels."""
+    n = 517
+    r = np.random.RandomState(5)
+    g = r.randint(1, 10, n).astype(object)        # non-str objects
+    g[7] = None                                   # the only genuine NA
+    arrays = {"g": g, "y": r.randn(n)}
+    legacy = h2o3_tpu.Frame.from_numpy(dict(arrays))
+    part = h2o3_tpu.Frame.from_numpy_partitioned(dict(arrays), n)
+    cl, cp = legacy.col("g"), part.col("g")
+    assert cl.type == cp.type == "categorical"
+    assert cl.domain == cp.domain
+    np.testing.assert_array_equal(np.asarray(cl.data), np.asarray(cp.data))
+    np.testing.assert_array_equal(np.asarray(cl.na_mask),
+                                  np.asarray(cp.na_mask))
+    # exactly one NA (the None) — the pre-fix symptom was all-NA codes
+    assert int(np.asarray(cp.na_mask)[:n].sum()) == 1
+
+
+def test_partitioned_host_view_is_seeded_at_ingest():
+    """host_view()/prefetch_host() run in single-process contexts (REST
+    handlers, scheduled items) that must never issue a collective: the
+    full f64 host cache is seeded AT INGEST, the one guaranteed
+    collective point."""
+    _, part = _both_frames()
+    for name in part.names:
+        c = part.col(name)
+        if getattr(c, "_part_cache", None) is not None:
+            assert getattr(c, "_host_cache", None) is not None, name
+
+
 def test_partitioned_ingest_off_knob_is_identity_single_process(
         monkeypatch):
     monkeypatch.setenv("H2O3TPU_GLOBAL_FIT", "off")
@@ -327,3 +361,35 @@ def test_sigkill_mid_global_fit_fails_fast_no_running_leak(
     assert res["fail_after_loss_s"] < max(10.0,
                                           4 * res["heartbeat_window_s"]), res
     assert res["running_leaks"] == [], res
+
+
+@pytest.mark.multiprocess
+def test_global_fit_host_caches_and_gather_blobs_2proc(acceptance):
+    """The fit pod's worker makes an ASYMMETRIC host_view() call (only
+    pid 1) before training — proof the host cache was seeded at ingest
+    and single-process host access needs no peer participation (a lazy
+    collective there would wedge the pod and fail the whole fixture)."""
+    fit, _ = acceptance
+    # no ingest gather blobs may survive the exchange either (the
+    # off-mode devolution path deletes them right after the barrier)
+    assert fit["gather_keys_resident"] == 0
+
+
+@pytest.mark.multiprocess
+def test_global_fit_off_devolves_to_replicated_2proc(tmp_path_factory,
+                                                     acceptance):
+    """H2O3TPU_GLOBAL_FIT=off on a 2-process cloud: partitioned ingest
+    devolves to the legacy replicated layout via the control-plane row
+    allgather — same SPMD program as the reference, so the fit still
+    bit-matches, no column is host-partitioned, and the dataset-sized
+    gather blobs are deleted from the coordination service as soon as
+    every peer has read them."""
+    off = _run_pod(tmp_path_factory.mktemp("globalfit_off"), "fit", 2,
+                   extra_env={"H2O3TPU_GLOBAL_FIT": "off"})
+    _, ref = acceptance
+    assert off["partitioned_cols"] == 0
+    assert off["gather_keys_resident"] == 0
+    assert off["forest_digest"] == ref["forest_digest"]
+    assert off["gbm_mse_hex"] == ref["gbm_mse_hex"]
+    for k, v in ref["glm_coefficients"].items():
+        assert abs(off["glm_coefficients"][k] - v) < 1e-10, k
