@@ -204,14 +204,26 @@ def _roofline_fields(algo):
     """Hardware-relative axis per config (telemetry/roofline.py): the
     last fit's MFU and HBM-bandwidth utilization as FRACTIONS of the
     detected device peaks — BENCH rounds become comparable across
-    backends, not just across rows/sec."""
+    backends, not just across rows/sec. Rides the step-profiler phase
+    breakdown (telemetry/stepprof.py) along: every BENCH line says not
+    just how fast but WHERE the step wall-clock went."""
+    out = {}
     try:
         from h2o3_tpu.telemetry import roofline
         f = roofline.last_fit(algo)
-        return {"mfu": round(f["mfu"], 6),
-                "hbm_util": round(f["hbm_util"], 6)}
+        out.update({"mfu": round(f["mfu"], 6),
+                    "hbm_util": round(f["hbm_util"], 6)})
     except Exception:   # noqa: BLE001 - accounting must never fail a config
-        return {}
+        pass
+    try:
+        from h2o3_tpu.telemetry import stepprof
+        ph = stepprof.last_fit_phases(algo)
+        if ph.get("phases"):
+            out["phases"] = ph["phases"]
+            out["collective_share"] = ph.get("collective_share", 0.0)
+    except Exception:   # noqa: BLE001
+        pass
+    return out
 
 
 # ---------------------------------------------------------------- configs
@@ -1451,9 +1463,24 @@ def bench_globalfit():
         with open(out) as f:
             return json.load(f)
 
+    def _host_phases(tmp, mode, nproc):
+        """Per-pid step-profiler splits the workers dropped next to the
+        report file — the WHY behind the rows/sec ratio (compute vs
+        collective wait vs host, per host)."""
+        out = {}
+        base = os.path.join(tmp, f"{mode}_{nproc}.json")
+        for i in range(nproc):
+            try:
+                with open(f"{base}.phases.{i}") as f:
+                    out[str(i)] = json.load(f)
+            except Exception:   # noqa: BLE001 - table is best-effort
+                pass
+        return out
+
     with tempfile.TemporaryDirectory() as tmp:
         one = _pod("bench", 1, tmp)
         two = _pod("bench", 2, tmp)
+        host_phases = _host_phases(tmp, "bench", 2)
         ratio = two["rows_per_sec"] / max(one["rows_per_sec"], 1e-9)
         _emit("globalfit GBM rows/sec, 2-host gloo pod on a host-"
               "partitioned frame (1-core container: both hosts "
@@ -1462,7 +1489,24 @@ def bench_globalfit():
               two["rows_per_sec"], "rows/sec", ratio,
               "same fit on 1 host",
               one_host_rows_per_sec=round(one["rows_per_sec"], 1),
-              ntrees=two["ntrees"], nrows=two["nrows"])
+              ntrees=two["ntrees"], nrows=two["nrows"],
+              host_phases=host_phases)
+        # human-readable per-host phase table next to the rows/sec line
+        if host_phases:
+            print("# globalfit per-host phase breakdown "
+                  "(seconds; telemetry/stepprof.py)", flush=True)
+            print(f"# {'host':>4} {'compute':>9} {'collective':>11} "
+                  f"{'hostprep':>9} {'checkpoint':>10} {'coll%':>6}",
+                  flush=True)
+            for h in sorted(host_phases):
+                ph = host_phases[h].get("phases") or {}
+                tot = sum(ph.values()) or 1.0
+                print(f"# {h:>4} {ph.get('compute', 0.0):>9.3f} "
+                      f"{ph.get('collective', 0.0):>11.3f} "
+                      f"{ph.get('host', 0.0):>9.3f} "
+                      f"{ph.get('checkpoint', 0.0):>10.3f} "
+                      f"{100.0 * ph.get('collective', 0.0) / tot:>5.1f}%",
+                      flush=True)
 
         kill = _pod("sigkill", 2, tmp,
                     {"H2O3TPU_HEARTBEAT_INTERVAL_S": "0.25",
@@ -2067,6 +2111,87 @@ def _stub_globalfit():
           n_merge / dt, "merges/sec", 1.0, "stub", rounds=200)
 
 
+def _stub_stepprof():
+    """`stepprof` line without a backend (ISSUE 20): the step-profiler
+    phase partition + ring bound, the pure skew/straggler verdict on
+    synthetic 2-peer snapshots, and scripts/benchdiff.py's pass/fail
+    contract (identical pair passes, a 30% step-time regression fails)
+    — all stdlib + registry, no jax."""
+    import importlib.util
+    import json as _json
+    import tempfile
+    from h2o3_tpu.telemetry import stepprof
+
+    stepprof.reset()
+    t0 = time.time()
+    # -- ring bound + partition ---------------------------------------
+    os.environ["H2O3TPU_STEPPROF_RING"] = "8"
+    try:
+        prof = stepprof.start("stub", nrows=1000)
+        assert prof is not None
+        for _ in range(50):
+            stepprof.chunk_begin()
+            stepprof.compute_done(None)
+            stepprof.chunk_end()
+        d = stepprof.finish(prof, model_key="stub_model", seconds=None)
+    finally:
+        os.environ.pop("H2O3TPU_STEPPROF_RING", None)
+    assert len(d["ring"]) == 8, f"ring unbounded: {len(d['ring'])}"
+    assert d["chunks"] == 50
+    assert abs(sum(d["phases"].values()) - d["seconds"]) < 0.25, d
+    assert stepprof.profile_for("stub_model")["algo"] == "stub"
+
+    # -- skew verdict on synthetic 2-peer snapshots -------------------
+    # peer 1 is the straggler: big SELF time, small collective wait;
+    # peer 0 spent half its wall blocked at the barrier
+    skew = stepprof.compute_skew({
+        "0": {"proc": 0, "seconds": 10.0,
+              "phases": {"host": 1.0, "compute": 4.0,
+                         "collective": 5.0, "checkpoint": 0.0}},
+        "1": {"proc": 1, "seconds": 10.0,
+              "phases": {"host": 2.0, "compute": 7.5,
+                         "collective": 0.5, "checkpoint": 0.0}}})
+    assert skew["straggler_proc"] == 1, skew
+    assert skew["skew_ratio"] > 1.5, skew
+    assert skew["hosts"]["0"]["collective_share"] > \
+        skew["hosts"]["1"]["collective_share"], skew
+
+    # -- benchdiff pass/fail contract ---------------------------------
+    bd_path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                           "scripts", "benchdiff.py")
+    spec = importlib.util.spec_from_file_location("benchdiff", bd_path)
+    bd = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(bd)
+    with tempfile.TemporaryDirectory() as tmp:
+        old = os.path.join(tmp, "old.json")
+        new = os.path.join(tmp, "new.json")
+        base = [{"metric": "fit_step", "value": 1.0, "unit": "seconds",
+                 "phases": {"host": 0.2, "compute": 0.8}},
+                {"metric": "gbm_rows", "value": 1e6, "unit": "rows/sec"}]
+        regressed = [{"metric": "fit_step", "value": 1.3,
+                      "unit": "seconds",
+                      "phases": {"host": 0.2, "compute": 1.1}},
+                     {"metric": "gbm_rows", "value": 1e6,
+                      "unit": "rows/sec"}]
+        with open(old, "w") as f:
+            _json.dump(base, f)
+        with open(new, "w") as f:
+            _json.dump(regressed, f)
+        rc_same = bd.main([old, old])
+        rc_reg = bd.main([old, new])
+    assert rc_same == 0, f"identical pair must pass, rc={rc_same}"
+    assert rc_reg == 1, f"30% regression must fail, rc={rc_reg}"
+
+    dt = max(time.time() - t0, 1e-6)
+    _emit("stepprof phase partition + skew verdict + benchdiff gate "
+          "(stub; ring bound, straggler id on synthetic peers, "
+          "regression pass/fail, no backend)",
+          50 / dt, "chunks/sec", 1.0, "stub",
+          ring_len=len(d["ring"]), straggler=skew["straggler_proc"],
+          skew_ratio=skew["skew_ratio"],
+          benchdiff_identical_rc=rc_same, benchdiff_regression_rc=rc_reg)
+
+
 if STUB:
     CONFIGS = [("stub_a", _stub_ok("stub_a")),
                ("stub_wedge", _stub_wedge),
@@ -2083,6 +2208,7 @@ if STUB:
                ("fleet", _stub_fleet),
                ("durability", _stub_durability),
                ("globalfit", _stub_globalfit),
+               ("stepprof", _stub_stepprof),
                ("stub_b", _stub_ok("stub_b"))]
     _MIN_NEED = {n: 1 for n, _ in CONFIGS}
     _HARD_CAP = {n: 30 for n, _ in CONFIGS}

@@ -866,6 +866,19 @@ def _model_one(params, body, mid=None):
     return {"models": [model_to_v3(m)]}
 
 
+@route("GET", r"/3/Models/(?P<mid>[^/]+)/profile")
+def _model_profile(params, body, mid=None):
+    """Per-fit step profile (telemetry/stepprof.py): phase totals,
+    per-chunk ring, collective-wait share. ``?cluster=1`` merges every
+    host's profile of a pod-global fit into the skew/straggler verdict
+    (pod_step_skew_ratio / pod_straggler_host)."""
+    from h2o3_tpu.telemetry import stepprof
+    out = stepprof.profile_for(mid)       # KeyError -> 404
+    if _cluster_requested(params):
+        out["cluster"] = stepprof.cluster_profile(mid)
+    return out
+
+
 @route("DELETE", r"/3/Models/(?P<mid>[^/]+)")
 def _model_del(params, body, mid=None):
     DKV.remove(mid)

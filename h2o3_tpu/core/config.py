@@ -160,6 +160,14 @@ class Config:
     # partitioned ingest to the legacy fully-replicated layout. The
     # single-process path is bit-identical in every mode.
     global_fit: str = "auto"
+    # -- training-step profiler (telemetry/stepprof.py) ----------------
+    # per-chunk phase timing (host/compute/collective/checkpoint) woven
+    # through every fit: "auto"/"on" profile every fit (registry op +
+    # one device sync per chunk — <2% on bench chunks), "off" disables
+    # the weave entirely
+    stepprof: str = "auto"
+    # bounded per-fit ring of chunk records kept for /profile + capsule
+    stepprof_ring: int = 128
     # -- performance kernels (ops/pallas/) -----------------------------
     # fused Pallas tree kernels (histogram+split+partition per level):
     # "auto" = Pallas on TPU backends, XLA elsewhere; "off" = always the
@@ -179,7 +187,8 @@ class Config:
                              "fit_checkpoint_every", "hbm_budget_mb",
                              "parse_workers", "parse_chunk_mb",
                              "score_batch_max_rows",
-                             "score_batch_queue_depth"})
+                             "score_batch_queue_depth",
+                             "stepprof_ring"})
     _FLOAT_FIELDS = frozenset({"infra_backoff_base_s", "infra_backoff_max_s",
                                "probe_timeout_s", "rest_queue_wait_s",
                                "cloud_timeout_s", "heartbeat_interval_s",

@@ -311,16 +311,20 @@ class FitCheckpointer:
 
     def save(self, unit: int, state: Dict[str, Any]) -> None:
         from h2o3_tpu import telemetry
+        from h2o3_tpu.telemetry import stepprof
         t0 = time.time()
-        blob = pickle.dumps({"version": FIT_SNAPSHOT_VERSION,
-                             "algo": self.algo, "unit": int(unit),
-                             "state": state}, protocol=4)
-        tmp = self.path + ".tmp"
-        with open(tmp, "wb") as f:
-            f.write(blob)
-            f.flush()
-            os.fsync(f.fileno())
-        os.replace(tmp, self.path)
+        # an active fit profile charges the snapshot write to its
+        # "checkpoint" phase (IO time is neither compute nor host prep)
+        with stepprof.phase("checkpoint"):
+            blob = pickle.dumps({"version": FIT_SNAPSHOT_VERSION,
+                                 "algo": self.algo, "unit": int(unit),
+                                 "state": state}, protocol=4)
+            tmp = self.path + ".tmp"
+            with open(tmp, "wb") as f:
+                f.write(blob)
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, self.path)
         self._last_unit = int(unit)
         _thread_state.last = (self.path, int(unit), self.algo)
         hook = _post_save_var.get()

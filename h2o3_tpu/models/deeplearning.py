@@ -609,20 +609,24 @@ class DeepLearningEstimator(ModelBuilder):
                     stopper.history = list(_st["stop_hist"])
                     scoring_history = list(_st["scoring_history"])
         from h2o3_tpu import telemetry
+        from h2o3_tpu.telemetry import stepprof
         while done < total_steps:
             k = min(chunk, total_steps - done)
             _ct0 = time.time()
+            stepprof.chunk_begin()
             with telemetry.span("deeplearning.chunk", steps=k):
                 params_net, opt_state, key = _train_steps_fused(
                     params_net, opt_state, Xh, y_dev, w, key,
                     jnp.float32(done),
                     jnp.int32((done * batch) % max(n, 1)),
                     jnp.float32(k), **sched, **step_kwargs)
+                stepprof.compute_done((params_net, opt_state))
             telemetry.histogram("train_chunk_seconds",
                                 algo="deeplearning").observe(
                 time.time() - _ct0)
             telemetry.counter("train_iterations_total",
                               algo="deeplearning").inc(k)
+            stepprof.chunk_end(steps=k)
             done += k
             job.update(k / total_steps, f"step {done}/{total_steps}")
             if stopper.enabled and (done >= next_score

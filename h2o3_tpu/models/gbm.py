@@ -47,7 +47,7 @@ from h2o3_tpu.ops import pallas as pallas_ops
 from h2o3_tpu.parallel.mesh import (get_mesh, put_sharded,
                                     row_sharding)
 from h2o3_tpu import telemetry
-from h2o3_tpu.telemetry import observed_jit
+from h2o3_tpu.telemetry import observed_jit, stepprof
 from h2o3_tpu.utils.log import get_logger
 
 log = get_logger("h2o3_tpu.gbm")
@@ -977,6 +977,7 @@ class GBMEstimator(ModelBuilder):
             while done < ntrees:
                 kk = min(_chunk, ntrees - done)
                 _ct0 = time.time()
+                stepprof.chunk_begin()
                 with telemetry.span("gbm.chunk", trees=kk):
                     tr_k, margins, vm_, gains, devs = _boost_scan_multi(
                         bm.bins, bm.nbins, y_dev, w, margins, key,
@@ -984,10 +985,12 @@ class GBMEstimator(ModelBuilder):
                         sample_rate=float(p["sample_rate"]), n_class=K,
                         ntrees=kk, B=bm.nbins_total, use_val=use_val,
                         tree0=prior_T + done)
+                    stepprof.compute_done((margins, vm_, devs))
                 telemetry.histogram("train_chunk_seconds",
                                     algo="gbm").observe(time.time() - _ct0)
                 telemetry.counter("train_iterations_total",
                                   algo="gbm").inc(kk)
+                stepprof.chunk_end(trees=kk)
                 keep = (_stop_point(np.asarray(devs), done, kk,
                                     score_interval, stopper,
                                     scoring_history)
@@ -1108,17 +1111,20 @@ class GBMEstimator(ModelBuilder):
                 while done < ntrees:
                     k = min(_chunk, ntrees - done)
                     _ct0 = time.time()
+                    stepprof.chunk_begin()
                     with telemetry.span("gbm.chunk", trees=k):
                         tr_k, margin, gains = _boost_scan(
                             bm.bins, bm.nbins, y_dev, w, margin, key,
                             constraints, interaction_sets, tp=tp,
                             dist=dist, sample_rate=float(p["sample_rate"]),
                             ntrees=k, tree0=prior_T + done)
+                        stepprof.compute_done((margin, gains))
                     telemetry.histogram(
                         "train_chunk_seconds",
                         algo="gbm").observe(time.time() - _ct0)
                     telemetry.counter("train_iterations_total",
                                       algo="gbm").inc(k)
+                    stepprof.chunk_end(trees=k)
                     chunks.append(tr_k)
                     if not light:
                         gains_total += np.asarray(gains)
@@ -1167,6 +1173,7 @@ class GBMEstimator(ModelBuilder):
                 while done < ntrees:
                     k = min(_chunk, ntrees - done)
                     _ct0 = time.time()
+                    stepprof.chunk_begin()
                     with telemetry.span("gbm.chunk", trees=k):
                         tr_k, margin, vm_, gains, devs = \
                             _boost_scan_scored(
@@ -1177,11 +1184,13 @@ class GBMEstimator(ModelBuilder):
                                 sample_rate=float(p["sample_rate"]),
                                 ntrees=k, B=bm.nbins_total,
                                 use_val=use_val, tree0=prior_T + done)
+                        stepprof.compute_done((margin, vm_, devs))
                     telemetry.histogram(
                         "train_chunk_seconds",
                         algo="gbm").observe(time.time() - _ct0)
                     telemetry.counter("train_iterations_total",
                                       algo="gbm").inc(k)
+                    stepprof.chunk_end(trees=k)
                     keep = _stop_point(np.asarray(devs), done, k,
                                        score_interval, stopper,
                                        scoring_history)
@@ -1405,15 +1414,18 @@ def fit_gbm_batched(builder_cls, params_list: List[dict], frame: Frame,
         k = min(_chunk, ntrees - done)
         alive = M - sum(stopped)
         _ct0 = time.time()
+        stepprof.chunk_begin()
         with telemetry.span("gbm.chunk", trees=k, batch=M):
             tr_b, margins, gains_b, devs_b = _boost_scan_batched(
                 bm.bins, bm.nbins, y_dev, w, margins, keys, knobs_b,
                 constraints, interaction_sets, tp=tp0, dist=dist,
                 ntrees=k, tree0=done)
+            stepprof.compute_done((margins, devs_b))
         telemetry.histogram("train_chunk_seconds",
                             algo="gbm").observe(time.time() - _ct0)
         telemetry.counter("train_iterations_total",
                           algo="gbm").inc(k * alive)
+        stepprof.chunk_end(trees=k, batch=M)
         devs_h = np.asarray(devs_b) if stopper_on else None
         gains_h = np.asarray(gains_b)
         for m in range(M):

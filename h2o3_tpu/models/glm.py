@@ -1072,6 +1072,7 @@ class GLMEstimator(ModelBuilder):
         from h2o3_tpu import telemetry
         from h2o3_tpu.core import recovery as _recovery
         from h2o3_tpu.core.watchdog import maybe_fail
+        from h2o3_tpu.telemetry import stepprof
         if fuse_path:
             # whole regularization path in ONE compiled scan of IRLS
             # while_loops (pyunit_glm_seed: 30 lambdas x CV folds paid a
@@ -1080,6 +1081,7 @@ class GLMEstimator(ModelBuilder):
             l2s = jnp.asarray([lam * (1.0 - alpha) for lam in lambdas],
                               jnp.float32)
             _st0 = time.time()
+            stepprof.chunk_begin()
             with telemetry.span("glm.solve", solver=solver,
                                 lambdas=len(lambdas)):
                 best, coef_path = _irls_solve_path(
@@ -1089,8 +1091,10 @@ class GLMEstimator(ModelBuilder):
                     jnp.float32(fam.p), jnp.float32(fam.theta),
                     jnp.float32(self._objective_eps()),
                     use_l1=alpha > 0)
+                stepprof.compute_done((best, coef_path))
             telemetry.histogram("train_chunk_seconds",
                                 algo="glm").observe(time.time() - _st0)
+            stepprof.chunk_end(lambdas=len(lambdas))
             telemetry.counter("train_iterations_total", algo="glm").inc(
                 len(lambdas) * int(p["max_iterations"]))
             job.update(1.0, f"lambda path ({len(lambdas)})")
@@ -1119,6 +1123,7 @@ class GLMEstimator(ModelBuilder):
                 l1 = lam * alpha
                 l2 = lam * (1.0 - alpha)
                 _st0 = time.time()
+                stepprof.chunk_begin()
                 with telemetry.span("glm.solve", solver=solver,
                                     lam=float(lam)):
                     if solver in ("coordinate_descent",
@@ -1139,10 +1144,12 @@ class GLMEstimator(ModelBuilder):
                                                int(p["max_iterations"]),
                                                float(p["beta_epsilon"]),
                                                off=off_or0)
+                    stepprof.compute_done(coef)
                 telemetry.histogram("train_chunk_seconds",
                                     algo="glm").observe(time.time() - _st0)
                 telemetry.counter("train_iterations_total",
                                   algo="glm").inc(int(p["max_iterations"]))
+                stepprof.chunk_end(lam=float(lam))
                 job.update(1.0 / len(lambdas),
                            f"lambda {li + 1}/{len(lambdas)}")
                 best = coef
@@ -1394,12 +1401,14 @@ def fit_glm_batched(builder_cls, params_list: List[dict], frame: Frame,
     coef0 = jnp.zeros((X1.shape[1],), jnp.float32)
     coefs = np.zeros((M, X1.shape[1]), np.float32)
     from h2o3_tpu import telemetry
+    from h2o3_tpu.telemetry import stepprof
     for use_l1 in (False, True):
         # sequential parity: _fit_irlsm picks ADMM iff l1 > 0
         idx = np.where((l1_all > 0) == use_l1)[0]
         if idx.size == 0:
             continue
         _st0 = time.time()
+        stepprof.chunk_begin()
         with telemetry.span("glm.solve_batched", solver="irlsm",
                             width=int(idx.size)):
             out = _irls_solve_batched(
@@ -1409,10 +1418,12 @@ def fit_glm_batched(builder_cls, params_list: List[dict], frame: Frame,
                 jnp.int32(p0["max_iterations"]), fam.name, fam.link,
                 jnp.float32(fam.p), jnp.float32(fam.theta),
                 jnp.asarray(oe_all[idx]), use_l1=use_l1)
+            stepprof.compute_done(out)
         telemetry.histogram("train_chunk_seconds",
                             algo="glm").observe(time.time() - _st0)
         telemetry.counter("train_iterations_total", algo="glm").inc(
             int(idx.size) * int(p0["max_iterations"]))
+        stepprof.chunk_end(width=int(idx.size))
         coefs[idx] = np.asarray(out)
 
     # ---- per-model unstack into ordinary Model objects ---------------

@@ -473,26 +473,40 @@ class ModelBuilder:
                     "fold_assignment is incompatible with fold_column "
                     "(hex/ModelBuilder fold-spec validation)")
             from h2o3_tpu import telemetry
-            from h2o3_tpu.telemetry import roofline
+            from h2o3_tpu.telemetry import roofline, stepprof
             with telemetry.span(f"{self.algo}.fit", algo=self.algo,
                                 nfolds=nfolds), \
                     _recovery.fit_checkpoint_scope(_fit_ckpt_dir):
                 rf_probe = roofline.fit_probe(self.algo)
+                # step profiler: the chunk loops charge their phase
+                # windows against this profile; finish registers the
+                # per-fit ledger for /3/Models/{id}/profile, the
+                # capsule, and the perf-regression baseline
+                _sp = stepprof.start(self.algo,
+                                     nrows=training_frame.nrows)
                 t_fit = time.time()
-                if nfolds >= 2:
-                    from h2o3_tpu.ml.cv import train_with_cv
-                    model = train_with_cv(self, training_frame, x, y,
-                                          nfolds, j,
-                                          validation_frame=validation_frame)
-                else:
-                    model = self._fit(training_frame, x, y, j,
-                                      validation_frame=validation_frame)
+                try:
+                    if nfolds >= 2:
+                        from h2o3_tpu.ml.cv import train_with_cv
+                        model = train_with_cv(
+                            self, training_frame, x, y, nfolds, j,
+                            validation_frame=validation_frame)
+                    else:
+                        model = self._fit(
+                            training_frame, x, y, j,
+                            validation_frame=validation_frame)
+                except BaseException:
+                    stepprof.finish(_sp)   # never leave a live profile
+                    raise
                 # roofline accounting INSIDE the span: the MFU/HBM
                 # numbers annotate the fit span and therefore land in
                 # the job's flight-recorder capsule (never raises)
-                roofline.record_model_fit(self, model, training_frame, x,
-                                          seconds=time.time() - t_fit,
-                                          probe=rf_probe)
+                _rf = roofline.record_model_fit(
+                    self, model, training_frame, x,
+                    seconds=time.time() - t_fit, probe=rf_probe)
+                stepprof.finish(_sp, model_key=dest_key,
+                                seconds=time.time() - t_fit,
+                                mfu=(_rf or {}).get("mfu"))
             telemetry.histogram("model_fit_seconds",
                                 algo=self.algo).observe(time.time() - t0)
             if custom_metric_func is not None and y is not None:

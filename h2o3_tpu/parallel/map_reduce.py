@@ -99,8 +99,13 @@ def frame_reduce(map_fn: Callable[..., Any], *arrays, mesh=None) -> Any:
         return jax.tree_util.tree_map(
             lambda s: jax.lax.psum(s, DATA_AXIS), stats)
 
+    from h2o3_tpu.telemetry import stepprof
+    _t0 = stepprof.t_mark()
     with telemetry.span("mr.frame_reduce"):
         out = _task(*arrays)
+    # charge the reduce wait to an active fit profile's collective
+    # phase — this is where a fast host waits on a straggler's psum
+    stepprof.collective_done(out, _t0)
     _charge_reduce_payload(out, mesh)
     return out
 

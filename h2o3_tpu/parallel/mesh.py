@@ -249,17 +249,26 @@ def put_sharded(host_array, sharding):
     When each process holds ONLY its own rows, use ``put_partitioned``
     (the H2O3TPU_GLOBAL_FIT host-partitioned ingest path)."""
     import numpy as _np
-    if getattr(sharding, "is_fully_addressable", True):
-        return jax.device_put(host_array, sharding)
-    if isinstance(host_array, jax.Array):
-        # already a global device array: reshard (device-to-device),
-        # never pull through the host
-        if host_array.sharding == sharding:
-            return host_array
-        return jax.device_put(host_array, sharding)
-    host_array = _np.asarray(host_array)
-    return jax.make_array_from_callback(
-        host_array.shape, sharding, lambda idx: host_array[idx])
+    import time as _time
+    from h2o3_tpu.telemetry import stepprof as _sp
+    _t0 = _time.perf_counter()
+    try:
+        if getattr(sharding, "is_fully_addressable", True):
+            return jax.device_put(host_array, sharding)
+        if isinstance(host_array, jax.Array):
+            # already a global device array: reshard (device-to-device),
+            # never pull through the host
+            if host_array.sharding == sharding:
+                return host_array
+            return jax.device_put(host_array, sharding)
+        host_array = _np.asarray(host_array)
+        return jax.make_array_from_callback(
+            host_array.shape, sharding, lambda idx: host_array[idx])
+    finally:
+        # wall-clock annotation on an active fit profile (stepprof
+        # marks are NOT part of the phase partition — they say where
+        # host time went, they don't re-charge it)
+        _sp.mark("put_sharded_seconds", _time.perf_counter() - _t0)
 
 
 FETCH_CALLS = 0      # observability: device→host fetches (tests assert
@@ -273,13 +282,20 @@ def fetch_replicated(x):
     every host sees the full array (water/MRTask postGlobal view)."""
     global FETCH_CALLS
     FETCH_CALLS += 1
-    leaves = jax.tree_util.tree_leaves(x)
-    if all(getattr(getattr(v, "sharding", None), "is_fully_addressable",
-                   True) for v in leaves):
-        return jax.device_get(x)
-    from jax.experimental import multihost_utils
-    return jax.device_get(multihost_utils.process_allgather(
-        x, tiled=True))
+    import time as _time
+    from h2o3_tpu.telemetry import stepprof as _sp
+    _t0 = _time.perf_counter()
+    try:
+        leaves = jax.tree_util.tree_leaves(x)
+        if all(getattr(getattr(v, "sharding", None),
+                       "is_fully_addressable", True) for v in leaves):
+            return jax.device_get(x)
+        from jax.experimental import multihost_utils
+        return jax.device_get(multihost_utils.process_allgather(
+            x, tiled=True))
+    finally:
+        _sp.mark("fetch_replicated_seconds",
+                 _time.perf_counter() - _t0)
 
 
 def shard_rows(x, mesh: Optional[Mesh] = None, block: int = 1,

@@ -44,6 +44,8 @@ from h2o3_tpu.telemetry import trace_context
 from h2o3_tpu.telemetry import slo
 from h2o3_tpu.telemetry import cluster
 from h2o3_tpu.telemetry import roofline
+from h2o3_tpu.telemetry import stepprof
+from h2o3_tpu.telemetry import perfbase
 
 snapshot = REGISTRY.snapshot
 to_prometheus = REGISTRY.to_prometheus
@@ -60,5 +62,6 @@ __all__ = [
     "add_collective_bytes", "spans_snapshot", "spans_aggregate",
     "install", "observed_jit", "snapshot", "to_prometheus",
     "compiles_snapshot", "flight_recorder", "trace_export",
-    "trace_context", "slo", "cluster", "roofline",
+    "trace_context", "slo", "cluster", "roofline", "stepprof",
+    "perfbase",
 ]

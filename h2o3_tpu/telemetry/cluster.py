@@ -158,6 +158,7 @@ def local_snapshot() -> Dict:
         "sched": _sched_snapshot(),
         "alerts": _alerts_snapshot(),
         "serving": _serving_snapshot(),
+        "stepprof": _stepprof_snapshot(),
     }
 
 
@@ -174,6 +175,17 @@ def _jobs_snapshot() -> List[Dict]:
         return jobs[:MAX_JOBS]
     except Exception:   # noqa: BLE001 - snapshot is best-effort
         return []
+
+
+def _stepprof_snapshot() -> Dict:
+    """This node's training-step profiles (telemetry/stepprof.py):
+    recent per-fit phase ledgers + inflight marks — the coordinator's
+    input for pod skew/straggler verdicts (stepprof.cluster_profile)."""
+    try:
+        from h2o3_tpu.telemetry import stepprof
+        return stepprof.snapshot()
+    except Exception:   # noqa: BLE001 - snapshot is best-effort
+        return {}
 
 
 def _sched_snapshot() -> Dict:

@@ -55,6 +55,7 @@ MAX_SPANS = 2048
 MAX_EVENTS = 2048
 MAX_COMPILES = 512
 MAX_LOGS = 1024
+MAX_STEP_PROFILES = 64
 
 TELEMETRY_SUFFIX = "_telemetry"
 
@@ -84,8 +85,9 @@ class JobTelemetry:
     """One job's bounded telemetry capsule (DKV value)."""
 
     __slots__ = ("job_key", "description", "start_ms", "end_ms", "status",
-                 "spans", "events", "compiles", "logs", "metric_deltas",
-                 "dropped", "node", "slo_alerts", "_counters0", "_lock")
+                 "spans", "events", "compiles", "logs", "step_profiles",
+                 "metric_deltas", "dropped", "node", "slo_alerts",
+                 "_counters0", "_lock")
 
     def __init__(self, job_key: str, description: str):
         self.job_key = job_key
@@ -104,6 +106,7 @@ class JobTelemetry:
         self.events: List[Dict] = []
         self.compiles: List[Dict] = []
         self.logs: List[Dict] = []
+        self.step_profiles: List[Dict] = []
         self.metric_deltas: Dict[str, float] = {}
         self.dropped: Dict[str, int] = {}
         self.slo_alerts: List[Dict] = []
@@ -129,6 +132,10 @@ class JobTelemetry:
 
     def add_log(self, log_record: Dict) -> None:
         self._add(self.logs, MAX_LOGS, "logs", log_record)
+
+    def add_step_profile(self, profile: Dict) -> None:
+        self._add(self.step_profiles, MAX_STEP_PROFILES,
+                  "step_profiles", profile)
 
     # -- lifecycle -----------------------------------------------------
     def finalize(self, status: str) -> None:
@@ -162,6 +169,10 @@ class JobTelemetry:
                 "events": list(self.events),
                 "compiles": list(self.compiles),
                 "logs": list(self.logs),
+                # getattr: capsules restored from a pre-step-profile
+                # checkpoint (core/checkpoint.py) lack the slot
+                "step_profiles": list(getattr(self, "step_profiles",
+                                              None) or []),
                 "metric_deltas": dict(self.metric_deltas),
                 "dropped": dict(self.dropped),
                 "slo_alerts": list(self.slo_alerts),
@@ -274,6 +285,15 @@ def record_compile(compile_event: Dict) -> None:
 def record_log(log_record: Dict) -> None:
     for cap in _ACTIVE.get():
         cap.add_log(log_record)
+
+
+def record_step_profile(profile: Dict) -> None:
+    """Per-fit step-profile block (telemetry/stepprof.py finish): the
+    capsule answer to "where did THIS fit's wall clock go" — and, per
+    fit, the MFU/phase record that the latest-wins ``model_fit_mfu``
+    gauge cannot carry for concurrent same-algo fits."""
+    for cap in _ACTIVE.get():
+        cap.add_step_profile(profile)
 
 
 def is_recording() -> bool:

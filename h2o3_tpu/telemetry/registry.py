@@ -283,6 +283,14 @@ class MetricsRegistry:
         with self._lock:
             self._metrics.clear()
 
+    def drop(self, name: str) -> None:
+        """Remove every metric registered under ``name``, any label set
+        (tests only — lets a subsystem reset just its own families)."""
+        name = _full_name(name)
+        with self._lock:
+            for key in [k for k in self._metrics if k[0] == name]:
+                del self._metrics[key]
+
     # -- exposition ---------------------------------------------------
     def snapshot(self) -> Dict[str, list]:
         """JSON shape: {counters: [...], gauges: [...], histograms: [...]},

@@ -380,6 +380,15 @@ def record_model_fit(builder, model, frame, x, seconds: float,
         # and must survive into the capsule as nonzero
         spans_mod.annotate(mfu=mfu, hbm_util=hbm,
                            roofline=roofline_meta)
+        # per-fit record on the MODEL: model_fit_mfu{algo} is a
+        # latest-wins gauge, so concurrent fits of the same algo
+        # (scheduler-spread grids) overwrite each other there — the
+        # per-fit truth lives here and in the capsule, the gauge stays
+        # "most recent fit" by contract (README §Observability)
+        try:
+            model.output["roofline"] = dict(rec)
+        except Exception:   # noqa: BLE001 - accounting must never fail
+            pass
         return rec
     except Exception:   # noqa: BLE001 - accounting must never fail a fit
         return None

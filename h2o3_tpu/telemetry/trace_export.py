@@ -32,6 +32,7 @@ from typing import Dict, Iterable, List, Optional
 # reserved synthetic tracks (real span tracks count up from 1)
 COMPILE_TID = 9001
 TIMELINE_TID = 9000
+STEPPROF_TID = 9002
 
 
 def _span_tids(spans: List[Dict]):
@@ -88,6 +89,33 @@ def _compile_event(c: Dict, pid: int) -> Dict:
             "args": {"seconds": c.get("dur_s", 0.0)}}
 
 
+def _stepprof_events(profiles: Iterable[Dict], pid: int) -> List[Dict]:
+    """Per-fit phase breakdown (telemetry/stepprof.py finish records in
+    the capsule) → one stacked bar per fit on a dedicated track: the
+    phases are a PARTITION of the fit's wall clock, so laying them
+    end-to-end from the fit's start renders "where the time went" as
+    contiguous phase-annotated segments."""
+    out: List[Dict] = []
+    for f in profiles:
+        t = float(f.get("ts", 0.0)) * 1e6          # epoch seconds → us
+        label = f.get("model_key") or f.get("algo", "fit")
+        for ph, secs in (f.get("phases") or {}).items():
+            dur_us = int(round(float(secs) * 1e6))
+            if dur_us <= 0:
+                continue
+            out.append({"name": f"{label}:{ph}", "cat": "stepprof",
+                        "ph": "X", "ts": int(t), "dur": max(dur_us, 1),
+                        "pid": pid, "tid": STEPPROF_TID,
+                        "args": {"phase": ph, "algo": f.get("algo"),
+                                 "seconds": round(float(secs), 6),
+                                 "chunks": f.get("chunks", 0),
+                                 "collective_share":
+                                     f.get("collective_share", 0.0),
+                                 "mfu": f.get("mfu")}})
+            t += dur_us
+    return out
+
+
 def _meta_event(pid: int, tid: Optional[int], name: str, label: str) -> Dict:
     return {"name": name, "cat": "__metadata", "ph": "M", "ts": 0,
             "pid": pid, "tid": tid if tid is not None else 0,
@@ -96,7 +124,8 @@ def _meta_event(pid: int, tid: Optional[int], name: str, label: str) -> Dict:
 
 def _events_for(spans: Iterable[Dict], events: Iterable[Dict],
                 compiles: Iterable[Dict], pid: int,
-                process_name: str) -> List[Dict]:
+                process_name: str,
+                step_profiles: Iterable[Dict] = ()) -> List[Dict]:
     """All trace events of ONE process/track-group (shared by the
     single-process build_trace and the multi-node cluster_trace)."""
     spans = list(spans)
@@ -113,15 +142,22 @@ def _events_for(spans: Iterable[Dict], events: Iterable[Dict],
         out.append(_instant_event(e, pid, tid))
     for c in compiles:
         out.append(_compile_event(c, pid))
+    sp = _stepprof_events(step_profiles, pid)
+    if sp:
+        out.append(_meta_event(pid, STEPPROF_TID, "thread_name",
+                               "step-profile"))
+        out.extend(sp)
     return out
 
 
 def build_trace(spans: Iterable[Dict], events: Iterable[Dict] = (),
                 compiles: Iterable[Dict] = (),
                 process_name: str = "h2o3-tpu",
-                extra: Optional[Dict] = None) -> Dict:
+                extra: Optional[Dict] = None,
+                step_profiles: Iterable[Dict] = ()) -> Dict:
     """Assemble Chrome trace JSON from already-snapshotted telemetry."""
-    out = _events_for(spans, events, compiles, os.getpid(), process_name)
+    out = _events_for(spans, events, compiles, os.getpid(), process_name,
+                      step_profiles=step_profiles)
     trace = {"traceEvents": out, "displayTimeUnit": "ms",
              "otherData": {"source": "h2o3_tpu.telemetry.trace_export"}}
     if extra:
@@ -224,7 +260,8 @@ def capsule_trace(capsule) -> Dict:
         process_name=f"h2o3-tpu job {d['job_key']}",
         extra={"job_key": d["job_key"], "description": d["description"],
                "status": d["status"], "metric_deltas": d["metric_deltas"],
-               "dropped": d["dropped"]})
+               "dropped": d["dropped"]},
+        step_profiles=d.get("step_profiles") or ())
 
 
 def process_trace(last_spans: int = 2048, last_events: int = 2048,

@@ -16,6 +16,8 @@
 #                                 # (cloud formation, durability, fleet,
 #                                 # tracing, global fit)
 #   scripts/tier1.sh full         # the ROADMAP.md one-shot (needs >870s)
+#   scripts/tier1.sh perfguard    # benchdiff gate vs committed BENCH
+#                                 # snapshot (jax-free, <10s)
 #
 # Every mode mirrors the ROADMAP.md tier-1 flags exactly; each capped
 # mode runs under `timeout -k 10 870`.
@@ -52,8 +54,16 @@ case "$MODE" in
         timeout -k 10 870 "${PYTEST[@]}" -m 'multiprocess and not slow' \
             tests/
         ;;
+    perfguard)
+        # perf-regression gate (ISSUE 20): diff the committed BENCH
+        # snapshot against itself through scripts/benchdiff.py — proves
+        # the gate's parse/compare path end-to-end, jax-free, <10s.
+        # An identical pair MUST pass; a broken parser fails loudly.
+        timeout -k 10 60 env JAX_PLATFORMS='' python \
+            scripts/benchdiff.py BENCH_r05.json BENCH_r05.json
+        ;;
     *)
-        echo "usage: $0 {part1|part2|full|multiprocess}" >&2
+        echo "usage: $0 {part1|part2|full|multiprocess|perfguard}" >&2
         exit 2
         ;;
 esac

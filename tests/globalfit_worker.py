@@ -16,7 +16,12 @@ Modes (``sys.argv[5]``):
   error within one heartbeat window of the loss being observed — no
   hang, no leaked RUNNING job.
 * ``bench`` — times the global GBM fit on the partitioned frame and
-  reports rows/sec (pid 0), for bench.py's ``globalfit`` config.
+  reports rows/sec (pid 0), for bench.py's ``globalfit`` config; every
+  pid also drops its ``{outfile}.phases.{pid}`` step-profiler split.
+* ``profile`` — ISSUE 20: 2-process fit with ONE artificially-delayed
+  host (``H2O3TPU_STEPPROF_DELAY_PID``/``_S``); pid 0 queries
+  ``GET /3/Models/{id}/profile?cluster=1`` and reports the
+  straggler/skew verdict.
 
 Workers that outlive a dead peer exit via ``os._exit`` — the normal
 distributed teardown would barrier against the corpse.
@@ -170,11 +175,85 @@ def run_bench():
     t0 = time.time()
     GBMEstimator(ntrees=ntrees, max_depth=4, seed=3).train(fr, y="y")
     dt = max(time.time() - t0, 1e-9)
+    # EVERY pid reports its own phase split (telemetry/stepprof.py):
+    # bench.py folds these into the per-host compute/collective/host
+    # table printed next to the rows/sec line
+    try:
+        from h2o3_tpu.telemetry import stepprof
+        ph = stepprof.last_fit_phases("gbm")
+        ph["proc"] = int(pid)
+        with open(f"{outfile}.phases.{pid}", "w") as f:
+            json.dump(ph, f)
+    except Exception as e:   # noqa: BLE001 - table is best-effort
+        print(f"WORKER-{pid}-PHASES-FAILED {e}", flush=True)
     if int(pid) == 0:
         with open(outfile, "w") as f:
             json.dump({"mode": mode, "rows_per_sec": N_ROWS * ntrees / dt,
                        "seconds": dt, "ntrees": ntrees,
                        "nrows": N_ROWS}, f)
+    print(f"WORKER-{pid}-DONE", flush=True)
+    h2o3_tpu.shutdown()
+
+
+def run_profile():
+    """ISSUE 20 acceptance leg: a 2-process global GBM fit with ONE
+    artificially-delayed host; ``GET /3/Models/{id}/profile?cluster=1``
+    on pid 0 must name the slow host as the straggler and show the fast
+    host's collective-wait share rising (it waits at the per-chunk
+    barrier probe while the slow host sleeps)."""
+    import urllib.request
+    from h2o3_tpu.telemetry import cluster, stepprof
+
+    delay_pid = int(os.environ.get("H2O3TPU_STEPPROF_DELAY_PID", "1"))
+    delay_s = os.environ.get("H2O3TPU_STEPPROF_DELAY_S", "0.25")
+    fr = make_frame()
+    # warmup fit with the SAME ntrees: chunk programs compile per chunk
+    # size, so an equal-shape warmup makes the profiled fit's compute
+    # phase pure chunk work, not XLA compile (identical on every host —
+    # it would bury the skew the delay is meant to produce)
+    params = dict(GBM_PARAMS, ntrees=30)
+    GBMEstimator(**params).train(fr, y="y")
+    if int(pid) == delay_pid:
+        # per-host injection: the pod-wide env would slow EVERY host
+        os.environ["H2O3TPU_STEPPROF_DELAY"] = delay_s
+        mark(f"injecting {delay_s}s/chunk delay on pid {pid}")
+    mark("warm; training profiled global fit")
+    gbm = GBMEstimator(**params).train(fr, y="y")
+    os.environ.pop("H2O3TPU_STEPPROF_DELAY", None)
+    local = stepprof.profile_for(gbm.key)
+    ok = cluster.publish(force=True)
+    mark(f"profile published ok={ok}; syncing")
+    from jax.experimental import multihost_utils
+    multihost_utils.sync_global_devices("stepprof-profile-published")
+
+    if int(pid) == 0:
+        # the peer's snapshot already sits in the coordination KV (the
+        # publish above), so only pid 0 needs to stay up for the fetch
+        from h2o3_tpu.api.server import start_server
+        port = int(os.environ.get("H2O3TPU_PROFILE_PORT", "54661"))
+        start_server(port=port, background=True)
+        url = (f"http://127.0.0.1:{port}/3/Models/{gbm.key}"
+               f"/profile?cluster=1")
+        prof = json.loads(urllib.request.urlopen(url, timeout=30).read())
+        from h2o3_tpu.telemetry.registry import REGISTRY
+        gauges = {g.name: g.value
+                  for g in REGISTRY.find("pod_step_skew_ratio")
+                  + REGISTRY.find("pod_straggler_host")}
+        result = {
+            "mode": mode,
+            "delay_pid": delay_pid,
+            "model_key": gbm.key,
+            "local_phases": local["phases"],
+            "chunks": local["chunks"],
+            "cluster": prof.get("cluster"),
+            "gauges": gauges,
+        }
+        with open(outfile, "w") as f:
+            json.dump(result, f)
+    # second barrier BEFORE teardown: shutdown() sweeps this node's KV
+    # snapshot first thing, so pid 1 racing into it would delete the
+    # very entry pid 0's cluster fetch above still needs to read
+    multihost_utils.sync_global_devices("stepprof-profile-fetched")
     print(f"WORKER-{pid}-DONE", flush=True)
     h2o3_tpu.shutdown()
 
@@ -241,5 +320,7 @@ elif mode == "bench":
     run_bench()
 elif mode == "sigkill":
     run_sigkill()
+elif mode == "profile":
+    run_profile()
 else:
     raise SystemExit(f"unknown mode {mode!r}")

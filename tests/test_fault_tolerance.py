@@ -281,6 +281,15 @@ def test_bench_wedged_config_costs_one_line(tmp_path):
     rf = next(v[0] for k, v in by_metric.items()
               if k.startswith("roofline"))
     assert rf["mfu"] > 0 and rf["hbm_util"] > 0
+    # the stepprof stub (ISSUE 20) proves the profiler's contracts
+    # without a backend: bounded ring, straggler identity on synthetic
+    # peers, and the benchdiff regression gate's pass/fail split
+    sp = next(v[0] for k, v in by_metric.items()
+              if k.startswith("stepprof"))
+    assert sp["ring_len"] == 8 and sp["straggler"] == 1
+    assert sp["skew_ratio"] > 1.5
+    assert sp["benchdiff_identical_rc"] == 0
+    assert sp["benchdiff_regression_rc"] == 1
     budget = by_metric["budget"][0]
     assert budget["left_s"] >= 0.0
     assert budget["budget_s"] >= 0.0
@@ -317,8 +326,8 @@ def test_bench_dead_backend_fails_fast_per_config(tmp_path):
     errors = [ln for ln in lines if "error" in ln]
     # one per stub config (incl. grid, treekernel, cloud, roofline,
     # checkpoint, memgov, ingest, serving, sched, slo, fleet,
-    # durability)
-    assert len(errors) == 16
+    # durability, globalfit, stepprof)
+    assert len(errors) == 17
     assert all("backend dead" in ln["error"] for ln in errors)
     budget = [ln for ln in lines if ln["metric"] == "budget"][0]
     assert budget["left_s"] >= 0.0

@@ -1,0 +1,361 @@
+"""Training-step profiler acceptance (ISSUE 20): per-chunk phase
+timing as a wall-clock partition, bounded rings, straggler/skew
+verdicts on per-host snapshots, the perf-baseline regression guard,
+and scripts/benchdiff.py's offline gate.
+
+The multiprocess leg spawns a REAL 2-process gloo pod
+(tests/globalfit_worker.py ``profile`` mode) with ONE artificially
+delayed host and asserts ``GET /3/Models/{id}/profile?cluster=1``
+names that host as the straggler.
+"""
+
+import importlib.util
+import json
+import os
+import socket
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+WORKER = os.path.join(REPO, "tests", "globalfit_worker.py")
+BENCHDIFF = os.path.join(REPO, "scripts", "benchdiff.py")
+
+from h2o3_tpu.telemetry import stepprof  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _fresh_registry():
+    stepprof.reset()
+    yield
+    stepprof.reset()
+
+
+def _load_benchdiff():
+    spec = importlib.util.spec_from_file_location("benchdiff", BENCHDIFF)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+# ------------------------------------------------------------ unit tier
+
+
+def test_ring_is_bounded_and_chunks_counted(monkeypatch):
+    monkeypatch.setenv("H2O3TPU_STEPPROF_RING", "16")
+    prof = stepprof.start("gbm", nrows=4000)
+    assert prof is not None
+    for _ in range(100):
+        stepprof.chunk_begin()
+        stepprof.compute_done(None)
+        stepprof.chunk_end(trees=5)
+    d = stepprof.finish(prof, model_key="m_ring", seconds=None)
+    assert len(d["ring"]) == 16          # bounded
+    assert d["chunks"] == 100            # but every chunk counted
+    assert stepprof.profile_for("m_ring")["chunks"] == 100
+
+
+def test_phase_partition_never_exceeds_wall_clock():
+    prof = stepprof.start("gbm", nrows=100)
+    for _ in range(3):
+        stepprof.chunk_begin()
+        time.sleep(0.01)                 # inside the compute window
+        stepprof.compute_done(None)
+        stepprof.chunk_end()
+    time.sleep(0.02)                     # trailing host gap
+    d = stepprof.finish(prof, model_key="m_part")
+    assert sum(d["phases"].values()) <= d["seconds"] + 1e-3
+    assert d["phases"]["compute"] >= 0.02        # 3 x 10ms windows
+    assert d["phases"]["host"] >= 0.015          # the trailing gap
+
+
+def test_delay_knob_charges_host_on_the_slow_chunk(monkeypatch):
+    """The fault-injected slow chunk: H2O3TPU_STEPPROF_DELAY sleeps in
+    chunk_end and the time lands in that chunk's host phase — the
+    straggler signature the pod leg detects cross-host."""
+    prof = stepprof.start("gbm", nrows=100)
+    stepprof.chunk_begin()
+    stepprof.compute_done(None)
+    stepprof.chunk_end()
+    monkeypatch.setenv("H2O3TPU_STEPPROF_DELAY", "0.08")
+    stepprof.chunk_begin()
+    stepprof.compute_done(None)
+    stepprof.chunk_end()
+    monkeypatch.delenv("H2O3TPU_STEPPROF_DELAY")
+    d = stepprof.finish(prof, model_key="m_delay")
+    fast, slow = d["ring"]
+    assert slow["phases"]["host"] >= 0.075
+    assert slow["phases"]["host"] > fast["phases"]["host"] + 0.05
+
+
+def test_phase_cm_and_marks():
+    prof = stepprof.start("glm", nrows=10)
+    with stepprof.phase("checkpoint"):
+        time.sleep(0.02)
+    stepprof.mark("put_sharded_seconds", 0.5)
+    d = stepprof.finish(prof, model_key="m_cm")
+    assert d["phases"]["checkpoint"] >= 0.015
+    assert d["marks"]["put_sharded_seconds"] == 0.5
+    # marks are annotations, NOT partition members
+    assert sum(d["phases"].values()) <= d["seconds"] + 1e-3
+
+
+def test_profile_registry_lookup_and_miss():
+    prof = stepprof.start("gbm")
+    stepprof.finish(prof, model_key="m_hit")
+    assert stepprof.profile_for("m_hit")["algo"] == "gbm"
+    with pytest.raises(KeyError):
+        stepprof.profile_for("m_nope")
+    assert stepprof.last_fit_phases("gbm")["chunks"] == 0
+    assert stepprof.last_fit_phases("deeplearning") == {}
+
+
+def test_disabled_knob_makes_weave_free(monkeypatch):
+    monkeypatch.setenv("H2O3TPU_STEPPROF", "off")
+    assert stepprof.start("gbm") is None
+    # the woven calls must all be no-ops without an active profile
+    stepprof.chunk_begin()
+    assert stepprof.compute_done("x") == "x"
+    stepprof.chunk_end()
+    assert stepprof.finish(None) is None
+
+
+def test_snapshot_bounds_published_payload():
+    for i in range(20):
+        prof = stepprof.start("gbm")
+        for _ in range(40):
+            stepprof.chunk_begin()
+            stepprof.chunk_end()
+        stepprof.finish(prof, model_key=f"m_{i}")
+    snap = stepprof.snapshot()
+    assert len(snap["fits"]) == stepprof.SNAPSHOT_FITS
+    assert all(len(f["ring"]) <= stepprof.SNAPSHOT_RING
+               for f in snap["fits"])
+    assert snap["fits"][0]["model_key"] == "m_19"      # newest first
+
+
+# ------------------------------------------------------- skew verdicts
+
+
+def _host(proc, host, compute, collective, checkpoint=0.0):
+    return {"proc": proc,
+            "seconds": host + compute + collective + checkpoint,
+            "phases": {"host": host, "compute": compute,
+                       "collective": collective,
+                       "checkpoint": checkpoint}}
+
+
+def test_compute_skew_names_the_straggler():
+    """Synthetic 2-peer snapshots: the slow host accrues SELF time, the
+    fast host accrues collective wait at the barrier probe."""
+    skew = stepprof.compute_skew({
+        "0": _host(0, host=0.5, compute=2.0, collective=7.5),
+        "1": _host(1, host=4.0, compute=5.5, collective=0.5)})
+    assert skew["straggler"] == "1"
+    assert skew["straggler_proc"] == 1
+    assert skew["skew_ratio"] == pytest.approx(9.5 / 2.5, rel=1e-3)
+    assert skew["hosts"]["0"]["collective_share"] > 0.7
+    assert skew["hosts"]["1"]["collective_share"] < 0.1
+
+
+def test_compute_skew_balanced_and_empty():
+    skew = stepprof.compute_skew({
+        "0": _host(0, host=1.0, compute=4.0, collective=1.0),
+        "1": _host(1, host=1.0, compute=4.0, collective=1.0)})
+    assert skew["skew_ratio"] == pytest.approx(1.0)
+    empty = stepprof.compute_skew({})
+    assert empty["straggler"] is None and empty["skew_ratio"] == 0.0
+
+
+def test_cluster_profile_single_process_sets_gauges():
+    """On a 1-process cloud cluster_profile degrades to the local view:
+    one host, skew 1.0, gauges published."""
+    prof = stepprof.start("gbm", nrows=100)
+    stepprof.chunk_begin()
+    stepprof.compute_done(None)
+    stepprof.chunk_end()
+    stepprof.finish(prof, model_key="m_solo")
+    from h2o3_tpu.telemetry import cluster
+    cluster.publish(force=True)
+    out = stepprof.cluster_profile("m_solo")
+    assert out["model_key"] == "m_solo"
+    assert len(out["hosts"]) == 1
+    assert out["straggler_proc"] == 0
+    from h2o3_tpu.telemetry.registry import REGISTRY
+    assert [g.value for g in REGISTRY.find("pod_straggler_host")] == [0.0]
+
+
+# --------------------------------------------------- perfbase baselines
+
+
+def test_perfbase_ratio_and_slo_rule(tmp_path, monkeypatch):
+    monkeypatch.setenv("H2O3TPU_PERF_BASELINE_DIR", str(tmp_path))
+    from h2o3_tpu.telemetry import perfbase
+    prof = {"seconds": 2.0, "chunks": 4,
+            "phases": {"host": 0.5, "compute": 1.5}}
+    assert perfbase.record_fit("gbm", 5000, prof, mfu=0.01) == 1.0
+    # 2x step-time regression vs the stored best
+    prof2 = {"seconds": 4.0, "chunks": 4,
+             "phases": {"host": 1.0, "compute": 3.0}}
+    assert perfbase.record_fit("gbm", 5000, prof2) == 2.0
+    doc = perfbase.load(perfbase.baseline_key("gbm", 5000))
+    assert doc["best_step_seconds"] == 0.5       # best is sticky
+    assert len(doc["history"]) == 2
+    # the default SLO rule fires on the gauge the record just set
+    from h2o3_tpu.telemetry import slo
+    from h2o3_tpu.telemetry.registry import REGISTRY
+    rule = {r.name: r for r in slo.default_rules()}["fit_step_regression"]
+    ok, detail = rule.check_fn(REGISTRY)
+    assert not ok and detail["worst_algo"] == "gbm"
+    assert detail["max_ratio"] == 2.0
+
+
+def test_perfbase_shape_buckets_isolate_baselines(tmp_path, monkeypatch):
+    monkeypatch.setenv("H2O3TPU_PERF_BASELINE_DIR", str(tmp_path))
+    from h2o3_tpu.telemetry import perfbase
+    assert perfbase.shape_bucket(4001) == "r4096"
+    assert perfbase.shape_bucket(4096) == "r4096"
+    assert perfbase.shape_bucket(4097) == "r8192"
+    slow = {"seconds": 10.0, "chunks": 1, "phases": {}}
+    fast = {"seconds": 0.1, "chunks": 1, "phases": {}}
+    perfbase.record_fit("gbm", 100, slow)
+    # a different shape bucket never compares against the 100-row best
+    assert perfbase.record_fit("gbm", 1_000_000, fast) == 1.0
+
+
+def test_perfbase_ignores_chunkless_fits(tmp_path, monkeypatch):
+    monkeypatch.setenv("H2O3TPU_PERF_BASELINE_DIR", str(tmp_path))
+    from h2o3_tpu.telemetry import perfbase
+    assert perfbase.record_fit("gbm", 10, {"seconds": 1.0,
+                                           "chunks": 0}) is None
+    assert os.listdir(str(tmp_path)) == []
+
+
+# ----------------------------------------------------------- benchdiff
+
+
+def test_benchdiff_flags_30pct_regression_and_passes_identical(tmp_path):
+    bd = _load_benchdiff()
+    old = tmp_path / "old.json"
+    new = tmp_path / "new.json"
+    old.write_text(json.dumps([
+        {"metric": "fit_step", "value": 1.0, "unit": "seconds",
+         "phases": {"host": 0.2, "compute": 0.8}},
+        {"metric": "gbm_rate", "value": 1000.0, "unit": "rows/sec"}]))
+    new.write_text(json.dumps([
+        {"metric": "fit_step", "value": 1.3, "unit": "seconds",
+         "phases": {"host": 0.2, "compute": 1.1}},
+        {"metric": "gbm_rate", "value": 990.0, "unit": "rows/sec"}]))
+    assert bd.main([str(old), str(old)]) == 0       # identical passes
+    assert bd.main([str(old), str(new)]) == 1       # +30% seconds fails
+    res = bd.compare(bd.load_metrics(str(old)), bd.load_metrics(str(new)))
+    assert res["regressions"] == ["fit_step"]
+    fail = next(r for r in res["rows"] if r["regressed"])
+    assert fail["phase_deltas"]["compute"] == pytest.approx(0.3)
+
+
+def test_benchdiff_direction_heuristic(tmp_path):
+    """rows/sec dropping 30% is a regression; seconds dropping 30% is
+    an improvement — unit direction decides the sign."""
+    bd = _load_benchdiff()
+    old = tmp_path / "o.json"
+    new = tmp_path / "n.json"
+    old.write_text(json.dumps([
+        {"metric": "rate", "value": 1000.0, "unit": "rows/sec"},
+        {"metric": "lat", "value": 1.0, "unit": "seconds"}]))
+    new.write_text(json.dumps([
+        {"metric": "rate", "value": 700.0, "unit": "rows/sec"},
+        {"metric": "lat", "value": 0.7, "unit": "seconds"}]))
+    res = bd.compare(bd.load_metrics(str(old)), bd.load_metrics(str(new)))
+    by = {r["metric"]: r["regressed"] for r in res["rows"]}
+    assert by == {"rate": True, "lat": False}
+
+
+def test_benchdiff_parses_bench_artifact_tails(tmp_path):
+    """The committed BENCH_*.json format: config entries whose `tail`
+    embeds JSON metric lines; parsing stops at the summary marker and
+    an all-error artifact diffs as a vacuous pass."""
+    bd = _load_benchdiff()
+    art = tmp_path / "BENCH_x.json"
+    art.write_text(json.dumps(
+        {"n": 5, "cmd": "bench", "rc": 0, "tail":
+         'noise\n{"metric": "gbm cfg", "value": 5.0, "unit": "rows/sec"}'
+         '\n# ---- summary\n{"metric": "gbm cfg", "value": 9.9, '
+         '"unit": "rows/sec"}'}))
+    m = bd.load_metrics(str(art))
+    assert m == [{"metric": "gbm cfg", "value": 5.0,
+                  "unit": "rows/sec"}]    # first wins, summary ignored
+    assert bd.main([str(art), str(art)]) == 0
+    # the committed r05 artifact (all-error round) stays a clean pass
+    r05 = os.path.join(REPO, "BENCH_r05.json")
+    assert bd.main([r05, r05]) == 0
+    assert bd.main(["/nonexistent.json", str(art)]) == 2
+
+
+# --------------------------------------------- the real 2-process leg
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+@pytest.mark.multiprocess
+def test_pod_profile_names_the_delayed_straggler(tmp_path):
+    """Acceptance: 2-process GBM global fit, pid 1 artificially delayed
+    per chunk. /3/Models/{id}/profile?cluster=1 on pid 0 must name pid
+    1 as the straggler, with pid 0's collective-wait share above the
+    straggler's (the fast host waits at the barrier probe), and the
+    pod_straggler_host gauge must carry the same verdict."""
+    out = str(tmp_path / "profile.json")
+    coord = f"127.0.0.1:{_free_port()}"
+    env = dict(os.environ)
+    env.pop("JAX_PLATFORMS", None)
+    env.update({"H2O3TPU_STEPPROF_DELAY_PID": "1",
+                "H2O3TPU_STEPPROF_DELAY_S": "0.5",
+                "H2O3TPU_PROFILE_PORT": str(_free_port())})
+    procs = [subprocess.Popen(
+        [sys.executable, WORKER, coord, "2", str(i), out, "profile"],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+        text=True) for i in range(2)]
+    logs = []
+    deadline = time.time() + 240
+    for p in procs:
+        try:
+            stdout, _ = p.communicate(timeout=max(deadline - time.time(),
+                                                  1.0))
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                if q.poll() is None:
+                    q.kill()
+            stdout, _ = p.communicate()
+            stdout = (stdout or "") + "\n[TIMEOUT]"
+        logs.append(stdout)
+    joined = "\n".join(f"--- worker {j} ---\n{lg[-3000:]}"
+                       for j, lg in enumerate(logs))
+    assert all(p.returncode == 0 for p in procs), joined
+    with open(out) as f:
+        res = json.load(f)
+    cl = res["cluster"]
+    assert cl is not None, joined
+    assert len(cl["hosts"]) == 2, cl
+    assert cl["straggler_proc"] == 1, cl
+    # skew = max/min self-time: the injected 0.5s/chunk delay must make
+    # pid 1's self-time measurably larger.  The bound is modest because
+    # the timeshared 1-core container runs both hosts' real compute
+    # back-to-back, diluting the ratio.
+    assert cl["skew_ratio"] > 1.1, cl
+    # the fast host's collective-wait share rises above the straggler's
+    hosts = {h["proc"]: h for h in cl["hosts"].values()}
+    assert hosts[0]["collective_share"] > hosts[1]["collective_share"], cl
+    # gauge names carry the registry's export prefix (h2o3tpu_...)
+    gauges = {k.rsplit("pod_", 1)[-1]: v for k, v in res["gauges"].items()}
+    assert gauges["straggler_host"] == 1.0, res
+    assert gauges["step_skew_ratio"] > 1.1, res
+    assert res["chunks"] >= 2, res

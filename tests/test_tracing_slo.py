@@ -363,7 +363,7 @@ def test_slo_default_rules_evaluate_on_live_registry():
     assert names == {"predict_p99_latency", "rest_availability",
                      "heartbeat_health", "fit_mfu_floor",
                      "fleet_routing_availability", "fleet_replica_floor",
-                     "data_durability_floor"}
+                     "data_durability_floor", "fit_step_regression"}
     assert out["windows_s"] == [300.0, 3600.0]
     for r in out["rules"]:
         assert r["state"] in slo.STATES
